@@ -1,0 +1,78 @@
+"""Benchmark entry point. Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Runs on whatever jax.devices() provides (one real TPU chip under the
+driver). Benchmarks the flagship training step's throughput.
+
+Reference baseline (BASELINE.md): BytePS's headline is scaling efficiency,
+not single-chip speed; on one chip the honest comparable is raw training
+throughput, so vs_baseline is reported against the ideal all-compute
+step time measured for the same model without any communication wrapper
+(ratio ≥ 1.0 means the framework adds no overhead vs plain JAX).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+import optax
+
+
+def main() -> None:
+    import byteps_tpu as bps
+    from byteps_tpu.training import DistributedTrainer
+    from byteps_tpu.models.mlp import mlp_init, mlp_loss
+
+    bps.init()
+
+    batch, dim, depth = 256, 2048, 8
+    params = mlp_init(jax.random.PRNGKey(0), dim, depth)
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, dim).astype(np.float32)
+    y = rng.randn(batch, dim).astype(np.float32)
+
+    trainer = DistributedTrainer(mlp_loss, params, optax.adamw(1e-3))
+
+    # warmup/compile
+    trainer.step((x, y))
+    jax.block_until_ready(trainer.params)
+
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = trainer.step((x, y))
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    framework_sps = batch * iters / dt
+
+    # ideal plain-JAX step (no framework) for vs_baseline
+    tx = optax.adamw(1e-3)
+    state = tx.init(params)
+
+    @jax.jit
+    def plain_step(p, s, bx, by):
+        g = jax.grad(mlp_loss)(p, (bx, by))
+        u, s = tx.update(g, s, p)
+        return optax.apply_updates(p, u), s
+
+    p2, s2 = plain_step(params, state, x, y)
+    jax.block_until_ready(p2)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        p2, s2 = plain_step(p2, s2, x, y)
+    jax.block_until_ready(p2)
+    plain_sps = batch * iters / (time.perf_counter() - t0)
+
+    print(json.dumps({
+        "metric": "mlp2048x8_train_throughput",
+        "value": round(framework_sps, 2),
+        "unit": "samples/sec",
+        "vs_baseline": round(framework_sps / plain_sps, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,136 @@
+"""byteps_tpu — a TPU-native distributed training framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of BytePS (reference:
+/root/reference — a PS-architecture data-parallel trainer for
+GPU clusters). The public surface keeps the reference's Horovod-style
+function names (reference: byteps/common/__init__.py:59-139,
+byteps/torch/__init__.py) so users can map one API onto the other:
+
+    import byteps_tpu as bps
+    bps.init()
+    grads = bps.push_pull(grads)            # bucketed, priority-scheduled
+    params = bps.broadcast_parameters(params)
+    tx = bps.DistributedOptimizer(optax.adam(1e-3))
+
+but the machinery underneath is mesh + shard_map + XLA collectives, not a
+queue pipeline — see byteps_tpu/parallel/collectives.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from .common.config import Config
+from .common.global_state import GlobalState
+from .common import naming
+from .version import __version__
+
+_suspended_decls = None
+
+
+# -- lifecycle (reference: operations.cc:34-129) ----------------------------
+
+def init(config: Optional[Config] = None, mesh=None) -> None:
+    """Initialise the runtime (reference: byteps_init, operations.cc:36-88)."""
+    GlobalState.init(config, mesh=mesh)
+
+
+def shutdown() -> None:
+    GlobalState.shutdown()
+
+
+def suspend() -> None:
+    """Tear down, remembering tensor declarations (reference: byteps_suspend)."""
+    global _suspended_decls
+    _suspended_decls = GlobalState.suspend()
+
+
+def resume(num_worker: Optional[int] = None, config: Optional[Config] = None,
+           mesh=None) -> None:
+    """Re-init after membership change, replaying declarations so name→key
+    stays stable (reference: byteps_resume, operations.cc:96-112)."""
+    global _suspended_decls
+    if config is None:
+        overrides = {}
+        if num_worker is not None:
+            overrides["num_worker"] = num_worker
+        config = Config.from_env(**overrides)
+    GlobalState.resume(_suspended_decls, config, mesh=mesh)
+    _suspended_decls = None
+
+
+# -- topology queries (reference: operations.cc:121-129) --------------------
+
+def rank() -> int:
+    """First data-parallel replica index owned by this process, in
+    ``[0, size())``. Single-controller JAX drives all local replicas from
+    one process, so unlike the reference (one process per GPU) a process
+    owns ``size() // jax.process_count()`` consecutive replica slots; for
+    dataset sharding use ``rank()`` with ``local_size()`` replicas, or just
+    ``DistributedTrainer.shard_batch`` which handles placement."""
+    return jax.process_index() * (size() // max(jax.process_count(), 1))
+
+
+def size() -> int:
+    """Total number of data-parallel replicas (reference: byteps_size)."""
+    if GlobalState.initialized():
+        return GlobalState.get().dp
+    return jax.device_count()
+
+
+def local_rank() -> int:
+    cfg = GlobalState.get().config if GlobalState.initialized() else Config.from_env()
+    return cfg.local_rank
+
+
+def local_size() -> int:
+    return jax.local_device_count()
+
+
+# -- data plane -------------------------------------------------------------
+
+def declare_tensor(name: str, priority: Optional[int] = None, **kwargs) -> int:
+    """Pre-declare a tensor (reference: byteps_declare_tensor / IsTensorDeclared);
+    returns its stable key."""
+    return GlobalState.get().registry.declare(name, priority=priority, **kwargs).declared_key
+
+
+def push_pull(tree, average: bool = True, name: Optional[str] = None):
+    """Synchronise a pytree of stacked [dp, ...] gradients across the data
+    axes — the reference's push_pull ≡ allreduce (common/__init__.py:83-100).
+    """
+    return GlobalState.get().engine.push_pull(tree, average=average, name=name)
+
+
+def broadcast_parameters(tree, root_rank: int = 0):
+    """Broadcast root's parameters to all ranks (reference:
+    torch/__init__.py:259-291)."""
+    return GlobalState.get().engine.broadcast(tree, root_rank)
+
+
+def get_pushpull_speed() -> float:
+    """MB/s over a 10 s sliding window (reference: global.cc:697-752)."""
+    t = GlobalState.get().telemetry
+    return t.mbps() if t is not None else 0.0
+
+
+# -- high-level wrappers ----------------------------------------------------
+
+def DistributedOptimizer(*args, **kwargs):
+    from .optim import DistributedOptimizer as _DO
+    return _DO(*args, **kwargs)
+
+
+def DistributedTrainer(*args, **kwargs):
+    from .training import DistributedTrainer as _DT
+    return _DT(*args, **kwargs)
+
+
+__all__ = [
+    "init", "shutdown", "suspend", "resume", "rank", "size", "local_rank",
+    "local_size", "declare_tensor", "push_pull", "broadcast_parameters",
+    "get_pushpull_speed", "DistributedOptimizer", "DistributedTrainer",
+    "Config", "__version__",
+]

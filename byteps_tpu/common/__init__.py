@@ -1,0 +1,3 @@
+from .config import Config
+from .naming import NameRegistry, TensorDecl, place_key
+from .partition import LeafSpec, Bucket, Segment, plan_buckets, partition_lengths

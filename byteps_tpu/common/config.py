@@ -1,0 +1,135 @@
+"""Environment-variable configuration system.
+
+The reference framework (BytePS) is configured purely through environment
+variables (reference: docs/env.md; global.cc:105-281 reads them at init).
+We keep that contract — every knob here is an env var with the same or an
+analogous name — but resolve them once into a frozen, typed ``Config``
+object instead of scattering ``getenv`` calls through the runtime.
+
+Env vars recognised (reference name → here):
+  DMLC_ROLE                → BPS_ROLE            (worker|server|scheduler)
+  DMLC_WORKER_ID           → BPS_WORKER_ID
+  DMLC_NUM_WORKER          → BPS_NUM_WORKER
+  BYTEPS_LOCAL_RANK/SIZE   → BPS_LOCAL_RANK/SIZE
+  BYTEPS_PARTITION_BYTES   → BPS_PARTITION_BYTES
+  BYTEPS_SCHEDULING_CREDIT → BPS_SCHEDULING_CREDIT
+  BYTEPS_MIN_COMPRESS_BYTES→ BPS_MIN_COMPRESS_BYTES
+  BYTEPS_FORCE_DISTRIBUTED → BPS_FORCE_DISTRIBUTED
+  BYTEPS_ENABLE_ASYNC      → BPS_ENABLE_ASYNC
+  BYTEPS_KEY_HASH_FN       → BPS_KEY_HASH_FN
+  BYTEPS_TRACE_ON/...      → BPS_TRACE_ON / BPS_TRACE_START_STEP /
+                             BPS_TRACE_END_STEP / BPS_TRACE_DIR
+  BYTEPS_TELEMETRY_ON      → BPS_TELEMETRY_ON
+  BYTEPS_LOG_LEVEL         → BPS_LOG_LEVEL
+  BYTEPS_SERVER_ENGINE_THREAD  → BPS_SERVER_ENGINE_THREAD
+  BYTEPS_SERVER_ENABLE_SCHEDULE→ BPS_SERVER_ENABLE_SCHEDULE
+
+The original ``BYTEPS_``/``DMLC_`` spellings are accepted as fallbacks so
+that launch scripts written for the reference keep working.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+_TRUE = {"1", "true", "yes", "on"}
+
+
+def _env(name: str, legacy: Optional[str] = None, default: Optional[str] = None) -> Optional[str]:
+    """Read BPS_* env var, falling back to the legacy BYTEPS_/DMLC_ name."""
+    v = os.environ.get(name)
+    if v is None and legacy is not None:
+        v = os.environ.get(legacy)
+    return v if v is not None else default
+
+
+def _env_int(name: str, legacy: Optional[str], default: int) -> int:
+    v = _env(name, legacy)
+    return int(v) if v not in (None, "") else default
+
+
+def _env_bool(name: str, legacy: Optional[str], default: bool = False) -> bool:
+    v = _env(name, legacy)
+    if v is None:
+        return default
+    return v.strip().lower() in _TRUE
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Frozen snapshot of all runtime knobs, resolved at ``bps.init()``."""
+
+    # --- topology / bootstrap (reference: docs/env.md:7-45) ---
+    role: str = "worker"                 # worker | server | scheduler
+    worker_id: int = 0
+    num_worker: int = 1
+    local_rank: int = 0
+    local_size: int = 1
+    force_distributed: bool = False
+    # JAX distributed coordinator (replaces DMLC_PS_ROOT_URI/PORT rendezvous)
+    coordinator_address: Optional[str] = None
+    process_id: Optional[int] = None
+    num_processes: Optional[int] = None
+
+    # --- pipeline tuning (reference: global.cc:134-143, scheduled_queue.cc:35-40) ---
+    partition_bytes: int = 4096000       # BYTEPS_PARTITION_BYTES default, global.cc:134
+    scheduling_credit: int = 0           # 0 = disabled, scheduled_queue.cc:35-45
+    reverse_layer_priority: bool = True  # issue grad buckets in reverse layer order
+
+    # --- PS / server mode (reference: server.cc:407-439) ---
+    enable_async: bool = False           # BYTEPS_ENABLE_ASYNC
+    enable_ps: bool = False              # route push_pull through host PS service
+    server_engine_threads: int = 4       # BYTEPS_SERVER_ENGINE_THREAD
+    server_enable_schedule: bool = False # BYTEPS_SERVER_ENABLE_SCHEDULE
+
+    # --- key placement (reference: global.cc:158-180) ---
+    key_hash_fn: str = "djb2"            # naive|built_in|djb2|sdbm
+
+    # --- compression (reference: global.cc:137-139) ---
+    min_compress_bytes: int = 65536      # BYTEPS_MIN_COMPRESS_BYTES default 64KiB
+
+    # --- tracing / telemetry (reference: global.cc:113-124, 697-752) ---
+    trace_on: bool = False
+    trace_start_step: int = 10
+    trace_end_step: int = 20
+    trace_dir: str = "."
+    telemetry_on: bool = False
+    debug_sample_tensor: str = ""        # BYTEPS_DEBUG_SAMPLE_TENSOR
+
+    # --- logging ---
+    log_level: str = "INFO"
+
+    @staticmethod
+    def from_env(**overrides) -> "Config":
+        cfg = dict(
+            role=_env("BPS_ROLE", "DMLC_ROLE", "worker"),
+            worker_id=_env_int("BPS_WORKER_ID", "DMLC_WORKER_ID", 0),
+            num_worker=_env_int("BPS_NUM_WORKER", "DMLC_NUM_WORKER", 1),
+            local_rank=_env_int("BPS_LOCAL_RANK", "BYTEPS_LOCAL_RANK", 0),
+            local_size=_env_int("BPS_LOCAL_SIZE", "BYTEPS_LOCAL_SIZE", 1),
+            force_distributed=_env_bool("BPS_FORCE_DISTRIBUTED", "BYTEPS_FORCE_DISTRIBUTED"),
+            coordinator_address=_env("BPS_COORDINATOR_ADDRESS", "DMLC_PS_ROOT_URI"),
+            # Multi-host bootstrap: one JAX process per host. Falls back to the
+            # reference's worker-count/worker-id env contract (docs/env.md:7-45).
+            num_processes=(int(v) if (v := _env("BPS_NUM_PROCESSES", "DMLC_NUM_WORKER")) else None),
+            process_id=(int(v) if (v := _env("BPS_PROCESS_ID", "DMLC_WORKER_ID")) else None),
+            partition_bytes=_env_int("BPS_PARTITION_BYTES", "BYTEPS_PARTITION_BYTES", 4096000),
+            scheduling_credit=_env_int("BPS_SCHEDULING_CREDIT", "BYTEPS_SCHEDULING_CREDIT", 0),
+            enable_async=_env_bool("BPS_ENABLE_ASYNC", "BYTEPS_ENABLE_ASYNC"),
+            enable_ps=_env_bool("BPS_ENABLE_PS", "BYTEPS_ENABLE_PS"),
+            server_engine_threads=_env_int("BPS_SERVER_ENGINE_THREAD", "BYTEPS_SERVER_ENGINE_THREAD", 4),
+            server_enable_schedule=_env_bool("BPS_SERVER_ENABLE_SCHEDULE", "BYTEPS_SERVER_ENABLE_SCHEDULE"),
+            key_hash_fn=_env("BPS_KEY_HASH_FN", "BYTEPS_KEY_HASH_FN", "djb2"),
+            min_compress_bytes=_env_int("BPS_MIN_COMPRESS_BYTES", "BYTEPS_MIN_COMPRESS_BYTES", 65536),
+            trace_on=_env_bool("BPS_TRACE_ON", "BYTEPS_TRACE_ON"),
+            trace_start_step=_env_int("BPS_TRACE_START_STEP", "BYTEPS_TRACE_START_STEP", 10),
+            trace_end_step=_env_int("BPS_TRACE_END_STEP", "BYTEPS_TRACE_END_STEP", 20),
+            trace_dir=_env("BPS_TRACE_DIR", "BYTEPS_TRACE_DIR", "."),
+            telemetry_on=_env_bool("BPS_TELEMETRY_ON", "BYTEPS_TELEMETRY_ON"),
+            debug_sample_tensor=_env("BPS_DEBUG_SAMPLE_TENSOR", "BYTEPS_DEBUG_SAMPLE_TENSOR", ""),
+            log_level=_env("BPS_LOG_LEVEL", "BYTEPS_LOG_LEVEL", "INFO"),
+        )
+        cfg.update(overrides)
+        return Config(**cfg)

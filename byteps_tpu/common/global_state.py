@@ -1,0 +1,103 @@
+"""Process-wide runtime state (reference: BytePSGlobal, global.h:52-225).
+
+Holds the resolved Config, the device mesh, the tensor name registry, the
+push_pull engine, telemetry, and the timeline tracer. Created by
+``bps.init()`` and torn down by ``bps.shutdown()``; ``suspend``/``resume``
+re-initialise with new membership while replaying tensor declarations so
+name→key mappings stay stable (reference: operations.cc:96-119,
+global.cc:431-436).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+from .config import Config
+from .logging import get_logger
+from .naming import NameRegistry
+
+log = get_logger()
+
+
+class GlobalState:
+    _instance: Optional["GlobalState"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, config: Config, mesh=None) -> None:
+        from ..parallel.mesh import make_mesh, dp_size
+        from ..parallel.collectives import PushPullEngine
+        from ..telemetry import PushPullSpeed
+        from ..timeline import Timeline
+
+        self.config = config
+        self.registry = NameRegistry()
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.telemetry = PushPullSpeed() if config.telemetry_on else None
+        self.timeline = Timeline(config) if config.trace_on else None
+        self.engine = PushPullEngine(
+            self.mesh, partition_bytes=config.partition_bytes,
+            registry=self.registry, telemetry=self.telemetry)
+        self.engine.timeline = self.timeline
+        self.dp = dp_size(self.mesh)
+        self.step = 0
+        log.info("BPS init: role=%s mesh=%s dp=%d partition_bytes=%d",
+                 config.role, dict(self.mesh.shape), self.dp, config.partition_bytes)
+
+    # -- lifecycle ----------------------------------------------------------
+    @classmethod
+    def init(cls, config: Optional[Config] = None, mesh=None) -> "GlobalState":
+        with cls._lock:
+            if cls._instance is not None:
+                return cls._instance
+            cfg = config or Config.from_env()
+            if cfg.coordinator_address and cfg.num_processes and cfg.num_processes > 1:
+                jax.distributed.initialize(
+                    coordinator_address=cfg.coordinator_address,
+                    num_processes=cfg.num_processes, process_id=cfg.process_id)
+            cls._instance = GlobalState(cfg, mesh=mesh)
+            return cls._instance
+
+    @classmethod
+    def get(cls) -> "GlobalState":
+        if cls._instance is None:
+            raise RuntimeError("byteps_tpu not initialised; call bps.init() first")
+        return cls._instance
+
+    @classmethod
+    def initialized(cls) -> bool:
+        return cls._instance is not None
+
+    @classmethod
+    def shutdown(cls) -> None:
+        with cls._lock:
+            inst = cls._instance
+            if inst is None:
+                return
+            if inst.timeline is not None:
+                inst.timeline.flush()
+            cls._instance = None
+
+    @classmethod
+    def suspend(cls) -> Optional[list]:
+        """Tear down but remember declarations for resume (reference:
+        byteps_suspend, operations.cc:114-119)."""
+        with cls._lock:
+            inst = cls._instance
+            if inst is None:
+                return None
+            decls = [(d.name, d.priority, d.compression_kwargs)
+                     for d in (inst.registry.get(n) for n in inst.registry.declared_names())]
+            cls._instance = None
+            return decls
+
+    @classmethod
+    def resume(cls, decls, config: Optional[Config] = None, mesh=None) -> "GlobalState":
+        """Re-init with new membership, replaying declarations in original
+        order for stable name→key (reference: ReDeclareTensor)."""
+        inst = cls.init(config, mesh=mesh)
+        for name, priority, kwargs in decls or []:
+            inst.registry.declare(name, priority=priority, **kwargs)
+        return inst

@@ -1,0 +1,126 @@
+"""Tensor declaration, stable name→key assignment, and PS key placement.
+
+Mirrors the reference's declaration machinery:
+  - ``IsTensorDeclared`` / declared-key assignment (reference: global.cc:412-429)
+  - per-partition PS keys ``declared_key << 16 | i`` (reference: operations.cc:301-317)
+  - server placement by hash of the key (reference: global.cc:566-677, five
+    hash functions selected with BYTEPS_KEY_HASH_FN)
+  - ``ReDeclareTensor`` replay so name→key stays stable across elastic
+    resume (reference: global.cc:431-436)
+
+On TPU the "server placement" is only used when the host-side PS reduction
+service is enabled (byteps_tpu.server); pure-ICI collectives don't need keys
+for correctness, but keys still drive bucket priority and tracing identity.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+MAX_PARTITIONS = 1 << 16  # per-tensor partition space, reference operations.cc:301
+
+
+def _hash_naive(key: int, n: int) -> int:
+    return key % n
+
+def _hash_built_in(key: int, n: int) -> int:
+    return hash(key) % n
+
+def _hash_djb2(key: int, n: int) -> int:
+    # reference: global.cc djb2 over the decimal-string form of the key
+    h = 5381
+    for ch in str(key):
+        h = ((h << 5) + h + ord(ch)) & 0xFFFFFFFF
+    return h % n
+
+def _hash_sdbm(key: int, n: int) -> int:
+    h = 0
+    for ch in str(key):
+        h = (ord(ch) + (h << 6) + (h << 16) - h) & 0xFFFFFFFF
+    return h % n
+
+HASH_FNS = {
+    "naive": _hash_naive,
+    "built_in": _hash_built_in,
+    "djb2": _hash_djb2,
+    "sdbm": _hash_sdbm,
+}
+
+
+@dataclass
+class TensorDecl:
+    """Per-tensor declaration record (reference: BPSContext, common.h:177-205)."""
+    name: str
+    declared_key: int
+    priority: int = 0                       # default -declared_key, like tf ops.cc:158
+    compression_kwargs: Dict[str, str] = field(default_factory=dict)
+    partition_keys: List[int] = field(default_factory=list)
+
+    def key_for_partition(self, i: int) -> int:
+        return (self.declared_key << 16) | i
+
+
+class NameRegistry:
+    """Thread-safe name→key registry with stable replay for elastic resume."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._decls: Dict[str, TensorDecl] = {}
+        self._order: List[str] = []          # declaration order, for replay
+        self._next_key = 0
+
+    def declare(self, name: str, priority: Optional[int] = None,
+                **compression_kwargs: str) -> TensorDecl:
+        """Declare a tensor; idempotent per name (reference: IsTensorDeclared)."""
+        with self._lock:
+            if name in self._decls:
+                return self._decls[name]
+            key = self._next_key
+            self._next_key += 1
+            decl = TensorDecl(
+                name=name,
+                declared_key=key,
+                priority=-key if priority is None else priority,
+                compression_kwargs={k: str(v) for k, v in compression_kwargs.items()},
+            )
+            self._decls[name] = decl
+            self._order.append(name)
+            return decl
+
+    def get(self, name: str) -> Optional[TensorDecl]:
+        with self._lock:
+            return self._decls.get(name)
+
+    def declared_names(self) -> List[str]:
+        with self._lock:
+            return list(self._order)
+
+    def redeclare_all(self) -> List[TensorDecl]:
+        """Replay declarations in original order after membership change
+        (reference: ReDeclareTensor, global.cc:431-436). Key assignment is
+        deterministic in declaration order, so replay keeps name→key stable."""
+        with self._lock:
+            order, decls = list(self._order), dict(self._decls)
+        self.reset()
+        return [self.declare(n, priority=decls[n].priority,
+                             **decls[n].compression_kwargs) for n in order]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._decls.clear()
+            self._order.clear()
+            self._next_key = 0
+
+
+def place_key(key: int, num_servers: int, hash_fn: str = "djb2") -> int:
+    """Which server shard owns a PS key (reference: global.cc:628-677)."""
+    if num_servers <= 1:
+        return 0
+    try:
+        fn = HASH_FNS[hash_fn]
+    except KeyError:
+        raise ValueError(f"unknown BPS_KEY_HASH_FN {hash_fn!r}; "
+                         f"choose from {sorted(HASH_FNS)}") from None
+    return fn(key, num_servers)

@@ -1,0 +1,140 @@
+"""Gradient bucketing / partitioning math.
+
+The reference partitions every tensor into fixed-byte chunks so that push,
+network, pull, and broadcast stages pipeline per chunk
+(reference: PartitionTensor, operations.cc:140-180; BYTEPS_PARTITION_BYTES
+global.cc:134-143). On TPU, XLA already pipelines a single collective
+internally, so per-tensor chunking buys nothing — what matters is the
+*opposite* aggregation: fusing many small gradients into few fixed-byte
+buckets so each collective is big enough to saturate ICI, while keeping
+several buckets so that (a) the first buckets of the backward pass can
+start communicating before the last gradients exist, and (b) priority
+ordering is possible at bucket granularity.
+
+So ``plan_buckets`` is the TPU-native analogue of PartitionTensor: it takes
+the flat list of (name, shape, dtype) leaves in declaration order and packs
+them greedily into buckets of ~``partition_bytes`` each. Oversized single
+tensors are split across buckets at element granularity (same role as the
+reference's chunk split with remainder-to-last, operations.cc:154-167).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class LeafSpec:
+    """One flat leaf of the gradient pytree."""
+    name: str
+    size: int          # number of elements
+    dtype: str         # numpy dtype name, e.g. "float32"
+
+    @property
+    def nbytes(self) -> int:
+        return self.size * np.dtype(self.dtype).itemsize
+
+
+@dataclass(frozen=True)
+class Segment:
+    """A contiguous slice of one leaf placed inside a bucket."""
+    leaf_index: int    # index into the leaf list
+    leaf_offset: int   # element offset within the (flattened) leaf
+    bucket_offset: int # element offset within the bucket buffer
+    length: int        # number of elements
+
+
+@dataclass(frozen=True)
+class Bucket:
+    """A fixed-size flat buffer holding segments of one or more leaves."""
+    index: int
+    size: int          # total elements
+    dtype: str
+    segments: Tuple[Segment, ...]
+    priority: int      # higher = communicated earlier
+
+
+def plan_buckets(leaves: Sequence[LeafSpec], partition_bytes: int,
+                 reverse_order: bool = True,
+                 priorities: Sequence[int] | None = None) -> List[Bucket]:
+    """Pack leaves into ~partition_bytes buckets.
+
+    ``reverse_order=True`` packs the *last-declared* leaves into the
+    *first* buckets: in a backward pass gradients arrive in reverse layer
+    order, so this makes bucket 0 complete (and communicable) earliest —
+    the TPU-native analogue of the reference's priority scheduling where
+    priority = -declared_key (reference: scheduled_queue.cc:82-102,
+    tf ops.cc:158).
+
+    ``priorities`` (one int per leaf, higher = communicated earlier)
+    overrides the default order — the per-tensor priority knob of the
+    reference's declare_tensor/scheduled queues. Ties keep leaf order.
+
+    All leaves in one bucket must share a dtype; a dtype change forces a
+    bucket boundary. Returns buckets with priority = -bucket_index.
+    """
+    if partition_bytes <= 0:
+        raise ValueError("partition_bytes must be positive")
+    if priorities is not None:
+        if len(priorities) != len(leaves):
+            raise ValueError("priorities must have one entry per leaf")
+        order = sorted(range(len(leaves)), key=lambda i: -priorities[i])
+    else:
+        order = list(range(len(leaves)))
+        if reverse_order:
+            order.reverse()
+
+    buckets: List[Bucket] = []
+    cur_segments: List[Segment] = []
+    cur_dtype: str | None = None
+    cur_fill = 0  # elements
+
+    def cap_elems(dtype: str) -> int:
+        return max(1, partition_bytes // np.dtype(dtype).itemsize)
+
+    def flush() -> None:
+        nonlocal cur_segments, cur_dtype, cur_fill
+        if cur_segments:
+            idx = len(buckets)
+            buckets.append(Bucket(index=idx, size=cur_fill, dtype=cur_dtype,
+                                  segments=tuple(cur_segments), priority=-idx))
+        cur_segments, cur_dtype, cur_fill = [], None, 0
+
+    for li in order:
+        leaf = leaves[li]
+        remaining = leaf.size
+        leaf_off = 0
+        while remaining > 0:
+            if cur_dtype is not None and cur_dtype != leaf.dtype:
+                flush()
+            if cur_dtype is None:
+                cur_dtype = leaf.dtype
+            cap = cap_elems(cur_dtype)
+            space = cap - cur_fill
+            if space <= 0:
+                flush()
+                continue
+            take = min(space, remaining)
+            cur_segments.append(Segment(leaf_index=li, leaf_offset=leaf_off,
+                                        bucket_offset=cur_fill, length=take))
+            cur_fill += take
+            leaf_off += take
+            remaining -= take
+            if cur_fill >= cap:
+                flush()
+    flush()
+    return buckets
+
+
+def partition_lengths(total: int, num_parts: int) -> List[int]:
+    """Even split with remainder to the last part (reference:
+    operations.cc:154-167 gives the remainder chunk to the final partition)."""
+    if num_parts <= 0:
+        raise ValueError("num_parts must be positive")
+    base = total // num_parts
+    lens = [base] * num_parts
+    lens[-1] += total - base * num_parts
+    return lens

@@ -1,0 +1,35 @@
+"""Minimal MLP model — the round-1 flagship placeholder and the
+synthetic-benchmark workhorse (reference analogue: the synthetic benchmark
+models in example/pytorch/benchmark_byteps.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def mlp_init(rng, dim: int, depth: int, out_dim: int | None = None):
+    out_dim = out_dim or dim
+    params = {}
+    keys = jax.random.split(rng, depth)
+    for i in range(depth):
+        d_out = out_dim if i == depth - 1 else dim
+        params[f"w{i}"] = jax.random.normal(keys[i], (dim, d_out)) / np.sqrt(dim)
+        params[f"b{i}"] = jnp.zeros((d_out,))
+    return params
+
+
+def mlp_apply(params, x):
+    depth = len(params) // 2
+    for i in range(depth):
+        x = x @ params[f"w{i}"] + params[f"b{i}"]
+        if i < depth - 1:
+            x = jax.nn.gelu(x)
+    return x
+
+
+def mlp_loss(params, batch):
+    x, y = batch
+    pred = mlp_apply(params, x)
+    return jnp.mean((pred - y) ** 2)

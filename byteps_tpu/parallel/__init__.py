@@ -1,0 +1,2 @@
+from .mesh import make_mesh, data_axes, dp_size, AXIS_ORDER
+from .collectives import allreduce, bucketed_allreduce, PushPullEngine, psum_reducer

@@ -1,0 +1,93 @@
+"""Device-mesh topology for the TPU rebuild.
+
+The reference's topology model is: N GPU processes per host, grouped per
+PCIe switch for NCCL, with ps-lite TCP/RDMA between hosts
+(reference: nccl_manager.cc:129-165; docs/architecture.md). The TPU-native
+equivalent is a single ``jax.sharding.Mesh`` whose axes express the same
+hierarchy:
+
+  - ``dcn``  axis — across slices / hosts over data-center network
+             (the reference's worker↔server ps-lite plane)
+  - ``data`` axis — data parallelism inside a slice over ICI
+             (the reference's NCCL reduce-scatter/all-gather plane)
+  - ``model``/``seq``/``expert``/``pipe`` axes — tensor / sequence /
+             expert / pipeline parallelism (additive scope; absent in the
+             reference, SURVEY §2.5)
+
+XLA inserts the right collectives per axis; hierarchical reduction
+(intra-slice psum over ICI, then inter-slice over DCN) falls out of
+reducing over ("data",) then ("dcn",) — no hand-written two-level
+pipeline needed.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order, outermost (slowest ICI wraparound) first.
+AXIS_ORDER: Tuple[str, ...] = ("dcn", "pipe", "data", "expert", "seq", "model")
+
+
+def make_mesh(axis_sizes: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh from named axis sizes.
+
+    Unspecified axes get size 1; if no axis is given, all devices go on
+    ``data``. Axis sizes must multiply to the device count, except that a
+    single ``-1`` axis absorbs the remainder (numpy reshape style).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    sizes = dict(axis_sizes or {})
+    for ax in sizes:
+        if ax not in AXIS_ORDER:
+            raise ValueError(f"unknown mesh axis {ax!r}; valid: {AXIS_ORDER}")
+    if not sizes:
+        sizes = {"data": n}
+    fixed = math.prod(v for v in sizes.values() if v != -1)
+    if any(v == -1 for v in sizes.values()):
+        if n % fixed:
+            raise ValueError(f"cannot infer -1 axis: {n} devices not divisible by {fixed}")
+        inferred = n // fixed
+        sizes = {k: (inferred if v == -1 else v) for k, v in sizes.items()}
+    if math.prod(sizes.values()) != n:
+        raise ValueError(f"axis sizes {sizes} do not multiply to {n} devices")
+
+    names = tuple(ax for ax in AXIS_ORDER if sizes.get(ax, 1) > 1)
+    if not names:  # degenerate single-device mesh still needs one axis
+        names = ("data",)
+        sizes = {"data": 1}
+    shape = tuple(sizes[ax] for ax in names)
+
+    if len(devices) == math.prod(shape):
+        try:
+            from jax.experimental import mesh_utils
+            if "dcn" in names and sizes.get("dcn", 1) > 1:
+                # Hybrid mesh: outer axis over DCN (slow), rest over ICI.
+                dcn = sizes["dcn"]
+                ici_shape = tuple(s for ax, s in zip(names, shape) if ax != "dcn")
+                mesh_devices = mesh_utils.create_hybrid_device_mesh(
+                    ici_shape, (dcn,) + (1,) * (len(ici_shape) - 1), devices=devices)
+                mesh_devices = mesh_devices.reshape(shape)
+            else:
+                mesh_devices = mesh_utils.create_device_mesh(shape, devices=devices)
+        except Exception:
+            mesh_devices = np.asarray(devices).reshape(shape)
+    else:
+        mesh_devices = np.asarray(devices).reshape(shape)
+    return Mesh(mesh_devices, names)
+
+
+def data_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """The axes a gradient all-reduce must span: every data-parallel axis
+    present in the mesh (hierarchical: ICI 'data' plus cross-slice 'dcn')."""
+    return tuple(ax for ax in ("dcn", "data") if ax in mesh.axis_names)
+
+
+def dp_size(mesh: Mesh) -> int:
+    return math.prod(mesh.shape[ax] for ax in data_axes(mesh)) if data_axes(mesh) else 1

@@ -1,0 +1,40 @@
+"""Push-pull bandwidth telemetry (reference: PushPullSpeed,
+global.cc:697-752 — a 10-second MB/s sliding window exposed to Python as
+``bps.get_pushpull_speed()``, operations.cc:131-136)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Tuple
+
+WINDOW_SEC = 10.0
+
+
+class PushPullSpeed:
+    def __init__(self, window_sec: float = WINDOW_SEC) -> None:
+        self._lock = threading.Lock()
+        self._window = window_sec
+        self._events: Deque[Tuple[float, int]] = deque()  # (ts, nbytes)
+
+    def record(self, nbytes: int, duration_s: float = 0.0) -> None:
+        now = time.time()
+        with self._lock:
+            self._events.append((now, nbytes))
+            self._evict(now)
+
+    def _evict(self, now: float) -> None:
+        while self._events and now - self._events[0][0] > self._window:
+            self._events.popleft()
+
+    def mbps(self) -> float:
+        """Mean MB/s over the sliding window."""
+        now = time.time()
+        with self._lock:
+            self._evict(now)
+            if not self._events:
+                return 0.0
+            total = sum(n for _, n in self._events)
+            span = max(now - self._events[0][0], 1e-6)
+            return total / span / 1e6

@@ -1,0 +1,79 @@
+"""Chrome-trace timeline of communication intervals.
+
+Reference: BYTEPS_TRACE_ON/START_STEP/END_STEP/DIR (global.cc:113-124),
+per-(key, stage) interval recording (scheduled_queue.cc:105-123,
+core_loops.cc:69-129), async dump to ``<dir>/<local_rank>/comm.json`` in
+Chrome Trace Format (global.cc:469-564; docs/timeline.md).
+
+Here each push_pull bucket emits one complete event per stage; we also
+bridge to ``jax.profiler`` traces for the device-side view. The output file
+name and JSON schema match the reference so existing viewers work.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import List
+
+from .common.config import Config
+
+
+class Timeline:
+    def __init__(self, config: Config) -> None:
+        self.cfg = config
+        self.enabled = config.trace_on
+        self._events: List[dict] = []
+        self._lock = threading.Lock()
+        self._t0 = time.time()
+        self.step = 0
+
+    def _active(self) -> bool:
+        return (self.enabled and
+                self.cfg.trace_start_step <= self.step <= self.cfg.trace_end_step)
+
+    def set_step(self, step: int) -> None:
+        self.step = step
+        if self.enabled and step == self.cfg.trace_end_step + 1:
+            self.flush()
+
+    def record(self, name: str, stage: str, start_s: float, dur_s: float,
+               key: int = 0) -> None:
+        """One complete ('X') event, microsecond timestamps like the
+        reference (global.cc:489-538)."""
+        if not self._active():
+            return
+        with self._lock:
+            self._events.append({
+                "name": stage, "ph": "X", "pid": key, "tid": 0,
+                "ts": int((start_s - self._t0) * 1e6), "dur": int(dur_s * 1e6),
+                "args": {"name": name, "step": self.step},
+            })
+
+    def span(self, name: str, stage: str, key: int = 0):
+        tl = self
+
+        class _Span:
+            def __enter__(self):
+                self.t = time.time()
+                return self
+
+            def __exit__(self, *exc):
+                tl.record(name, stage, self.t, time.time() - self.t, key)
+                return False
+
+        return _Span()
+
+    def flush(self) -> None:
+        with self._lock:
+            events, self._events = self._events, []
+        if not events:
+            return
+        rank = self.cfg.local_rank
+        outdir = os.path.join(self.cfg.trace_dir, str(rank))
+        os.makedirs(outdir, exist_ok=True)
+        path = os.path.join(outdir, "comm.json")
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)

@@ -1,0 +1,93 @@
+"""Tests for auxiliary subsystems: timeline tracing, telemetry, priority
+knobs, config (reference: SURVEY §5 — global.cc:448-564 timeline,
+global.cc:697-752 telemetry)."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import byteps_tpu as bps
+from byteps_tpu.common.config import Config
+from byteps_tpu.common.partition import LeafSpec, plan_buckets
+
+
+def test_config_from_env_legacy_names(monkeypatch):
+    monkeypatch.delenv("BPS_PARTITION_BYTES", raising=False)
+    monkeypatch.setenv("BYTEPS_PARTITION_BYTES", "1234")
+    monkeypatch.setenv("DMLC_ROLE", "server")
+    cfg = Config.from_env()
+    assert cfg.partition_bytes == 1234
+    assert cfg.role == "server"
+
+
+def test_config_new_names_win(monkeypatch):
+    monkeypatch.setenv("BYTEPS_PARTITION_BYTES", "1")
+    monkeypatch.setenv("BPS_PARTITION_BYTES", "2")
+    assert Config.from_env().partition_bytes == 2
+
+
+def test_config_multihost_fields(monkeypatch):
+    monkeypatch.setenv("DMLC_NUM_WORKER", "4")
+    monkeypatch.setenv("DMLC_WORKER_ID", "2")
+    cfg = Config.from_env()
+    assert cfg.num_processes == 4
+    assert cfg.process_id == 2
+
+
+def test_plan_buckets_respects_priorities():
+    leaves = [LeafSpec(f"l{i}", 10, "float32") for i in range(3)]
+    buckets = plan_buckets(leaves, 40, priorities=[5, 99, 1])
+    first = [s.leaf_index for s in buckets[0].segments]
+    assert first[0] == 1  # highest priority leaf leads
+
+
+def test_plan_buckets_priority_length_mismatch():
+    leaves = [LeafSpec("a", 10, "float32")]
+    with pytest.raises(ValueError):
+        plan_buckets(leaves, 40, priorities=[1, 2])
+
+
+def test_timeline_writes_chrome_trace(tmp_path, mesh8):
+    cfg = Config.from_env(trace_on=True, trace_start_step=0, trace_end_step=5,
+                          trace_dir=str(tmp_path))
+    bps.init(config=cfg, mesh=mesh8)
+    x = jax.device_put(np.ones((8, 64), np.float32),
+                       NamedSharding(mesh8, P("data")))
+    bps.push_pull(x, name="grad")
+    bps.shutdown()  # flushes
+    out = tmp_path / "0" / "comm.json"
+    assert out.exists()
+    trace = json.loads(out.read_text())
+    stages = {e["name"] for e in trace["traceEvents"]}
+    assert "PUSH_PULL" in stages and "DISPATCH" in stages
+    names = {e["args"]["name"] for e in trace["traceEvents"]}
+    assert names == {"grad"}
+
+
+def test_telemetry_window(mesh8):
+    cfg = Config.from_env(telemetry_on=True)
+    bps.init(config=cfg, mesh=mesh8)
+    x = jax.device_put(np.ones((8, 1024), np.float32),
+                       NamedSharding(mesh8, P("data")))
+    bps.push_pull(x)
+    assert bps.get_pushpull_speed() > 0
+
+
+def test_declared_priority_changes_bucket_order(mesh8):
+    """Pre-declaring priorities reorders which leaves go in bucket 0."""
+    bps.init(mesh=mesh8)
+    # engine names leaves by keystr path with optional prefix
+    bps.declare_tensor("g.['a']", priority=100)
+    bps.declare_tensor("g.['b']", priority=-100)
+    eng = bps.common.global_state.GlobalState.get().engine
+    x = {"a": jax.device_put(np.ones((8, 4), np.float32), NamedSharding(mesh8, P("data"))),
+         "b": jax.device_put(np.ones((8, 4), np.float32), NamedSharding(mesh8, P("data")))}
+    _, progs, _ = eng._plan(x, True, name="g")
+    # 'a' has the highest priority → it leads bucket 0
+    first_bucket = progs[0][2]
+    specs_in_first = {s.leaf_index for s in first_bucket.segments}
+    assert 0 in specs_in_first

@@ -1,0 +1,62 @@
+"""Tests for tensor declaration / key stability (reference:
+IsTensorDeclared global.cc:412-429, ReDeclareTensor global.cc:431-436,
+key placement global.cc:566-677)."""
+
+import pytest
+
+from byteps_tpu.common.naming import NameRegistry, place_key, HASH_FNS
+
+
+def test_declare_idempotent():
+    r = NameRegistry()
+    d1 = r.declare("w1")
+    d2 = r.declare("w1")
+    assert d1.declared_key == d2.declared_key == 0
+
+
+def test_keys_assigned_in_order():
+    r = NameRegistry()
+    keys = [r.declare(f"t{i}").declared_key for i in range(5)]
+    assert keys == list(range(5))
+
+
+def test_default_priority_is_negative_key():
+    # reference: tf ops.cc:158 priority = -declared_key
+    r = NameRegistry()
+    assert r.declare("a").priority == 0
+    assert r.declare("b").priority == -1
+
+
+def test_partition_key_encoding():
+    # reference: operations.cc:301-317 key = declared_key<<16 | i
+    r = NameRegistry()
+    d = r.declare("x")
+    d2 = r.declare("y")
+    assert d2.key_for_partition(3) == (1 << 16) | 3
+    assert d.key_for_partition(0) == 0
+
+
+def test_redeclare_replay_stable():
+    r = NameRegistry()
+    for n in ["a", "b", "c"]:
+        r.declare(n)
+    before = {n: r.get(n).declared_key for n in ["a", "b", "c"]}
+    r.redeclare_all()
+    after = {n: r.get(n).declared_key for n in ["a", "b", "c"]}
+    assert before == after
+
+
+def test_place_key_all_hashes_in_range():
+    for name in HASH_FNS:
+        for key in range(100):
+            s = place_key(key, 7, name)
+            assert 0 <= s < 7
+
+
+def test_place_key_single_server():
+    assert place_key(123, 1) == 0
+
+
+def test_place_key_unknown_hash():
+    with pytest.raises(ValueError):
+        place_key(1, 4, "nope")

@@ -1,0 +1,78 @@
+"""Unit tests for bucketing/partition math (reference analogue:
+PartitionTensor, operations.cc:140-180)."""
+
+import numpy as np
+import pytest
+
+from byteps_tpu.common.partition import (LeafSpec, partition_lengths,
+                                         plan_buckets)
+
+
+def reconstruct(leaves, buckets):
+    """Check every element of every leaf is covered exactly once."""
+    seen = {i: np.zeros(l.size, dtype=int) for i, l in enumerate(leaves)}
+    for b in buckets:
+        assert b.size == sum(s.length for s in b.segments)
+        offs = sorted(s.bucket_offset for s in b.segments)
+        # segments tile the bucket contiguously
+        pos = 0
+        for o, s in zip(offs, sorted(b.segments, key=lambda s: s.bucket_offset)):
+            assert o == pos
+            pos += s.length
+        for s in b.segments:
+            seen[s.leaf_index][s.leaf_offset:s.leaf_offset + s.length] += 1
+    for i, cov in seen.items():
+        assert (cov == 1).all(), f"leaf {i} coverage wrong"
+
+
+def test_single_small_leaf():
+    leaves = [LeafSpec("a", 10, "float32")]
+    buckets = plan_buckets(leaves, 1 << 20)
+    assert len(buckets) == 1
+    reconstruct(leaves, buckets)
+
+
+def test_many_leaves_packed():
+    leaves = [LeafSpec(f"l{i}", 100, "float32") for i in range(10)]
+    buckets = plan_buckets(leaves, 1000 * 4)  # 1000 elems per bucket
+    assert len(buckets) == 1
+    assert buckets[0].size == 1000
+    reconstruct(leaves, buckets)
+
+
+def test_oversized_leaf_split():
+    leaves = [LeafSpec("big", 2500, "float32")]
+    buckets = plan_buckets(leaves, 1000 * 4)
+    assert len(buckets) == 3
+    assert [b.size for b in buckets] == [1000, 1000, 500]
+    reconstruct(leaves, buckets)
+
+
+def test_reverse_order_puts_last_leaf_first():
+    leaves = [LeafSpec("first", 10, "float32"), LeafSpec("last", 10, "float32")]
+    buckets = plan_buckets(leaves, 10 * 4, reverse_order=True)
+    assert buckets[0].segments[0].leaf_index == 1
+    assert buckets[1].segments[0].leaf_index == 0
+
+
+def test_dtype_boundary_forces_new_bucket():
+    leaves = [LeafSpec("a", 10, "float32"), LeafSpec("b", 10, "bfloat16")]
+    buckets = plan_buckets(leaves, 1 << 20)
+    assert len(buckets) == 2
+    dtypes = {b.dtype for b in buckets}
+    assert dtypes == {"float32", "bfloat16"}
+    reconstruct(leaves, buckets)
+
+
+def test_priorities_descend():
+    leaves = [LeafSpec(f"l{i}", 1000, "float32") for i in range(8)]
+    buckets = plan_buckets(leaves, 1000 * 4)
+    assert [b.priority for b in buckets] == [-b.index for b in buckets]
+
+
+def test_partition_lengths_remainder_to_last():
+    # reference: remainder chunk goes to the final partition
+    assert partition_lengths(10, 3) == [3, 3, 4]
+    assert partition_lengths(9, 3) == [3, 3, 3]
+    with pytest.raises(ValueError):
+        partition_lengths(5, 0)
