@@ -1,0 +1,5 @@
+from .base import Compressor, create, register, registered_names
+from .rng import XorShift128Plus
+from . import onebit, topk, randomk, dithering  # register implementations
+from .decorators import VanillaErrorFeedback, NesterovMomentum
+from .reducer import CompressionPlan

@@ -1,0 +1,91 @@
+"""Compressor interface + registry.
+
+Reference: compressor.h:74-117 (Compress/Decompress/FastUpdateError),
+compressor_registry.cc (name→ctor map; Create() resolves the decorator
+chain momentum → error-feedback → compressor from string kwargs).
+
+TPU-native differences:
+  - Compressors are *pure functions* on 1-D bucket buffers: state (error
+    feedback memory, momentum, RNG keys) is threaded explicitly as a
+    pytree so the whole thing jits and lives inside the train step.
+  - Payloads are fixed-shape pytrees of arrays (XLA needs static shapes),
+    not byte blobs.
+  - The kwargs surface is string-typed and uses the reference's key names
+    (``compressor_type``, ``ef_type``, ``momentum_type``, ``compressor_k``,
+    ``compressor_onebit_scaling``, ``momentum_mu``, ``seed``,
+    ``dithering_partition``, ``dithering_normalize``) so per-tensor attrs
+    written for the reference port directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+
+Payload = Any     # pytree of arrays, fixed shapes
+State = Any       # pytree of arrays
+
+_REGISTRY: Dict[str, Callable[..., "Compressor"]] = {}
+
+
+def register(name: str):
+    """Register under ``<name>`` (reference registers ``<name>_<kind>``;
+    the kind suffix is implied by the kwargs key here)."""
+    def deco(ctor):
+        if name in _REGISTRY:
+            raise ValueError(f"duplicate compressor {name!r}")
+        _REGISTRY[name] = ctor
+        return ctor
+    return deco
+
+
+class Compressor:
+    """A pure, jit-safe compressor over a flat float buffer of length n."""
+
+    #: bytes per element of the *payload* relative to input — informational
+    name: str = "identity"
+
+    def __init__(self, size: int, dtype: str = "float32") -> None:
+        self.size = size       # number of elements in the buffer
+        self.dtype = dtype
+
+    def init_state(self) -> State:
+        return ()
+
+    def compress(self, x: jnp.ndarray, state: State) -> Tuple[Payload, State]:
+        raise NotImplementedError
+
+    def decompress(self, payload: Payload) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def payload_nbytes(self) -> int:
+        """Wire size of one compressed payload (for telemetry/ratio)."""
+        raise NotImplementedError
+
+
+def create(kwargs: Dict[str, str], size: int,
+           dtype: str = "float32") -> Optional[Compressor]:
+    """Resolve the decorator chain from string kwargs (reference:
+    CompressorRegistry::Create, compressor_registry.cc:40-56: momentum →
+    ef → compressor, outermost first). Returns None if no compressor_type.
+    """
+    if "compressor_type" not in kwargs:
+        return None
+    ctor = _REGISTRY.get(kwargs["compressor_type"])
+    if ctor is None:
+        raise ValueError(f"no compressor registered under "
+                         f"{kwargs['compressor_type']!r}; have {sorted(_REGISTRY)}")
+    comp = ctor(kwargs, size, dtype)
+    if kwargs.get("ef_type") == "vanilla":
+        from .decorators import VanillaErrorFeedback
+        comp = VanillaErrorFeedback(comp)
+    if kwargs.get("momentum_type") == "nesterov":
+        from .decorators import NesterovMomentum
+        mu = float(kwargs.get("momentum_mu", 0.9))
+        comp = NesterovMomentum(comp, mu=mu)
+    return comp
+
+
+def registered_names():
+    return sorted(_REGISTRY)

@@ -1,0 +1,82 @@
+"""Compressor decorators: error feedback and Nesterov momentum.
+
+Reference:
+  - error_feedback.h:26-46 — ``corrected = grad + error; compressed =
+    Compress(corrected); error = corrected - Decompress(compressed)``.
+  - vanilla_error_feedback.{cc,h} — additionally scales the carried error
+    by η_{t-1}/η_t read from an mmap'd ``lr.s`` file the trainer writes
+    each step (vanilla_error_feedback.h:26-38). Here the lr ratio is
+    threaded through state explicitly (``set_lr``-style file IPC is
+    replaced by a value in the train state — same math, no mmap).
+  - momentum.h + nesterov_momentum.h:26-34 — ``m = μm + g; g += μm``;
+    worker-only (compressor_registry.cc:41-46).
+
+All decorators are pure: state in, state out, jit-safe.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from .base import Compressor
+
+
+class VanillaErrorFeedback(Compressor):
+    name = "vanilla_ef"
+
+    def __init__(self, inner: Compressor) -> None:
+        super().__init__(inner.size, inner.dtype)
+        self.inner = inner
+
+    def init_state(self):
+        return {
+            "error": jnp.zeros((self.size,), dtype=self.dtype),
+            # lr_prev/lr_now scale carried error by η_{t-1}/η_t; equal by
+            # default (ratio 1) when the schedule is constant/unknown.
+            "lr_prev": jnp.float32(1.0),
+            "lr_now": jnp.float32(1.0),
+            "inner": self.inner.init_state(),
+        }
+
+    def compress(self, x: jnp.ndarray, state) -> Tuple[dict, dict]:
+        ratio = state["lr_prev"] / jnp.maximum(state["lr_now"], 1e-30)
+        corrected = x + ratio * state["error"]
+        payload, inner_state = self.inner.compress(corrected, state["inner"])
+        error = corrected - self.inner.decompress(payload)
+        return payload, {"error": error, "lr_prev": state["lr_now"],
+                         "lr_now": state["lr_now"], "inner": inner_state}
+
+    def decompress(self, payload):
+        return self.inner.decompress(payload)
+
+    def payload_nbytes(self) -> int:
+        return self.inner.payload_nbytes()
+
+
+class NesterovMomentum(Compressor):
+    name = "nesterov_momentum"
+
+    def __init__(self, inner: Compressor, mu: float = 0.9) -> None:
+        super().__init__(inner.size, inner.dtype)
+        self.inner = inner
+        self.mu = mu
+
+    def init_state(self):
+        return {"m": jnp.zeros((self.size,), dtype=self.dtype),
+                "inner": self.inner.init_state()}
+
+    def compress(self, x: jnp.ndarray, state) -> Tuple[dict, dict]:
+        m = self.mu * state["m"] + x          # m = μm + g
+        corrected = x + self.mu * m           # g += μm (nesterov lookahead)
+        payload, inner_state = self.inner.compress(corrected, state["inner"])
+        new_state = {"m": m, "inner": inner_state}
+        # EF inner decorator keeps its own error on the corrected signal
+        return payload, new_state
+
+    def decompress(self, payload):
+        return self.inner.decompress(payload)
+
+    def payload_nbytes(self) -> int:
+        return self.inner.payload_nbytes()

@@ -1,0 +1,66 @@
+"""Onebit (signSGD) compressor: 32:1 sign-bit packing with optional
+L1-mean scale (reference: impl/onebit.{cc,h} — sign bits packed MSB-first
+into words, scale = mean |x| appended when compressor_onebit_scaling on).
+
+TPU-native: the pack/unpack is pure vectorized bit arithmetic on uint32
+lanes (VPU-friendly, fuses into the surrounding program); payload is
+(packed words, scale) with static shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .base import Compressor, register
+
+PACK = 32  # bits per word
+
+
+@register("onebit")
+def _make(kwargs, size, dtype):
+    scaled = kwargs.get("compressor_onebit_scaling", "false").lower() in (
+        "1", "true", "yes")
+    return OnebitCompressor(size, dtype, use_scale=scaled)
+
+
+class OnebitCompressor(Compressor):
+    name = "onebit"
+
+    def __init__(self, size: int, dtype: str = "float32",
+                 use_scale: bool = False) -> None:
+        super().__init__(size, dtype)
+        self.use_scale = use_scale
+        self.chunks = (size + PACK - 1) // PACK
+
+    def compress(self, x: jnp.ndarray, state=()) -> Tuple[dict, tuple]:
+        n = self.size
+        pad = self.chunks * PACK - n
+        # padding with zeros: sign bit of 0.0 is 0 ("positive"), matching the
+        # reference's zero-padded trailing word
+        xp = jnp.pad(x, (0, pad))
+        neg = (xp < 0).astype(jnp.uint32).reshape(self.chunks, PACK)
+        # MSB-first: element 0 of each chunk lands in the top bit
+        shifts = jnp.arange(PACK - 1, -1, -1, dtype=jnp.uint32)
+        # disjoint bits, so sum == bitwise OR
+        packed = (neg << shifts).sum(axis=1, dtype=jnp.uint32)
+        if self.use_scale:
+            scale = jnp.mean(jnp.abs(x)).astype(jnp.float32)
+        else:
+            scale = jnp.float32(1.0)
+        return {"packed": packed, "scale": scale}, state
+
+    def decompress(self, payload: dict) -> jnp.ndarray:
+        packed = payload["packed"]
+        shifts = jnp.arange(PACK - 1, -1, -1, dtype=jnp.uint32)
+        bits = (packed[:, None] >> shifts) & jnp.uint32(1)
+        # bit 1 → negative: value -scale; bit 0 → +scale (reference:
+        # sign = 1 - ((x & 1) << 1))
+        signs = 1.0 - 2.0 * bits.astype(jnp.float32)
+        out = (signs * payload["scale"]).reshape(-1)[: self.size]
+        return out.astype(self.dtype)
+
+    def payload_nbytes(self) -> int:
+        return self.chunks * 4 + 4

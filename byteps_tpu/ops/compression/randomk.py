@@ -1,0 +1,62 @@
+"""RandomK sparsifier: k uniformly-sampled coordinates as (index, value)
+pairs, sampled **with replacement** like the reference (reference:
+impl/randomk.cc CompressImpl draws Randint(0, len) k times; duplicates
+possible and harmless since they carry identical values).
+
+Determinism: the reference is deterministic only when seeded
+(``seed`` kwarg → XorShift128+ with state {seed, seed}). Here:
+  - the jit path threads a jax.random key through compressor state
+    (different stream, same algorithm — documented deviation);
+  - ``compress_with_indices`` takes host-provided indices, which the golden
+    tests drive with the bit-exact XorShift128+ from .rng to verify the
+    math against a numpy model, mirroring the reference's test strategy
+    (tests/utils.py reimplements the RNG in numba).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import Compressor, register
+from .topk import resolve_k
+
+
+@register("randomk")
+def _make(kwargs, size, dtype):
+    seed = int(kwargs.get("seed", 0))
+    return RandomkCompressor(size, dtype, k=resolve_k(kwargs, size, dtype),
+                             seed=seed)
+
+
+class RandomkCompressor(Compressor):
+    name = "randomk"
+
+    def __init__(self, size: int, dtype: str = "float32", k: int = 1,
+                 seed: int = 0) -> None:
+        super().__init__(size, dtype)
+        self.k = min(k, size)
+        self.seed = seed
+
+    def init_state(self):
+        return {"key": jax.random.PRNGKey(self.seed)}
+
+    def compress(self, x: jnp.ndarray, state) -> Tuple[dict, dict]:
+        key, sub = jax.random.split(state["key"])
+        idx = jax.random.randint(sub, (self.k,), 0, self.size, dtype=jnp.int32)
+        return self.compress_with_indices(x, idx)[0], {"key": key}
+
+    def compress_with_indices(self, x: jnp.ndarray,
+                              idx: jnp.ndarray) -> Tuple[dict, tuple]:
+        idx = jnp.asarray(idx, dtype=jnp.int32)
+        return {"indices": idx, "values": x[idx]}, ()
+
+    def decompress(self, payload: dict) -> jnp.ndarray:
+        out = jnp.zeros((self.size,), dtype=self.dtype)
+        return out.at[payload["indices"]].set(payload["values"])
+
+    def payload_nbytes(self) -> int:
+        return self.k * (4 + np.dtype(self.dtype).itemsize)

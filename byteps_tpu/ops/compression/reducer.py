@@ -1,0 +1,94 @@
+"""Compressed cross-replica reduction.
+
+The reference compresses on the CPU buffer right before PUSH and
+decompresses after PULL, with the server summing decompressed payloads
+(reference: core_loops.cc:498-536, server.cc:86-113). An XLA psum over
+bit-packed payloads would be meaningless (the same reason NCCL allreduce
+couldn't compress — docs/gradient-compression.md "Motivation"), so the
+TPU-native exchange is gather-based: every replica all-gathers the
+*compressed* payloads over ICI/DCN, then locally decompress-sums. Wire
+bytes per step drop from O(n) to O(world × payload) — a win whenever
+payload ≪ n/world, exactly the regime compression targets.
+
+``CompressionPlan`` binds the bucket plan to per-bucket compressor
+instances and threads their state (EF memory, momentum, RNG keys) as one
+pytree, so the whole reduction jits inside the train step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...common.partition import Bucket, LeafSpec, plan_buckets
+from . import base
+
+
+class CompressionPlan:
+    """Per-bucket compressors over a fixed gradient-tree structure."""
+
+    def __init__(self, specs: Sequence[LeafSpec], partition_bytes: int,
+                 kwargs: Dict[str, str], min_compress_bytes: int = 65536):
+        self.buckets: List[Bucket] = plan_buckets(specs, partition_bytes,
+                                                  reverse_order=True)
+        self.compressors: List[Optional[base.Compressor]] = []
+        for b in self.buckets:
+            nbytes = b.size * np.dtype(b.dtype).itemsize
+            if nbytes < min_compress_bytes:
+                # small buckets skip compression (reference:
+                # operations.cc:362-364, BYTEPS_MIN_COMPRESS_BYTES)
+                self.compressors.append(None)
+            else:
+                self.compressors.append(base.create(kwargs, b.size, b.dtype))
+
+    @classmethod
+    def for_tree(cls, tree, partition_bytes: int, kwargs: Dict[str, str],
+                 min_compress_bytes: int = 65536) -> "CompressionPlan":
+        from ...parallel.collectives import leaf_specs_of_tree
+        return cls(leaf_specs_of_tree(tree), partition_bytes, kwargs,
+                   min_compress_bytes)
+
+    def init_state(self):
+        return tuple(c.init_state() if c is not None else ()
+                     for c in self.compressors)
+
+    def reduce_tree(self, tree, states, axes: Tuple[str, ...],
+                    average: bool = True):
+        """Bucketed compressed allreduce; call inside shard_map. Returns
+        (reduced tree, new compressor states)."""
+        from ...parallel.collectives import _pack_bucket, _unpack_bucket
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        shapes = [l.shape for l in leaves]
+        flat = [l.ravel() for l in leaves]
+        n = 1
+        for ax in axes:
+            n *= jax.lax.axis_size(ax)
+        new_states = []
+        for b, comp, st in zip(self.buckets, self.compressors, states):
+            buf = _pack_bucket(flat, b)
+            if comp is None or not axes:
+                red = jax.lax.psum(buf, axes) if axes else buf
+                new_states.append(st)
+            else:
+                payload, st2 = comp.compress(buf, st)
+                gathered = jax.tree_util.tree_map(
+                    lambda p: jax.lax.all_gather(p, axes, axis=0, tiled=False),
+                    payload)
+                world = n
+
+                def dec_one(i, acc):
+                    pl = jax.tree_util.tree_map(lambda g: g[i], gathered)
+                    return acc + comp.decompress(pl)
+
+                red = jax.lax.fori_loop(
+                    0, world, dec_one,
+                    jnp.zeros((b.size,), dtype=b.dtype))
+                new_states.append(st2)
+            if average:
+                red = red / n
+            _unpack_bucket(red, b, flat)
+        out = [f.reshape(s) for f, s in zip(flat, shapes)]
+        return jax.tree_util.tree_unflatten(treedef, out), tuple(new_states)

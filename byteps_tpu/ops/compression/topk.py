@@ -1,0 +1,53 @@
+"""TopK sparsifier: keep the k largest-magnitude entries as (index, value)
+pairs (reference: impl/topk.{cc,h}; k resolved from ``compressor_k`` — a
+fraction of the buffer when < 1, an absolute count otherwise,
+reference: topk.cc registry lambda).
+
+TPU-native: jax.lax.top_k on |x| (MXU/VPU-friendly), static k; payload is
+(int32 indices, values)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import Compressor, register
+
+
+def resolve_k(kwargs, size: int, dtype: str) -> int:
+    """compressor_k < 1 → fraction of the *byte* size over element size,
+    i.e. a fraction of the element count (reference: randomk.cc/topk.cc
+    registry: k = factor * size_bytes / dtype_len); ≥ 1 → absolute."""
+    factor = float(kwargs.get("compressor_k", 0.01))
+    if factor < 1:
+        k = int(factor * size)
+        return max(k, 1)
+    return int(factor)
+
+
+@register("topk")
+def _make(kwargs, size, dtype):
+    return TopkCompressor(size, dtype, k=resolve_k(kwargs, size, dtype))
+
+
+class TopkCompressor(Compressor):
+    name = "topk"
+
+    def __init__(self, size: int, dtype: str = "float32", k: int = 1) -> None:
+        super().__init__(size, dtype)
+        self.k = min(k, size)
+
+    def compress(self, x: jnp.ndarray, state=()) -> Tuple[dict, tuple]:
+        _, idx = jax.lax.top_k(jnp.abs(x), self.k)
+        vals = x[idx]
+        return {"indices": idx.astype(jnp.int32), "values": vals}, state
+
+    def decompress(self, payload: dict) -> jnp.ndarray:
+        out = jnp.zeros((self.size,), dtype=self.dtype)
+        return out.at[payload["indices"]].set(payload["values"])
+
+    def payload_nbytes(self) -> int:
+        return self.k * (4 + np.dtype(self.dtype).itemsize)

@@ -37,12 +37,37 @@ def _make(inner: optax.GradientTransformation, axes: Tuple[str, ...],
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+def _make_compressed(inner: optax.GradientTransformation, axes: Tuple[str, ...],
+                     average: bool, partition_bytes: int,
+                     compression: dict, min_compress_bytes: int):
+    from .ops.compression.reducer import CompressionPlan
+    plan_holder = {}
+
+    def init_fn(params):
+        plan = CompressionPlan.for_tree(params, partition_bytes,
+                                        {k: str(v) for k, v in compression.items()},
+                                        min_compress_bytes)
+        plan_holder["plan"] = plan
+        return {"inner": inner.init(params), "comp": plan.init_state()}
+
+    def update_fn(grads, state, params=None, **extra):
+        plan = plan_holder["plan"]
+        grads, comp_state = plan.reduce_tree(grads, state["comp"], axes,
+                                             average=average)
+        updates, inner_state = inner.update(grads, state["inner"], params, **extra)
+        return updates, {"inner": inner_state, "comp": comp_state}
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def distributed_optimizer(inner: optax.GradientTransformation,
                           axes: Sequence[str] = ("data",),
                           average: bool = True,
                           partition_bytes: int = 4 << 20,
                           backward_passes_per_step: int = 1,
-                          reducer: Reducer = psum_reducer):
+                          reducer: Reducer = psum_reducer,
+                          compression: dict | None = None,
+                          min_compress_bytes: int = 65536):
     """Wrap an optax transformation with cross-replica gradient sync.
 
     ``backward_passes_per_step > 1`` accumulates locally and only
@@ -50,8 +75,18 @@ def distributed_optimizer(inner: optax.GradientTransformation,
     torch/__init__.py:83-113) — implemented with optax.MultiSteps so the
     allreduce itself sits under the every-k branch and no bandwidth is
     spent on intermediate passes.
+
+    ``compression`` is a string-kwargs dict in the reference's format
+    (docs/gradient-compression.md "Interface"), e.g.
+    ``{"compressor_type": "onebit", "compressor_onebit_scaling": "true",
+    "ef_type": "vanilla"}``; buckets under ``min_compress_bytes`` skip
+    compression (reference: BYTEPS_MIN_COMPRESS_BYTES).
     """
-    gt = _make(inner, tuple(axes), average, partition_bytes, reducer)
+    if compression:
+        gt = _make_compressed(inner, tuple(axes), average, partition_bytes,
+                              compression, min_compress_bytes)
+    else:
+        gt = _make(inner, tuple(axes), average, partition_bytes, reducer)
     if backward_passes_per_step > 1:
         gt = optax.MultiSteps(gt, every_k_schedule=backward_passes_per_step)
     return gt

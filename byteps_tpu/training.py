@@ -47,18 +47,25 @@ class DistributedTrainer:
                  mesh: Optional[Mesh] = None, partition_bytes: Optional[int] = None,
                  backward_passes_per_step: int = 1,
                  reducer: Reducer = psum_reducer,
+                 compression: Optional[dict] = None,
+                 min_compress_bytes: Optional[int] = None,
                  donate: bool = True) -> None:
         if mesh is None:
             mesh = GlobalState.get().mesh if GlobalState.initialized() else make_mesh()
         if partition_bytes is None:
             partition_bytes = (GlobalState.get().config.partition_bytes
                                if GlobalState.initialized() else 4 << 20)
+        if min_compress_bytes is None:
+            min_compress_bytes = (GlobalState.get().config.min_compress_bytes
+                                  if GlobalState.initialized() else 65536)
         self.mesh = mesh
         self.axes = data_axes(mesh)
         self.tx = distributed_optimizer(tx, axes=self.axes,
                                         partition_bytes=partition_bytes,
                                         backward_passes_per_step=backward_passes_per_step,
-                                        reducer=reducer)
+                                        reducer=reducer,
+                                        compression=compression,
+                                        min_compress_bytes=min_compress_bytes)
         replicated = NamedSharding(mesh, P())
         # Copy (not alias) into the trainer: the step donates its param
         # buffers, and device_put aliases when the sharding already matches —
