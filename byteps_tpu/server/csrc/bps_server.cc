@@ -1,0 +1,427 @@
+// bps_server: host-side key-value reduction service.
+//
+// Native equivalent of the reference's BytePS server (reference:
+// byteps/server/server.cc — KVServer request handler + multi-threaded
+// summation engine; queue.h priority queues; cpu_reducer.cc typed
+// summation). On TPU this is the host-offload aggregation shard used for
+// cross-slice (DCN) reduction and for async-PS mode, fed from device HBM
+// via the Python bindings (server/engine.py) instead of ps-lite RDMA.
+//
+// Same capabilities, redesigned:
+//   - per-key double buffer (accumulate vs serve) instead of parked pull
+//     request queues (server.cc:371-404): pulls block on a condition
+//     variable until the round completes, next round's pushes never
+//     corrupt in-flight pulls;
+//   - sticky least-loaded key→engine-thread assignment (server.h:149-173);
+//   - optional priority scheduling: keys with more pushes outstanding are
+//     summed first, unblocking waiters sooner (BYTEPS_SERVER_ENABLE_SCHEDULE,
+//     queue.h heap compare);
+//   - sync mode: first push copies, later pushes sum, all-workers-pushed
+//     publishes (server.cc:290-369 COPY_FIRST/SUM_RECV/ALL_RECV);
+//   - async mode: pushes sum immediately into the store, pulls never wait
+//     (server.cc:310-314, BYTEPS_ENABLE_ASYNC).
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <memory>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum DType : int { F32 = 0, F64 = 1, I32 = 2, I64 = 3, F16 = 4, BF16 = 5, U8 = 6 };
+
+inline size_t dtype_size(int d) {
+  switch (d) {
+    case F64: case I64: return 8;
+    case F32: case I32: return 4;
+    case F16: case BF16: return 2;
+    default: return 1;
+  }
+}
+
+// ---- half-precision scalar conversions (role of reference half.h) ----
+inline float half_to_float(uint16_t h) {
+  uint32_t sign = (uint32_t)(h & 0x8000) << 16;
+  uint32_t exp = (h >> 10) & 0x1F;
+  uint32_t man = h & 0x3FF;
+  uint32_t bits;
+  if (exp == 0) {
+    if (man == 0) {
+      bits = sign;
+    } else {  // subnormal
+      exp = 127 - 15 + 1;
+      while ((man & 0x400) == 0) { man <<= 1; exp--; }
+      man &= 0x3FF;
+      bits = sign | (exp << 23) | (man << 13);
+    }
+  } else if (exp == 31) {
+    bits = sign | 0x7F800000 | (man << 13);
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (man << 13);
+  }
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+inline uint16_t float_to_half(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint32_t sign = (bits >> 16) & 0x8000;
+  int32_t exp = (int32_t)((bits >> 23) & 0xFF) - 127 + 15;
+  uint32_t man = bits & 0x7FFFFF;
+  if (exp <= 0) return (uint16_t)sign;               // flush to zero
+  if (exp >= 31) return (uint16_t)(sign | 0x7C00);   // inf
+  return (uint16_t)(sign | (exp << 10) | (man >> 13));
+}
+
+inline float bf16_to_float(uint16_t h) {
+  uint32_t bits = (uint32_t)h << 16;
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+inline uint16_t float_to_bf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  // round-to-nearest-even
+  uint32_t lsb = (bits >> 16) & 1;
+  bits += 0x7FFF + lsb;
+  return (uint16_t)(bits >> 16);
+}
+
+// ---- typed summation: dst += src (role of reference cpu_reducer.cc) ----
+template <typename T>
+void sum_typed(T* dst, const T* src, size_t n) {
+#pragma omp parallel for simd
+  for (size_t i = 0; i < n; ++i) dst[i] += src[i];
+}
+
+void reduce_sum(void* dst, const void* src, size_t nbytes, int dtype) {
+  switch (dtype) {
+    case F32: sum_typed((float*)dst, (const float*)src, nbytes / 4); break;
+    case F64: sum_typed((double*)dst, (const double*)src, nbytes / 8); break;
+    case I32: sum_typed((int32_t*)dst, (const int32_t*)src, nbytes / 4); break;
+    case I64: sum_typed((int64_t*)dst, (const int64_t*)src, nbytes / 8); break;
+    case F16: {
+      size_t n = nbytes / 2;
+      uint16_t* d = (uint16_t*)dst;
+      const uint16_t* s = (const uint16_t*)src;
+#pragma omp parallel for
+      for (size_t i = 0; i < n; ++i)
+        d[i] = float_to_half(half_to_float(d[i]) + half_to_float(s[i]));
+      break;
+    }
+    case BF16: {
+      size_t n = nbytes / 2;
+      uint16_t* d = (uint16_t*)dst;
+      const uint16_t* s = (const uint16_t*)src;
+#pragma omp parallel for
+      for (size_t i = 0; i < n; ++i)
+        d[i] = float_to_bf16(bf16_to_float(d[i]) + bf16_to_float(s[i]));
+      break;
+    }
+    default: {  // U8: saturating nonsense is worse than wrap; plain add
+      uint8_t* d = (uint8_t*)dst;
+      const uint8_t* s = (const uint8_t*)src;
+      for (size_t i = 0; i < nbytes; ++i) d[i] += s[i];
+    }
+  }
+}
+
+// ---- key store ----
+struct KeyStore {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<char> merged;  // published result, served by pulls
+  std::vector<char> accum;   // in-progress round accumulation (sync mode)
+  size_t len = 0;
+  int dtype = F32;
+  int push_count = 0;   // engine-applied pushes this round
+  int pull_count = 0;   // pulls served since publish
+  uint64_t round = 0;   // published rounds
+  bool ready = false;   // merged holds a publishable round result
+  int tid = 0;          // sticky engine thread
+  int enqueued = 0;     // pushes enqueued since init; round-relative
+};
+
+struct Task {
+  uint64_t key;
+  std::vector<char> data;  // owned copy of the pushed payload
+  bool first;              // COPY_FIRST vs SUM_RECV
+};
+
+class Server;
+
+class EngineThread {
+ public:
+  explicit EngineThread(Server* srv, int id, bool schedule)
+      : srv_(srv), id_(id), schedule_(schedule),
+        thread_([this] { Run(); }) {}
+
+  ~EngineThread() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    thread_.join();
+  }
+
+  void Push(Task&& t) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      queue_.push_back(std::move(t));
+    }
+    cv_.notify_one();
+  }
+
+  std::atomic<uint64_t> assigned_bytes{0};
+
+ private:
+  void Run();
+  size_t PickNext();  // index into queue_, priority-aware
+
+  Server* srv_;
+  int id_;
+  bool schedule_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<Task> queue_;
+  bool stop_ = false;
+  std::thread thread_;
+};
+
+class Server {
+ public:
+  Server(int num_workers, int num_threads, bool schedule, bool async_mode)
+      : num_workers_(num_workers), async_(async_mode) {
+    for (int i = 0; i < num_threads; ++i)
+      engines_.emplace_back(new EngineThread(this, i, schedule));
+  }
+
+  ~Server() { engines_.clear(); }
+
+  int InitKey(uint64_t key, uint64_t nbytes, int dtype, const void* init) {
+    std::lock_guard<std::mutex> lk(map_mu_);
+    auto& ks = stores_[key];  // creates
+    std::lock_guard<std::mutex> klk(ks.mu);
+    ks.len = nbytes;
+    ks.dtype = dtype;
+    ks.merged.assign(nbytes, 0);
+    ks.accum.assign(nbytes, 0);
+    ks.push_count = ks.pull_count = 0;
+    ks.enqueued = 0;
+    ks.round = 0;
+    // sticky least-loaded thread assignment (reference: server.h:149-173)
+    int best = 0;
+    uint64_t best_load = UINT64_MAX;
+    for (size_t i = 0; i < engines_.size(); ++i) {
+      uint64_t l = engines_[i]->assigned_bytes.load();
+      if (l < best_load) { best_load = l; best = (int)i; }
+    }
+    ks.tid = best;
+    engines_[best]->assigned_bytes += nbytes;
+    if (init != nullptr) {
+      std::memcpy(ks.merged.data(), init, nbytes);
+      ks.ready = true;   // store initialized: async pulls may proceed
+    } else {
+      ks.ready = false;
+    }
+    return 0;
+  }
+
+  KeyStore* Find(uint64_t key) {
+    std::lock_guard<std::mutex> lk(map_mu_);
+    auto it = stores_.find(key);
+    return it == stores_.end() ? nullptr : &it->second;
+  }
+
+  int Push(uint64_t key, const void* data, uint64_t nbytes) {
+    KeyStore* ks = Find(key);
+    if (ks == nullptr || nbytes != ks->len) return -1;
+    bool first;
+    {
+      // first-of-round is positional: each worker pushes exactly once per
+      // round (the reference's contract — updates.request.size() counts)
+      std::lock_guard<std::mutex> lk(ks->mu);
+      first = (ks->enqueued % num_workers_) == 0;
+      ks->enqueued++;
+    }
+    Task t;
+    t.key = key;
+    t.first = first && !async_;
+    t.data.assign((const char*)data, (const char*)data + nbytes);
+    engines_[ks->tid]->Push(std::move(t));
+    return 0;
+  }
+
+  // engine-thread callback: apply one task
+  void Apply(Task& t) {
+    KeyStore* ks = Find(t.key);
+    if (ks == nullptr) return;
+    std::unique_lock<std::mutex> lk(ks->mu);
+    if (async_) {
+      // async: sum straight into the served store, no rounds
+      reduce_sum(ks->merged.data(), t.data.data(), ks->len, ks->dtype);
+      ks->ready = true;
+      ks->round++;
+      lk.unlock();
+      ks->cv.notify_all();
+      return;
+    }
+    if (t.first) {
+      std::memcpy(ks->accum.data(), t.data.data(), ks->len);
+    } else {
+      reduce_sum(ks->accum.data(), t.data.data(), ks->len, ks->dtype);
+    }
+    ks->push_count++;
+    if (ks->push_count == num_workers_) {
+      ks->merged.swap(ks->accum);
+      ks->push_count = 0;
+      ks->ready = true;
+      ks->round++;
+      lk.unlock();
+      ks->cv.notify_all();
+    }
+  }
+
+  // Pull round ``want_round`` (1-based). 0 means "latest published".
+  // Round-numbered pulls replace the reference's per-sender response
+  // tracking (server.cc:371-404 seen_sender_): each worker pulls the round
+  // it just contributed to, so a fast worker can never be served a stale
+  // round twice and a slow worker's round cannot be overwritten (the next
+  // publish needs every worker's push, which follows their pull).
+  int Pull(uint64_t key, void* dst, uint64_t nbytes, uint64_t want_round,
+           int timeout_ms) {
+    KeyStore* ks = Find(key);
+    if (ks == nullptr || nbytes > ks->len) return -1;
+    std::unique_lock<std::mutex> lk(ks->mu);
+    if (async_) {
+      if (!ks->ready) return -3;  // async pull before init
+      std::memcpy(dst, ks->merged.data(), nbytes);
+      return 0;
+    }
+    uint64_t want = want_round == 0 ? (ks->round > 0 ? ks->round : 1)
+                                    : want_round;
+    bool ok = ks->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                              [&] { return ks->round >= want; });
+    if (!ok) return -2;  // timeout
+    std::memcpy(dst, ks->merged.data(), nbytes);
+    return 0;
+  }
+
+  uint64_t Round(uint64_t key) {
+    KeyStore* ks = Find(key);
+    if (ks == nullptr) return 0;
+    std::lock_guard<std::mutex> lk(ks->mu);
+    return ks->round;
+  }
+
+  int PushCount(uint64_t key) {
+    KeyStore* ks = Find(key);
+    if (ks == nullptr) return -1;
+    std::lock_guard<std::mutex> lk(ks->mu);
+    return ks->push_count;
+  }
+
+  uint64_t EngineLoad(int tid) {
+    if (tid < 0 || (size_t)tid >= engines_.size()) return 0;
+    return engines_[(size_t)tid]->assigned_bytes.load();
+  }
+
+  int KeyThread(uint64_t key) {
+    KeyStore* ks = Find(key);
+    return ks == nullptr ? -1 : ks->tid;
+  }
+
+  int num_workers_;
+  bool async_;
+  std::mutex map_mu_;
+  std::unordered_map<uint64_t, KeyStore> stores_;
+  std::vector<std::unique_ptr<EngineThread>> engines_;
+};
+
+size_t EngineThread::PickNext() {
+  if (!schedule_ || queue_.size() == 1) return 0;
+  // priority: the key with the most pushes already applied this round is
+  // closest to publishing — run its tasks first (reference: queue.h
+  // compare on push_cnt under BYTEPS_SERVER_ENABLE_SCHEDULE)
+  size_t best = 0;
+  int best_cnt = -1;
+  for (size_t i = 0; i < queue_.size(); ++i) {
+    int c = srv_->PushCount(queue_[i].key);
+    if (c > best_cnt) { best_cnt = c; best = i; }
+  }
+  return best;
+}
+
+void EngineThread::Run() {
+  for (;;) {
+    Task t;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_.wait(lk, [this] { return stop_ || !queue_.empty(); });
+      if (stop_ && queue_.empty()) return;
+      size_t idx = PickNext();
+      t = std::move(queue_[idx]);
+      queue_.erase(queue_.begin() + idx);
+    }
+    srv_->Apply(t);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* bps_server_create(int num_workers, int num_threads, int enable_schedule,
+                        int async_mode) {
+  if (num_workers <= 0 || num_threads <= 0) return nullptr;
+  return new Server(num_workers, num_threads, enable_schedule != 0,
+                    async_mode != 0);
+}
+
+void bps_server_destroy(void* h) { delete (Server*)h; }
+
+int bps_server_init_key(void* h, uint64_t key, uint64_t nbytes, int dtype,
+                        const void* init) {
+  return ((Server*)h)->InitKey(key, nbytes, dtype, init);
+}
+
+int bps_server_push(void* h, uint64_t key, const void* data, uint64_t nbytes) {
+  return ((Server*)h)->Push(key, data, nbytes);
+}
+
+int bps_server_pull(void* h, uint64_t key, void* dst, uint64_t nbytes,
+                    uint64_t want_round, int timeout_ms) {
+  return ((Server*)h)->Pull(key, dst, nbytes, want_round, timeout_ms);
+}
+
+uint64_t bps_server_round(void* h, uint64_t key) {
+  return ((Server*)h)->Round(key);
+}
+
+uint64_t bps_server_engine_load(void* h, int tid) {
+  return ((Server*)h)->EngineLoad(tid);
+}
+
+int bps_server_key_thread(void* h, uint64_t key) {
+  return ((Server*)h)->KeyThread(key);
+}
+
+// standalone typed reducer, exposed for tests and host-side reuse
+// (reference: cpu_reducer.cc sum)
+void bps_reduce_sum(void* dst, const void* src, uint64_t nbytes, int dtype) {
+  reduce_sum(dst, src, nbytes, dtype);
+}
+
+}  // extern "C"

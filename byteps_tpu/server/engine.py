@@ -1,0 +1,186 @@
+"""Python bindings for the native host reduction service.
+
+The reference loads its server as a ctypes CDLL from ``import
+byteps.server`` (reference: server/__init__.py:21-27); we do the same for
+``libbps_server.so`` (built from csrc/ via make — no pip/pybind needed).
+
+``PSServer`` is the per-process server shard; ``HostPSBackend`` drives a
+set of shards from the worker side, giving push_pull a PS route: device →
+host numpy → sharded key stores (placement by the same key hash as the
+reference, byteps_tpu.common.naming.place_key) → summation engine → pull →
+device. This models the reference's CPU-server bandwidth story and powers
+async-PS mode (weight-delta push / fresh-weight pull, no worker barrier;
+reference: BYTEPS_ENABLE_ASYNC, torch/__init__.py:186-214).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+_DTYPES = {"float32": 0, "float64": 1, "int32": 2, "int64": 3,
+           "float16": 4, "bfloat16": 5, "uint8": 6}
+
+_LIB: Optional[ctypes.CDLL] = None
+
+
+def _lib() -> ctypes.CDLL:
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    here = os.path.join(os.path.dirname(__file__), "csrc")
+    so = os.path.join(here, "libbps_server.so")
+    if not os.path.exists(so):
+        subprocess.run(["make", "-C", here], check=True,
+                       capture_output=True)
+    lib = ctypes.CDLL(so)
+    lib.bps_server_create.restype = ctypes.c_void_p
+    lib.bps_server_create.argtypes = [ctypes.c_int] * 4
+    lib.bps_server_destroy.argtypes = [ctypes.c_void_p]
+    lib.bps_server_init_key.restype = ctypes.c_int
+    lib.bps_server_init_key.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
+        ctypes.c_void_p]
+    lib.bps_server_push.restype = ctypes.c_int
+    lib.bps_server_push.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64]
+    lib.bps_server_pull.restype = ctypes.c_int
+    lib.bps_server_pull.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.c_uint64, ctypes.c_int]
+    lib.bps_server_round.restype = ctypes.c_uint64
+    lib.bps_server_round.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.bps_server_engine_load.restype = ctypes.c_uint64
+    lib.bps_server_engine_load.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.bps_server_key_thread.restype = ctypes.c_int
+    lib.bps_server_key_thread.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.bps_reduce_sum.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int]
+    _LIB = lib
+    return lib
+
+
+def reduce_sum_inplace(dst: np.ndarray, src: np.ndarray) -> None:
+    """dst += src via the native typed reducer (reference: CpuReducer::sum)."""
+    assert dst.dtype == src.dtype and dst.nbytes == src.nbytes
+    dt = _DTYPES[str(dst.dtype)]
+    _lib().bps_reduce_sum(dst.ctypes.data_as(ctypes.c_void_p),
+                          src.ctypes.data_as(ctypes.c_void_p),
+                          dst.nbytes, dt)
+
+
+class PSServer:
+    """One native server shard (reference: byteps_server(), server.cc:441-514)."""
+
+    def __init__(self, num_workers: int, engine_threads: int = 4,
+                 enable_schedule: bool = False, async_mode: bool = False):
+        self._lib = _lib()
+        self._h = self._lib.bps_server_create(
+            num_workers, engine_threads, int(enable_schedule), int(async_mode))
+        if not self._h:
+            raise RuntimeError("bps_server_create failed")
+        self.num_workers = num_workers
+        self.async_mode = async_mode
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.bps_server_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # noqa: D105
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def init_key(self, key: int, nbytes: int, dtype: str = "float32",
+                 init: Optional[np.ndarray] = None) -> None:
+        ptr = init.ctypes.data_as(ctypes.c_void_p) if init is not None else None
+        rc = self._lib.bps_server_init_key(self._h, key, nbytes,
+                                           _DTYPES[dtype], ptr)
+        if rc != 0:
+            raise RuntimeError(f"init_key({key}) failed rc={rc}")
+
+    def push(self, key: int, data: np.ndarray) -> None:
+        data = np.ascontiguousarray(data)
+        rc = self._lib.bps_server_push(
+            self._h, key, data.ctypes.data_as(ctypes.c_void_p), data.nbytes)
+        if rc != 0:
+            raise RuntimeError(f"push({key}) failed rc={rc} "
+                               f"(len mismatch or key not initialised)")
+
+    def pull(self, key: int, out: np.ndarray, round: int = 0,
+             timeout_ms: int = 30000) -> None:
+        """Pull round ``round`` (1-based; 0 = latest published). Sync-mode
+        callers should pass the round their push contributed to."""
+        rc = self._lib.bps_server_pull(
+            self._h, key, out.ctypes.data_as(ctypes.c_void_p), out.nbytes,
+            round, timeout_ms)
+        if rc == -2:
+            raise TimeoutError(f"pull({key}) round={round} timed out "
+                               f"after {timeout_ms}ms")
+        if rc != 0:
+            raise RuntimeError(f"pull({key}) failed rc={rc}")
+
+    def round(self, key: int) -> int:
+        return self._lib.bps_server_round(self._h, key)
+
+    def engine_load(self, tid: int) -> int:
+        return self._lib.bps_server_engine_load(self._h, tid)
+
+    def key_thread(self, key: int) -> int:
+        return self._lib.bps_server_key_thread(self._h, key)
+
+
+class HostPSBackend:
+    """Worker-side driver over sharded PSServer instances.
+
+    Keys are placed on shards by hash (reference: global.cc:628-677) via
+    ``place_key``. In-process shards model the colocated-server deployment
+    (reference: BYTEPS_ENABLE_IPC best-practice); the data path and engine
+    are identical for a networked deployment.
+    """
+
+    def __init__(self, num_servers: int = 1, num_workers: int = 1,
+                 engine_threads: int = 4, enable_schedule: bool = False,
+                 async_mode: bool = False, hash_fn: str = "djb2"):
+        self.servers = [PSServer(num_workers, engine_threads, enable_schedule,
+                                 async_mode)
+                        for _ in range(num_servers)]
+        self.hash_fn = hash_fn
+        self.async_mode = async_mode
+        self._rounds: Dict[int, int] = {}
+
+    def close(self) -> None:
+        for s in self.servers:
+            s.close()
+
+    def _shard(self, key: int) -> PSServer:
+        from ..common.naming import place_key
+        return self.servers[place_key(key, len(self.servers), self.hash_fn)]
+
+    def init_key(self, key: int, nbytes: int, dtype: str = "float32",
+                 init: Optional[np.ndarray] = None) -> None:
+        self._shard(key).init_key(key, nbytes, dtype, init)
+
+    def push(self, key: int, data: np.ndarray) -> None:
+        self._shard(key).push(key, data)
+
+    def pull(self, key: int, out: np.ndarray, round: int = 0,
+             timeout_ms: int = 30000) -> None:
+        self._shard(key).pull(key, out, round, timeout_ms)
+
+    def push_pull(self, key: int, data: np.ndarray,
+                  timeout_ms: int = 30000) -> np.ndarray:
+        """One sync round from a single-worker's perspective: push, then
+        pull the round this push completes (per-key local round counter)."""
+        self.push(key, data)
+        rnd = self._rounds.get(key, 0) + 1
+        self._rounds[key] = rnd
+        out = np.empty_like(data)
+        self.pull(key, out, rnd if not self.async_mode else 0, timeout_ms)
+        return out

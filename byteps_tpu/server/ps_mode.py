@@ -1,0 +1,122 @@
+"""PS-mode data paths: device ↔ host reduction service.
+
+Two modes, mirroring the reference's two PS deployments:
+
+  - **Sync** (``PSGradientExchange``): gradients already reduced over the
+    local ICI mesh hop to the host and are summed across worker processes
+    by the sharded key stores — the reference's steady-state push/pull
+    pipeline (core_loops.cc:538-618) with the ICI collective playing the
+    role of the intra-node NCCL stage. Buckets are pushed in priority
+    order and pulled in the same order, so the server sums bucket k while
+    bucket k+1 is still uploading (the reference's pipelining-by-partition,
+    operations.cc:140-180).
+
+  - **Async** (``AsyncPSWorker``): no worker barrier at all — each worker
+    pushes *weight deltas* and pulls fresh weights whenever it finishes a
+    local step (reference: BYTEPS_ENABLE_ASYNC server.cc:310-314; torch
+    `__init__.py`:186-214 pushing ``w_new - w_old``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..common.naming import NameRegistry
+from ..common.partition import LeafSpec, plan_buckets
+from .engine import HostPSBackend
+
+
+class PSGradientExchange:
+    """Sync-mode bucketed gradient exchange through the host PS service."""
+
+    def __init__(self, backend: HostPSBackend, partition_bytes: int = 4 << 20,
+                 registry: Optional[NameRegistry] = None) -> None:
+        self.backend = backend
+        self.partition_bytes = partition_bytes
+        self.registry = registry or NameRegistry()
+        self._plans: Dict = {}
+        self._round = 0
+
+    def _plan(self, tree):
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        key = (treedef, tuple((l.shape, str(l.dtype)) for l in leaves))
+        if key in self._plans:
+            return self._plans[key]
+        paths = [jax.tree_util.keystr(p)
+                 for p, _ in jax.tree_util.tree_leaves_with_path(tree)]
+        decl = self.registry.declare(paths[0].split("[")[0] or "grads")
+        specs = [LeafSpec(name=p, size=int(np.prod(l.shape)),
+                          dtype=str(np.dtype(l.dtype)))
+                 for p, l in zip(paths, leaves)]
+        buckets = plan_buckets(specs, self.partition_bytes, reverse_order=True)
+        # per-bucket PS keys: declared_key<<16 | bucket (reference:
+        # operations.cc:301-317)
+        keyed = [(decl.key_for_partition(b.index), b) for b in buckets]
+        for pskey, b in keyed:
+            nbytes = b.size * np.dtype(b.dtype).itemsize
+            self.backend.init_key(pskey, nbytes, b.dtype)
+        plan = (treedef, keyed)
+        self._plans[key] = plan
+        return plan
+
+    def exchange(self, tree):
+        """Push all buckets (priority order), then pull each — one sync
+        round. Returns the summed tree."""
+        treedef, keyed = self._plan(tree)
+        leaves, _ = jax.tree_util.tree_flatten(tree)
+        flat = [np.asarray(l).reshape(-1) for l in leaves]
+        self._round += 1
+        bufs = []
+        for pskey, b in keyed:
+            buf = np.empty(b.size, dtype=b.dtype)
+            for s in b.segments:
+                buf[s.bucket_offset:s.bucket_offset + s.length] = \
+                    flat[s.leaf_index][s.leaf_offset:s.leaf_offset + s.length]
+            self.backend.push(pskey, buf)
+            bufs.append(buf)
+        out = [f.copy() for f in flat]
+        for (pskey, b), buf in zip(keyed, bufs):
+            self.backend.pull(pskey, buf, round=self._round)
+            for s in b.segments:
+                out[s.leaf_index][s.leaf_offset:s.leaf_offset + s.length] = \
+                    buf[s.bucket_offset:s.bucket_offset + s.length]
+        shaped = [o.reshape(l.shape) for o, l in zip(out, leaves)]
+        return jax.tree_util.tree_unflatten(treedef, shaped)
+
+
+class AsyncPSWorker:
+    """Async-PS training worker: local step + weight-delta push + fresh
+    weight pull, no inter-worker barrier."""
+
+    def __init__(self, backend: HostPSBackend, params, name: str = "model",
+                 init_store: bool = True) -> None:
+        self.backend = backend
+        leaves, self.treedef = jax.tree_util.tree_flatten(params)
+        self.shapes = [l.shape for l in leaves]
+        self.dtypes = [str(np.dtype(l.dtype)) for l in leaves]
+        self.sizes = [int(np.prod(l.shape)) for l in leaves]
+        self.keys = list(range(len(leaves)))
+        if init_store:
+            for k, l in zip(self.keys, leaves):
+                arr = np.ascontiguousarray(np.asarray(l).reshape(-1))
+                self.backend.init_key(k, arr.nbytes, str(arr.dtype), init=arr)
+
+    def pull_weights(self):
+        outs = []
+        for k, n, dt, shp in zip(self.keys, self.sizes, self.dtypes, self.shapes):
+            buf = np.empty(n, dtype=dt)
+            self.backend.pull(k, buf)
+            outs.append(buf.reshape(shp))
+        return jax.tree_util.tree_unflatten(self.treedef, outs)
+
+    def push_delta(self, new_params, old_params):
+        """Push w_new - w_old; the server accumulates deltas into the
+        global weights (reference: async push of ``w - prev_w``)."""
+        new_l = jax.tree_util.tree_leaves(new_params)
+        old_l = jax.tree_util.tree_leaves(old_params)
+        for k, nw, od in zip(self.keys, new_l, old_l):
+            delta = np.asarray(nw).reshape(-1) - np.asarray(od).reshape(-1)
+            self.backend.push(k, np.ascontiguousarray(delta))
