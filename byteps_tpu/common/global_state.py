@@ -41,6 +41,7 @@ class GlobalState:
             self.mesh, partition_bytes=config.partition_bytes,
             registry=self.registry, telemetry=self.telemetry)
         self.engine.timeline = self.timeline
+        self.engine.debug_sample = config.debug_sample_tensor
         self.dp = dp_size(self.mesh)
         self.step = 0
         log.info("BPS init: role=%s mesh=%s dp=%d partition_bytes=%d",

@@ -1,0 +1,143 @@
+"""bpslaunch-tpu: process launcher (reference: launcher/launch.py —
+bpslaunch reads DMLC_ROLE, spawns per-GPU workers with BYTEPS_LOCAL_RANK
+set, numactl-pins them, and execs servers/schedulers; launcher/
+dist_launcher.py SSHes to hosts propagating DMLC_* env).
+
+TPU-native differences:
+  - one JAX process per *host* drives all local chips, so there is no
+    per-GPU fanout; the launcher's job is to resolve the process's place
+    in the job (process_id / num_processes / coordinator) and exec the
+    training script with BPS_* env set.
+  - rendezvous is jax.distributed's coordinator (no scheduler role); TPU
+    pod metadata supplies topology when present, with env-var overrides
+    (same precedence model as the reference's env contract).
+  - optional numactl pinning survives (useful for the host PS service:
+    BPS_NUMA_ON, reference launcher/launch.py:44-122).
+  - ``--server`` runs a standalone host reduction server process
+    (reference: python3 -c 'import byteps.server').
+
+Usage:
+  bpslaunch-tpu [--coordinator HOST:PORT] [--num-processes N]
+                [--process-id I] [--numa] [--server] -- CMD [ARGS...]
+  bpslaunch-tpu --hosts h1,h2,... -- CMD [ARGS...]      # SSH fan-out
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import shutil
+import subprocess
+import sys
+from typing import List, Optional
+
+
+def _tpu_metadata_env() -> dict:
+    """Topology from TPU pod metadata env (set by the TPU runtime), with
+    graceful fallback to single-process."""
+    env = {}
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    worker_id = os.environ.get("TPU_WORKER_ID", os.environ.get("CLOUD_TPU_TASK_ID"))
+    if hostnames and worker_id is not None:
+        hosts = [h for h in hostnames.split(",") if h]
+        env["BPS_NUM_PROCESSES"] = str(len(hosts))
+        env["BPS_PROCESS_ID"] = str(worker_id)
+        port = os.environ.get("BPS_COORDINATOR_PORT", "8476")
+        env["BPS_COORDINATOR_ADDRESS"] = f"{hosts[0]}:{port}"
+    return env
+
+
+def build_env(args) -> dict:
+    env = dict(os.environ)
+    env.update(_tpu_metadata_env())
+    if args.coordinator:
+        env["BPS_COORDINATOR_ADDRESS"] = args.coordinator
+    if args.num_processes is not None:
+        env["BPS_NUM_PROCESSES"] = str(args.num_processes)
+    if args.process_id is not None:
+        env["BPS_PROCESS_ID"] = str(args.process_id)
+    env.setdefault("BPS_ROLE", "server" if args.server else "worker")
+    return env
+
+
+def numa_prefix(enabled: bool) -> List[str]:
+    """numactl pinning for the host-side services (reference:
+    launcher/launch.py:44-122 NUMA binding)."""
+    if not enabled or shutil.which("numactl") is None:
+        return []
+    node = os.environ.get("BPS_NUMA_NODE", "0")
+    return ["numactl", f"--cpunodebind={node}", f"--membind={node}"]
+
+
+def run_local(args, cmd: List[str]) -> int:
+    env = build_env(args)
+    if args.server:
+        # standalone reduction server (reference: byteps.server import)
+        from ..server.engine import PSServer
+        import signal
+        import time
+        n = int(env.get("BPS_NUM_PROCESSES", "1"))
+        srv = PSServer(num_workers=n,
+                       engine_threads=int(env.get("BPS_SERVER_ENGINE_THREAD", "4")),
+                       enable_schedule=env.get("BPS_SERVER_ENABLE_SCHEDULE", "") == "1",
+                       async_mode=env.get("BPS_ENABLE_ASYNC", "") == "1")
+        print(f"[bpslaunch-tpu] server up (workers={n}); Ctrl-C to stop",
+              file=sys.stderr)
+        stop = []
+        signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+        try:
+            while not stop:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+        srv.close()
+        return 0
+    full = numa_prefix(args.numa) + cmd
+    return subprocess.call(full, env=env)
+
+
+def run_ssh(args, cmd: List[str]) -> int:
+    """SSH fan-out (reference: launcher/dist_launcher.py)."""
+    hosts = [h for h in args.hosts.split(",") if h]
+    coordinator = args.coordinator or f"{hosts[0]}:8476"
+    procs = []
+    for i, host in enumerate(hosts):
+        envs = " ".join([
+            f"BPS_COORDINATOR_ADDRESS={shlex.quote(coordinator)}",
+            f"BPS_NUM_PROCESSES={len(hosts)}",
+            f"BPS_PROCESS_ID={i}",
+        ])
+        remote = f"cd {shlex.quote(os.getcwd())} && {envs} {' '.join(map(shlex.quote, cmd))}"
+        procs.append(subprocess.Popen(["ssh", "-o", "StrictHostKeyChecking=no",
+                                       host, remote]))
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="bpslaunch-tpu", description=__doc__)
+    parser.add_argument("--coordinator", help="coordinator HOST:PORT")
+    parser.add_argument("--num-processes", type=int)
+    parser.add_argument("--process-id", type=int)
+    parser.add_argument("--hosts", help="comma-separated hosts for SSH fan-out")
+    parser.add_argument("--numa", action="store_true", help="numactl pinning")
+    parser.add_argument("--server", action="store_true",
+                        help="run a standalone host reduction server")
+    parser.add_argument("cmd", nargs=argparse.REMAINDER,
+                        help="-- command to launch")
+    args = parser.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd and not args.server:
+        parser.error("no command given")
+    if args.hosts:
+        return run_ssh(args, cmd)
+    return run_local(args, cmd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

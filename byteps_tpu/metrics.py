@@ -1,0 +1,43 @@
+"""Metric averaging across replicas (reference: the Keras
+MetricAverageCallback, _keras/callbacks.py:68-114 — push_pulls each metric
+at epoch end). Here a single helper that averages a pytree of scalars over
+the data axes, usable eagerly or in-jit."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .common.global_state import GlobalState
+
+
+def average_metrics(metrics):
+    """Average scalar metrics across all data-parallel replicas.
+
+    Eager form: values are host scalars/arrays holding per-process values;
+    with a single controller they are already global, so this is the
+    identity unless a PS backend spans processes — kept for API parity and
+    multi-process deployments.
+    """
+    gs = GlobalState.get()
+    if gs.dp <= 1:
+        return metrics
+    # stack-convention tree: leading replica axis → mean over it
+    def avg(x):
+        x = jnp.asarray(x)
+        if x.ndim >= 1 and x.shape[0] == gs.dp:
+            return x.mean(axis=0)
+        return x
+    return jax.tree_util.tree_map(avg, metrics)
+
+
+def allreduce_metric(value, axes=("data",), average: bool = True):
+    """In-jit metric reduction (use inside your shard_map'd eval step)."""
+    v = jax.lax.psum(value, tuple(axes))
+    if average:
+        n = 1
+        for ax in axes:
+            n *= jax.lax.axis_size(ax)
+        v = v / n
+    return v

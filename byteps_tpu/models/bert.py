@@ -1,0 +1,55 @@
+"""BERT model family — the flagship benchmark config (reference headline:
+BERT-large scaling on 256 GPUs, README.md:37-44).
+
+MLM objective on the shared transformer core. ``bert_large()`` matches the
+reference benchmark's geometry (24×1024×16, seq 512, mixed precision).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import (TransformerConfig, apply, init_params, lm_loss,
+                          logits, param_specs)
+
+
+def bert_config(hidden=1024, layers=24, heads=16, vocab_size=30522,
+                max_seq=512, dtype="bfloat16", **kw) -> TransformerConfig:
+    return TransformerConfig(vocab_size=vocab_size, hidden=hidden,
+                             layers=layers, heads=heads, mlp_dim=4 * hidden,
+                             max_seq=max_seq, causal=False, dtype=dtype, **kw)
+
+
+def bert_large(**kw) -> TransformerConfig:
+    return bert_config(hidden=1024, layers=24, heads=16, **kw)
+
+
+def bert_base(**kw) -> TransformerConfig:
+    return bert_config(hidden=768, layers=12, heads=12, **kw)
+
+
+def bert_tiny(**kw) -> TransformerConfig:
+    """Test-sized config."""
+    return bert_config(hidden=64, layers=2, heads=4, vocab_size=128,
+                       max_seq=64, dtype="float32", remat=False, **kw)
+
+
+def mlm_loss(params, cfg: TransformerConfig, batch):
+    """batch = (masked_tokens, targets) with targets < 0 at unmasked
+    positions (standard MLM convention)."""
+    return lm_loss(params, cfg, batch)
+
+
+def synth_mlm_batch(rng: np.random.RandomState, batch: int, seq: int,
+                    vocab: int, mask_frac: float = 0.15, mask_id: int = 0):
+    """Synthetic MLM data (the reference benchmarks use synthetic inputs,
+    example/pytorch/benchmark_byteps.py)."""
+    tokens = rng.randint(1, vocab, size=(batch, seq)).astype(np.int32)
+    mask = rng.rand(batch, seq) < mask_frac
+    targets = np.where(mask, tokens, -1).astype(np.int32)
+    masked = np.where(mask, mask_id, tokens).astype(np.int32)
+    return masked, targets

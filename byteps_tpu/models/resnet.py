@@ -1,0 +1,106 @@
+"""ResNet family (reference benchmark config: ResNet-50 ImageNet,
+docs/performance.md:3-12). Pure-JAX functional implementation; convs lower
+straight onto the MXU via XLA. BatchNorm uses batch statistics (training
+mode); gradients for the affine params flow normally.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# stage plan: (blocks, channels) per stage; ResNet-50 uses bottleneck blocks
+RESNET50_STAGES = [(3, 256), (4, 512), (6, 1024), (3, 2048)]
+RESNET18_STAGES = [(2, 64), (2, 128), (2, 256), (2, 512)]
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    fan_in = kh * kw * cin
+    return jax.random.normal(key, (kh, kw, cin, cout)) * np.sqrt(2.0 / fan_in)
+
+
+def _conv(x, w, stride=1, padding="SAME"):
+    return jax.lax.conv_general_dilated(
+        x, w, (stride, stride), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _bn(x, p, eps=1e-5):
+    mu = x.mean(axis=(0, 1, 2), keepdims=True)
+    var = x.var(axis=(0, 1, 2), keepdims=True)
+    xn = (x - mu) * jax.lax.rsqrt(var + eps)
+    return xn * p["scale"] + p["bias"]
+
+
+def _bn_init(c):
+    return {"scale": jnp.ones((c,)), "bias": jnp.zeros((c,))}
+
+
+def init_resnet50(rng, num_classes: int = 1000, stages=None):
+    stages = stages or RESNET50_STAGES
+    keys = iter(jax.random.split(rng, 200))
+    params = {"stem": {"conv": _conv_init(next(keys), 7, 7, 3, 64),
+                       "bn": _bn_init(64)},
+              "stages": [], "fc_w": None, "fc_b": None}
+    cin = 64
+    for si, (blocks, cout) in enumerate(stages):
+        stage = []
+        for bi in range(blocks):
+            stride = 2 if (bi == 0 and si > 0) else 1
+            mid = cout // 4
+            blk = {
+                "conv1": _conv_init(next(keys), 1, 1, cin, mid), "bn1": _bn_init(mid),
+                "conv2": _conv_init(next(keys), 3, 3, mid, mid), "bn2": _bn_init(mid),
+                "conv3": _conv_init(next(keys), 1, 1, mid, cout), "bn3": _bn_init(cout),
+            }
+            if cin != cout or stride != 1:
+                blk["proj"] = _conv_init(next(keys), 1, 1, cin, cout)
+                blk["proj_bn"] = _bn_init(cout)
+            stage.append(blk)
+            cin = cout
+        params["stages"].append(stage)
+    params["fc_w"] = jax.random.normal(next(keys), (cin, num_classes)) * 0.01
+    params["fc_b"] = jnp.zeros((num_classes,))
+    return params
+
+
+def _bottleneck(x, blk, stride):
+    out = jax.nn.relu(_bn(_conv(x, blk["conv1"]), blk["bn1"]))
+    out = jax.nn.relu(_bn(_conv(out, blk["conv2"], stride=stride), blk["bn2"]))
+    out = _bn(_conv(out, blk["conv3"]), blk["bn3"])
+    if "proj" in blk:
+        x = _bn(_conv(x, blk["proj"], stride=stride), blk["proj_bn"])
+    return jax.nn.relu(out + x)
+
+
+def resnet50_apply(params, x):
+    """x: [n, h, w, 3] → logits [n, classes]."""
+    x = _conv(x, params["stem"]["conv"], stride=2)
+    x = jax.nn.relu(_bn(x, params["stem"]["bn"]))
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+    for si, stage in enumerate(params["stages"]):
+        for bi, blk in enumerate(stage):
+            # stride 2 on the first block of stages 1+ (standard ResNet)
+            x = _bottleneck(x, blk, 2 if (bi == 0 and si > 0) else 1)
+    x = x.mean(axis=(1, 2))
+    return x @ params["fc_w"] + params["fc_b"]
+
+
+def resnet_loss(params, batch):
+    x, y = batch
+    lg = resnet50_apply(params, x)
+    logp = jax.nn.log_softmax(lg)
+    return -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
+
+
+def synth_imagenet_batch(rng: np.random.RandomState, n: int, size: int = 224,
+                         classes: int = 1000):
+    """Synthetic ImageNet-like data (reference: tests/utils.py fake_data)."""
+    x = rng.randn(n, size, size, 3).astype(np.float32)
+    y = rng.randint(0, classes, size=(n,)).astype(np.int32)
+    return x, y

@@ -1,0 +1,219 @@
+"""Transformer encoder/decoder core, TPU-first.
+
+The reference has no model code at all (it wraps torch/tf/mxnet models);
+its benchmark configs are BERT-large / GPT-2 style transformers
+(reference: README.md:37-44, example/pytorch/benchmark_byteps.py). Here
+the model zoo is part of the framework, built for the MXU:
+
+  - matmul-heavy blocks in bfloat16, fp32 accumulation for softmax/LN
+  - optional **tensor parallelism** over the ``model`` mesh axis,
+    Megatron-style: QKV and MLP-in are column-parallel (no comm), attn-out
+    and MLP-out are row-parallel (one psum each); heads divide across TP
+    ranks
+  - optional **sequence parallelism** over the ``seq`` axis via ring
+    attention (byteps_tpu.parallel.ring)
+  - ``param_specs`` returns the PartitionSpec tree so pjit/shard_map can
+    lay the weights out without a wrapper class
+  - ``jax.checkpoint`` on each block to trade FLOPs for HBM when training
+    deep configs
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.ring import local_attention, ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    hidden: int = 1024
+    layers: int = 24
+    heads: int = 16
+    mlp_dim: int = 4096
+    max_seq: int = 512
+    causal: bool = False          # False: BERT-style encoder; True: GPT
+    dtype: str = "bfloat16"       # compute dtype (params stay fp32)
+    remat: bool = True            # checkpoint each block
+    tp_axis: Optional[str] = None # mesh axis for tensor parallelism
+    sp_axis: Optional[str] = None # mesh axis for ring-attention seq shards
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.heads
+
+
+# ----------------------------------------------------------------- params
+
+def init_params(rng, cfg: TransformerConfig):
+    """Full (unsharded) parameter pytree; shard with param_specs."""
+    keys = jax.random.split(rng, cfg.layers + 3)
+    h, m = cfg.hidden, cfg.mlp_dim
+    sd = 0.02
+
+    def norm(key, shape):
+        return jax.random.normal(key, shape, dtype=jnp.float32) * sd
+
+    def one_block(key):
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        return {
+            "ln1": {"scale": jnp.ones((h,)), "bias": jnp.zeros((h,))},
+            # [h, 3, heads, head_dim] so TP shards whole heads, not a
+            # contiguous slice of the fused [q|k|v] columns
+            "qkv": norm(k1, (h, 3, cfg.heads, cfg.head_dim)),
+            "attn_out": norm(k2, (h, h)),
+            "ln2": {"scale": jnp.ones((h,)), "bias": jnp.zeros((h,))},
+            "mlp_in": norm(k3, (h, m)),
+            "mlp_in_b": jnp.zeros((m,)),
+            "mlp_out": norm(k4, (m, h)),
+            "mlp_out_b": jnp.zeros((h,)),
+        }
+
+    blocks = [one_block(keys[i + 2]) for i in range(cfg.layers)]
+    # stack per-layer params on a leading layer axis: the whole depth runs
+    # as one lax.scan, so compile time is O(1) in layer count
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
+    return {
+        "embed": {
+            "tok": norm(keys[0], (cfg.vocab_size, h)),
+            "pos": norm(keys[1], (cfg.max_seq, h)),
+        },
+        "blocks": stacked,
+        "final_ln": {"scale": jnp.ones((h,)), "bias": jnp.zeros((h,))},
+    }
+
+
+def param_specs(cfg: TransformerConfig):
+    """PartitionSpec tree matching init_params: column-parallel weights
+    shard their output dim on tp_axis, row-parallel their input dim."""
+    tp = cfg.tp_axis
+    rep = P()
+    lead = P(None)  # stacked layer axis is never sharded
+    block = {
+        "ln1": {"scale": lead, "bias": lead},
+        "qkv": P(None, None, None, tp, None),  # column parallel over heads
+        "attn_out": P(None, tp, None),         # row parallel
+        "ln2": {"scale": lead, "bias": lead},
+        "mlp_in": P(None, None, tp),
+        "mlp_in_b": P(None, tp),
+        "mlp_out": P(None, tp, None),
+        "mlp_out_b": lead,
+    }
+    return {
+        "embed": {"tok": rep, "pos": rep},
+        "blocks": block,
+        "final_ln": {"scale": rep, "bias": rep},
+    }
+
+
+# ----------------------------------------------------------------- layers
+
+def _layernorm(x, scale, bias, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    out = (x32 - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+    return out.astype(x.dtype)
+
+
+def _attention(x, blk, cfg: TransformerConfig, tp_size: int):
+    b, s, _ = x.shape
+    local_heads = cfg.heads // tp_size
+    qkv = jnp.einsum("bsh,hcnd->bscnd", x, blk["qkv"].astype(x.dtype))
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [b, s, lh, hd]
+    if cfg.sp_axis is not None:
+        out = ring_attention(q, k, v, cfg.sp_axis, causal=cfg.causal)
+    else:
+        out = local_attention(q, k, v, causal=cfg.causal)
+    out = out.reshape(b, s, local_heads * cfg.head_dim)
+    out = out @ blk["attn_out"].astype(x.dtype)   # row-parallel: partial sum
+    if cfg.tp_axis is not None:
+        out = jax.lax.psum(out, cfg.tp_axis)
+    return out
+
+
+def _mlp(x, blk, cfg: TransformerConfig):
+    hdt = x.dtype
+    h = x @ blk["mlp_in"].astype(hdt) + blk["mlp_in_b"].astype(hdt)
+    h = jax.nn.gelu(h)
+    out = h @ blk["mlp_out"].astype(hdt)          # row-parallel: partial sum
+    if cfg.tp_axis is not None:
+        out = jax.lax.psum(out, cfg.tp_axis)
+    return out + blk["mlp_out_b"].astype(hdt)
+
+
+def _block(x, blk, cfg: TransformerConfig, tp_size: int):
+    x = x + _attention(_layernorm(x, blk["ln1"]["scale"], blk["ln1"]["bias"]),
+                       blk, cfg, tp_size)
+    x = x + _mlp(_layernorm(x, blk["ln2"]["scale"], blk["ln2"]["bias"]),
+                 blk, cfg)
+    return x
+
+
+def apply(params, cfg: TransformerConfig, tokens: jnp.ndarray,
+          positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Forward to final hidden states [b, s_local, hidden].
+
+    Call inside shard_map when tp/sp axes are set. With sp_axis, ``tokens``
+    is the local sequence shard and ``positions`` must be the global
+    positions of that shard (defaults assume shard-contiguous layout).
+    """
+    dt = jnp.dtype(cfg.dtype)
+    b, s = tokens.shape
+    if positions is None:
+        if cfg.sp_axis is not None:
+            offset = jax.lax.axis_index(cfg.sp_axis) * s
+        else:
+            offset = 0
+        positions = offset + jnp.arange(s)
+    tp_size = jax.lax.axis_size(cfg.tp_axis) if cfg.tp_axis else 1
+    x = params["embed"]["tok"][tokens].astype(dt)
+    x = x + params["embed"]["pos"][positions].astype(dt)
+
+    blk_fn = partial(_block, cfg=cfg, tp_size=tp_size)
+    if cfg.remat:
+        blk_fn = jax.checkpoint(blk_fn)
+
+    def body(carry, blk):
+        return blk_fn(carry, blk), None
+
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+    x = _layernorm(x, params["final_ln"]["scale"], params["final_ln"]["bias"])
+    return x
+
+
+def logits(params, cfg: TransformerConfig, hidden: jnp.ndarray) -> jnp.ndarray:
+    """Tied-embedding LM head → [b, s, vocab] in fp32."""
+    return jnp.einsum("bsh,vh->bsv", hidden.astype(jnp.float32),
+                      params["embed"]["tok"].astype(jnp.float32))
+
+
+def lm_loss(params, cfg: TransformerConfig, batch) -> jnp.ndarray:
+    """Cross-entropy LM loss. batch = (tokens, targets); targets < 0 are
+    ignored (the MLM mask convention).
+
+    Under sequence parallelism the nll-sum and mask-count are psum'd over
+    the sp axis *before* dividing, so every rank holds the true global
+    loss — local-mean losses would weight shards with different mask
+    counts unevenly and bias the gradient."""
+    tokens, targets = batch
+    h = apply(params, cfg, tokens)
+    lg = logits(params, cfg, h)
+    logp = jax.nn.log_softmax(lg, axis=-1)
+    mask = (targets >= 0)
+    tgt = jnp.where(mask, targets, 0)
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    nll_sum = (nll * mask).sum()
+    cnt = mask.sum().astype(jnp.float32)
+    if cfg.sp_axis is not None:
+        nll_sum = jax.lax.psum(nll_sum, cfg.sp_axis)
+        cnt = jax.lax.psum(cnt, cfg.sp_axis)
+    return nll_sum / jnp.maximum(cnt, 1.0)

@@ -1,0 +1,63 @@
+"""VGG-16 (reference benchmark config: docs/performance.md — the
+communication-heavy model where the reference's PS design wins most,
++100% over Horovod; its 138M params stress gradient bandwidth)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .resnet import _conv, _conv_init
+
+# VGG-16: conv channel plan per block ('M' = 2x2 maxpool)
+VGG16_PLAN = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+              512, 512, 512, "M", 512, 512, 512, "M"]
+
+
+def init_vgg16(rng, num_classes: int = 1000, in_hw: int = 224):
+    keys = iter(jax.random.split(rng, 32))
+    params = {"convs": [], "fcs": []}
+    cin = 3
+    hw = in_hw
+    for item in VGG16_PLAN:
+        if item == "M":
+            hw //= 2
+            continue
+        params["convs"].append({
+            "w": _conv_init(next(keys), 3, 3, cin, item),
+            "b": jnp.zeros((item,)),
+        })
+        cin = item
+    flat = cin * hw * hw
+    for dout in (4096, 4096, num_classes):
+        params["fcs"].append({
+            "w": jax.random.normal(next(keys), (flat, dout)) * np.sqrt(2.0 / flat),
+            "b": jnp.zeros((dout,)),
+        })
+        flat = dout
+    return params
+
+
+def vgg16_apply(params, x):
+    ci = 0
+    for item in VGG16_PLAN:
+        if item == "M":
+            x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 2, 2, 1),
+                                      (1, 2, 2, 1), "VALID")
+            continue
+        p = params["convs"][ci]
+        x = jax.nn.relu(_conv(x, p["w"]) + p["b"])
+        ci += 1
+    x = x.reshape(x.shape[0], -1)
+    for i, p in enumerate(params["fcs"]):
+        x = x @ p["w"] + p["b"]
+        if i < len(params["fcs"]) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def vgg_loss(params, batch):
+    x, y = batch
+    logp = jax.nn.log_softmax(vgg16_apply(params, x))
+    return -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()

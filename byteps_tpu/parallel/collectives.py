@@ -155,6 +155,7 @@ class PushPullEngine:
         self.registry = registry or NameRegistry()
         self.telemetry = telemetry
         self.timeline = None
+        self.debug_sample = ""   # tensor-name substring to sample-log
         self._programs: Dict[Tuple, Tuple] = {}  # structure key → compiled plan
         self._bcast_fns: Dict[int, Callable] = {}
 
@@ -240,6 +241,15 @@ class PushPullEngine:
                 self.timeline.record(name or "push_pull", "DISPATCH",
                                      tb, time.time() - tb, key=bucket.index)
         result = jax.tree_util.tree_unflatten(treedef, out)
+        if self.debug_sample and name and self.debug_sample in name:
+            # numeric debugging sampler (reference: BYTEPS_DEBUG_SAMPLE_TENSOR
+            # prints tensor values per stage, core_loops.cc:37-67)
+            from ..common.logging import get_logger
+            for p, leaf in jax.tree_util.tree_leaves_with_path(result):
+                arr = np.asarray(leaf)
+                get_logger().info("SAMPLE %s%s mean=%.6g std=%.6g first=%.6g",
+                                  name, jax.tree_util.keystr(p),
+                                  arr.mean(), arr.std(), arr.ravel()[0])
         if self.telemetry is not None or self.timeline is not None:
             jax.block_until_ready(result)
             dt = time.time() - t0

@@ -1,0 +1,120 @@
+"""Ring attention: sequence/context parallelism over an ICI mesh axis.
+
+Absent from the reference (SURVEY §5 "Long-context: entirely absent") but
+first-class here: long sequences are sharded over the ``seq`` mesh axis;
+each device computes blockwise attention for its query shard while K/V
+shards rotate around the ring via ``ppermute``, overlapping the next
+block's transfer with the current block's compute. Softmax is accumulated
+online (flash-attention style running max / normalizer), so the full
+[seq, seq] score matrix never materializes.
+
+References (public techniques): Ring Attention (Liu et al. 2023),
+blockwise online softmax (Milakov & Gimelshein 2018). Math below is the
+standard log-sum-exp streaming update.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _block_attn(q, k, v, bias, scale):
+    """One block: scores [*, hq, sq, sk] → (unnormalized out, row max, row
+    normalizer)."""
+    s = jnp.einsum("...qhd,...khd->...hqk", q, k) * scale
+    if bias is not None:
+        s = s + bias
+    m = jnp.max(s, axis=-1, keepdims=True)            # [..., h, sq, 1]
+    # guard fully-masked rows (all -inf)
+    m = jnp.maximum(m, -1e30)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("...hqk,...khd->...qhd", p, v)
+    return o, m, l
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None) -> jnp.ndarray:
+    """Attention with q/k/v sharded on the sequence axis.
+
+    Args:
+      q, k, v: local shards [batch, seq_local, heads, head_dim].
+      axis_name: mesh axis holding the sequence shards.
+      causal: apply a causal mask consistent with the *global* sequence
+        order (shard i holds positions [i*seq_local, (i+1)*seq_local)).
+
+    Returns the local output shard [batch, seq_local, heads, head_dim].
+    """
+    sp = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+
+    def make_bias(kv_rank):
+        if not causal:
+            return None
+        q_pos = idx * sq + jnp.arange(sq)[:, None]        # global q positions
+        k_pos = kv_rank * sq + jnp.arange(sq)[None, :]    # global k positions
+        mask = q_pos >= k_pos
+        return jnp.where(mask, 0.0, -jnp.inf)[None, None, :, :]
+
+    # online softmax state
+    o = jnp.zeros_like(q, dtype=jnp.float32)
+    m = jnp.full((b, h, sq, 1), -jnp.inf, dtype=jnp.float32)
+    l = jnp.zeros((b, h, sq, 1), dtype=jnp.float32)
+
+    def accumulate(step, o, m, l, k_blk, v_blk):
+        kv_rank = (idx - step) % sp
+        bias = make_bias(kv_rank)
+        o_b, m_b, l_b = _block_attn(q.astype(jnp.float32),
+                                    k_blk.astype(jnp.float32),
+                                    v_blk.astype(jnp.float32), bias, scale)
+        new_m = jnp.maximum(m, m_b)
+        alpha = jnp.exp(m - new_m)        # rescale old accumulation
+        beta = jnp.exp(m_b - new_m)       # rescale new block
+        l_new = l * alpha + l_b * beta
+        # alpha/beta are [b, h, sq, 1]; o is [b, sq, h, d]
+        a_t = jnp.swapaxes(alpha, 1, 2)   # [b, sq, h, 1]
+        b_t = jnp.swapaxes(beta, 1, 2)
+        o_new = o * a_t + o_b * b_t
+        return o_new, new_m, l_new
+
+    def body(step, carry):
+        o, m, l, k_blk, v_blk = carry
+        o, m, l = accumulate(step, o, m, l, k_blk, v_blk)
+        # rotate K/V one step around the ring (next-lower neighbor's shard
+        # arrives; transfer overlaps the next iteration's compute)
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return o, m, l, k_next, v_next
+
+    # sp-1 rotations suffice: the last block is consumed outside the loop
+    # so no dead ppermute pair rides the critical path
+    o, m, l, k_last, v_last = jax.lax.fori_loop(0, sp - 1, body,
+                                                (o, m, l, k, v))
+    o, m, l = accumulate(sp - 1, o, m, l, k_last, v_last)
+    l = jnp.maximum(jnp.swapaxes(l, 1, 2), 1e-30)     # [b, sq, h, 1]
+    return (o / l).astype(q.dtype)
+
+
+def local_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None):
+    """Single-device reference attention, same layout [b, s, h, d]."""
+    b, s, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    sc = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                    k.astype(jnp.float32)) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        sc = jnp.where(mask[None, None], sc, -jnp.inf)
+    p = jax.nn.softmax(sc, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)

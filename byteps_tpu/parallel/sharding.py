@@ -1,0 +1,61 @@
+"""Sharding utilities: parameter/optimizer-state spec inference.
+
+Optax state pytrees (e.g. Adam's mu/nu) embed the parameter tree; when
+params are sharded over a TP/FSDP axis the matching state leaves must be
+sharded identically and the scalars replicated. ``opt_state_specs`` walks
+the state shape-tree and assigns each leaf the spec of the param whose
+tree path is a suffix of the state leaf's path (shape-checked), P() for
+everything else.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+def _path_key(path) -> tuple:
+    out = []
+    for e in path:
+        if hasattr(e, "key"):
+            out.append(("k", e.key))
+        elif hasattr(e, "idx"):
+            out.append(("i", e.idx))
+        else:
+            out.append(("s", str(e)))
+    return tuple(out)
+
+
+def opt_state_specs(tx, params, param_specs) -> Any:
+    """Infer PartitionSpecs for ``tx.init(params)``'s state tree."""
+    p_entries = []
+    for (ppath, pleaf), (_, spec) in zip(
+            jax.tree_util.tree_leaves_with_path(params),
+            jax.tree_util.tree_leaves_with_path(
+                param_specs, is_leaf=lambda x: isinstance(x, P))):
+        p_entries.append((_path_key(ppath), pleaf.shape, spec))
+
+    state_shape = jax.eval_shape(tx.init, params)
+
+    def assign(path, leaf):
+        key = _path_key(path)
+        for pkey, pshape, spec in p_entries:
+            if len(key) >= len(pkey) and key[-len(pkey):] == pkey \
+                    and tuple(leaf.shape) == tuple(pshape):
+                return spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(assign, state_shape)
+
+
+def shard_tree(tree, specs, mesh):
+    """device_put every leaf with its NamedSharding."""
+    from jax.sharding import NamedSharding
+    flat_specs = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, P))
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out = [jax.device_put(l, NamedSharding(mesh, s))
+           for l, s in zip(leaves, flat_specs)]
+    return jax.tree_util.tree_unflatten(treedef, out)

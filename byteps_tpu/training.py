@@ -116,3 +116,119 @@ class DistributedTrainer:
         if gs is not None and gs.timeline is not None:
             gs.timeline.set_step(self.step_count)
         return loss
+
+
+class ShardedTrainer:
+    """Full multi-way trainer: data × tensor × sequence parallelism.
+
+    Generalizes DistributedTrainer to sharded parameters. Per-leaf grad
+    synchronization is derived from the param spec: a gradient must be
+    summed over every mesh axis its computation was sharded on *except*
+    the axes that shard the leaf itself (those grads are owned per-shard).
+    The data-axis allreduce then runs through the bucketed
+    distributed_optimizer like the pure-DP path.
+
+      - params sharded per ``param_specs`` (TP axes inside the spec)
+      - batch sharded over (data..., seq) with leading batch dim on data
+        and sequence dim on the sp axis
+      - optimizer state sharded to match params (opt_state_specs)
+    """
+
+    def __init__(self, loss_fn: Callable, params, param_spec_tree,
+                 tx: optax.GradientTransformation, mesh: Mesh,
+                 batch_spec: Optional[P] = None,
+                 partition_bytes: int = 4 << 20,
+                 compression: Optional[dict] = None,
+                 min_compress_bytes: int = 65536,
+                 donate: bool = True) -> None:
+        from .parallel.sharding import opt_state_specs, shard_tree
+
+        self.mesh = mesh
+        self.dp_axes = data_axes(mesh)
+        other_axes = tuple(ax for ax in mesh.axis_names
+                           if ax not in self.dp_axes)
+        if compression and other_axes:
+            # The compression plan is built from global leaf shapes but
+            # would run on local TP/SP shards inside shard_map; per-rank
+            # plans with spec-sharded EF/momentum state are future work.
+            raise NotImplementedError(
+                "gradient compression currently composes with data "
+                f"parallelism only; mesh has non-data axes {other_axes}")
+        self.tx = distributed_optimizer(
+            tx, axes=self.dp_axes, partition_bytes=partition_bytes,
+            compression=compression, min_compress_bytes=min_compress_bytes)
+        self.pspec = param_spec_tree
+        self.ospec = opt_state_specs(self.tx, params, param_spec_tree)
+        if batch_spec is None:
+            seq_ax = "seq" if "seq" in mesh.axis_names else None
+            batch_spec = P(self.dp_axes if self.dp_axes else None, seq_ax)
+        self.batch_spec = batch_spec
+        self.params = shard_tree(params, self.pspec, mesh)
+        self.opt_state = shard_tree(self.tx.init(params), self.ospec, mesh)
+        loss_axes = tuple(ax for ax in mesh.axis_names
+                          if ax in _spec_axes(batch_spec))
+
+        flat_specs = jax.tree_util.tree_leaves(
+            param_spec_tree, is_leaf=lambda x: isinstance(x, P))
+        import math
+        other_prod = math.prod(mesh.shape[a] for a in other_axes) if other_axes else 1
+
+        def step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            # Per-leaf grad sync over the non-dp axes the leaf is NOT
+            # sharded on, then a uniform 1/prod(other_axes) rescale.
+            # Why the rescale: inside shard_map the VJP of a forward psum
+            # delivers the *sum* of all ranks' cotangents, so when the loss
+            # value is replicated across an axis of size n, every gradient
+            # path through that psum comes out n-times the true gradient —
+            # uniformly, for sharded and replicated leaves alike (the loss
+            # itself must be truly global, see lm_loss's sp handling).
+            # P is a tuple subclass, so flatten both trees explicitly.
+            g_leaves, g_def = jax.tree_util.tree_flatten(grads)
+            synced = []
+            for g, s in zip(g_leaves, flat_specs):
+                axes = tuple(a for a in other_axes if a not in _spec_axes(s))
+                g = jax.lax.psum(g, axes) if axes else g
+                if other_prod > 1:
+                    g = g / other_prod
+                synced.append(g)
+            grads = jax.tree_util.tree_unflatten(g_def, synced)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            if loss_axes:
+                loss = jax.lax.pmean(loss, loss_axes)
+            return params, opt_state, loss
+
+        shard_fn = jax.shard_map(
+            step, mesh=mesh,
+            in_specs=(self.pspec, self.ospec, batch_spec),
+            out_specs=(self.pspec, self.ospec, P()),
+            check_vma=False)
+        self._step_fn = jax.jit(shard_fn,
+                                donate_argnums=(0, 1) if donate else ())
+        self.step_count = 0
+
+    def shard_batch(self, batch):
+        sharding = NamedSharding(self.mesh, self.batch_spec)
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sharding), batch)
+
+    def step(self, batch):
+        batch = self.shard_batch(batch)
+        self.params, self.opt_state, loss = self._step_fn(
+            self.params, self.opt_state, batch)
+        self.step_count += 1
+        return loss
+
+
+def _spec_axes(spec) -> tuple:
+    """Mesh axes mentioned in a PartitionSpec."""
+    axes = []
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            axes.extend(entry)
+        else:
+            axes.append(entry)
+    return tuple(axes)

@@ -1,0 +1,72 @@
+"""BERT pretraining with full multi-way sharding (the reference's headline
+workload: BERT-large mixed-precision at scale, README.md:37-44).
+
+  python examples/bert_pretrain.py --config large --dp 8
+  python examples/bert_pretrain.py --config tiny --dp 2 --tp 2 --sp 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import numpy as np
+import optax
+
+import byteps_tpu as bps
+from byteps_tpu.models import bert, transformer
+from byteps_tpu.parallel.mesh import make_mesh
+from byteps_tpu.training import ShardedTrainer
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="tiny", choices=["tiny", "base", "large"])
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--sp", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--compression", default=None)
+    args = ap.parse_args()
+
+    axes = {}
+    if args.dp > 1:
+        axes["data"] = args.dp
+    if args.tp > 1:
+        axes["model"] = args.tp
+    if args.sp > 1:
+        axes["seq"] = args.sp
+    mesh = make_mesh(axes or {"data": 1},
+                     devices=jax.devices()[: max(1, args.dp * args.tp * args.sp)])
+    bps.init(mesh=mesh)
+
+    cfg_fn = {"tiny": bert.bert_tiny, "base": bert.bert_base,
+              "large": bert.bert_large}[args.config]
+    cfg = cfg_fn(tp_axis="model" if args.tp > 1 else None,
+                 sp_axis="seq" if args.sp > 1 else None)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    specs = transformer.param_specs(cfg)
+
+    compression = ({"compressor_type": args.compression, "compressor_k": "0.01"}
+                   if args.compression else None)
+    trainer = ShardedTrainer(
+        lambda p, b: bert.mlm_loss(p, cfg, b), params, specs,
+        optax.adamw(1e-4), mesh=mesh, compression=compression)
+
+    rng = np.random.RandomState(0)
+    t0 = time.perf_counter()
+    for step in range(args.steps):
+        batch = bert.synth_mlm_batch(rng, args.batch, args.seq, cfg.vocab_size)
+        loss = trainer.step(batch)
+        if step % 5 == 0:
+            print(f"step {step}: loss {float(loss):.4f}")
+    print(f"{args.batch * args.steps / (time.perf_counter() - t0):.1f} samples/sec "
+          f"on mesh {dict(mesh.shape)}")
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""Elastic training driver loop (reference:
+example/pytorch/elastic_benchmark_byteps.py — suspend/resume with changing
+membership, keeping tensor name→key stable).
+
+Simulates a membership change mid-training on the local mesh: train on the
+full mesh, suspend, checkpoint, resume on half the devices, continue.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import jax
+import numpy as np
+import optax
+
+import byteps_tpu as bps
+from byteps_tpu.checkpoint import restore_checkpoint, save_checkpoint
+from byteps_tpu.models.mlp import mlp_init, mlp_loss
+from byteps_tpu.parallel.mesh import make_mesh
+from byteps_tpu.training import DistributedTrainer
+
+
+def main() -> None:
+    devices = jax.devices()
+    full = make_mesh({"data": len(devices)})
+    bps.init(mesh=full)
+    print(f"phase 1: training on {len(devices)} devices")
+
+    params = mlp_init(jax.random.PRNGKey(0), 256, 4)
+    trainer = DistributedTrainer(mlp_loss, params, optax.adam(1e-3), mesh=full)
+    rng = np.random.RandomState(0)
+    batch = lambda: (rng.randn(32, 256).astype(np.float32),
+                     rng.randn(32, 256).astype(np.float32))
+    for _ in range(10):
+        loss = trainer.step(batch())
+    print("phase 1 loss:", float(loss))
+
+    ckpt = os.path.join(tempfile.mkdtemp(), "elastic")
+    save_checkpoint(ckpt, trainer.params, trainer.opt_state, step=10,
+                    registry=bps.common.global_state.GlobalState.get().registry)
+    bps.suspend()
+
+    # membership change: resume on half the devices
+    half = make_mesh({"data": max(1, len(devices) // 2)},
+                     devices=devices[: max(1, len(devices) // 2)])
+    bps.resume(config=bps.Config.from_env(), mesh=half)
+    print(f"phase 2: resumed on {bps.size()} devices")
+
+    p, opt, step, _ = restore_checkpoint(ckpt, trainer.params, trainer.opt_state)
+    trainer2 = DistributedTrainer(mlp_loss, jax.tree_util.tree_map(np.asarray, p),
+                                  optax.adam(1e-3), mesh=half)
+    # restore the optimizer moments and step counter too — resume must not
+    # reset optimization dynamics
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    replicated = NamedSharding(half, P())
+    trainer2.opt_state = jax.tree_util.tree_map(
+        lambda x: jax.device_put(np.asarray(x), replicated), opt)
+    trainer2.step_count = step
+    for _ in range(10):
+        loss = trainer2.step(batch())
+    print(f"phase 2 loss (resumed from step {step}):", float(loss))
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

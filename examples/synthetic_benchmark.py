@@ -1,0 +1,97 @@
+"""Synthetic throughput benchmark (reference:
+example/pytorch/benchmark_byteps.py, example/tensorflow/synthetic_benchmark.py
+— train a benchmark model on synthetic data, print img/sec or samples/sec).
+
+Usage:
+  python examples/synthetic_benchmark.py --model bert-large --batch 8
+  python examples/synthetic_benchmark.py --model resnet50 --batch 32
+  python examples/synthetic_benchmark.py --model mlp --compression onebit
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import numpy as np
+import optax
+
+import byteps_tpu as bps
+from byteps_tpu.training import DistributedTrainer
+
+
+def build(model: str, batch: int):
+    rng = np.random.RandomState(0)
+    if model.startswith("bert"):
+        from byteps_tpu.models import bert, transformer
+        cfg = {"bert-large": bert.bert_large, "bert-base": bert.bert_base,
+               "bert-tiny": bert.bert_tiny}[model]()
+        params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+        seq = min(cfg.max_seq, 512)
+        data = bert.synth_mlm_batch(rng, batch, seq, cfg.vocab_size)
+        loss_fn = lambda p, b: bert.mlm_loss(p, cfg, b)
+    elif model.startswith("gpt2"):
+        from byteps_tpu.models import gpt2, transformer
+        cfg = {"gpt2-medium": gpt2.gpt2_medium, "gpt2-small": gpt2.gpt2_small,
+               "gpt2-tiny": gpt2.gpt2_tiny}[model]()
+        params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+        data = gpt2.synth_lm_batch(rng, batch, min(cfg.max_seq, 512),
+                                   cfg.vocab_size)
+        loss_fn = lambda p, b: gpt2.causal_lm_loss(p, cfg, b)
+    elif model == "resnet50":
+        from byteps_tpu.models import resnet
+        params = resnet.init_resnet50(jax.random.PRNGKey(0))
+        data = resnet.synth_imagenet_batch(rng, batch)
+        loss_fn = resnet.resnet_loss
+    elif model == "vgg16":
+        from byteps_tpu.models import resnet, vgg
+        params = vgg.init_vgg16(jax.random.PRNGKey(0))
+        data = resnet.synth_imagenet_batch(rng, batch)
+        loss_fn = vgg.vgg_loss
+    elif model == "mlp":
+        from byteps_tpu.models.mlp import mlp_init, mlp_loss
+        params = mlp_init(jax.random.PRNGKey(0), 2048, 8)
+        data = (rng.randn(batch, 2048).astype(np.float32),
+                rng.randn(batch, 2048).astype(np.float32))
+        loss_fn = mlp_loss
+    else:
+        raise SystemExit(f"unknown model {model}")
+    return params, data, loss_fn
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="bert-tiny")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--compression", default=None,
+                    help="onebit|topk|randomk|dithering")
+    ap.add_argument("--ef", action="store_true", help="error feedback")
+    args = ap.parse_args()
+
+    bps.init()
+    params, data, loss_fn = build(args.model, args.batch)
+    compression = None
+    if args.compression:
+        compression = {"compressor_type": args.compression,
+                       "compressor_k": "0.01", "seed": "42"}
+        if args.ef:
+            compression["ef_type"] = "vanilla"
+
+    trainer = DistributedTrainer(loss_fn, params, optax.adamw(1e-4),
+                                 compression=compression)
+    float(trainer.step(data))   # compile + sync
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        loss = trainer.step(data)
+    final = float(loss)         # readback = real timing on TPU tunnels
+    dt = time.perf_counter() - t0
+    print(f"model={args.model} batch={args.batch} world={bps.size()} "
+          f"compression={args.compression or 'none'}: "
+          f"{args.batch * args.iters / dt:.1f} samples/sec  loss={final:.4f}")
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,100 @@
+"""Gradient exactness under TP/SP: the update applied by ShardedTrainer
+must equal single-device training — sharding is a layout choice, not an
+algorithm change. Catches psum-VJP inflation and loss-denominator bugs."""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from byteps_tpu.models import bert, gpt2, transformer
+from byteps_tpu.parallel.mesh import make_mesh
+from byteps_tpu.training import ShardedTrainer
+
+
+def _single_device_step(cfg_ref, params, loss_fn_ref, batch, lr=0.1):
+    tx = optax.sgd(lr)
+    state = tx.init(params)
+    loss, g = jax.value_and_grad(loss_fn_ref)(params, batch)
+    updates, _ = tx.update(g, state, params)
+    return optax.apply_updates(params, updates), float(loss)
+
+
+def _trainer_step(cfg, params, loss_fn, mesh, batch, lr=0.1):
+    trainer = ShardedTrainer(loss_fn, params, transformer.param_specs(cfg),
+                             optax.sgd(lr), mesh=mesh, donate=False)
+    loss = trainer.step(batch)
+    # gather params to host, fully replicated view
+    out = jax.tree_util.tree_map(np.asarray, trainer.params)
+    return out, float(loss)
+
+
+MESHES = [
+    ({"model": 2}, dict(tp_axis="model")),
+    ({"seq": 2}, dict(sp_axis="seq")),
+    ({"data": 2}, {}),
+    ({"model": 2, "seq": 2}, dict(tp_axis="model", sp_axis="seq")),
+    ({"data": 2, "model": 2, "seq": 2}, dict(tp_axis="model", sp_axis="seq")),
+]
+
+
+def equal_count_mlm_batch(rng, batch, seq, vocab):
+    """MLM batch with identical mask counts per example, so the DP
+    mean-of-per-shard-losses (Horovod/BytePS semantics: each worker
+    normalizes by its own count, grads averaged) coincides with the global
+    loss and the comparison below is exact for every mesh."""
+    tokens = rng.randint(1, vocab, size=(batch, seq)).astype(np.int32)
+    mask = (np.arange(seq)[None, :] % 7) == 3
+    mask = np.broadcast_to(mask, tokens.shape)
+    targets = np.where(mask, tokens, -1).astype(np.int32)
+    masked = np.where(mask, 0, tokens).astype(np.int32)
+    return masked, targets
+
+
+@pytest.mark.parametrize("axes,cfg_kw", MESHES)
+def test_bert_step_matches_single_device(axes, cfg_kw):
+    ndev = int(np.prod(list(axes.values())))
+    mesh = make_mesh(axes, devices=jax.devices()[:ndev])
+    cfg = bert.bert_tiny(**cfg_kw)
+    cfg_ref = bert.bert_tiny()
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg_ref)
+    rng = np.random.RandomState(0)
+    batch = equal_count_mlm_batch(rng, 4, 32, cfg_ref.vocab_size)
+
+    want, loss_ref = _single_device_step(
+        cfg_ref, params, lambda p, b: bert.mlm_loss(p, cfg_ref, b), batch)
+    got, loss_sh = _trainer_step(
+        cfg, params, lambda p, b: bert.mlm_loss(p, cfg, b), mesh, batch)
+
+    assert abs(loss_sh - loss_ref) < 1e-4, (loss_sh, loss_ref)
+    flat_w, _ = jax.tree_util.tree_flatten(want)
+    flat_g, _ = jax.tree_util.tree_flatten(got)
+    for w, g in zip(flat_w, flat_g):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-3, atol=2e-5)
+
+
+def test_gpt2_sp_step_matches_single_device():
+    """Causal LM with sequence parallelism: the ppermute'd target shift and
+    global positions must reproduce single-device next-token training."""
+    mesh = make_mesh({"seq": 2}, devices=jax.devices()[:2])
+    cfg = gpt2.gpt2_tiny(sp_axis="seq")
+    cfg_ref = gpt2.gpt2_tiny()
+    params = transformer.init_params(jax.random.PRNGKey(1), cfg_ref)
+    rng = np.random.RandomState(1)
+    tokens = gpt2.synth_lm_batch(rng, 4, 32, cfg_ref.vocab_size)
+
+    want, loss_ref = _single_device_step(
+        cfg_ref, params, lambda p, b: gpt2.causal_lm_loss(p, cfg_ref, b), tokens)
+    got, loss_sh = _trainer_step(
+        cfg, params, lambda p, b: gpt2.causal_lm_loss(p, cfg, b), mesh, tokens)
+
+    # note: single-device path trains on s-1 inputs, SP path on s inputs
+    # with the last target masked — identical (input, target) pairs except
+    # the final input token which has no target either way; losses match.
+    assert abs(loss_sh - loss_ref) < 1e-4, (loss_sh, loss_ref)
+    flat_w, _ = jax.tree_util.tree_flatten(want)
+    flat_g, _ = jax.tree_util.tree_flatten(got)
+    for w, g in zip(flat_w, flat_g):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-3, atol=3e-5)

@@ -1,0 +1,150 @@
+"""Model-family tests: shapes, distributed training, TP/SP equivalence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import byteps_tpu as bps
+from byteps_tpu.models import bert, gpt2, resnet, transformer, vgg
+from byteps_tpu.parallel.mesh import make_mesh
+from byteps_tpu.training import DistributedTrainer
+
+
+def test_bert_tiny_forward_shape():
+    cfg = bert.bert_tiny()
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    toks = np.zeros((2, 16), np.int32)
+    h = transformer.apply(params, cfg, jnp.asarray(toks))
+    assert h.shape == (2, 16, cfg.hidden)
+    lg = transformer.logits(params, cfg, h)
+    assert lg.shape == (2, 16, cfg.vocab_size)
+
+
+def test_bert_tiny_trains(mesh8):
+    bps.init(mesh=mesh8)
+    cfg = bert.bert_tiny()
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(0)
+
+    def loss_fn(p, batch):
+        return bert.mlm_loss(p, cfg, batch)
+
+    trainer = DistributedTrainer(loss_fn, params, optax.adam(3e-3), mesh=mesh8)
+    fixed = bert.synth_mlm_batch(rng, 16, 32, cfg.vocab_size)
+    losses = [float(trainer.step(fixed)) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.8  # memorizes the fixed batch
+
+
+def test_gpt2_tiny_trains(mesh8):
+    bps.init(mesh=mesh8)
+    cfg = gpt2.gpt2_tiny()
+    params = transformer.init_params(jax.random.PRNGKey(1), cfg)
+    rng = np.random.RandomState(1)
+
+    def loss_fn(p, batch):
+        return gpt2.causal_lm_loss(p, cfg, batch)
+
+    trainer = DistributedTrainer(loss_fn, params, optax.adam(3e-3), mesh=mesh8)
+    fixed = gpt2.synth_lm_batch(rng, 16, 33, cfg.vocab_size)
+    losses = [float(trainer.step(fixed)) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.8  # memorizes the fixed batch
+
+
+def test_resnet_forward_and_grad():
+    params = resnet.init_resnet50(jax.random.PRNGKey(0), num_classes=10,
+                                  stages=[(1, 64), (1, 128)])
+    x, y = resnet.synth_imagenet_batch(np.random.RandomState(0), 2, size=32,
+                                       classes=10)
+    lg = resnet.resnet50_apply(params, jnp.asarray(x))
+    assert lg.shape == (2, 10)
+    g = jax.grad(resnet.resnet_loss)(params, (jnp.asarray(x), jnp.asarray(y)))
+    assert np.isfinite(float(jax.tree_util.tree_reduce(
+        lambda a, b: a + jnp.abs(b).sum(), g, 0.0)))
+
+
+def test_vgg_forward():
+    params = vgg.init_vgg16(jax.random.PRNGKey(0), num_classes=10, in_hw=32)
+    x = np.zeros((2, 32, 32, 3), np.float32)
+    lg = vgg.vgg16_apply(params, jnp.asarray(x))
+    assert lg.shape == (2, 10)
+
+
+# ----------------------------------------------------- TP / SP correctness
+
+def _tiny_cfg(**kw):
+    return bert.bert_tiny(**kw)
+
+
+def test_tensor_parallel_matches_single_device():
+    """TP=4 forward must equal the unsharded forward — the Megatron
+    column/row split is an exact reparameterization."""
+    mesh = make_mesh({"model": 4}, devices=jax.devices()[:4])
+    cfg_tp = _tiny_cfg(tp_axis="model")
+    cfg_ref = _tiny_cfg()
+    params = transformer.init_params(jax.random.PRNGKey(2), cfg_ref)
+    toks = np.asarray(np.random.RandomState(3).randint(1, 100, (2, 16)),
+                      dtype=np.int32)
+    want = np.asarray(transformer.apply(params, cfg_ref, jnp.asarray(toks)))
+
+    specs = transformer.param_specs(cfg_tp)
+
+    def fwd(p, t):
+        return transformer.apply(p, cfg_tp, t)
+
+    fn = jax.jit(jax.shard_map(
+        fwd, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda s: s, specs,
+                                         is_leaf=lambda x: isinstance(x, P)),
+                  P()),
+        out_specs=P(), check_vma=False))
+    sharded_params = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs,
+        is_leaf=lambda x: not isinstance(x, (dict, list)))
+    got = np.asarray(fn(sharded_params, jnp.asarray(toks)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_sequence_parallel_matches_single_device():
+    """SP=4 (ring attention) forward must equal the unsharded forward."""
+    mesh = make_mesh({"seq": 4}, devices=jax.devices()[:4])
+    cfg_sp = _tiny_cfg(sp_axis="seq")
+    cfg_ref = _tiny_cfg()
+    params = transformer.init_params(jax.random.PRNGKey(4), cfg_ref)
+    toks = np.asarray(np.random.RandomState(5).randint(1, 100, (2, 32)),
+                      dtype=np.int32)
+    want = np.asarray(transformer.apply(params, cfg_ref, jnp.asarray(toks)))
+
+    def fwd(p, t):
+        return transformer.apply(p, cfg_sp, t)
+
+    fn = jax.jit(jax.shard_map(fwd, mesh=mesh,
+                               in_specs=(P(), P(None, "seq")),
+                               out_specs=P(None, "seq"), check_vma=False))
+    got = np.asarray(fn(params, jnp.asarray(toks)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_dp_tp_sp_combined_train_step():
+    """2×2×2 mesh: data × model × seq all at once through ShardedTrainer —
+    the full multi-way sharding the driver's dryrun exercises. Training on
+    a fixed batch must reduce the loss (grad sync across every axis must
+    be correct for that to happen)."""
+    from byteps_tpu.training import ShardedTrainer
+    mesh = make_mesh({"data": 2, "seq": 2, "model": 2})
+    cfg = _tiny_cfg(tp_axis="model", sp_axis="seq")
+    params = transformer.init_params(jax.random.PRNGKey(6), cfg)
+    specs = transformer.param_specs(cfg)
+
+    def loss_fn(p, batch):
+        return bert.mlm_loss(p, cfg, batch)
+
+    trainer = ShardedTrainer(loss_fn, params, specs, optax.adam(3e-3),
+                             mesh=mesh)
+    rng = np.random.RandomState(7)
+    toks, tgts = bert.synth_mlm_batch(rng, 8, 32, cfg.vocab_size)
+    losses = [float(trainer.step((toks, tgts))) for _ in range(25)]
+    assert np.isfinite(losses[-1])
+    assert losses[-1] < losses[0] * 0.8, losses[::5]
