@@ -45,6 +45,8 @@ class TransformerConfig:
     remat: bool = True            # checkpoint each block
     tp_axis: Optional[str] = None # mesh axis for tensor parallelism
     sp_axis: Optional[str] = None # mesh axis for ring-attention seq shards
+    pp_axis: Optional[str] = None # mesh axis for pipeline (layer) stages
+    pp_microbatches: int = 0      # GPipe microbatches (0 → pipeline size)
 
     @property
     def head_dim(self) -> int:
@@ -95,16 +97,17 @@ def param_specs(cfg: TransformerConfig):
     """PartitionSpec tree matching init_params: column-parallel weights
     shard their output dim on tp_axis, row-parallel their input dim."""
     tp = cfg.tp_axis
+    pp = cfg.pp_axis  # stacked layer axis shards across pipeline stages
     rep = P()
-    lead = P(None)  # stacked layer axis is never sharded
+    lead = P(pp)
     block = {
         "ln1": {"scale": lead, "bias": lead},
-        "qkv": P(None, None, None, tp, None),  # column parallel over heads
-        "attn_out": P(None, tp, None),         # row parallel
+        "qkv": P(pp, None, None, tp, None),    # column parallel over heads
+        "attn_out": P(pp, tp, None),           # row parallel
         "ln2": {"scale": lead, "bias": lead},
-        "mlp_in": P(None, None, tp),
-        "mlp_in_b": P(None, tp),
-        "mlp_out": P(None, tp, None),
+        "mlp_in": P(pp, None, tp),
+        "mlp_in_b": P(pp, tp),
+        "mlp_out": P(pp, tp, None),
         "mlp_out_b": lead,
     }
     return {
@@ -185,7 +188,26 @@ def apply(params, cfg: TransformerConfig, tokens: jnp.ndarray,
     def body(carry, blk):
         return blk_fn(carry, blk), None
 
-    x, _ = jax.lax.scan(body, x, params["blocks"])
+    def stack_fn(blocks, h):
+        out, _ = jax.lax.scan(body, h, blocks)
+        return out
+
+    if cfg.pp_axis is not None:
+        # GPipe over the pipe axis: params["blocks"] arrives as this
+        # stage's layer shard; microbatch the batch dim and stream.
+        from ..parallel.pipeline import pipeline
+        pn = jax.lax.axis_size(cfg.pp_axis)
+        if cfg.layers % pn:
+            raise ValueError(
+                f"{cfg.layers} layers not divisible by {pn} pipeline stages")
+        n_micro = cfg.pp_microbatches or pn
+        if b % n_micro:
+            raise ValueError(f"batch {b} not divisible by {n_micro} microbatches")
+        xm = x.reshape(n_micro, b // n_micro, *x.shape[1:])
+        xm = pipeline(stack_fn, params["blocks"], xm, cfg.pp_axis)
+        x = xm.reshape(b, *x.shape[1:])   # valid on the last stage only
+    else:
+        x = stack_fn(params["blocks"], x)
     x = _layernorm(x, params["final_ln"]["scale"], params["final_ln"]["bias"])
     return x
 
@@ -216,4 +238,12 @@ def lm_loss(params, cfg: TransformerConfig, batch) -> jnp.ndarray:
     if cfg.sp_axis is not None:
         nll_sum = jax.lax.psum(nll_sum, cfg.sp_axis)
         cnt = jax.lax.psum(cnt, cfg.sp_axis)
+    if cfg.pp_axis is not None:
+        # Only the last pipeline stage holds real hidden states; mask the
+        # other ranks' (finite, zero-init) dummy outputs and replicate —
+        # the psum's n× grad factor matches the trainer's uniform rescale
+        # convention (see ShardedTrainer.step).
+        from ..parallel.pipeline import last_stage_value
+        nll_sum = last_stage_value(nll_sum, cfg.pp_axis)
+        cnt = last_stage_value(cnt, cfg.pp_axis)
     return nll_sum / jnp.maximum(cnt, 1.0)

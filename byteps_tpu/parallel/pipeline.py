@@ -1,0 +1,100 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a mesh axis.
+
+Absent from the reference (SURVEY §2.5 — "Pipeline parallelism: Absent"),
+first-class here. Layers are sharded across the ``pipe`` mesh axis (each
+rank owns a contiguous stack of blocks); the batch is split into
+microbatches that stream through the stages, activations hopping to the
+next stage via ``ppermute`` each tick. Everything lives inside one
+shard_map'd, jitted step: `lax.scan` drives the ticks, so compile time is
+O(1) in microbatch count, and XLA overlaps each tick's ppermute with the
+next tick's compute.
+
+Schedule: plain GPipe with ``n_micro + n_stages - 1`` ticks; the bubble
+fraction is ``(n_stages-1)/(n_micro+n_stages-1)`` — raise the microbatch
+count to amortize it. All stages execute the same ``stage_fn`` (SPMD);
+non-final ranks produce dummy outputs that carry zero cotangent, so
+gradients are exact without any per-stage program.
+
+Reference (public technique): GPipe (Huang et al. 2019); the
+collective-permute formulation follows the standard JAX SPMD pipelining
+pattern (scaling-book §pipelining).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def pipeline(stage_fn: Callable, stage_params, inputs: jnp.ndarray,
+             axis_name: str) -> jnp.ndarray:
+    """Run microbatches through a pipeline over ``axis_name``.
+
+    Call inside shard_map. Every rank holds its own ``stage_params`` shard
+    (layers split across the axis) and the same ``inputs``.
+
+    Args:
+      stage_fn: ``stage_fn(stage_params, x) -> y`` — this rank's stage;
+        must preserve the activation shape (a stack of residual blocks).
+      stage_params: this rank's layer shard (pytree).
+      inputs: ``[n_micro, mb, ...]`` microbatched activations. Only stage
+        0's value is consumed; other ranks' inputs are ignored.
+      axis_name: the pipeline mesh axis.
+
+    Returns:
+      ``[n_micro, mb, ...]`` outputs, valid on the LAST stage only (other
+      ranks hold garbage with zero gradient contribution).
+    """
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return _scan_micro(stage_fn, stage_params, inputs)
+    stage = jax.lax.axis_index(axis_name)
+    n_micro = inputs.shape[0]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    state = jnp.zeros_like(inputs[0])
+    outputs = jnp.zeros_like(inputs)
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (clipped ticks past the end feed a
+        # duplicate whose output never reaches the last stage in time —
+        # harmless, and keeps the scan body shape-static)
+        inp = jax.lax.dynamic_index_in_dim(
+            inputs, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+        x = jnp.where(stage == 0, inp, state)
+        y = stage_fn(stage_params, x)
+        # the last stage commits microbatch t-(n-1) once the fill ends
+        out_idx = jnp.clip(t - (n - 1), 0, n_micro - 1)
+        cur = jax.lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
+        commit = jnp.logical_and(t >= n - 1, stage == n - 1)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(commit, y, cur), out_idx, 0)
+        # hop activations to the next stage (last→0 link carries garbage
+        # that stage 0 overwrites on the next tick)
+        state = jax.lax.ppermute(y, axis_name, perm)
+        return (state, outputs), None
+
+    (state, outputs), _ = jax.lax.scan(tick, (state, outputs),
+                                       jnp.arange(n_micro + n - 1))
+    return outputs
+
+
+def _scan_micro(stage_fn, stage_params, inputs):
+    """Degenerate 1-stage pipeline: just map over microbatches."""
+    def body(_, x):
+        return None, stage_fn(stage_params, x)
+    _, out = jax.lax.scan(body, None, inputs)
+    return out
+
+
+def last_stage_value(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Replicate the last stage's value to all ranks (for losses computed
+    from pipeline outputs: mask non-final ranks, then psum)."""
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    is_last = jax.lax.axis_index(axis_name) == n - 1
+    return jax.lax.psum(jnp.where(is_last, x, jnp.zeros_like(x)), axis_name)
