@@ -1,0 +1,234 @@
+"""Mixture-of-Experts transformer with expert parallelism.
+
+Additive scope vs the reference (SURVEY §2.5: "Expert parallelism (EP/MoE):
+Absent"). TPU-first design:
+
+  - GShard/Switch-style top-k routing with **static-shape capacity
+    buffers**: dispatch/combine are one-hot einsums, so everything stays
+    MXU-shaped and jit-compatible (no dynamic token counts).
+  - Experts shard over the ``expert`` mesh axis; tokens travel to their
+    experts via ``lax.all_to_all`` over ICI and back — the canonical EP
+    exchange.
+  - The ``expert`` axis doubles as a batch axis (batch sharded over
+    data × expert), so every rank routes its own token shard: EP adds no
+    idle ranks, and gradient rescale in ShardedTrainer treats ``expert``
+    exactly like a data axis (per-leaf psum + uniform 1/n).
+  - Load-balance auxiliary loss (Switch: E · Σ_e f_e·p_e) accumulated
+    through the block scan carry.
+
+References (public techniques): GShard (Lepikhin et al. 2020), Switch
+Transformer (Fedus et al. 2021).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .transformer import (TransformerConfig, _attention, _layernorm)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(TransformerConfig):
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25   # per-expert buffer = cf·k·T/E tokens
+    ep_axis: Optional[str] = None   # mesh axis holding expert shards
+    aux_weight: float = 1e-2        # load-balance loss coefficient
+
+
+# ----------------------------------------------------------------- params
+
+def init_moe_params(rng, cfg: MoEConfig):
+    """Parameter pytree: transformer attention + per-expert FFN weights,
+    per-layer leaves stacked on a leading layer axis (lax.scan depth)."""
+    keys = jax.random.split(rng, cfg.layers + 3)
+    h, m, e = cfg.hidden, cfg.mlp_dim, cfg.num_experts
+    sd = 0.02
+
+    def norm(key, shape):
+        return jax.random.normal(key, shape, dtype=jnp.float32) * sd
+
+    def one_block(key):
+        k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+        return {
+            "ln1": {"scale": jnp.ones((h,)), "bias": jnp.zeros((h,))},
+            "qkv": norm(k1, (h, 3, cfg.heads, cfg.head_dim)),
+            "attn_out": norm(k2, (h, h)),
+            "ln2": {"scale": jnp.ones((h,)), "bias": jnp.zeros((h,))},
+            "router": norm(k3, (h, e)),
+            "w_in": norm(k4, (e, h, m)),
+            "w_in_b": jnp.zeros((e, m)),
+            "w_out": norm(k5, (e, m, h)),
+            "w_out_b": jnp.zeros((e, h)),
+        }
+
+    blocks = [one_block(keys[i + 2]) for i in range(cfg.layers)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
+    return {
+        "embed": {
+            "tok": norm(keys[0], (cfg.vocab_size, h)),
+            "pos": norm(keys[1], (cfg.max_seq, h)),
+        },
+        "blocks": stacked,
+        "final_ln": {"scale": jnp.ones((h,)), "bias": jnp.zeros((h,))},
+    }
+
+
+def moe_param_specs(cfg: MoEConfig):
+    """PartitionSpec tree: expert-indexed weights shard on ep_axis; the
+    router and attention stay replicated across it."""
+    ep = cfg.ep_axis
+    rep = P()
+    lead = P(None)
+    block = {
+        "ln1": {"scale": lead, "bias": lead},
+        "qkv": P(None, None, None, cfg.tp_axis, None),
+        "attn_out": P(None, cfg.tp_axis, None),
+        "ln2": {"scale": lead, "bias": lead},
+        "router": P(None, None, None),
+        "w_in": P(None, ep, None, None),
+        "w_in_b": P(None, ep, None),
+        "w_out": P(None, ep, None, None),
+        "w_out_b": P(None, ep, None),
+    }
+    return {
+        "embed": {"tok": rep, "pos": rep},
+        "blocks": block,
+        "final_ln": {"scale": rep, "bias": rep},
+    }
+
+
+# ------------------------------------------------------------------ layer
+
+def _route(x, router_w, cfg: MoEConfig):
+    """Top-k routing. x: [T, h] → (combine [T, E, C], dispatch [T, E, C],
+    aux scalar). Static capacity C; overflow tokens are dropped (their
+    residual path carries them through)."""
+    tcount, e = x.shape[0], cfg.num_experts
+    cap = max(1, int(cfg.capacity_factor * cfg.top_k * tcount / e))
+    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                   # [T, E]
+
+    # top-k expert choices per token; renormalize gate weights over the k
+    topv, topi = jax.lax.top_k(probs, cfg.top_k)              # [T, k]
+    gates_norm = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+
+    # one-hot per choice → position in each expert's capacity buffer.
+    # Choices are flattened in (k, token) order so first choices win
+    # buffer slots before any second choice competes.
+    sel = jax.nn.one_hot(topi, e, dtype=jnp.float32)          # [T, k, E]
+    sel_flat = sel.transpose(1, 0, 2).reshape(-1, e)          # [k*T, E]
+    pos_flat = jnp.cumsum(sel_flat, axis=0) - sel_flat        # slot index
+    keep_flat = sel_flat * (pos_flat < cap)
+    dispatch_flat = keep_flat[..., None] * jax.nn.one_hot(
+        pos_flat.astype(jnp.int32), cap, dtype=jnp.float32)   # [k*T, E, C]
+    dispatch_k = dispatch_flat.reshape(cfg.top_k, tcount, e, cap)
+    combine = jnp.einsum("ktec,tk->tec", dispatch_k, gates_norm)
+    dispatch = dispatch_k.sum(0)                              # [T, E, C]
+
+    # Switch aux loss: E · Σ_e (fraction routed to e)·(mean prob of e)
+    frac = sel.sum(1).mean(0)                                 # [E]
+    aux = e * jnp.sum(frac * probs.mean(0)) / cfg.top_k
+    return combine, dispatch, aux
+
+
+def _moe_ffn(x, blk, cfg: MoEConfig):
+    """MoE FFN over flattened tokens x: [T, h] → ([T, h], aux)."""
+    combine, dispatch, aux = _route(x, blk["router"], cfg)
+    dt = x.dtype
+    buf = jnp.einsum("tec,th->ech", dispatch.astype(dt), x)   # [E, C, h]
+
+    if cfg.ep_axis is not None:
+        n = jax.lax.axis_size(cfg.ep_axis)
+        if cfg.num_experts % n:
+            raise ValueError(
+                f"{cfg.num_experts} experts not divisible by ep size {n}")
+        # exchange: every rank keeps E/n experts, receives all ranks' slots
+        buf = jax.lax.all_to_all(buf, cfg.ep_axis, split_axis=0,
+                                 concat_axis=1, tiled=True)   # [E/n, n·C, h]
+
+    h1 = jnp.einsum("ech,ehm->ecm", buf, blk["w_in"].astype(dt))
+    h1 = jax.nn.gelu(h1 + blk["w_in_b"][:, None, :].astype(dt))
+    out = jnp.einsum("ecm,emh->ech", h1, blk["w_out"].astype(dt))
+    out = out + blk["w_out_b"][:, None, :].astype(dt)
+
+    if cfg.ep_axis is not None:
+        out = jax.lax.all_to_all(out, cfg.ep_axis, split_axis=1,
+                                 concat_axis=0, tiled=True)   # [E, C, h]
+
+    y = jnp.einsum("tec,ech->th", combine.astype(dt), out)
+    return y, aux
+
+
+def _moe_block(carry, blk, cfg: MoEConfig, tp_size: int):
+    x, aux_acc = carry
+    x = x + _attention(_layernorm(x, blk["ln1"]["scale"], blk["ln1"]["bias"]),
+                       blk, cfg, tp_size)
+    b, s, h = x.shape
+    flat = _layernorm(x, blk["ln2"]["scale"], blk["ln2"]["bias"]).reshape(-1, h)
+    y, aux = _moe_ffn(flat, blk, cfg)
+    return (x + y.reshape(b, s, h), aux_acc + aux), None
+
+
+# ---------------------------------------------------------------- forward
+
+def moe_apply(params, cfg: MoEConfig, tokens: jnp.ndarray,
+              positions: Optional[jnp.ndarray] = None
+              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Forward to (hidden [b, s, h], mean aux loss). Call inside shard_map
+    when ep/tp/sp axes are set."""
+    if cfg.pp_axis is not None:
+        raise ValueError("MoE does not support pipeline parallelism yet; "
+                         "unset pp_axis")
+    dt = jnp.dtype(cfg.dtype)
+    b, s = tokens.shape
+    if positions is None:
+        if cfg.sp_axis is not None:
+            offset = jax.lax.axis_index(cfg.sp_axis) * s
+        else:
+            offset = 0
+        positions = offset + jnp.arange(s)
+    tp_size = jax.lax.axis_size(cfg.tp_axis) if cfg.tp_axis else 1
+    x = params["embed"]["tok"][tokens].astype(dt)
+    x = x + params["embed"]["pos"][positions].astype(dt)
+
+    blk_fn = partial(_moe_block, cfg=cfg, tp_size=tp_size)
+    if cfg.remat:
+        blk_fn = jax.checkpoint(blk_fn)
+
+    (x, aux), _ = jax.lax.scan(blk_fn, (x, jnp.float32(0.0)),
+                               params["blocks"])
+    x = _layernorm(x, params["final_ln"]["scale"], params["final_ln"]["bias"])
+    return x, aux / cfg.layers
+
+
+def moe_lm_loss(params, cfg: MoEConfig, batch) -> jnp.ndarray:
+    """Cross-entropy + load-balance aux. batch = (tokens, targets),
+    targets < 0 ignored (same convention as transformer.lm_loss)."""
+    tokens, targets = batch
+    h, aux = moe_apply(params, cfg, tokens)
+    lg = jnp.einsum("bsh,vh->bsv", h.astype(jnp.float32),
+                    params["embed"]["tok"].astype(jnp.float32))
+    logp = jax.nn.log_softmax(lg, axis=-1)
+    mask = (targets >= 0)
+    tgt = jnp.where(mask, targets, 0)
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    nll_sum = (nll * mask).sum()
+    cnt = mask.sum().astype(jnp.float32)
+    if cfg.sp_axis is not None:
+        nll_sum = jax.lax.psum(nll_sum, cfg.sp_axis)
+        cnt = jax.lax.psum(cnt, cfg.sp_axis)
+    return nll_sum / jnp.maximum(cnt, 1.0) + cfg.aux_weight * aux
+
+
+def moe_tiny(**kw) -> MoEConfig:
+    """Test-sized config."""
+    return MoEConfig(vocab_size=128, hidden=64, layers=2, heads=4,
+                     mlp_dim=128, max_seq=64, causal=False, dtype="float32",
+                     remat=False, num_experts=4, top_k=2, **kw)

@@ -165,9 +165,13 @@ def apply(params, cfg: TransformerConfig, tokens: jnp.ndarray,
           positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Forward to final hidden states [b, s_local, hidden].
 
-    Call inside shard_map when tp/sp axes are set. With sp_axis, ``tokens``
-    is the local sequence shard and ``positions`` must be the global
-    positions of that shard (defaults assume shard-contiguous layout).
+    Call inside shard_map when tp/sp/pp axes are set. With sp_axis,
+    ``tokens`` is the local sequence shard and ``positions`` must be the
+    global positions of that shard (defaults assume shard-contiguous
+    layout). With pp_axis, the returned hidden states are only valid on
+    the LAST pipeline stage — finite zeros-fed garbage elsewhere; mask
+    any derived quantity with ``parallel.pipeline.last_stage_value`` (as
+    ``lm_loss`` does) before use.
     """
     dt = jnp.dtype(cfg.dtype)
     b, s = tokens.shape

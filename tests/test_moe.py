@@ -1,0 +1,97 @@
+"""MoE + expert parallelism: EP equivalence, dropping, end-to-end training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import byteps_tpu as bps
+from byteps_tpu.models import bert, moe
+from byteps_tpu.parallel.mesh import make_mesh
+from byteps_tpu.training import ShardedTrainer
+
+
+def _batch(rng, b, s, vocab):
+    return bert.synth_mlm_batch(rng, b, s, vocab)
+
+
+def test_moe_forward_shapes_and_aux():
+    cfg = moe.moe_tiny()
+    params = moe.init_moe_params(jax.random.PRNGKey(0), cfg)
+    toks = np.random.RandomState(1).randint(1, 100, (2, 16)).astype(np.int32)
+    h, aux = moe.moe_apply(params, cfg, jnp.asarray(toks))
+    assert h.shape == (2, 16, cfg.hidden)
+    # perfectly balanced routing gives aux == 1; anything finite ≥ ~1 is sane
+    assert np.isfinite(float(aux)) and float(aux) > 0.5
+
+
+def test_expert_parallel_matches_single_device():
+    """ep=4 hidden states equal the unsharded forward per token when
+    capacity never drops — all_to_all only relocates compute. (Loss values
+    differ by the per-shard-masked-mean weighting, so hidden states are
+    the right equivalence target.)"""
+    mesh = make_mesh({"expert": 4}, devices=jax.devices()[:4])
+    # cf·k/E = 1 → capacity = T even if every token picks the same expert
+    cfg_ep = moe.moe_tiny(ep_axis="expert", capacity_factor=2.0)
+    cfg_ref = moe.moe_tiny(capacity_factor=2.0)
+    params = moe.init_moe_params(jax.random.PRNGKey(2), cfg_ref)
+    toks = np.random.RandomState(3).randint(
+        1, 100, (8, 32)).astype(np.int32)
+    want, _ = moe.moe_apply(params, cfg_ref, jnp.asarray(toks))
+
+    specs = moe.moe_param_specs(cfg_ep)
+
+    def fwd(p, t):
+        h, _ = moe.moe_apply(p, cfg_ep, t)   # batch shard per rank
+        return h
+
+    fn = jax.jit(jax.shard_map(fwd, mesh=mesh,
+                               in_specs=(specs, P("expert")),
+                               out_specs=P("expert"), check_vma=False))
+    sharded = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs,
+        is_leaf=lambda x: not isinstance(x, (dict, list)))
+    got = np.asarray(fn(sharded, jnp.asarray(toks)))
+    np.testing.assert_allclose(got, np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
+def test_capacity_dropping_is_graceful():
+    """Tiny capacity drops most tokens; the residual path still carries
+    them — loss stays finite and close to the no-expert baseline."""
+    cfg = moe.moe_tiny(capacity_factor=0.1)
+    params = moe.init_moe_params(jax.random.PRNGKey(4), cfg)
+    jb = tuple(jnp.asarray(b)
+               for b in _batch(np.random.RandomState(5), 4, 32, cfg.vocab_size))
+    loss = float(moe.moe_lm_loss(params, cfg, jb))
+    assert np.isfinite(loss)
+
+
+def test_moe_trains_expert_parallel():
+    """{expert:4, data:2} training memorizes a fixed batch; expert weights
+    get complete gradients through the all_to_all round trip."""
+    cfg = moe.moe_tiny(ep_axis="expert")
+    mesh = make_mesh({"expert": 4, "data": 2})
+    params = moe.init_moe_params(jax.random.PRNGKey(6), cfg)
+    tr = ShardedTrainer(lambda p, b: moe.moe_lm_loss(p, cfg, b),
+                        params, moe.moe_param_specs(cfg),
+                        optax.adam(3e-3), mesh=mesh,
+                        batch_spec=P(("data", "expert")))
+    fixed = _batch(np.random.RandomState(7), 16, 32, cfg.vocab_size)
+    losses = [float(tr.step(fixed)) for _ in range(30)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8
+
+
+def test_moe_gradients_flow_to_all_experts():
+    """Every expert used by routing receives gradient (no dead all_to_all
+    transpose)."""
+    cfg = moe.moe_tiny()
+    params = moe.init_moe_params(jax.random.PRNGKey(8), cfg)
+    jb = tuple(jnp.asarray(b)
+               for b in _batch(np.random.RandomState(9), 8, 32, cfg.vocab_size))
+    g = jax.grad(moe.moe_lm_loss)(params, cfg, jb)
+    gw = np.asarray(g["blocks"]["w_in"])   # [L, E, h, m]
+    per_expert = np.abs(gw).sum(axis=(0, 2, 3))
+    assert (per_expert > 0).all(), per_expert

@@ -8,13 +8,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-import byteps_tpu as bps
 from byteps_tpu.models import bert, gpt2, transformer
 from byteps_tpu.parallel.mesh import make_mesh
-from byteps_tpu.parallel.pipeline import pipeline
+from byteps_tpu.parallel.pipeline import last_stage_value, pipeline
 from byteps_tpu.training import DistributedTrainer, ShardedTrainer
 
 
@@ -39,9 +37,7 @@ def test_pipeline_primitive_matches_sequential():
     def run(ws, x):
         out = pipeline(stage_fn, ws, x, "pipe")
         # replicate last stage's outputs so out_specs can be P()
-        n = jax.lax.axis_size("pipe")
-        is_last = jax.lax.axis_index("pipe") == n - 1
-        return jax.lax.psum(jnp.where(is_last, out, jnp.zeros_like(out)), "pipe")
+        return last_stage_value(out, "pipe")
 
     fn = jax.jit(jax.shard_map(run, mesh=mesh, in_specs=(P("pipe"), P()),
                                out_specs=P(), check_vma=False))
