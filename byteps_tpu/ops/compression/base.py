@@ -14,7 +14,9 @@ TPU-native differences:
     (``compressor_type``, ``ef_type``, ``momentum_type``, ``compressor_k``,
     ``compressor_onebit_scaling``, ``momentum_mu``, ``seed``,
     ``dithering_partition``, ``dithering_normalize``) so per-tensor attrs
-    written for the reference port directly.
+    written for the reference port directly. ``compressor_backend``
+    (auto|pallas|jnp) selects the Pallas kernel path — currently honored
+    by onebit only; other compressors ignore it.
 """
 
 from __future__ import annotations
