@@ -39,21 +39,52 @@ def _make(inner: optax.GradientTransformation, axes: Tuple[str, ...],
 
 def _make_compressed(inner: optax.GradientTransformation, axes: Tuple[str, ...],
                      average: bool, partition_bytes: int,
-                     compression: dict, min_compress_bytes: int):
+                     compression: dict, min_compress_bytes: int,
+                     leaf_specs=None, state_world: int = 1):
+    """Compressed-allreduce wrapper.
+
+    ``leaf_specs``: LOCAL per-shard leaf shapes (from
+    parallel.sharding.local_leaf_specs) when composing with TP/SP/PP;
+    defaults to the global shapes of the params passed to init (correct
+    for pure DP, where params are replicated).
+
+    ``state_world``: compressor state (EF error, momentum) diverges on
+    every device — the gradients it tracks are per-shard. State leaves get
+    a leading device axis of this size, sharded over all mesh axes by the
+    trainer; inside shard_map each rank sees (and updates) its [1, ...]
+    row. A replicated spec here would be silently wrong: XLA may
+    canonicalize "replicated" state to one rank's copy, losing every other
+    rank's error memory.
+    """
+    import jax
+    import jax.numpy as jnp
     from .ops.compression.reducer import CompressionPlan
     plan_holder = {}
 
-    def init_fn(params):
-        plan = CompressionPlan.for_tree(params, partition_bytes,
-                                        {k: str(v) for k, v in compression.items()},
+    def _plan_for(params):
+        kw = {k: str(v) for k, v in compression.items()}
+        if leaf_specs is not None:
+            return CompressionPlan(leaf_specs, partition_bytes, kw,
+                                   min_compress_bytes)
+        return CompressionPlan.for_tree(params, partition_bytes, kw,
                                         min_compress_bytes)
-        plan_holder["plan"] = plan
-        return {"inner": inner.init(params), "comp": plan.init_state()}
+
+    def init_fn(params):
+        # rebuild per init: re-initing with a different tree must not
+        # reuse a stale bucket plan
+        plan = plan_holder["plan"] = _plan_for(params)
+        comp = jax.tree_util.tree_map(
+            lambda z: jnp.broadcast_to(z, (state_world,) + jnp.shape(z)),
+            plan.init_state())
+        return {"inner": inner.init(params), "comp": comp}
 
     def update_fn(grads, state, params=None, **extra):
         plan = plan_holder["plan"]
-        grads, comp_state = plan.reduce_tree(grads, state["comp"], axes,
+        local = jax.tree_util.tree_map(lambda x: x[0], state["comp"])
+        grads, comp_state = plan.reduce_tree(grads, local, axes,
                                              average=average)
+        comp_state = jax.tree_util.tree_map(lambda x: x[None],
+                                            comp_state)
         updates, inner_state = inner.update(grads, state["inner"], params, **extra)
         return updates, {"inner": inner_state, "comp": comp_state}
 
@@ -67,7 +98,9 @@ def distributed_optimizer(inner: optax.GradientTransformation,
                           backward_passes_per_step: int = 1,
                           reducer: Reducer = psum_reducer,
                           compression: dict | None = None,
-                          min_compress_bytes: int = 65536):
+                          min_compress_bytes: int = 65536,
+                          compression_leaf_specs=None,
+                          compression_state_world: int = 1):
     """Wrap an optax transformation with cross-replica gradient sync.
 
     ``backward_passes_per_step > 1`` accumulates locally and only
@@ -84,7 +117,9 @@ def distributed_optimizer(inner: optax.GradientTransformation,
     """
     if compression:
         gt = _make_compressed(inner, tuple(axes), average, partition_bytes,
-                              compression, min_compress_bytes)
+                              compression, min_compress_bytes,
+                              leaf_specs=compression_leaf_specs,
+                              state_world=compression_state_world)
     else:
         gt = _make(inner, tuple(axes), average, partition_bytes, reducer)
     if backward_passes_per_step > 1:

@@ -10,10 +10,37 @@ everything else.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, List, Optional, Tuple
 
 import jax
 from jax.sharding import PartitionSpec as P
+
+
+def spec_axes(spec) -> tuple:
+    """Mesh axes mentioned in a PartitionSpec (flattening tuple entries)."""
+    axes = []
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            axes.extend(entry)
+        else:
+            axes.append(entry)
+    return tuple(axes)
+
+
+def _paired_spec_leaves(tree, spec_tree):
+    """Zip tree leaves with spec leaves, insisting the counts line up —
+    a bare ``None`` spec leaf is an *empty pytree* and silently drops out
+    of flattening, mispairing everything after it."""
+    t_leaves = jax.tree_util.tree_leaves_with_path(tree)
+    s_leaves = jax.tree_util.tree_leaves_with_path(
+        spec_tree, is_leaf=lambda x: isinstance(x, P))
+    if len(t_leaves) != len(s_leaves):
+        raise ValueError(
+            f"spec tree has {len(s_leaves)} leaves but the value tree has "
+            f"{len(t_leaves)}; use P() (not None) for replicated leaves")
+    return t_leaves, s_leaves
 
 
 def _path_key(path) -> tuple:
@@ -28,26 +55,59 @@ def _path_key(path) -> tuple:
     return tuple(out)
 
 
-def opt_state_specs(tx, params, param_specs) -> Any:
-    """Infer PartitionSpecs for ``tx.init(params)``'s state tree."""
-    p_entries = []
-    for (ppath, pleaf), (_, spec) in zip(
-            jax.tree_util.tree_leaves_with_path(params),
-            jax.tree_util.tree_leaves_with_path(
-                param_specs, is_leaf=lambda x: isinstance(x, P))):
-        p_entries.append((_path_key(ppath), pleaf.shape, spec))
+def opt_state_specs(tx, params, param_specs,
+                    comp_axes: Optional[Tuple[str, ...]] = None) -> Any:
+    """Infer PartitionSpecs for ``tx.init(params)``'s state tree.
+
+    ``comp_axes``: when the transformation carries compressor state (the
+    ``"comp"`` subtree from a compressed distributed_optimizer), those
+    leaves are *per-device* — EF error and momentum diverge on every mesh
+    coordinate — so their leading device axis shards over all mesh axes.
+    """
+    p_leaves, s_leaves = _paired_spec_leaves(params, param_specs)
+    p_entries = [(_path_key(ppath), pleaf.shape, spec)
+                 for (ppath, pleaf), (_, spec) in zip(p_leaves, s_leaves)]
 
     state_shape = jax.eval_shape(tx.init, params)
 
     def assign(path, leaf):
         key = _path_key(path)
+        # param-derived leaves (mu/nu/...) match first, so a user param
+        # group literally named "comp" keeps its param spec; only
+        # unmatched leaves under a "comp" dict key are compressor state
         for pkey, pshape, spec in p_entries:
             if len(key) >= len(pkey) and key[-len(pkey):] == pkey \
                     and tuple(leaf.shape) == tuple(pshape):
                 return spec
+        if comp_axes and ("k", "comp") in key:
+            return P(comp_axes)
         return P()
 
     return jax.tree_util.tree_map_with_path(assign, state_shape)
+
+
+def local_leaf_specs(params, param_specs, mesh) -> List["LeafSpec"]:
+    """Per-shard LeafSpecs: each leaf's size divided by the product of the
+    mesh-axis sizes its PartitionSpec shards it over. This is the shape a
+    gradient leaf has *inside* shard_map — what a compression plan must be
+    built from when composing with TP/SP/PP."""
+    import numpy as np
+    from ..common.partition import LeafSpec
+
+    out = []
+    p_leaves, s_leaves = _paired_spec_leaves(params, param_specs)
+    for (path, leaf), (_, spec) in zip(p_leaves, s_leaves):
+        denom = 1
+        for ax in spec_axes(spec):
+            denom *= mesh.shape[ax]
+        size = int(np.prod(leaf.shape)) if leaf.shape else 1
+        if size % denom:
+            raise ValueError(f"leaf {jax.tree_util.keystr(path)} of size "
+                             f"{size} not divisible by sharding {spec}")
+        out.append(LeafSpec(name=jax.tree_util.keystr(path),
+                            size=size // denom,
+                            dtype=str(np.dtype(leaf.dtype))))
+    return out
 
 
 def shard_tree(tree, specs, mesh):

@@ -26,6 +26,7 @@ from .common.global_state import GlobalState
 from .optim import distributed_optimizer
 from .parallel.collectives import Reducer, psum_reducer
 from .parallel.mesh import data_axes, make_mesh
+from .parallel.sharding import spec_axes as _spec_axes
 
 
 class DistributedTrainer:
@@ -65,15 +66,29 @@ class DistributedTrainer:
                                         backward_passes_per_step=backward_passes_per_step,
                                         reducer=reducer,
                                         compression=compression,
-                                        min_compress_bytes=min_compress_bytes)
+                                        min_compress_bytes=min_compress_bytes,
+                                        compression_state_world=mesh.size)
         replicated = NamedSharding(mesh, P())
         # Copy (not alias) into the trainer: the step donates its param
         # buffers, and device_put aliases when the sharding already matches —
         # donation must never invalidate the caller's arrays.
         self.params = jax.tree_util.tree_map(
             lambda x: jax.device_put(jnp.array(x), replicated), params)
+        if compression:
+            # compressor state (EF error, momentum) is per-device: leading
+            # device axis sharded over the whole mesh (see _make_compressed)
+            from .parallel.sharding import opt_state_specs
+            self._ostate_spec = opt_state_specs(
+                self.tx, self.params,
+                jax.tree_util.tree_map(lambda _: P(), self.params),
+                comp_axes=tuple(mesh.axis_names))
+        else:
+            self._ostate_spec = P()
+        ostate_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), self._ostate_spec,
+            is_leaf=lambda x: isinstance(x, P))
         self.opt_state = jax.jit(self.tx.init,
-                                 out_shardings=replicated)(self.params)
+                                 out_shardings=ostate_shardings)(self.params)
         self._loss_fn = loss_fn
         self._step_fn = self._build_step(donate)
         self.step_count = 0
@@ -93,8 +108,8 @@ class DistributedTrainer:
 
         shard_fn = jax.shard_map(
             step, mesh=mesh,
-            in_specs=(P(), P(), batch_spec),
-            out_specs=(P(), P(), P()),
+            in_specs=(P(), self._ostate_spec, batch_spec),
+            out_specs=(P(), self._ostate_spec, P()),
             check_vma=False)
         donate_argnums = (0, 1) if donate else ()
         return jax.jit(shard_fn, donate_argnums=donate_argnums)
@@ -141,30 +156,40 @@ class ShardedTrainer:
                  compression: Optional[dict] = None,
                  min_compress_bytes: int = 65536,
                  donate: bool = True) -> None:
-        from .parallel.sharding import opt_state_specs, shard_tree
+        from .parallel.sharding import (local_leaf_specs, opt_state_specs,
+                                        shard_tree)
 
         self.mesh = mesh
         self.dp_axes = data_axes(mesh)
         other_axes = tuple(ax for ax in mesh.axis_names
                            if ax not in self.dp_axes)
-        if compression and other_axes:
-            # The compression plan is built from global leaf shapes but
-            # would run on local TP/SP shards inside shard_map; per-rank
-            # plans with spec-sharded EF/momentum state are future work.
-            raise NotImplementedError(
-                "gradient compression currently composes with data "
-                f"parallelism only; mesh has non-data axes {other_axes}")
+        # Compression composes with TP/SP/PP: the plan is built from the
+        # LOCAL (per-shard) leaf shapes gradients have inside shard_map,
+        # and compressor state is per-device (leading axis over the mesh).
+        comp_specs = (local_leaf_specs(params, param_spec_tree, mesh)
+                      if compression else None)
         self.tx = distributed_optimizer(
             tx, axes=self.dp_axes, partition_bytes=partition_bytes,
-            compression=compression, min_compress_bytes=min_compress_bytes)
+            compression=compression, min_compress_bytes=min_compress_bytes,
+            compression_leaf_specs=comp_specs,
+            compression_state_world=mesh.size)
         self.pspec = param_spec_tree
-        self.ospec = opt_state_specs(self.tx, params, param_spec_tree)
+        self.ospec = opt_state_specs(
+            self.tx, params, param_spec_tree,
+            comp_axes=tuple(mesh.axis_names) if compression else None)
         if batch_spec is None:
             seq_ax = "seq" if "seq" in mesh.axis_names else None
             batch_spec = P(self.dp_axes if self.dp_axes else None, seq_ax)
         self.batch_spec = batch_spec
         self.params = shard_tree(params, self.pspec, mesh)
-        self.opt_state = shard_tree(self.tx.init(params), self.ospec, mesh)
+        # init under jit with out_shardings so large state (and the
+        # per-device comp-state broadcast) never materializes unsharded
+        # on one device
+        ostate_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), self.ospec,
+            is_leaf=lambda x: isinstance(x, P))
+        self.opt_state = jax.jit(self.tx.init,
+                                 out_shardings=ostate_shardings)(params)
         loss_axes = tuple(ax for ax in mesh.axis_names
                           if ax in _spec_axes(batch_spec))
 
@@ -221,14 +246,3 @@ class ShardedTrainer:
         return loss
 
 
-def _spec_axes(spec) -> tuple:
-    """Mesh axes mentioned in a PartitionSpec."""
-    axes = []
-    for entry in spec:
-        if entry is None:
-            continue
-        if isinstance(entry, (tuple, list)):
-            axes.extend(entry)
-        else:
-            axes.append(entry)
-    return tuple(axes)
