@@ -110,6 +110,41 @@ def broadcast_parameters(tree, root_rank: int = 0):
     return GlobalState.get().engine.broadcast(tree, root_rank)
 
 
+def broadcast_optimizer_state(opt_state, root_rank: int = 0):
+    """Broadcast root's optimizer state to all ranks (reference:
+    torch/__init__.py:293-409, which tensor-izes scalar state first).
+
+    Same stacked convention as ``push_pull``/``broadcast_parameters``:
+    array leaves carry a leading [dp, ...] replica axis (scalar state as
+    [dp] arrays — already tensor-ized in optax). Non-array leaves (None,
+    callables) pass through untouched."""
+    import jax.numpy as jnp
+    eng = GlobalState.get().engine
+    dp = eng.dp
+    leaves, treedef = jax.tree_util.tree_flatten(opt_state)
+    arr_idx, scalar_idx, sub = [], set(), []
+    for i, l in enumerate(leaves):
+        if not hasattr(l, "dtype"):
+            continue
+        l = jnp.asarray(l)
+        if l.ndim == 0:
+            # tensor-ize scalar state (the reference does the same,
+            # torch/__init__.py:293-409): tile to [dp], squeeze after
+            scalar_idx.add(i)
+            l = jnp.tile(l[None], dp)
+        elif l.shape[0] != dp:
+            raise ValueError(
+                f"broadcast_optimizer_state expects stacked [dp={dp}, ...] "
+                f"leaves; got shape {tuple(l.shape)} — stack per-replica "
+                "state on a leading replica axis first")
+        arr_idx.append(i)
+        sub.append(l)
+    out = eng.broadcast(sub, root_rank)
+    for i, v in zip(arr_idx, out):
+        leaves[i] = v[0] if i in scalar_idx else v
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def get_pushpull_speed() -> float:
     """MB/s over a 10 s sliding window (reference: global.cc:697-752)."""
     t = GlobalState.get().telemetry
@@ -131,6 +166,7 @@ def DistributedTrainer(*args, **kwargs):
 __all__ = [
     "init", "shutdown", "suspend", "resume", "rank", "size", "local_rank",
     "local_size", "declare_tensor", "push_pull", "broadcast_parameters",
-    "get_pushpull_speed", "DistributedOptimizer", "DistributedTrainer",
+    "broadcast_optimizer_state", "get_pushpull_speed",
+    "DistributedOptimizer", "DistributedTrainer",
     "Config", "__version__",
 ]

@@ -1,0 +1,82 @@
+"""Training-loop helpers mirroring the reference's Keras callbacks
+(reference: byteps/_keras/callbacks.py:23-196 — BroadcastGlobalVariables,
+MetricAverage, LearningRateSchedule, LearningRateWarmup).
+
+Keras callbacks mutate a stateful training loop; the JAX-native shape of
+the same features is (a) optax *schedules* for everything learning-rate
+(they live inside the jitted step, so there is no per-epoch host sync),
+and (b) pure functions over host metrics for cross-process averaging.
+Parameter broadcast at train start is ``bps.broadcast_parameters`` /
+``bps.broadcast_optimizer_state`` (and DistributedTrainer replicates by
+construction).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Mapping, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# -- learning-rate schedules (reference: LearningRateScheduleCallback) ------
+
+def multiplier_schedule(base_lr: float,
+                        multiplier: Union[float, Callable[[int], float]],
+                        staircase_every: Optional[int] = None):
+    """optax-style schedule ``step -> lr``: ``base_lr * multiplier(step)``.
+
+    ``multiplier`` may be a constant or a callable of the step count
+    (reference passes a callable of epoch; steps are the JAX-native unit).
+    ``staircase_every`` quantizes the step (reference: staircase=True
+    evaluates the multiplier on whole epochs only).
+    """
+    def sched(step):
+        s = step // staircase_every * staircase_every if staircase_every else step
+        m = multiplier(s) if callable(multiplier) else multiplier
+        return jnp.asarray(base_lr * m, jnp.float32)
+    return sched
+
+
+def warmup_schedule(base_lr: float, world_size: int, warmup_steps: int,
+                    after: Optional[Callable[[int], float]] = None):
+    """Gradual warmup (Goyal et al. 2017; reference:
+    LearningRateWarmupCallback): ramp from ``base_lr`` to
+    ``world_size * base_lr`` over ``warmup_steps``, then follow ``after``
+    (a schedule on post-warmup steps, itself scaled by world_size) or stay
+    flat at the scaled rate.
+    """
+    peak = base_lr * world_size
+
+    def sched(step):
+        frac = jnp.minimum(step / max(warmup_steps, 1), 1.0)
+        warm = base_lr + frac * (peak - base_lr)
+        if after is None:
+            return jnp.asarray(warm, jnp.float32)
+        post = jnp.asarray(after(jnp.maximum(step - warmup_steps, 0)),
+                           jnp.float32) * world_size
+        return jnp.where(step < warmup_steps, warm, post).astype(jnp.float32)
+    return sched
+
+
+# -- metric averaging (reference: MetricAverageCallback) --------------------
+
+def metric_average(metrics: Union[float, Mapping[str, float]],
+                   ) -> Union[float, Dict[str, float]]:
+    """Average host-side metrics across processes (reference averages epoch
+    logs over workers). Single-process jobs (including a multi-chip mesh
+    under one controller, where trainer losses are already global means)
+    return the input unchanged.
+    """
+    if jax.process_count() == 1:
+        return dict(metrics) if isinstance(metrics, Mapping) else metrics
+    from jax.experimental import multihost_utils
+
+    def avg_one(v: float) -> float:
+        vals = multihost_utils.process_allgather(jnp.float32(v))
+        return float(np.mean(np.asarray(vals)))
+
+    if isinstance(metrics, Mapping):
+        return {k: avg_one(v) for k, v in metrics.items()}
+    return avg_one(metrics)

@@ -76,17 +76,17 @@ def _make_compressed(inner: optax.GradientTransformation, axes: Tuple[str, ...],
         comp = jax.tree_util.tree_map(
             lambda z: jnp.broadcast_to(z, (state_world,) + jnp.shape(z)),
             plan.init_state())
-        return {"inner": inner.init(params), "comp": comp}
+        return {"inner": inner.init(params), "bps_comp": comp}
 
     def update_fn(grads, state, params=None, **extra):
         plan = plan_holder["plan"]
-        local = jax.tree_util.tree_map(lambda x: x[0], state["comp"])
+        local = jax.tree_util.tree_map(lambda x: x[0], state["bps_comp"])
         grads, comp_state = plan.reduce_tree(grads, local, axes,
                                              average=average)
         comp_state = jax.tree_util.tree_map(lambda x: x[None],
                                             comp_state)
         updates, inner_state = inner.update(grads, state["inner"], params, **extra)
-        return updates, {"inner": inner_state, "comp": comp_state}
+        return updates, {"inner": inner_state, "bps_comp": comp_state}
 
     return optax.GradientTransformation(init_fn, update_fn)
 

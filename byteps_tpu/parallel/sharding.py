@@ -60,7 +60,7 @@ def opt_state_specs(tx, params, param_specs,
     """Infer PartitionSpecs for ``tx.init(params)``'s state tree.
 
     ``comp_axes``: when the transformation carries compressor state (the
-    ``"comp"`` subtree from a compressed distributed_optimizer), those
+    ``"bps_comp"`` subtree from a compressed distributed_optimizer), those
     leaves are *per-device* — EF error and momentum diverge on every mesh
     coordinate — so their leading device axis shards over all mesh axes.
     """
@@ -73,13 +73,13 @@ def opt_state_specs(tx, params, param_specs,
     def assign(path, leaf):
         key = _path_key(path)
         # param-derived leaves (mu/nu/...) match first, so a user param
-        # group literally named "comp" keeps its param spec; only
-        # unmatched leaves under a "comp" dict key are compressor state
+        # group literally named "bps_comp" keeps its param spec; only
+        # unmatched leaves under a "bps_comp" dict key are compressor state
         for pkey, pshape, spec in p_entries:
             if len(key) >= len(pkey) and key[-len(pkey):] == pkey \
                     and tuple(leaf.shape) == tuple(pshape):
                 return spec
-        if comp_axes and ("k", "comp") in key:
+        if comp_axes and ("k", "bps_comp") in key:
             return P(comp_axes)
         return P()
 
@@ -119,3 +119,15 @@ def shard_tree(tree, specs, mesh):
     out = [jax.device_put(l, NamedSharding(mesh, s))
            for l, s in zip(leaves, flat_specs)]
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def init_sharded_state(tx, params, spec_tree, mesh):
+    """``tx.init(params)`` under jit with per-leaf out_shardings, so large
+    state (and per-device comp-state broadcasts) never materializes
+    unsharded on one device. ``spec_tree`` may be a single P() (applied to
+    every leaf) or a tree matching the state structure."""
+    from jax.sharding import NamedSharding
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), spec_tree,
+        is_leaf=lambda x: isinstance(x, P))
+    return jax.jit(tx.init, out_shardings=shardings)(params)

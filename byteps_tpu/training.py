@@ -84,11 +84,9 @@ class DistributedTrainer:
                 comp_axes=tuple(mesh.axis_names))
         else:
             self._ostate_spec = P()
-        ostate_shardings = jax.tree_util.tree_map(
-            lambda s: NamedSharding(mesh, s), self._ostate_spec,
-            is_leaf=lambda x: isinstance(x, P))
-        self.opt_state = jax.jit(self.tx.init,
-                                 out_shardings=ostate_shardings)(self.params)
+        from .parallel.sharding import init_sharded_state
+        self.opt_state = init_sharded_state(self.tx, self.params,
+                                            self._ostate_spec, mesh)
         self._loss_fn = loss_fn
         self._step_fn = self._build_step(donate)
         self.step_count = 0
@@ -156,8 +154,8 @@ class ShardedTrainer:
                  compression: Optional[dict] = None,
                  min_compress_bytes: int = 65536,
                  donate: bool = True) -> None:
-        from .parallel.sharding import (local_leaf_specs, opt_state_specs,
-                                        shard_tree)
+        from .parallel.sharding import (init_sharded_state, local_leaf_specs,
+                                        opt_state_specs, shard_tree)
 
         self.mesh = mesh
         self.dp_axes = data_axes(mesh)
@@ -182,14 +180,7 @@ class ShardedTrainer:
             batch_spec = P(self.dp_axes if self.dp_axes else None, seq_ax)
         self.batch_spec = batch_spec
         self.params = shard_tree(params, self.pspec, mesh)
-        # init under jit with out_shardings so large state (and the
-        # per-device comp-state broadcast) never materializes unsharded
-        # on one device
-        ostate_shardings = jax.tree_util.tree_map(
-            lambda s: NamedSharding(mesh, s), self.ospec,
-            is_leaf=lambda x: isinstance(x, P))
-        self.opt_state = jax.jit(self.tx.init,
-                                 out_shardings=ostate_shardings)(params)
+        self.opt_state = init_sharded_state(self.tx, params, self.ospec, mesh)
         loss_axes = tuple(ax for ax in mesh.axis_names
                           if ax in _spec_axes(batch_spec))
 

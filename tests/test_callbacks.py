@@ -1,0 +1,74 @@
+"""Callback-equivalent helpers: LR schedules, metric averaging,
+broadcast_optimizer_state (reference: _keras/callbacks.py,
+torch/__init__.py:293-409)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import byteps_tpu as bps
+from byteps_tpu.callbacks import (metric_average, multiplier_schedule,
+                                  warmup_schedule)
+
+
+def test_multiplier_schedule_constant_and_callable():
+    s = multiplier_schedule(0.1, 0.5)
+    assert float(s(0)) == np.float32(0.05)
+    s2 = multiplier_schedule(0.1, lambda step: 2.0 if step >= 10 else 1.0)
+    assert float(s2(5)) == np.float32(0.1)
+    assert float(s2(10)) == np.float32(0.2)
+
+
+def test_multiplier_schedule_staircase():
+    s = multiplier_schedule(1.0, lambda step: step, staircase_every=100)
+    assert float(s(199)) == 100.0   # quantized down to whole "epochs"
+
+
+def test_warmup_schedule_ramps_to_scaled_lr():
+    s = warmup_schedule(0.1, world_size=8, warmup_steps=100)
+    assert np.isclose(float(s(0)), 0.1)
+    assert np.isclose(float(s(50)), 0.1 + 0.5 * 0.7)
+    assert np.isclose(float(s(100)), 0.8)
+    assert np.isclose(float(s(1000)), 0.8)   # flat after warmup
+
+
+def test_warmup_schedule_hands_off_to_after():
+    after = optax.exponential_decay(0.1, transition_steps=100, decay_rate=0.5)
+    s = warmup_schedule(0.1, world_size=4, warmup_steps=10, after=after)
+    assert np.isclose(float(s(10)), 0.4)     # after(0) * world
+    assert float(s(110)) < float(s(10))      # decaying
+    # usable inside an optax optimizer in a jitted step
+    tx = optax.adam(s)
+    p = {"w": jnp.ones(4)}
+    st = tx.init(p)
+    g = {"w": jnp.ones(4)}
+    up, _ = jax.jit(tx.update)(g, st, p)
+    assert np.isfinite(np.asarray(up["w"])).all()
+
+
+def test_metric_average_single_process_identity():
+    assert metric_average(3.5) == 3.5
+    assert metric_average({"loss": 1.0, "acc": 0.5}) == {"loss": 1.0,
+                                                        "acc": 0.5}
+
+
+def test_broadcast_optimizer_state(mesh8):
+    """Divergent per-rank state becomes root's everywhere; non-array
+    leaves pass through."""
+    bps.init(mesh=mesh8)
+    rng = np.random.RandomState(0)
+    state = {
+        "mu": jnp.asarray(rng.randn(8, 16).astype(np.float32)),
+        "count": jnp.arange(8, dtype=jnp.int32),   # scalar state as [dp]
+        "fn": None,
+    }
+    from tests.test_collectives import stacked
+    state["mu"] = stacked(mesh8, np.asarray(state["mu"]))
+    out = bps.broadcast_optimizer_state(state, root_rank=3)
+    mu = np.asarray(out["mu"])
+    for r in range(8):
+        np.testing.assert_allclose(mu[r], np.asarray(state["mu"])[3])
+    cnt = np.asarray(out["count"])
+    assert (cnt == 3).all()
+    assert out["fn"] is None

@@ -85,7 +85,7 @@ def test_compression_state_threads_through_steps(mesh8):
         min_compress_bytes=0)
     rng = np.random.RandomState(2)
     trainer.step(make_xor_batch(rng, 64))
-    comp_state = trainer.opt_state["comp"]
+    comp_state = trainer.opt_state["bps_comp"]
     errs = [np.abs(np.asarray(s["error"])).sum()
             for s in comp_state if isinstance(s, dict) and "error" in s]
     assert errs and any(e > 0 for e in errs)
@@ -105,7 +105,7 @@ def test_ef_state_diverges_per_device(mesh8):
     rng = np.random.RandomState(4)
     trainer.step(make_xor_batch(rng, 64))
     trainer.step(make_xor_batch(rng, 64))
-    for s in trainer.opt_state["comp"]:
+    for s in trainer.opt_state["bps_comp"]:
         if isinstance(s, dict) and "error" in s:
             rows = np.asarray(s["error"])          # [8, n]
             assert rows.shape[0] == 8
