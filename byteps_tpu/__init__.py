@@ -104,45 +104,30 @@ def push_pull(tree, average: bool = True, name: Optional[str] = None):
     return GlobalState.get().engine.push_pull(tree, average=average, name=name)
 
 
-def broadcast_parameters(tree, root_rank: int = 0):
+def broadcast_parameters(tree, root_rank: int = 0,
+                         stacked: Optional[bool] = None):
     """Broadcast root's parameters to all ranks (reference:
-    torch/__init__.py:259-291)."""
-    return GlobalState.get().engine.broadcast(tree, root_rank)
+    torch/__init__.py:259-291).
+
+    Leaves following the stacked eager convention (committed [dp, ...]
+    arrays sharded on the data axis — or any [dp, ...] leaf when
+    ``stacked=True``) are broadcast from root's row; replicated leaves
+    (plain numpy / unsharded / model-sharded) are already rank-consistent
+    under single-controller JAX and pass through (multi-process: broadcast
+    from the root's process). See PushPullEngine.broadcast."""
+    return GlobalState.get().engine.broadcast(tree, root_rank, stacked)
 
 
-def broadcast_optimizer_state(opt_state, root_rank: int = 0):
+def broadcast_optimizer_state(opt_state, root_rank: int = 0,
+                              stacked: Optional[bool] = None):
     """Broadcast root's optimizer state to all ranks (reference:
-    torch/__init__.py:293-409, which tensor-izes scalar state first).
-
-    Same stacked convention as ``push_pull``/``broadcast_parameters``:
-    array leaves carry a leading [dp, ...] replica axis (scalar state as
-    [dp] arrays — already tensor-ized in optax). Non-array leaves (None,
-    callables) pass through untouched."""
-    import jax.numpy as jnp
-    eng = GlobalState.get().engine
-    dp = eng.dp
-    leaves, treedef = jax.tree_util.tree_flatten(opt_state)
-    arr_idx, scalar_idx, sub = [], set(), []
-    for i, l in enumerate(leaves):
-        if not hasattr(l, "dtype"):
-            continue
-        l = jnp.asarray(l)
-        if l.ndim == 0:
-            # tensor-ize scalar state (the reference does the same,
-            # torch/__init__.py:293-409): tile to [dp], squeeze after
-            scalar_idx.add(i)
-            l = jnp.tile(l[None], dp)
-        elif l.shape[0] != dp:
-            raise ValueError(
-                f"broadcast_optimizer_state expects stacked [dp={dp}, ...] "
-                f"leaves; got shape {tuple(l.shape)} — stack per-replica "
-                "state on a leading replica axis first")
-        arr_idx.append(i)
-        sub.append(l)
-    out = eng.broadcast(sub, root_rank)
-    for i, v in zip(arr_idx, out):
-        leaves[i] = v[0] if i in scalar_idx else v
-    return jax.tree_util.tree_unflatten(treedef, leaves)
+    torch/__init__.py:293-409, which tensor-izes scalar state before its
+    torch broadcast — optax state is already arrays, so this is the same
+    per-leaf semantics as ``broadcast_parameters``: stacked [dp, ...]
+    data-sharded leaves — or any [dp, ...] leaf with ``stacked=True`` —
+    take root's row; replicated leaves are rank-consistent already and
+    pass through; non-array leaves (None, callables) untouched)."""
+    return GlobalState.get().engine.broadcast(opt_state, root_rank, stacked)
 
 
 def get_pushpull_speed() -> float:

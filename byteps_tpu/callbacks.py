@@ -64,19 +64,26 @@ def warmup_schedule(base_lr: float, world_size: int, warmup_steps: int,
 
 def metric_average(metrics: Union[float, Mapping[str, float]],
                    ) -> Union[float, Dict[str, float]]:
-    """Average host-side metrics across processes (reference averages epoch
-    logs over workers). Single-process jobs (including a multi-chip mesh
-    under one controller, where trainer losses are already global means)
-    return the input unchanged.
+    """Average host-side metrics across replicas AND processes (reference
+    averages epoch logs over workers). Stacked [dp]-leading values are
+    first averaged over the replica axis (delegates to
+    ``byteps_tpu.metrics.average_metrics``); then, in multi-process jobs,
+    values are averaged across processes. Single-process jobs with scalar
+    metrics (trainer losses are already global means) return the input
+    unchanged.
     """
+    from .metrics import average_metrics
+    metrics = average_metrics(metrics)
     if jax.process_count() == 1:
         return dict(metrics) if isinstance(metrics, Mapping) else metrics
     from jax.experimental import multihost_utils
 
-    def avg_one(v: float) -> float:
-        vals = multihost_utils.process_allgather(jnp.float32(v))
-        return float(np.mean(np.asarray(vals)))
-
     if isinstance(metrics, Mapping):
-        return {k: avg_one(v) for k, v in metrics.items()}
-    return avg_one(metrics)
+        # one batched allgather for all keys, not one barrier per metric
+        keys = list(metrics)
+        stackv = jnp.asarray([jnp.float32(metrics[k]) for k in keys])
+        vals = np.asarray(multihost_utils.process_allgather(stackv))
+        means = vals.mean(axis=0)
+        return {k: float(m) for k, m in zip(keys, means)}
+    vals = multihost_utils.process_allgather(jnp.float32(metrics))
+    return float(np.mean(np.asarray(vals)))

@@ -20,14 +20,19 @@ def average_metrics(metrics):
     identity unless a PS backend spans processes — kept for API parity and
     multi-process deployments.
     """
-    gs = GlobalState.get()
+    try:
+        gs = GlobalState.get()
+    except RuntimeError:   # not initialised: single replica, identity
+        return metrics
     if gs.dp <= 1:
         return metrics
-    # stack-convention tree: leading replica axis → mean over it
+    # stack-convention tree: leading replica axis → mean over it; other
+    # leaves untouched (cross-process averaging of host scalars is
+    # byteps_tpu.callbacks.metric_average, which delegates here first)
     def avg(x):
-        x = jnp.asarray(x)
-        if x.ndim >= 1 and x.shape[0] == gs.dp:
-            return x.mean(axis=0)
+        if getattr(x, "ndim", None) is not None and x.ndim >= 1 \
+                and x.shape[0] == gs.dp:
+            return jnp.asarray(x).mean(axis=0)
         return x
     return jax.tree_util.tree_map(avg, metrics)
 

@@ -280,10 +280,80 @@ class PushPullEngine:
         self._bcast_fns[root_rank] = fn
         return fn
 
-    def broadcast(self, tree, root_rank: int = 0):
+    def _stacked_leaf(self, leaf) -> bool:
+        """True iff ``leaf`` follows the stacked eager convention: a
+        committed [dp, ...] array sharded over the data axis. Plain numpy /
+        uncommitted / model-sharded leaves are NOT stacked — treating a
+        replicated [dp, k] weight as per-rank rows would corrupt it."""
+        if not isinstance(leaf, jax.Array) or leaf.ndim < 1 \
+                or leaf.shape[0] != self.dp:
+            return False
+        spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+        if not spec:
+            return False
+        s0 = spec[0]
+        names = (s0,) if isinstance(s0, str) else tuple(s0 or ())
+        return any(a in names for a in self.axes)
+
+    def broadcast(self, tree, root_rank: int = 0,
+                  stacked: Optional[bool] = None):
         """Replicate root's slice to all ranks (reference:
         broadcast_parameters = zero-non-root + push_pull sum,
-        torch/__init__.py:259-291 — here a native select + psum)."""
-        if not self.axes:
+        torch/__init__.py:259-291 — here a native select + psum).
+
+        Per-leaf semantics by ``stacked``:
+          - ``None`` (auto): leaves committed to the data axis with a
+            leading [dp, ...] replica dim get the masked-psum broadcast;
+            leaves committed to the mesh otherwise (replicated /
+            model-sharded) are globally consistent already and pass
+            through; host-local leaves pass through single-process (warned
+            when ambiguous, i.e. shape[0] == dp) and are broadcast from
+            root's process when there are several processes.
+          - ``True``: every array leaf with shape[0] == dp is committed to
+            the data sharding and broadcast (caller asserts the stacked
+            convention).
+          - ``False``: no leaf is treated as stacked.
+        """
+        nproc = jax.process_count()
+        if not self.axes and nproc == 1:
             return tree
-        return jax.tree_util.tree_map(self._bcast_program(root_rank), tree)
+        # no data axes (model-parallel-only mesh): no stacked leaves exist,
+        # but host-local leaves must still be made process-consistent below
+        fn = self._bcast_program(root_rank) if self.axes else None
+        stacked_sh = (jax.sharding.NamedSharding(self.mesh, P(self.axes))
+                      if self.axes else None)
+        warned = []
+
+        def committed_to_mesh(x) -> bool:
+            return isinstance(x, jax.Array) and isinstance(
+                getattr(x, "sharding", None), jax.sharding.NamedSharding)
+
+        def per_leaf(x):
+            is_arr = hasattr(x, "dtype") or isinstance(x, np.ndarray)
+            if not is_arr:
+                return x
+            leading_dp = (fn is not None and getattr(x, "ndim", 0) >= 1
+                          and x.shape[0] == self.dp)
+            if stacked is True and leading_dp:
+                return fn(jax.device_put(x, stacked_sh))
+            if stacked is None and fn is not None:
+                if self._stacked_leaf(x):
+                    return fn(x)
+                if committed_to_mesh(x):
+                    return x  # globally consistent by construction
+                if leading_dp and not warned:
+                    warned.append(True)
+                    from ..common.logging import get_logger
+                    get_logger().warning(
+                        "broadcast: leaf with leading dim == dp=%d is not "
+                        "committed to the data axis; treating it as "
+                        "replicated. Pass stacked=True (or device_put with "
+                        "a data-axis sharding) for per-rank row broadcast.",
+                        self.dp)
+            if nproc > 1 and not committed_to_mesh(x):
+                from jax.experimental import multihost_utils
+                src = jax.process_index() == (root_rank * nproc) // self.dp
+                return multihost_utils.broadcast_one_to_all(x, is_source=src)
+            return x
+
+        return jax.tree_util.tree_map(per_leaf, tree)

@@ -150,13 +150,11 @@ struct KeyStore {
   uint64_t round = 0;   // published rounds
   bool ready = false;   // merged holds a publishable round result
   int tid = 0;          // sticky engine thread
-  int enqueued = 0;     // pushes enqueued since init; round-relative
 };
 
 struct Task {
   uint64_t key;
   std::vector<char> data;  // owned copy of the pushed payload
-  bool first;              // COPY_FIRST vs SUM_RECV
 };
 
 class Server;
@@ -219,7 +217,6 @@ class Server {
     ks.merged.assign(nbytes, 0);
     ks.accum.assign(nbytes, 0);
     ks.push_count = ks.pull_count = 0;
-    ks.enqueued = 0;
     ks.round = 0;
     // sticky least-loaded thread assignment (reference: server.h:149-173)
     int best = 0;
@@ -248,17 +245,8 @@ class Server {
   int Push(uint64_t key, const void* data, uint64_t nbytes) {
     KeyStore* ks = Find(key);
     if (ks == nullptr || nbytes != ks->len) return -1;
-    bool first;
-    {
-      // first-of-round is positional: each worker pushes exactly once per
-      // round (the reference's contract — updates.request.size() counts)
-      std::lock_guard<std::mutex> lk(ks->mu);
-      first = (ks->enqueued % num_workers_) == 0;
-      ks->enqueued++;
-    }
     Task t;
     t.key = key;
-    t.first = first && !async_;
     t.data.assign((const char*)data, (const char*)data + nbytes);
     engines_[ks->tid]->Push(std::move(t));
     return 0;
@@ -278,7 +266,12 @@ class Server {
       ks->cv.notify_all();
       return;
     }
-    if (t.first) {
+    // COPY_FIRST vs SUM_RECV decided at apply time from push_count: a
+    // round's tasks may reach the engine in any interleaving (concurrent
+    // pushers, priority reordering), and summation is commutative, so
+    // whichever task lands first is the copy (reference: server.cc:290-342
+    // decides from updates.request.size() inside the handler).
+    if (ks->push_count == 0) {
       std::memcpy(ks->accum.data(), t.data.data(), ks->len);
     } else {
       reduce_sum(ks->accum.data(), t.data.data(), ks->len, ks->dtype);

@@ -65,7 +65,9 @@ def test_broadcast_optimizer_state(mesh8):
     }
     from tests.test_collectives import stacked
     state["mu"] = stacked(mesh8, np.asarray(state["mu"]))
-    out = bps.broadcast_optimizer_state(state, root_rank=3)
+    # "count" is an uncommitted [dp] array: stacked=True asserts the
+    # stacked convention for it (auto mode would treat it as replicated)
+    out = bps.broadcast_optimizer_state(state, root_rank=3, stacked=True)
     mu = np.asarray(out["mu"])
     for r in range(8):
         np.testing.assert_allclose(mu[r], np.asarray(state["mu"])[3])

@@ -77,6 +77,39 @@ def test_broadcast_parameters(mesh8):
         np.testing.assert_allclose(out[r], x[3])
 
 
+def test_broadcast_replicated_leaves_identity(mesh8):
+    """Replicated params — plain numpy, any shape, even leading dim == dp —
+    must pass through untouched: they are rank-consistent by construction
+    and masked-psum on a replicated [dp, k] weight would corrupt it."""
+    eng = PushPullEngine(mesh8)
+    tree = {
+        "w": np.arange(6.0, dtype=np.float32),          # not divisible by dp
+        "v": np.arange(DP * 3.0, dtype=np.float32).reshape(DP, 3),  # ambiguous
+        "s": np.float32(2.5),
+        "none": None,
+        "fn": len,
+    }
+    out = eng.broadcast(tree, root_rank=3)
+    np.testing.assert_allclose(np.asarray(out["w"]), tree["w"])
+    np.testing.assert_allclose(np.asarray(out["v"]), tree["v"])
+    np.testing.assert_allclose(np.asarray(out["s"]), 2.5)
+    assert out["none"] is None and out["fn"] is len
+
+
+def test_broadcast_stacked_flag_commits_host_arrays(mesh8):
+    """stacked=True treats uncommitted [dp, ...] leaves as per-rank rows."""
+    eng = PushPullEngine(mesh8)
+    x = np.arange(DP * 4, dtype=np.float32).reshape(DP, 4)
+    out = np.asarray(eng.broadcast({"g": x}, root_rank=2, stacked=True)["g"])
+    for r in range(DP):
+        np.testing.assert_allclose(out[r], x[2])
+    # stacked=False: even a committed data-sharded leaf passes through
+    dev = stacked(mesh8, x)
+    keep = np.asarray(eng.broadcast({"g": dev}, root_rank=2,
+                                    stacked=False)["g"])
+    np.testing.assert_allclose(keep, x)
+
+
 def test_bucketed_allreduce_inside_shard_map(mesh8):
     """The in-jit form: grads computed per-shard, reduced in buckets."""
     rng = np.random.RandomState(2)
