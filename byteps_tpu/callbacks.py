@@ -79,11 +79,15 @@ def metric_average(metrics: Union[float, Mapping[str, float]],
     from jax.experimental import multihost_utils
 
     if isinstance(metrics, Mapping):
-        # one batched allgather for all keys, not one barrier per metric
+        # one batched allgather for all keys, not one barrier per metric;
+        # non-scalar values collapse to their mean (the return contract is
+        # one float per key, matching the reference's epoch-log averaging)
         keys = list(metrics)
-        stackv = jnp.asarray([jnp.float32(metrics[k]) for k in keys])
+        stackv = jnp.asarray([jnp.mean(jnp.asarray(metrics[k], jnp.float32))
+                              for k in keys])
         vals = np.asarray(multihost_utils.process_allgather(stackv))
         means = vals.mean(axis=0)
         return {k: float(m) for k, m in zip(keys, means)}
-    vals = multihost_utils.process_allgather(jnp.float32(metrics))
+    vals = multihost_utils.process_allgather(
+        jnp.mean(jnp.asarray(metrics, jnp.float32)))
     return float(np.mean(np.asarray(vals)))
