@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import time
+from functools import partial
 
 import jax
 import numpy as np
@@ -30,7 +31,7 @@ def main() -> None:
     on_tpu = jax.devices()[0].platform != "cpu"
     if on_tpu:
         cfg = bert.bert_large(max_seq=512)
-        batch, seq = 8, 512
+        batch, seq = 32, 512      # larger per-chip batch keeps the MXU fed
         iters = 5
     else:  # CPU smoke fallback so the bench always emits a line
         cfg = bert.bert_tiny()
@@ -44,18 +45,15 @@ def main() -> None:
     def loss_fn(p, b):
         return bert.mlm_loss(p, cfg, b)
 
-    trainer = DistributedTrainer(loss_fn, params, optax.adamw(1e-4))
-    float(trainer.step(data))               # compile + sync (readback forces
-    t0 = time.perf_counter()                # real execution on the tunnel)
-    for _ in range(iters):
-        loss = trainer.step(data)
-    float(loss)                             # chained deps -> full timing
-    fw_sps = batch * iters / (time.perf_counter() - t0)
-
-    # plain-JAX baseline: identical model/optimizer, no framework
+    # The first seconds of execution on a fresh process/tunnel run a few
+    # percent slow, so EACH phase runs `warm` untimed steps before its
+    # timed window — enough to saturate chip warmup so phase order doesn't
+    # bias the ratio. (The two phases can't coexist: two param+adam copies
+    # of BERT-large exceed one chip's HBM, hence the del/gc between them.)
+    warm = 3 if on_tpu else 1
     tx = optax.adamw(1e-4)
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(0, 1))
     def plain_step(p, s, b):
         l, g = jax.value_and_grad(loss_fn)(p, b)
         u, s = tx.update(g, s, p)
@@ -63,13 +61,30 @@ def main() -> None:
 
     state = tx.init(params)
     jb = (np.asarray(data[0]), np.asarray(data[1]))
-    p2, s2, l = plain_step(params, state, jb)
+    # donate a COPY: `params` itself seeds the framework phase below
+    p2 = jax.tree_util.tree_map(jax.numpy.array, params)
+    for _ in range(warm):
+        p2, s2, l = plain_step(p2, state, jb)
+        state = s2
     float(l)
     t0 = time.perf_counter()
     for _ in range(iters):
         p2, s2, l = plain_step(p2, s2, jb)
     float(l)
     plain_sps = batch * iters / (time.perf_counter() - t0)
+    del p2, s2, state
+    import gc
+    gc.collect()
+
+    trainer = DistributedTrainer(loss_fn, params, optax.adamw(1e-4))
+    for _ in range(warm):                   # compile + chip warmup (readback
+        loss = trainer.step(data)           # forces real execution on the
+    float(loss)                             # tunnel)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = trainer.step(data)
+    float(loss)                             # chained deps -> full timing
+    fw_sps = batch * iters / (time.perf_counter() - t0)
 
     print(json.dumps({
         "metric": "bert_large_mlm_train_throughput" if on_tpu

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.ring import local_attention, ring_attention
+from ..parallel.ring import ring_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -43,6 +43,7 @@ class TransformerConfig:
     causal: bool = False          # False: BERT-style encoder; True: GPT
     dtype: str = "bfloat16"       # compute dtype (params stay fp32)
     remat: bool = True            # checkpoint each block
+    attn_impl: str = "auto"       # auto | flash (Pallas) | naive
     tp_axis: Optional[str] = None # mesh axis for tensor parallelism
     sp_axis: Optional[str] = None # mesh axis for ring-attention seq shards
     pp_axis: Optional[str] = None # mesh axis for pipeline (layer) stages
@@ -135,7 +136,8 @@ def _attention(x, blk, cfg: TransformerConfig, tp_size: int):
     if cfg.sp_axis is not None:
         out = ring_attention(q, k, v, cfg.sp_axis, causal=cfg.causal)
     else:
-        out = local_attention(q, k, v, causal=cfg.causal)
+        from ..ops.flash_attention import attention
+        out = attention(q, k, v, causal=cfg.causal, impl=cfg.attn_impl)
     out = out.reshape(b, s, local_heads * cfg.head_dim)
     out = out @ blk["attn_out"].astype(x.dtype)   # row-parallel: partial sum
     if cfg.tp_axis is not None:
@@ -217,9 +219,14 @@ def apply(params, cfg: TransformerConfig, tokens: jnp.ndarray,
 
 
 def logits(params, cfg: TransformerConfig, hidden: jnp.ndarray) -> jnp.ndarray:
-    """Tied-embedding LM head → [b, s, vocab] in fp32."""
-    return jnp.einsum("bsh,vh->bsv", hidden.astype(jnp.float32),
-                      params["embed"]["tok"].astype(jnp.float32))
+    """Tied-embedding LM head → [b, s, vocab] in fp32.
+
+    The matmul runs at the compute dtype (bf16 on the MXU — at fp32 this
+    one op dominates the step) with fp32 accumulation."""
+    dt = jnp.dtype(cfg.dtype)
+    return jnp.einsum("bsh,vh->bsv", hidden.astype(dt),
+                      params["embed"]["tok"].astype(dt),
+                      preferred_element_type=jnp.float32)
 
 
 def lm_loss(params, cfg: TransformerConfig, batch) -> jnp.ndarray:

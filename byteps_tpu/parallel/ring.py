@@ -24,8 +24,10 @@ import jax.numpy as jnp
 
 def _block_attn(q, k, v, bias, scale):
     """One block: scores [*, hq, sq, sk] → (unnormalized out, row max, row
-    normalizer)."""
-    s = jnp.einsum("...qhd,...khd->...hqk", q, k) * scale
+    normalizer). Inputs stay in their compute dtype (bf16 on the MXU);
+    accumulation is fp32 via preferred_element_type."""
+    s = jnp.einsum("...qhd,...khd->...hqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if bias is not None:
         s = s + bias
     m = jnp.max(s, axis=-1, keepdims=True)            # [..., h, sq, 1]
@@ -33,7 +35,8 @@ def _block_attn(q, k, v, bias, scale):
     m = jnp.maximum(m, -1e30)
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jnp.einsum("...hqk,...khd->...qhd", p, v)
+    o = jnp.einsum("...hqk,...khd->...qhd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
     return o, m, l
 
 
@@ -72,9 +75,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     def accumulate(step, o, m, l, k_blk, v_blk):
         kv_rank = (idx - step) % sp
         bias = make_bias(kv_rank)
-        o_b, m_b, l_b = _block_attn(q.astype(jnp.float32),
-                                    k_blk.astype(jnp.float32),
-                                    v_blk.astype(jnp.float32), bias, scale)
+        o_b, m_b, l_b = _block_attn(q, k_blk, v_blk, bias, scale)
         new_m = jnp.maximum(m, m_b)
         alpha = jnp.exp(m - new_m)        # rescale old accumulation
         beta = jnp.exp(m_b - new_m)       # rescale new block
@@ -110,11 +111,12 @@ def local_attention(q, k, v, causal: bool = False,
     b, s, h, d = q.shape
     if scale is None:
         scale = d ** -0.5
-    sc = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                    k.astype(jnp.float32)) * scale
+    sc = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                    preferred_element_type=jnp.float32) * scale
     if causal:
         mask = jnp.tril(jnp.ones((s, s), bool))
         sc = jnp.where(mask[None, None], sc, -jnp.inf)
     p = jax.nn.softmax(sc, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
     return out.astype(q.dtype)

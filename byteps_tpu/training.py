@@ -61,7 +61,15 @@ class DistributedTrainer:
                                   if GlobalState.initialized() else 65536)
         self.mesh = mesh
         self.axes = data_axes(mesh)
-        self.tx = distributed_optimizer(tx, axes=self.axes,
+        # Size-1 data axes reduce to identity psums; dropping them skips the
+        # whole bucket pack/unpack (pure HBM overhead on a single chip).
+        # Lossy paths keep them — compression and custom reducers must see
+        # the gradient even at world 1 (reference: BYTEPS_FORCE_DISTRIBUTED
+        # tests run 1-worker compressed).
+        lossless = compression is None and reducer is psum_reducer
+        comm_axes = (tuple(a for a in self.axes if mesh.shape[a] > 1)
+                     if lossless else self.axes)
+        self.tx = distributed_optimizer(tx, axes=comm_axes,
                                         partition_bytes=partition_bytes,
                                         backward_passes_per_step=backward_passes_per_step,
                                         reducer=reducer,
@@ -166,8 +174,10 @@ class ShardedTrainer:
         # and compressor state is per-device (leading axis over the mesh).
         comp_specs = (local_leaf_specs(params, param_spec_tree, mesh)
                       if compression else None)
+        comm_axes = (self.dp_axes if compression else
+                     tuple(a for a in self.dp_axes if mesh.shape[a] > 1))
         self.tx = distributed_optimizer(
-            tx, axes=self.dp_axes, partition_bytes=partition_bytes,
+            tx, axes=comm_axes, partition_bytes=partition_bytes,
             compression=compression, min_compress_bytes=min_compress_bytes,
             compression_leaf_specs=comp_specs,
             compression_state_world=mesh.size)
