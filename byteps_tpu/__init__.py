@@ -104,6 +104,24 @@ def push_pull(tree, average: bool = True, name: Optional[str] = None):
     return GlobalState.get().engine.push_pull(tree, average=average, name=name)
 
 
+def push_pull_async(tree, average: bool = True,
+                    name: Optional[str] = None) -> int:
+    """Dispatch push_pull, return an int handle (reference:
+    torch/ops.py push_pull_async + handle_manager)."""
+    return GlobalState.get().engine.push_pull_async(tree, average=average,
+                                                    name=name)
+
+
+def poll(handle: int) -> bool:
+    """True once the handle's reduction has completed on device."""
+    return GlobalState.get().engine.poll(handle)
+
+
+def synchronize(handle: int):
+    """Block until the handle's reduction is done; return the result."""
+    return GlobalState.get().engine.synchronize(handle)
+
+
 def broadcast_parameters(tree, root_rank: int = 0,
                          stacked: Optional[bool] = None):
     """Broadcast root's parameters to all ranks (reference:
@@ -148,10 +166,17 @@ def DistributedTrainer(*args, **kwargs):
     return _DT(*args, **kwargs)
 
 
+def MirroredStrategy(*args, **kwargs):
+    """Strategy-style API (reference: docs/MirroredStrategy.md)."""
+    from .strategy import MirroredStrategy as _MS
+    return _MS(*args, **kwargs)
+
+
 __all__ = [
     "init", "shutdown", "suspend", "resume", "rank", "size", "local_rank",
-    "local_size", "declare_tensor", "push_pull", "broadcast_parameters",
+    "local_size", "declare_tensor", "push_pull", "push_pull_async",
+    "poll", "synchronize", "broadcast_parameters",
     "broadcast_optimizer_state", "get_pushpull_speed",
-    "DistributedOptimizer", "DistributedTrainer",
+    "DistributedOptimizer", "DistributedTrainer", "MirroredStrategy",
     "Config", "__version__",
 ]

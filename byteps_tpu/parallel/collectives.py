@@ -158,6 +158,11 @@ class PushPullEngine:
         self.debug_sample = ""   # tensor-name substring to sample-log
         self._programs: Dict[Tuple, Tuple] = {}  # structure key → compiled plan
         self._bcast_fns: Dict[int, Callable] = {}
+        # handle manager (reference: torch handle_manager.{cc,h} — int
+        # handles mapped to in-flight results; JAX dispatch is already
+        # async so a handle just pins the dispatched output arrays)
+        self._handles: Dict[int, object] = {}
+        self._next_handle = 0
 
     # -- plan & compile one program set per tree structure -------------------
     def _plan(self, tree, average: bool, name: Optional[str] = None):
@@ -222,9 +227,14 @@ class PushPullEngine:
         return plan
 
     def push_pull(self, tree, average: Optional[bool] = None,
-                  name: Optional[str] = None):
+                  name: Optional[str] = None, sync: bool = True):
         """Reduce a pytree of [dp, ...] stacked arrays; returns same shapes
-        with every replica slice equal to the reduction."""
+        with every replica slice equal to the reduction.
+
+        ``sync=False`` (the async-handle path) skips the blocking
+        telemetry/timeline readback — recording then happens at
+        ``synchronize()`` so enabling the timeline doesn't silently
+        serialize the overlap it is meant to measure."""
         avg = self.average if average is None else average
         _, progs, _ = self._plan(tree, avg, name)
         leaves, treedef = jax.tree_util.tree_flatten(tree)
@@ -250,8 +260,46 @@ class PushPullEngine:
                 get_logger().info("SAMPLE %s%s mean=%.6g std=%.6g first=%.6g",
                                   name, jax.tree_util.keystr(p),
                                   arr.mean(), arr.std(), arr.ravel()[0])
-        if self.telemetry is not None or self.timeline is not None:
+        if sync and (self.telemetry is not None or self.timeline is not None):
             jax.block_until_ready(result)
+            dt = time.time() - t0
+            if self.telemetry is not None:
+                self.telemetry.record(nbytes, dt)
+            if self.timeline is not None:
+                self.timeline.record(name or "push_pull", "PUSH_PULL", t0, dt)
+        return result
+
+    # -- async handle API (reference: torch ops.py push_pull_async /
+    #    poll / synchronize, handle_manager.cc) ----------------------------
+    def push_pull_async(self, tree, average: Optional[bool] = None,
+                        name: Optional[str] = None) -> int:
+        """Dispatch the bucketed reduction and return an int handle.
+
+        The collectives are enqueued on the device; the caller's host
+        thread continues immediately (the cross-barrier overlap of the
+        reference, minus the poller thread). Telemetry/timeline recording
+        is deferred to ``synchronize`` so it never blocks dispatch."""
+        result = self.push_pull(tree, average=average, name=name, sync=False)
+        h = self._next_handle
+        self._next_handle += 1
+        nbytes = sum(l.nbytes for l in jax.tree_util.tree_leaves(tree))
+        self._handles[h] = (result, time.time(), nbytes, name)
+        return h
+
+    def poll(self, handle: int) -> bool:
+        """True once every array behind ``handle`` has finished computing
+        (reference: byteps_torch_poll → handle_manager PollHandle)."""
+        result, _, _, _ = self._handles[handle]
+        return all(leaf.is_ready() for leaf in
+                   jax.tree_util.tree_leaves(result)
+                   if isinstance(leaf, jax.Array))
+
+    def synchronize(self, handle: int):
+        """Block until done and return the reduced tree; the handle is
+        released (reference: synchronize(handle), ops.py:204-236)."""
+        result, t0, nbytes, name = self._handles.pop(handle)
+        result = jax.block_until_ready(result)
+        if self.telemetry is not None or self.timeline is not None:
             dt = time.time() - t0
             if self.telemetry is not None:
                 self.telemetry.record(nbytes, dt)

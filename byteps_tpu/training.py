@@ -52,7 +52,14 @@ class DistributedTrainer:
                  min_compress_bytes: Optional[int] = None,
                  donate: bool = True) -> None:
         if mesh is None:
-            mesh = GlobalState.get().mesh if GlobalState.initialized() else make_mesh()
+            # a MirroredStrategy scope takes precedence over the global mesh
+            from .strategy import current_strategy
+            strat = current_strategy()
+            if strat is not None:
+                mesh = strat.mesh
+            else:
+                mesh = (GlobalState.get().mesh if GlobalState.initialized()
+                        else make_mesh())
         if partition_bytes is None:
             partition_bytes = (GlobalState.get().config.partition_bytes
                                if GlobalState.initialized() else 4 << 20)

@@ -1,0 +1,8 @@
+"""Put the repo root on sys.path so the examples run from a checkout
+(`python examples/foo.py`) without installation. Import this before
+byteps_tpu in every example."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

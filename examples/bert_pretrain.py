@@ -14,6 +14,7 @@ import jax
 import numpy as np
 import optax
 
+import _bootstrap  # noqa: F401  (repo-root sys.path shim)
 import byteps_tpu as bps
 from byteps_tpu.models import bert, transformer
 from byteps_tpu.parallel.mesh import make_mesh

@@ -15,6 +15,7 @@ import jax
 import numpy as np
 import optax
 
+import _bootstrap  # noqa: F401  (repo-root sys.path shim)
 import byteps_tpu as bps
 from byteps_tpu.checkpoint import restore_checkpoint, save_checkpoint
 from byteps_tpu.models.mlp import mlp_init, mlp_loss

@@ -17,6 +17,7 @@ import jax
 import numpy as np
 import optax
 
+import _bootstrap  # noqa: F401  (repo-root sys.path shim)
 import byteps_tpu as bps
 from byteps_tpu.training import DistributedTrainer
 
@@ -68,6 +69,10 @@ def main() -> None:
     ap.add_argument("--compression", default=None,
                     help="onebit|topk|randomk|dithering")
     ap.add_argument("--ef", action="store_true", help="error feedback")
+    ap.add_argument("--barrier", action="store_true",
+                    help="force a host readback every step (no async "
+                         "dispatch overlap — the reference's pre-"
+                         "cross-barrier behavior, docs/cross-barrier.md)")
     args = ap.parse_args()
 
     bps.init()
@@ -85,6 +90,8 @@ def main() -> None:
     t0 = time.perf_counter()
     for _ in range(args.iters):
         loss = trainer.step(data)
+        if args.barrier:
+            float(loss)         # per-step sync barrier
     final = float(loss)         # readback = real timing on TPU tunnels
     dt = time.perf_counter() - t0
     print(f"model={args.model} batch={args.batch} world={bps.size()} "

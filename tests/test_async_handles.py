@@ -1,0 +1,61 @@
+"""Async handle API (reference: torch/ops.py push_pull_async / poll /
+synchronize backed by handle_manager.cc)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import byteps_tpu as bps
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    bps.init()
+    yield
+    bps.shutdown()
+
+
+def _stacked(val):
+    """[dp, ...] stacked convention of the eager engine."""
+    return jnp.broadcast_to(jnp.asarray(val), (8,) + np.shape(val))
+
+
+def test_async_roundtrip_matches_sync():
+    tree = {"w": _stacked(np.arange(6.0).reshape(2, 3)),
+            "b": _stacked(np.ones(4))}
+    h = bps.push_pull_async(tree, average=True)
+    out = bps.synchronize(h)
+    ref = bps.push_pull(tree, average=True)
+    for k in tree:
+        np.testing.assert_allclose(np.asarray(out[k]), np.asarray(ref[k]))
+
+
+def test_poll_becomes_true_and_handle_released():
+    tree = {"x": _stacked(np.ones(16, np.float32))}
+    h = bps.push_pull_async(tree)
+    out = bps.synchronize(h)          # blocks; afterwards poll must fail
+    assert np.all(np.isfinite(np.asarray(out["x"])))
+    with pytest.raises(KeyError):
+        bps.synchronize(h)            # handle is single-use
+
+
+def test_poll_true_after_completion():
+    import time
+    tree = {"x": _stacked(np.ones(8, np.float32))}
+    h = bps.push_pull_async(tree)
+    # dispatch is async; poll must flip to True once the work drains
+    deadline = time.time() + 30.0
+    while not bps.poll(h) and time.time() < deadline:
+        time.sleep(0.005)
+    assert bps.poll(h)
+    bps.synchronize(h)
+
+
+def test_many_handles_in_flight():
+    trees = [{"x": _stacked(np.full(8, i, np.float32))} for i in range(5)]
+    handles = [bps.push_pull_async(t) for t in trees]
+    outs = [bps.synchronize(h) for h in handles]
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(np.asarray(o["x"]),
+                                   np.full(8, i, np.float32).reshape(1, 8)
+                                   .repeat(8, 0))

@@ -1,0 +1,102 @@
+"""MirroredStrategy surface on the 8-device CPU mesh (reference:
+docs/MirroredStrategy.md, tensorflow/distribute/mirrored_strategy.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import byteps_tpu as bps
+from byteps_tpu.strategy import MirroredStrategy, current_strategy
+
+
+@pytest.fixture
+def strat():
+    bps.init()
+    yield MirroredStrategy()
+    bps.shutdown()
+
+
+def test_num_replicas(strat):
+    assert strat.num_replicas_in_sync == 8
+
+
+def test_scope_sets_current(strat):
+    assert current_strategy() is None
+    with strat.scope() as s:
+        assert current_strategy() is s
+    assert current_strategy() is None
+
+
+def test_run_splits_batch(strat):
+    x = jnp.arange(16.0).reshape(16, 1)
+
+    def per_replica(xs):
+        # each replica sees 2 rows; psum of local sums = global sum
+        return xs + jax.lax.psum(jnp.sum(xs), strat.axes)
+
+    out = strat.run(per_replica, (x,))
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(x) + float(x.sum()))
+
+
+def test_reduce_mean_sum(strat):
+    v = jnp.arange(8.0)
+    assert float(strat.reduce("mean", v)) == pytest.approx(3.5)
+    assert float(strat.reduce("sum", v)) == pytest.approx(28.0)
+    with pytest.raises(ValueError):
+        strat.reduce("max", v)
+
+
+def test_distribute_dataset(strat):
+    batches = [{"x": np.ones((8, 4), np.float32) * i} for i in range(3)]
+    seen = list(strat.experimental_distribute_dataset(batches))
+    assert len(seen) == 3
+    assert seen[1]["x"].sharding.spec == jax.sharding.PartitionSpec(
+        strat.axes)
+
+
+def test_scope_sets_trainer_mesh(strat):
+    from byteps_tpu.parallel.mesh import make_mesh
+    from byteps_tpu.training import DistributedTrainer
+    import optax as _optax
+    custom = MirroredStrategy(make_mesh({"data": 4, "model": 2}))
+    with custom.scope():
+        tr = DistributedTrainer(lambda p, b: jnp.sum(p["w"] * b),
+                                {"w": jnp.ones(3)}, _optax.sgd(0.1))
+    assert tr.mesh is custom.mesh
+    tr2 = DistributedTrainer(lambda p, b: jnp.sum(p["w"] * b),
+                             {"w": jnp.ones(3)}, _optax.sgd(0.1))
+    assert tr2.mesh is not custom.mesh      # outside scope: global mesh
+
+
+def test_run_caches_compiled_fn(strat):
+    calls = []
+
+    def fn(x):
+        calls.append(1)
+        return x * 2
+
+    x = jnp.arange(8.0)
+    for _ in range(4):
+        strat.run(fn, (x,))
+    assert len(calls) == 1                   # traced once, cached after
+
+
+def test_make_step_trains(strat):
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 4).astype(np.float32)
+    W = rng.randn(4, 1).astype(np.float32)
+    Y = X @ W
+
+    def loss_fn(p, b):
+        xx, yy = b
+        return jnp.mean((xx @ p["w"] - yy) ** 2)
+
+    with strat.scope():
+        step = strat.make_step(loss_fn, optax.adam(0.1),
+                               {"w": jnp.zeros((4, 1))})
+    losses = [float(step((X, Y))) for _ in range(40)]
+    assert losses[-1] < 0.05 * losses[0]
+    assert step.trainer.step_count == 40
