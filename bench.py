@@ -42,8 +42,12 @@ def main() -> None:
     rng = np.random.RandomState(0)
     data = bert.synth_mlm_batch(rng, batch, seq, cfg.vocab_size)
 
+    # LM head only on masked positions (max_predictions_per_seq): with 15%
+    # masking, 0.2·seq caps overflow at +3σ of the binomial mask count
+    max_pred = max(1, int(0.2 * seq))
+
     def loss_fn(p, b):
-        return bert.mlm_loss(p, cfg, b)
+        return bert.mlm_loss(p, cfg, b, max_predictions=max_pred)
 
     # The first seconds of execution on a fresh process/tunnel run a few
     # percent slow, so EACH phase runs `warm` untimed steps before its

@@ -39,7 +39,8 @@ class GlobalState:
         self.timeline = Timeline(config) if config.trace_on else None
         self.engine = PushPullEngine(
             self.mesh, partition_bytes=config.partition_bytes,
-            registry=self.registry, telemetry=self.telemetry)
+            registry=self.registry, telemetry=self.telemetry,
+            scheduling_credit=config.scheduling_credit)
         self.engine.timeline = self.timeline
         self.engine.debug_sample = config.debug_sample_tensor
         self.dp = dp_size(self.mesh)

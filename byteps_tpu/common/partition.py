@@ -56,6 +56,10 @@ class Bucket:
     segments: Tuple[Segment, ...]
     priority: int      # higher = communicated earlier
 
+    @property
+    def nbytes(self) -> int:
+        return self.size * np.dtype(self.dtype).itemsize
+
 
 def plan_buckets(leaves: Sequence[LeafSpec], partition_bytes: int,
                  reverse_order: bool = True,

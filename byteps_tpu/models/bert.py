@@ -38,10 +38,39 @@ def bert_tiny(**kw) -> TransformerConfig:
                        max_seq=64, dtype="float32", remat=False, **kw)
 
 
-def mlm_loss(params, cfg: TransformerConfig, batch):
+def mlm_loss(params, cfg: TransformerConfig, batch,
+             max_predictions: Optional[int] = None):
     """batch = (masked_tokens, targets) with targets < 0 at unmasked
-    positions (standard MLM convention)."""
-    return lm_loss(params, cfg, batch)
+    positions (standard MLM convention).
+
+    ``max_predictions``: gather up to K masked positions per sequence and
+    run the LM head only on those (the standard max_predictions_per_seq
+    trick) — with 15% masking the full-sequence head is ~6× wasted MXU
+    work and a [b, s, vocab] fp32 activation. Exact as long as no
+    sequence has more than K masked positions; sequences over the cap
+    drop their latest-position extras. None = full-sequence head (used
+    under SP/PP, where hidden states are sequence-sharded)."""
+    if max_predictions is None or cfg.sp_axis is not None \
+            or cfg.pp_axis is not None:
+        return lm_loss(params, cfg, batch)
+    tokens, targets = batch
+    b, s = tokens.shape
+    k = min(max_predictions, s)
+    h = apply(params, cfg, tokens)                      # [b, s, hid]
+    mask = targets >= 0
+    # masked positions first; earlier positions win ties/cap overflow
+    score = mask.astype(jnp.float32) * 2.0 - jnp.arange(s) / s
+    _, idx = jax.lax.top_k(score, k)                    # [b, k]
+    sel_h = jnp.take_along_axis(h, idx[..., None], axis=1)
+    sel_t = jnp.take_along_axis(targets, idx, axis=1)
+    w = jnp.take_along_axis(mask, idx, axis=1)
+    lg = logits(params, cfg, sel_h)                     # [b, k, vocab]
+    logp = jax.nn.log_softmax(lg, axis=-1)
+    tgt = jnp.where(w, sel_t, 0)
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    nll_sum = (nll * w).sum()
+    cnt = w.sum().astype(jnp.float32)
+    return nll_sum / jnp.maximum(cnt, 1.0)
 
 
 def synth_mlm_batch(rng: np.random.RandomState, batch: int, seq: int,

@@ -145,7 +145,8 @@ class PushPullEngine:
     def __init__(self, mesh: Mesh, partition_bytes: int = 4 << 20,
                  average: bool = True, reducer: Reducer = psum_reducer,
                  registry: Optional[NameRegistry] = None,
-                 telemetry: Optional[object] = None) -> None:
+                 telemetry: Optional[object] = None,
+                 scheduling_credit: int = 0) -> None:
         self.mesh = mesh
         self.axes = data_axes(mesh)
         self.dp = dp_size(mesh)
@@ -154,6 +155,11 @@ class PushPullEngine:
         self.reducer = reducer
         self.registry = registry or NameRegistry()
         self.telemetry = telemetry
+        # Byte-credit flow control (reference: BYTEPS_SCHEDULING_CREDIT,
+        # scheduled_queue.cc:33-45 — 0 disables). Bounds the bytes of
+        # in-flight bucket collectives; when exceeded, dispatch blocks on
+        # the oldest outstanding bucket before issuing the next.
+        self.scheduling_credit = scheduling_credit
         self.timeline = None
         self.debug_sample = ""   # tensor-name substring to sample-log
         self._programs: Dict[Tuple, Tuple] = {}  # structure key → compiled plan
@@ -242,11 +248,33 @@ class PushPullEngine:
         t0 = time.time() if (self.telemetry or self.timeline) else 0.0
         out = list(leaves)
         # Priority order: progs is already bucket-index order == priority desc.
+        # Credit gating applies only to the synchronous path: the async
+        # handle API promises non-blocking dispatch, and its caller owns
+        # the in-flight set via poll/synchronize.
+        credit = self.scheduling_credit if sync else 0
+        inflight: List[Tuple[int, list]] = []   # (bucket bytes, results)
+        inflight_bytes = 0
         for fn, leaf_idxs, bucket in progs:
+            if credit > 0 and inflight and inflight_bytes > credit:
+                tc = time.time()
+                while inflight and inflight_bytes > credit:
+                    done_bytes, done_results = inflight.pop(0)
+                    jax.block_until_ready(done_results)
+                    inflight_bytes -= done_bytes
+                if self.timeline is not None:
+                    # make the stall visible in the trace — it is the whole
+                    # point of tuning the credit knob
+                    self.timeline.record(name or "push_pull", "CREDIT_BLOCK",
+                                         tc, time.time() - tc,
+                                         key=bucket.index)
             tb = time.time() if self.timeline is not None else 0.0
             results = fn(*[out[i] for i in leaf_idxs])
             for i, r in zip(leaf_idxs, results):
                 out[i] = r
+            if credit > 0:
+                b = int(bucket.nbytes)
+                inflight.append((b, results))
+                inflight_bytes += b
             if self.timeline is not None:
                 self.timeline.record(name or "push_pull", "DISPATCH",
                                      tb, time.time() - tb, key=bucket.index)

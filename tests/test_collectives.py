@@ -145,3 +145,45 @@ def test_public_api_declare_and_resume(mesh8):
     bps.resume(config=bps.Config.from_env(), mesh=mesh8)
     assert bps.declare_tensor("layer0/w") == k1
     assert bps.declare_tensor("layer1/w") == k2
+
+
+def test_scheduling_credit_bounds_inflight():
+    """BPS_SCHEDULING_CREDIT: dispatch still produces correct sums when
+    flow control forces blocking on outstanding buckets (reference:
+    scheduled_queue.cc:33-45)."""
+    import byteps_tpu as bps
+    from byteps_tpu.common.config import Config
+    # tiny partition → many buckets; tiny credit → constant blocking
+    bps.init(Config.from_env(partition_bytes=256, scheduling_credit=512))
+    from byteps_tpu.common.global_state import GlobalState
+    eng = GlobalState.get().engine
+    assert eng.scheduling_credit == 512
+    tree = {f"w{i}": jnp.broadcast_to(jnp.full((32,), float(i)), (8, 32))
+            for i in range(8)}
+    # the gate must actually block on outstanding buckets, not just exist
+    calls = []
+    real_block = jax.block_until_ready
+
+    def counting_block(x):
+        calls.append(1)
+        return real_block(x)
+
+    jax.block_until_ready, restore = counting_block, real_block
+    try:
+        out = eng.push_pull(tree, average=True)
+    finally:
+        jax.block_until_ready = restore
+    assert calls, "credit gate never blocked despite credit < tree bytes"
+    for i in range(8):
+        np.testing.assert_allclose(np.asarray(out[f"w{i}"]),
+                                   np.full((8, 32), float(i)))
+    # async path is exempt: non-blocking dispatch contract
+    calls.clear()
+    jax.block_until_ready = counting_block
+    try:
+        h = eng.push_pull_async(tree)
+        assert not calls, "push_pull_async must not credit-block dispatch"
+    finally:
+        jax.block_until_ready = restore
+    eng.synchronize(h)
+    bps.shutdown()

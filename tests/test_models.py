@@ -148,3 +148,52 @@ def test_dp_tp_sp_combined_train_step():
     losses = [float(trainer.step((toks, tgts))) for _ in range(25)]
     assert np.isfinite(losses[-1])
     assert losses[-1] < losses[0] * 0.8, losses[::5]
+
+
+class TestMLMGatheredHead:
+    """mlm_loss(max_predictions=K) — LM head on gathered masked positions
+    must match the full-sequence path exactly when K covers every mask."""
+
+    def _setup(self):
+        from byteps_tpu.models import bert, transformer
+        cfg = bert.bert_tiny()
+        params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+        rng = np.random.RandomState(3)
+        batch = bert.synth_mlm_batch(rng, 4, 64, cfg.vocab_size)
+        return bert, cfg, params, batch
+
+    def test_loss_and_grads_match_full_path(self):
+        bert, cfg, params, batch = self._setup()
+        full = bert.mlm_loss(params, cfg, batch)
+        gath = bert.mlm_loss(params, cfg, batch, max_predictions=64)
+        np.testing.assert_allclose(float(full), float(gath), rtol=1e-6)
+        gf = jax.grad(lambda p: bert.mlm_loss(p, cfg, batch))(params)
+        gg = jax.grad(lambda p: bert.mlm_loss(
+            p, cfg, batch, max_predictions=64))(params)
+        for a, b in zip(jax.tree_util.tree_leaves(gf),
+                        jax.tree_util.tree_leaves(gg)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
+
+    def test_cap_overflow_drops_latest_positions(self):
+        bert, cfg, params, batch = self._setup()
+        tokens, targets = batch
+        n_masked = int((targets >= 0).sum(axis=1).max())
+        k = max(1, n_masked - 2)        # force overflow on some row
+        loss = bert.mlm_loss(params, cfg, batch, max_predictions=k)
+        assert np.isfinite(float(loss))
+        # truncated loss equals the full loss computed on the truncated
+        # target set (earliest k masked positions per row kept)
+        t2 = np.asarray(targets).copy()
+        for r in range(t2.shape[0]):
+            pos = np.where(t2[r] >= 0)[0]
+            t2[r, pos[k:]] = -1
+        ref = bert.mlm_loss(params, cfg, (tokens, t2.astype(np.int32)))
+        np.testing.assert_allclose(float(loss), float(ref), rtol=1e-6)
+
+    def test_zero_masks_safe(self):
+        bert, cfg, params, batch = self._setup()
+        tokens, targets = batch
+        none = np.full_like(np.asarray(targets), -1)
+        loss = bert.mlm_loss(params, cfg, (tokens, none), max_predictions=8)
+        assert float(loss) == 0.0
