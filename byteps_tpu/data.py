@@ -1,0 +1,124 @@
+"""Host input pipeline: sharded device placement with double-buffered
+prefetch.
+
+The reference delegates data loading to the frameworks and ships only a
+synthetic generator for tests (reference: tests/utils.py fake_data,
+example/pytorch/benchmark_byteps.py synthetic inputs). Here the input
+path is part of the framework because on TPU it is a real bottleneck
+class: the host must overlap (a) producing the next batch and (b) the
+host→device transfer with the current step's compute.
+
+``prefetch_to_mesh`` is the workhorse: a background thread device_puts
+batches with the data-axis sharding while the caller trains on the
+previous one — the JAX-native equivalent of a framework DataLoader's
+pinned-memory prefetch queue.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .parallel.mesh import data_axes
+
+
+def data_sharding(mesh: Mesh, spec: Optional[P] = None) -> NamedSharding:
+    """The batch placement: split over the mesh's data axes by default."""
+    if spec is None:
+        axes = data_axes(mesh)
+        spec = P(axes) if axes else P()
+    return NamedSharding(mesh, spec)
+
+
+def shard_batch(batch, mesh: Mesh, spec: Optional[P] = None,
+                sharding: Optional[NamedSharding] = None):
+    """Place one host batch onto the mesh, split over the data axes.
+
+    Hot loops should build the sharding once with ``data_sharding`` and
+    pass it, avoiding per-batch construction.
+    """
+    if sharding is None:
+        sharding = data_sharding(mesh, spec)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding),
+                                  batch)
+
+
+def prefetch_to_mesh(it: Iterable, mesh: Mesh, spec: Optional[P] = None,
+                     buffer_size: int = 2) -> Iterator:
+    """Iterate ``it``, yielding mesh-sharded batches, transferring up to
+    ``buffer_size`` batches ahead on a background thread.
+
+    device_put is async, but issuing it from a separate thread also
+    overlaps the host-side work (pytree traversal, layout, page pinning)
+    with the training loop's Python time.
+    """
+    q: "queue.Queue" = queue.Queue(maxsize=buffer_size)
+    stop = threading.Event()
+    _END = object()
+    sharding = data_sharding(mesh, spec)
+
+    def producer():
+        try:
+            for batch in it:
+                if stop.is_set():
+                    return
+                q.put(shard_batch(batch, mesh, sharding=sharding))
+            q.put(_END)
+        except BaseException as e:          # propagate into the consumer
+            q.put(e)
+
+    t = threading.Thread(target=producer, daemon=True,
+                         name="bps-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        # drain so the producer's blocked put() can observe stop
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+
+
+# ------------------------------------------------------ synthetic sources
+
+def synthetic_batches(make_batch: Callable[[np.random.RandomState], object],
+                      seed: int = 0, steps: Optional[int] = None) -> Iterator:
+    """Endless (or ``steps``-long) stream from a batch factory — the
+    fake_data equivalent for benchmarks/tests."""
+    rng = np.random.RandomState(seed)
+    i = 0
+    while steps is None or i < steps:
+        yield make_batch(rng)
+        i += 1
+
+
+def mlm_stream(batch: int, seq: int, vocab: int, seed: int = 0,
+               steps: Optional[int] = None) -> Iterator:
+    """Synthetic MLM batches (tokens, targets) for BERT-style pretraining."""
+    from .models.bert import synth_mlm_batch
+    return synthetic_batches(
+        lambda rng: synth_mlm_batch(rng, batch, seq, vocab),
+        seed=seed, steps=steps)
+
+
+def imagenet_stream(batch: int, seed: int = 0,
+                    steps: Optional[int] = None) -> Iterator:
+    """Synthetic 224×224 image batches (images, labels) for ResNet/VGG."""
+    from .models.resnet import synth_imagenet_batch
+    return synthetic_batches(
+        lambda rng: synth_imagenet_batch(rng, batch),
+        seed=seed, steps=steps)

@@ -19,7 +19,7 @@ from typing import Callable, Iterable, Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from .common.global_state import GlobalState
 from .parallel.mesh import data_axes, make_mesh
@@ -104,11 +104,10 @@ class MirroredStrategy:
 
     def experimental_distribute_dataset(self, dataset: Iterable):
         """Yield batches placed on the mesh, split over the data axes."""
-        sharding = NamedSharding(self.mesh,
-                                 P(self.axes) if self.axes else P())
+        from .data import data_sharding, shard_batch
+        sharding = data_sharding(self.mesh)
         for batch in dataset:
-            yield jax.tree_util.tree_map(
-                lambda x: jax.device_put(x, sharding), batch)
+            yield shard_batch(batch, self.mesh, sharding=sharding)
 
     # ---------------------------------------------------------- train step
 

@@ -129,10 +129,8 @@ class DistributedTrainer:
 
     def shard_batch(self, batch):
         """Place a host batch onto the mesh, split along the data axes."""
-        spec = P(self.axes) if self.axes else P()
-        sharding = NamedSharding(self.mesh, spec)
-        return jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, sharding), batch)
+        from .data import shard_batch
+        return shard_batch(batch, self.mesh)
 
     def step(self, batch) -> jnp.ndarray:
         """One training step on a (host or device) global batch; returns loss."""
@@ -242,9 +240,8 @@ class ShardedTrainer:
         self.step_count = 0
 
     def shard_batch(self, batch):
-        sharding = NamedSharding(self.mesh, self.batch_spec)
-        return jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, sharding), batch)
+        from .data import shard_batch
+        return shard_batch(batch, self.mesh, self.batch_spec)
 
     def step(self, batch):
         batch = self.shard_batch(batch)

@@ -1,0 +1,108 @@
+"""Scaling-efficiency harness (the reference's headline metric: BERT-large
+scaling efficiency at N workers vs the smallest config, README.md:37-44).
+
+Sweeps data-parallel mesh sizes over the available devices with a FIXED
+per-replica batch (weak scaling, the reference's setup), measures
+samples/sec, and reports efficiency = throughput(N) / (N/base ·
+throughput(base)).
+
+On real multi-chip hardware this produces the judged curve; on a single
+chip or the virtual CPU mesh it still validates the whole code path and
+prints the table (absolute numbers are then not meaningful).
+
+Usage:
+  python examples/scaling_bench.py --model bert-large --per-replica-batch 8
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/scaling_bench.py --model bert-tiny --iters 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import time
+
+import jax
+import numpy as np
+import optax
+
+import _bootstrap  # noqa: F401  (repo-root sys.path shim)
+import byteps_tpu as bps
+from byteps_tpu.parallel.mesh import make_mesh
+from byteps_tpu.training import DistributedTrainer
+
+
+def build(model: str, batch: int, seq: int):
+    from byteps_tpu.models import bert, transformer
+    cfg = {"bert-large": bert.bert_large, "bert-base": bert.bert_base,
+           "bert-tiny": bert.bert_tiny}[model]()
+    seq = min(cfg.max_seq, seq)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    data = bert.synth_mlm_batch(np.random.RandomState(0), batch, seq,
+                                cfg.vocab_size)
+    max_pred = max(1, int(0.2 * seq))
+    loss_fn = lambda p, b: bert.mlm_loss(p, cfg, b,
+                                         max_predictions=max_pred)
+    return params, data, loss_fn
+
+
+def measure(n_dev: int, model: str, per_replica_batch: int, seq: int,
+            iters: int) -> float:
+    mesh = make_mesh({"data": n_dev}, devices=jax.devices()[:n_dev])
+    global_batch = per_replica_batch * n_dev
+    params, data, loss_fn = build(model, global_batch, seq)
+    trainer = DistributedTrainer(loss_fn, params, optax.adamw(1e-4),
+                                 mesh=mesh)
+    del params
+    float(trainer.step(data))                  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = trainer.step(data)
+    float(loss)                                # force device completion
+    sps = global_batch * iters / (time.perf_counter() - t0)
+    del trainer
+    gc.collect()
+    return sps
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="bert-tiny")
+    ap.add_argument("--per-replica-batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--iters", type=int, default=5)
+    args = ap.parse_args()
+    if args.iters < 1:
+        ap.error("--iters must be >= 1")
+
+    bps.init()
+    n = len(jax.devices())
+    sizes = []
+    s = 1
+    while s <= n:
+        sizes.append(s)
+        s *= 2
+    if sizes[-1] != n:        # non-power-of-two machine: measure all of it
+        sizes.append(n)
+    rows = []
+    for s in sizes:
+        sps = measure(s, args.model, args.per_replica_batch, args.seq,
+                      args.iters)
+        rows.append((s, sps))
+        base_s, base_sps = rows[0]
+        eff = sps / (s / base_s * base_sps)
+        print(f"devices={s:4d}  samples/sec={sps:10.2f}  "
+              f"per-device={sps/s:8.2f}  efficiency={eff:6.1%}")
+    base_s, base_sps = rows[0]
+    print(json.dumps({
+        "metric": f"{args.model}_scaling_efficiency_{base_s}to{rows[-1][0]}",
+        "value": round(rows[-1][1] / (rows[-1][0] / base_s * base_sps), 4),
+        "unit": "fraction",
+        "per_device_samples_sec": {str(s): round(v / s, 2) for s, v in rows},
+    }))
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()
