@@ -8,40 +8,37 @@ samples/sec/chip; vs_baseline is the ratio against a plain-JAX training
 step of the identical model with no framework wrapper (≥ 1.0 means the
 framework's distribution layer adds no single-chip overhead; the
 reference's multi-worker scaling numbers need multiple hosts).
+
+The measurement scaffold (`mlm_setup`, `time_plain_steps`) is shared
+with examples/perf_lab.py so A/B lab numbers stay comparable to this
+headline bench.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 from functools import partial
 
 import jax
+
+# Honor JAX_PLATFORMS even when a sitecustomize force-selects a platform
+# via jax.config (which outranks the env var): re-assert the user's choice.
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 import numpy as np
 import optax
 
 
-def main() -> None:
-    import byteps_tpu as bps
+def mlm_setup(cfg, batch: int, seq: int):
+    """(params, batch data, loss_fn) for the flagship MLM config."""
     from byteps_tpu.models import bert, transformer
-    from byteps_tpu.training import DistributedTrainer
-
-    bps.init()
-
-    on_tpu = jax.devices()[0].platform != "cpu"
-    if on_tpu:
-        cfg = bert.bert_large(max_seq=512)
-        batch, seq = 32, 512      # larger per-chip batch keeps the MXU fed
-        iters = 5
-    else:  # CPU smoke fallback so the bench always emits a line
-        cfg = bert.bert_tiny()
-        batch, seq = 8, 32
-        iters = 3
 
     params = transformer.init_params(jax.random.PRNGKey(0), cfg)
-    rng = np.random.RandomState(0)
-    data = bert.synth_mlm_batch(rng, batch, seq, cfg.vocab_size)
-
+    data = bert.synth_mlm_batch(np.random.RandomState(0), batch, seq,
+                                cfg.vocab_size)
     # LM head only on masked positions (max_predictions_per_seq): with 15%
     # masking, 0.2·seq caps overflow at +3σ of the binomial mask count
     max_pred = max(1, int(0.2 * seq))
@@ -49,34 +46,63 @@ def main() -> None:
     def loss_fn(p, b):
         return bert.mlm_loss(p, cfg, b, max_predictions=max_pred)
 
+    return params, data, loss_fn
+
+
+def time_plain_steps(params, data, loss_fn, batch: int, iters: int,
+                     warm: int) -> float:
+    """samples/sec of a donated, jitted plain-JAX train step (no
+    framework wrapper). Consumes ``params`` (donation)."""
+    tx = optax.adamw(1e-4)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(p, s, b):
+        l, g = jax.value_and_grad(loss_fn)(p, b)
+        u, s = tx.update(g, s, p)
+        return optax.apply_updates(p, u), s, l
+
+    state = tx.init(params)
+    jb = jax.tree_util.tree_map(np.asarray, data)
+    for _ in range(warm):
+        params, state, l = step(params, state, jb)
+    float(l)                         # real readback: the tunnel's
+    t0 = time.perf_counter()         # block_until_ready doesn't wait
+    for _ in range(iters):
+        params, state, l = step(params, state, jb)
+    float(l)
+    return batch * iters / (time.perf_counter() - t0)
+
+
+def main() -> None:
+    import byteps_tpu as bps
+    from byteps_tpu.models import bert
+    from byteps_tpu.training import DistributedTrainer
+
+    bps.init()
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    if on_tpu:
+        cfg = bert.bert_large(max_seq=512)
+        batch, seq = 64, 512      # reference headline config: batch 64/chip
+        iters = 5
+    else:  # CPU smoke fallback so the bench always emits a line
+        cfg = bert.bert_tiny()
+        batch, seq = 8, 32
+        iters = 3
+
+    params, data, loss_fn = mlm_setup(cfg, batch, seq)
+
     # The first seconds of execution on a fresh process/tunnel run a few
     # percent slow, so EACH phase runs `warm` untimed steps before its
     # timed window — enough to saturate chip warmup so phase order doesn't
     # bias the ratio. (The two phases can't coexist: two param+adam copies
     # of BERT-large exceed one chip's HBM, hence the del/gc between them.)
     warm = 3 if on_tpu else 1
-    tx = optax.adamw(1e-4)
 
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def plain_step(p, s, b):
-        l, g = jax.value_and_grad(loss_fn)(p, b)
-        u, s = tx.update(g, s, p)
-        return optax.apply_updates(p, u), s, l
-
-    state = tx.init(params)
-    jb = (np.asarray(data[0]), np.asarray(data[1]))
     # donate a COPY: `params` itself seeds the framework phase below
     p2 = jax.tree_util.tree_map(jax.numpy.array, params)
-    for _ in range(warm):
-        p2, s2, l = plain_step(p2, state, jb)
-        state = s2
-    float(l)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        p2, s2, l = plain_step(p2, s2, jb)
-    float(l)
-    plain_sps = batch * iters / (time.perf_counter() - t0)
-    del p2, s2, state
+    plain_sps = time_plain_steps(p2, data, loss_fn, batch, iters, warm)
+    del p2
     import gc
     gc.collect()
 

@@ -43,11 +43,21 @@ class TransformerConfig:
     causal: bool = False          # False: BERT-style encoder; True: GPT
     dtype: str = "bfloat16"       # compute dtype (params stay fp32)
     remat: bool = True            # checkpoint each block
+    remat_policy: Optional[str] = None  # None (save nothing) | "dots" —
+                                  # save MXU outputs, recompute elementwise
     attn_impl: str = "auto"       # auto | flash (Pallas) | naive
     tp_axis: Optional[str] = None # mesh axis for tensor parallelism
     sp_axis: Optional[str] = None # mesh axis for ring-attention seq shards
     pp_axis: Optional[str] = None # mesh axis for pipeline (layer) stages
     pp_microbatches: int = 0      # GPipe microbatches (0 → pipeline size)
+
+    def __post_init__(self):
+        if self.remat_policy not in (None, "dots"):
+            raise ValueError(
+                f"remat_policy must be None|'dots', got {self.remat_policy!r}")
+        if self.remat_policy is not None and not self.remat:
+            raise ValueError("remat_policy set but remat=False — the policy "
+                             "would be silently ignored")
 
     @property
     def head_dim(self) -> int:
@@ -189,7 +199,9 @@ def apply(params, cfg: TransformerConfig, tokens: jnp.ndarray,
 
     blk_fn = partial(_block, cfg=cfg, tp_size=tp_size)
     if cfg.remat:
-        blk_fn = jax.checkpoint(blk_fn)
+        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                  if cfg.remat_policy == "dots" else None)
+        blk_fn = jax.checkpoint(blk_fn, policy=policy)
 
     def body(carry, blk):
         return blk_fn(carry, blk), None

@@ -1,0 +1,64 @@
+"""Perf lab: A/B timing harness for flagship-bench tuning knobs.
+
+Times one configuration of the BERT-large MLM train step per invocation
+(fresh process = fresh HBM; two configs of BERT-large + adam do not
+coexist on one chip). Prints one JSON line: config, samples/sec.
+Reuses bench.py's measurement scaffold so numbers are directly
+comparable to the headline bench.
+
+Usage:
+  python examples/perf_lab.py --remat full|none|dots --batch 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+
+import _bootstrap  # noqa: F401  (also puts the repo root on sys.path)
+from bench import mlm_setup, time_plain_steps
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--remat", default="full",
+                    choices=["full", "none", "dots"])
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--block-q", type=int, default=0,
+                    help="flash block override (0 = kernel default)")
+    ap.add_argument("--block-k", type=int, default=0)
+    args = ap.parse_args()
+
+    from byteps_tpu.models import bert
+
+    cfg = bert.bert_large(max_seq=args.seq)
+    cfg = dataclasses.replace(
+        cfg, remat=args.remat != "none",
+        remat_policy="dots" if args.remat == "dots" else None)
+
+    if args.block_q or args.block_k:
+        import inspect
+
+        import byteps_tpu.ops.flash_attention as fa
+        orig = fa.flash_attention
+        defaults = inspect.signature(orig).parameters
+
+        def patched(q, k, v, causal=False, scale=None, **kw):
+            return orig(q, k, v, causal, scale,
+                        args.block_q or defaults["block_q"].default,
+                        args.block_k or defaults["block_k"].default)
+        fa.flash_attention = patched
+
+    params, data, loss_fn = mlm_setup(cfg, args.batch, args.seq)
+    sps = time_plain_steps(params, data, loss_fn, args.batch, args.iters,
+                           warm=3)
+    print(json.dumps({"remat": args.remat, "batch": args.batch,
+                      "block_q": args.block_q, "block_k": args.block_k,
+                      "samples_per_sec": round(sps, 2)}))
+
+
+if __name__ == "__main__":
+    main()
