@@ -281,7 +281,9 @@ def _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     out, lse = _flash_fwd(qt, kt, vt, causal, scale, bq, bk, interpret)
-    return jnp.swapaxes(out, 1, 2), (qt, kt, vt, out, lse)
+    # store lse as [b,h,s]: a trailing dim of 1 lane-pads to 128 on TPU,
+    # bloating the saved residual 128x when it survives to the backward
+    return jnp.swapaxes(out, 1, 2), (qt, kt, vt, out, lse[..., 0])
 
 
 def _vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
@@ -293,7 +295,7 @@ def _vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
     qt, kt, vt, out, lse = res
     scale, bq, bk = _resolve(jnp.swapaxes(qt, 1, 2), scale, block_q, block_k)
     do = jnp.swapaxes(g, 1, 2)
-    dq, dk, dv = _flash_bwd(qt, kt, vt, out, lse, do,
+    dq, dk, dv = _flash_bwd(qt, kt, vt, out, lse[..., None], do,
                             causal, scale, bq, bk, interpret)
     return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
             jnp.swapaxes(dv, 1, 2))

@@ -23,7 +23,7 @@ from bench import mlm_setup, time_plain_steps
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--remat", default="full",
-                    choices=["full", "none", "dots"])
+                    choices=["full", "none", "dots", "mlp_only"])
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--seq", type=int, default=512)
     ap.add_argument("--iters", type=int, default=5)
@@ -37,7 +37,8 @@ def main() -> None:
     cfg = bert.bert_large(max_seq=args.seq)
     cfg = dataclasses.replace(
         cfg, remat=args.remat != "none",
-        remat_policy="dots" if args.remat == "dots" else None)
+        remat_policy=args.remat if args.remat in ("dots", "mlp_only")
+        else None)
 
     if args.block_q or args.block_k:
         import inspect

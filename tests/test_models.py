@@ -197,3 +197,35 @@ class TestMLMGatheredHead:
         none = np.full_like(np.asarray(targets), -1)
         loss = bert.mlm_loss(params, cfg, (tokens, none), max_predictions=8)
         assert float(loss) == 0.0
+
+
+@pytest.mark.parametrize("policy", [None, "dots", "mlp_only"])
+def test_remat_policies_match_no_remat(policy):
+    """Every remat_policy computes the same function as remat=False."""
+    import dataclasses
+    cfg0 = bert.bert_tiny()                        # remat=False
+    cfg = dataclasses.replace(cfg0, remat=True, remat_policy=policy)
+    params = transformer.init_params(jax.random.PRNGKey(1), cfg)
+    batch = bert.synth_mlm_batch(np.random.RandomState(1), 4, 32,
+                                 cfg.vocab_size)
+
+    def lg(c):
+        loss, grads = jax.value_and_grad(
+            lambda p: bert.mlm_loss(p, c, batch))(params)
+        return loss, grads
+
+    l_ref, g_ref = lg(cfg0)
+    l, g = lg(cfg)
+    np.testing.assert_allclose(float(l), float(l_ref), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        g, g_ref)
+
+
+def test_remat_policy_validation():
+    import dataclasses
+    cfg = bert.bert_tiny()
+    with pytest.raises(ValueError, match="remat_policy"):
+        dataclasses.replace(cfg, remat=True, remat_policy="bogus")
+    with pytest.raises(ValueError, match="ignored"):
+        dataclasses.replace(cfg, remat=False, remat_policy="dots")
