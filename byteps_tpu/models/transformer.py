@@ -149,7 +149,8 @@ def _attention(x, blk, cfg: TransformerConfig, tp_size: int):
     qkv = jnp.einsum("bsh,hcnd->bscnd", x, blk["qkv"].astype(x.dtype))
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [b, s, lh, hd]
     if cfg.sp_axis is not None:
-        out = ring_attention(q, k, v, cfg.sp_axis, causal=cfg.causal)
+        out = ring_attention(q, k, v, cfg.sp_axis, causal=cfg.causal,
+                             impl=cfg.attn_impl)
     else:
         from ..ops.flash_attention import attention
         out = attention(q, k, v, causal=cfg.causal, impl=cfg.attn_impl)

@@ -88,8 +88,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = m_scr[:, :1] + jnp.log(l)
 
 
-def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret):
-    """q,k,v: [b, h, s, d] → (out [b,h,s,d], lse [b,h,s,1] fp32)."""
+def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret, out_dtype=None):
+    """q,k,v: [b, h, s, d] → (out [b,h,s,d], lse [b,h,s,1] fp32).
+
+    out_dtype overrides the output dtype (default q.dtype) — ring
+    attention requests fp32 partials so the per-step LSE combine does
+    not accumulate one bf16 rounding per ring step."""
     b, h, s, d = q.shape
     nq, nk = s // bq, s // bk
     grid = (b, h, nq, nk)
@@ -108,7 +112,7 @@ def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret):
             pl.BlockSpec((1, 1, bq, 1), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, d), out_dtype or q.dtype),
             jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
         ],
         scratch_shapes=[
@@ -210,11 +214,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, out, lse, do, causal, scale, bq, bk, interpret):
+def _flash_bwd(q, k, v, out, lse, do, causal, scale, bq, bk, interpret,
+               delta=None):
     b, h, s, d = q.shape
     nq, nk = s // bq, s // bk
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1, keepdims=True)                 # [b,h,s,1]
+    if delta is None:      # ring callers hoist this loop-invariant reduction
+        delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1, keepdims=True)             # [b,h,s,1]
 
     qspec = pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0))
     kspec = pl.BlockSpec((1, 1, bk, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0))

@@ -8,6 +8,18 @@ block's transfer with the current block's compute. Softmax is accumulated
 online (flash-attention style running max / normalizer), so the full
 [seq, seq] score matrix never materializes.
 
+Two implementations behind one dispatcher:
+
+  - **flash ring** (TPU default): each ring step runs the Pallas flash
+    kernels on the local (q, k_blk) pair — scores stay in VMEM — and the
+    per-block normalized partials are merged by log-sum-exp. The custom
+    backward rotates k/v (and the dk/dv accumulators) around the ring
+    again, calling the flash backward kernels with the FINAL lse and
+    out: p = exp(s - lse_final) is the exact global softmax probability
+    of that block, so each block's (dq, dk, dv) contribution is exact.
+  - **pure-JAX ring** (CPU tests, unsupported shapes): same math with
+    materialized [*, h, sq, sk] score blocks.
+
 References (public techniques): Ring Attention (Liu et al. 2023),
 blockwise online softmax (Milakov & Gimelshein 2018). Math below is the
 standard log-sum-exp streaming update.
@@ -42,7 +54,8 @@ def _block_attn(q, k, v, bias, scale):
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    axis_name: str, causal: bool = False,
-                   scale: Optional[float] = None) -> jnp.ndarray:
+                   scale: Optional[float] = None,
+                   impl: str = "auto", interpret: bool = False) -> jnp.ndarray:
     """Attention with q/k/v sharded on the sequence axis.
 
     Args:
@@ -50,9 +63,25 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
       axis_name: mesh axis holding the sequence shards.
       causal: apply a causal mask consistent with the *global* sequence
         order (shard i holds positions [i*seq_local, (i+1)*seq_local)).
+      impl: "auto" (flash ring on TPU when shapes allow) | "flash" |
+        "naive" (pure-JAX blocks).
+      interpret: run the Pallas kernels in interpret mode (CPU tests).
 
     Returns the local output shard [batch, seq_local, heads, head_dim].
     """
+    if impl not in ("auto", "flash", "naive"):
+        raise ValueError(f"impl must be auto|flash|naive, got {impl!r}")
+    if impl != "naive":
+        from ..ops.flash_attention import supported
+        on_tpu = jax.default_backend() == "tpu"
+        if impl == "flash" or (on_tpu and supported(q.shape)):
+            if scale is None:
+                scale = q.shape[-1] ** -0.5
+            return _ring_flash(q, k, v, axis_name, causal, scale, interpret)
+    return _ring_naive(q, k, v, axis_name, causal, scale)
+
+
+def _ring_naive(q, k, v, axis_name, causal, scale):
     sp = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, sq, h, d = q.shape
@@ -86,12 +115,13 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         o_new = o * a_t + o_b * b_t
         return o_new, new_m, l_new
 
+    perm = _ring_perm(sp)
+
     def body(step, carry):
         o, m, l, k_blk, v_blk = carry
         o, m, l = accumulate(step, o, m, l, k_blk, v_blk)
         # rotate K/V one step around the ring (next-lower neighbor's shard
         # arrives; transfer overlaps the next iteration's compute)
-        perm = [(i, (i + 1) % sp) for i in range(sp)]
         k_next = jax.lax.ppermute(k_blk, axis_name, perm)
         v_next = jax.lax.ppermute(v_blk, axis_name, perm)
         return o, m, l, k_next, v_next
@@ -103,6 +133,174 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     o, m, l = accumulate(sp - 1, o, m, l, k_last, v_last)
     l = jnp.maximum(jnp.swapaxes(l, 1, 2), 1e-30)     # [b, sq, h, 1]
     return (o / l).astype(q.dtype)
+
+
+# ------------------------------------------------------------- flash ring
+
+def _ring_perm(sp):
+    return [(i, (i + 1) % sp) for i in range(sp)]
+
+
+def _blk_cases(causal, idx, kv_rank):
+    """0 = hidden (future kv shard), 1 = diagonal, 2 = fully visible."""
+    if not causal:
+        return None
+    return jnp.int32(jnp.sign(idx - kv_rank)) + 1
+
+
+def _flash_blk_fwd(q_t, k_t, v_t, case, scale, interpret):
+    """One ring step's flash forward. q_t/k_t/v_t: [b,h,s,d].
+    Returns a normalized fp32 partial out [b,h,s,d] (fp32 so the
+    per-step combine doesn't accumulate a bf16 rounding per ring step)
+    and lse [b,h,s,1] fp32. ``case`` None → non-causal visible."""
+    from ..ops.flash_attention import _flash_fwd, _pick_block
+
+    b, h, s, d = q_t.shape
+    bq = bk = _pick_block(s, 512)
+
+    def visible(_):
+        return _flash_fwd(q_t, k_t, v_t, False, scale, bq, bk, interpret,
+                          out_dtype=jnp.float32)
+
+    if case is None:
+        return visible(None)
+
+    def diagonal(_):
+        return _flash_fwd(q_t, k_t, v_t, True, scale, bq, bk, interpret,
+                          out_dtype=jnp.float32)
+
+    def hidden(_):
+        return (jnp.zeros(q_t.shape, jnp.float32),
+                jnp.full((b, h, s, 1), -1e30, jnp.float32))
+
+    return jax.lax.switch(case, [hidden, diagonal, visible], None)
+
+
+def _combine(o, lse, o_b, lse_b):
+    """Merge two normalized partials ([b,h,s,d] fp32, [b,h,s,1] fp32)."""
+    m = jnp.maximum(lse, lse_b)
+    w = jnp.exp(lse - m)
+    w_b = jnp.exp(lse_b - m)
+    new_lse = m + jnp.log(w + w_b)
+    return (o * jnp.exp(lse - new_lse)
+            + o_b * jnp.exp(lse_b - new_lse)), new_lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash(q, k, v, axis_name, causal, scale, interpret):
+    out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale,
+                                  interpret)
+    return out
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale, interpret):
+    sp = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    q_t = jnp.swapaxes(q, 1, 2)                       # [b,h,sq,d]
+    perm = _ring_perm(sp)
+
+    o = jnp.zeros((b, h, sq, d), jnp.float32)
+    lse = jnp.full((b, h, sq, 1), -1e30, jnp.float32)
+
+    def accumulate(step, o, lse, k_blk, v_blk):
+        kv_rank = (idx - step) % sp
+        o_b, lse_b = _flash_blk_fwd(
+            q_t, jnp.swapaxes(k_blk, 1, 2), jnp.swapaxes(v_blk, 1, 2),
+            _blk_cases(causal, idx, kv_rank), scale, interpret)
+        return _combine(o, lse, o_b, lse_b)
+
+    def body(step, carry):
+        o, lse, k_blk, v_blk = carry
+        o, lse = accumulate(step, o, lse, k_blk, v_blk)
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return o, lse, k_next, v_next
+
+    o, lse, k_last, v_last = jax.lax.fori_loop(0, sp - 1, body,
+                                               (o, lse, k, v))
+    o, lse = accumulate(sp - 1, o, lse, k_last, v_last)
+    out = jnp.swapaxes(o, 1, 2).astype(q.dtype)       # [b,sq,h,d]
+    # lse stored [b,h,sq]: a trailing unit dim lane-pads 128x on TPU
+    return out, (q, k, v, out, lse[..., 0])
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, scale, interpret):
+    return _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale, interpret)
+
+
+def _ring_flash_vjp_bwd(axis_name, causal, scale, interpret, res, g):
+    from ..ops.flash_attention import _flash_bwd, _pick_block
+
+    q, k, v, out, lse = res
+    lse = lse[..., None]                              # back to [b,h,sq,1]
+    sp = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    bq = bk = _pick_block(sq, 512)
+    q_t = jnp.swapaxes(q, 1, 2)
+    out_t = jnp.swapaxes(out, 1, 2)
+    do_t = jnp.swapaxes(g, 1, 2)
+    # delta is loop-invariant (depends only on do and the final out):
+    # compute it once instead of once per ring step inside _flash_bwd
+    delta = jnp.sum(do_t.astype(jnp.float32) * out_t.astype(jnp.float32),
+                    axis=-1, keepdims=True)           # [b,h,sq,1]
+    perm = _ring_perm(sp)
+
+    def blk_bwd(k_t, v_t, case):
+        # flash bwd with the FINAL lse/out: p = exp(s - lse_final) is the
+        # exact global softmax probability of this block, so the per-block
+        # (dq, dk, dv) are exact contributions that just sum.
+        def visible(_):
+            return _flash_bwd(q_t, k_t, v_t, out_t, lse, do_t,
+                              False, scale, bq, bk, interpret, delta=delta)
+
+        if case is None:
+            return visible(None)
+
+        def diagonal(_):
+            return _flash_bwd(q_t, k_t, v_t, out_t, lse, do_t,
+                              True, scale, bq, bk, interpret, delta=delta)
+
+        def hidden(_):
+            return (jnp.zeros_like(q_t), jnp.zeros_like(k_t),
+                    jnp.zeros_like(v_t))
+
+        return jax.lax.switch(case, [hidden, diagonal, visible], None)
+
+    def accumulate(step, dq, k_blk, v_blk, dk_blk, dv_blk):
+        kv_rank = (idx - step) % sp
+        dq_b, dk_b, dv_b = blk_bwd(
+            jnp.swapaxes(k_blk, 1, 2), jnp.swapaxes(v_blk, 1, 2),
+            _blk_cases(causal, idx, kv_rank))
+        return (dq + dq_b.astype(jnp.float32),
+                dk_blk + jnp.swapaxes(dk_b, 1, 2).astype(jnp.float32),
+                dv_blk + jnp.swapaxes(dv_b, 1, 2).astype(jnp.float32))
+
+    def body(step, carry):
+        dq, k_blk, v_blk, dk_blk, dv_blk = carry
+        dq, dk_blk, dv_blk = accumulate(step, dq, k_blk, v_blk,
+                                        dk_blk, dv_blk)
+        # dk/dv accumulators travel WITH their k/v shard around the ring
+        k_blk, v_blk, dk_blk, dv_blk = (
+            jax.lax.ppermute(x, axis_name, perm)
+            for x in (k_blk, v_blk, dk_blk, dv_blk))
+        return dq, k_blk, v_blk, dk_blk, dv_blk
+
+    dq = jnp.zeros((b, h, sq, d), jnp.float32)
+    dkv0 = jnp.zeros((b, sq, h, d), jnp.float32)
+    dq, k_blk, v_blk, dk_blk, dv_blk = jax.lax.fori_loop(
+        0, sp - 1, body, (dq, k, v, dkv0, dkv0))
+    dq, dk_blk, dv_blk = accumulate(sp - 1, dq, k_blk, v_blk,
+                                    dk_blk, dv_blk)
+    # sp-1 rotations happened; one more brings each dk/dv shard home
+    dk = jax.lax.ppermute(dk_blk, axis_name, perm)
+    dv = jax.lax.ppermute(dv_blk, axis_name, perm)
+    return (jnp.swapaxes(dq, 1, 2).astype(q.dtype), dk.astype(k.dtype),
+            dv.astype(v.dtype))
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
 
 
 def local_attention(q, k, v, causal: bool = False,

@@ -50,3 +50,57 @@ def test_ring_attention_bf16():
     got = np.asarray(fn(jax.device_put(q, sharding), jax.device_put(k, sharding),
                         jax.device_put(v, sharding)).astype(jnp.float32))
     np.testing.assert_allclose(got, want, rtol=5e-2, atol=5e-2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_ring_matches_naive_ring(causal):
+    """Pallas flash ring (interpret mode) vs pure-JAX ring: fwd + grads."""
+    mesh = make_mesh({"seq": 4}, devices=jax.devices()[:4])
+    b, s, h, d = 1, 512, 2, 16   # 128-token shards: flash-supported
+    rng = np.random.RandomState(2)
+    q = rng.randn(b, s, h, d).astype(np.float32) * 0.5
+    k = rng.randn(b, s, h, d).astype(np.float32) * 0.5
+    v = rng.randn(b, s, h, d).astype(np.float32)
+
+    def run(impl):
+        def f(q, k, v):
+            def loss(q, k, v):
+                o = ring_attention(q, k, v, "seq", causal=causal,
+                                   impl=impl, interpret=True)
+                return (o * (o + 1.0)).sum()
+            l, g = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+            return jax.lax.psum(l, "seq"), g
+
+        spec = P(None, "seq")
+        fn = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=spec,
+            out_specs=(P(), spec), check_vma=False))
+        sharding = NamedSharding(mesh, spec)
+        l, g = fn(*(jax.device_put(x, sharding) for x in (q, k, v)))
+        return float(l), tuple(np.asarray(x) for x in g)
+
+    l_naive, g_naive = run("naive")
+    l_flash, g_flash = run("flash")
+    np.testing.assert_allclose(l_flash, l_naive, rtol=1e-4)
+    for gf, gn in zip(g_flash, g_naive):
+        np.testing.assert_allclose(gf, gn, rtol=2e-3, atol=2e-3)
+
+
+def test_flash_ring_matches_local_single_device():
+    """Flash ring on a 1-shard 'ring' == plain local attention."""
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("seq",))
+    b, s, h, d = 2, 256, 2, 16
+    rng = np.random.RandomState(3)
+    q = rng.randn(b, s, h, d).astype(np.float32) * 0.5
+    k = rng.randn(b, s, h, d).astype(np.float32) * 0.5
+    v = rng.randn(b, s, h, d).astype(np.float32)
+    want = np.asarray(local_attention(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v), causal=True))
+    spec = P(None, "seq")
+    fn = jax.jit(jax.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "seq", causal=True,
+                                       impl="flash", interpret=True),
+        mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False))
+    sharding = NamedSharding(mesh, spec)
+    got = np.asarray(fn(*(jax.device_put(x, sharding) for x in (q, k, v))))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
