@@ -172,11 +172,26 @@ def MirroredStrategy(*args, **kwargs):
     return _MS(*args, **kwargs)
 
 
+# Reference-named compat classes (torch DDP / tf2 tape / Compression —
+# see byteps_tpu/compat.py). Exposed lazily as REAL classes so
+# isinstance/subclassing work, while keeping import light.
+_COMPAT_EXPORTS = ("DistributedDataParallel", "DistributedGradientTape",
+                   "Compression")
+
+
+def __getattr__(name):
+    if name in _COMPAT_EXPORTS:
+        from . import compat
+        return getattr(compat, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "init", "shutdown", "suspend", "resume", "rank", "size", "local_rank",
     "local_size", "declare_tensor", "push_pull", "push_pull_async",
     "poll", "synchronize", "broadcast_parameters",
     "broadcast_optimizer_state", "get_pushpull_speed",
     "DistributedOptimizer", "DistributedTrainer", "MirroredStrategy",
+    "DistributedDataParallel", "DistributedGradientTape", "Compression",
     "Config", "__version__",
 ]

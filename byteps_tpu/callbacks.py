@@ -91,3 +91,65 @@ def metric_average(metrics: Union[float, Mapping[str, float]],
     vals = multihost_utils.process_allgather(
         jnp.mean(jnp.asarray(metrics, jnp.float32)))
     return float(np.mean(np.asarray(vals)))
+
+
+# -- class-named wrappers (reference callback class names) ------------------
+# The reference's Keras callbacks mutate a stateful loop; these wrappers
+# give the same names to the JAX-native pieces above so a reference user
+# finds them: construct once, call from your host loop.
+
+class BroadcastGlobalVariablesCallback:
+    """Broadcast params (and optionally optimizer state) from root at the
+    start of training (reference: _keras/callbacks.py BroadcastGlobalVariables).
+    Call ``on_train_begin`` once before the first step."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+
+    def on_train_begin(self, params, opt_state=None):
+        from . import broadcast_optimizer_state, broadcast_parameters
+        params = broadcast_parameters(params, root_rank=self.root_rank)
+        if opt_state is None:
+            return params
+        return params, broadcast_optimizer_state(opt_state,
+                                                 root_rank=self.root_rank)
+
+
+class MetricAverageCallback:
+    """Average epoch metrics over replicas/processes (reference:
+    _keras/callbacks.py MetricAverage). Call ``on_epoch_end(logs)``."""
+
+    def on_epoch_end(self, metrics):
+        return metric_average(metrics)
+
+    __call__ = on_epoch_end
+
+
+class LearningRateScheduleCallback:
+    """``multiplier_schedule`` under its reference name; the instance is
+    an optax schedule (``callback(step) -> lr``)."""
+
+    def __init__(self, base_lr: float, multiplier,
+                 staircase_every: Optional[int] = None):
+        self._sched = multiplier_schedule(base_lr, multiplier,
+                                          staircase_every)
+
+    def __call__(self, step):
+        return self._sched(step)
+
+
+class LearningRateWarmupCallback:
+    """``warmup_schedule`` under its reference name; the instance is an
+    optax schedule (``callback(step) -> lr``)."""
+
+    def __init__(self, base_lr: float, world_size: Optional[int] = None,
+                 warmup_steps: int = 1000, after=None):
+        if world_size is None:
+            from .common.global_state import GlobalState
+            world_size = (GlobalState.get().dp
+                          if GlobalState.initialized() else 1)
+        self._sched = warmup_schedule(base_lr, world_size, warmup_steps,
+                                      after)
+
+    def __call__(self, step):
+        return self._sched(step)

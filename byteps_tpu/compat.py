@@ -1,0 +1,142 @@
+"""Name-level compatibility with the reference's plugin APIs.
+
+The JAX-native shapes of these features live elsewhere (training.py,
+optim.py, callbacks.py); this module gives them the exact names a
+BytePS/Horovod user greps for (reference: torch/parallel/distributed.py
+DistributedDataParallel, tensorflow/__init__.py:341-415
+DistributedGradientTape, */compression.py Compression classes).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from .training import DistributedTrainer
+
+
+class _NoneCompressor:
+    """Identity (reference: Compression.none)."""
+
+    @staticmethod
+    def compress(tree):
+        return tree, None
+
+    @staticmethod
+    def decompress(tree, ctx):
+        return tree
+
+
+class _FP16Compressor:
+    """Halve wire bytes by casting float leaves to 16-bit before
+    communication (reference: Compression.fp16 — intra-node framework
+    cast, docs/gradient-compression.md "Intra-node"). On TPU the 16-bit
+    float is bfloat16: same matmul dtype the MXU uses, no overflow from
+    the fp16 5-bit exponent."""
+
+    @staticmethod
+    def compress(tree):
+        dtypes = jax.tree_util.tree_map(lambda x: x.dtype, tree)
+        cast = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+        return cast, dtypes
+
+    @staticmethod
+    def decompress(tree, dtypes):
+        return jax.tree_util.tree_map(
+            lambda x, dt: x.astype(dt), tree, dtypes)
+
+
+class Compression:
+    """Selector namespace, Horovod-style: ``compression=Compression.fp16``."""
+    none = _NoneCompressor
+    fp16 = _FP16Compressor
+
+
+class DistributedGradientTape:
+    """tf2-style tape: per-replica grads averaged across the data axes
+    (reference: tensorflow/__init__.py:341-415). The batch is split over
+    the mesh's data axes; each replica differentiates its shard and the
+    gradients are mean-reduced (through the ``compression`` cast, if
+    set) before being returned — the tape analog of the trainer's step.
+
+    ```python
+    tape = bps.DistributedGradientTape(loss_fn)
+    loss, grads = tape.gradient(params, batch)   # grads already averaged
+    ```
+    """
+
+    def __init__(self, loss_fn: Callable, compression=Compression.none,
+                 mesh=None):
+        from jax.sharding import PartitionSpec as P
+
+        from .common.global_state import GlobalState
+        from .parallel.mesh import data_axes, make_mesh
+
+        if mesh is None:
+            mesh = (GlobalState.get().mesh if GlobalState.initialized()
+                    else make_mesh())
+        axes = data_axes(mesh)
+        compress, decompress = compression.compress, compression.decompress
+
+        def f(params, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            if axes:
+                wire, ctx = compress(grads)
+                wire = jax.tree_util.tree_map(
+                    lambda x: jax.lax.pmean(x, axes), wire)
+                grads = decompress(wire, ctx)
+                loss = jax.lax.pmean(loss, axes)
+            return loss, grads
+
+        batch_spec = P(axes) if axes else P()
+        self._fn = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(), batch_spec),
+            out_specs=(P(), P()), check_vma=False))
+        self._mesh = mesh
+
+    def gradient(self, params, batch):
+        from .data import shard_batch
+        return self._fn(params, shard_batch(batch, self._mesh))
+
+    __call__ = gradient
+
+
+def _fp16_wire_reducer(x, axes):
+    """Bucket reducer casting the wire payload to bf16 (Compression.fp16
+    semantics: halve allreduce bytes, keep accumulation visible dtype)."""
+    from .parallel.collectives import psum_reducer
+    if not axes or not jnp.issubdtype(x.dtype, jnp.floating):
+        return psum_reducer(x, axes)
+    return jax.lax.psum(x.astype(jnp.bfloat16), axes).astype(x.dtype)
+
+
+class DistributedDataParallel(DistributedTrainer):
+    """torch-style name for the data-parallel trainer (reference:
+    torch/parallel/distributed.py). A torch DDP wraps a module and syncs
+    grads at backward; the JAX seam for "backward finished" is the
+    jitted train step, so this IS DistributedTrainer — see
+    docs/DistributedDataParallel.md for the full mapping.
+
+    ``compression`` additionally accepts the Horovod-style selectors
+    ``Compression.none`` / ``Compression.fp16`` (translated to a plain /
+    bf16-wire reducer) next to the trainer's string-kwargs dict form."""
+
+    def __init__(self, loss_fn, params, tx, compression=None, **kwargs):
+        if compression is Compression.none:
+            compression = None
+        elif compression is Compression.fp16:
+            if "reducer" in kwargs:
+                raise TypeError("pass either reducer= or "
+                                "compression=Compression.fp16, not both")
+            compression = None
+            kwargs["reducer"] = _fp16_wire_reducer
+        elif not (compression is None or isinstance(compression, dict)):
+            raise TypeError(
+                "compression must be Compression.none, Compression.fp16, or "
+                f"a string-kwargs dict, got {compression!r}")
+        super().__init__(loss_fn, params, tx, compression=compression,
+                         **kwargs)
