@@ -55,6 +55,7 @@ class TransformerConfig:
     sp_axis: Optional[str] = None # mesh axis for ring-attention seq shards
     pp_axis: Optional[str] = None # mesh axis for pipeline (layer) stages
     pp_microbatches: int = 0      # GPipe microbatches (0 → pipeline size)
+    scan_unroll: int = 1          # lax.scan unroll factor over layers
 
     def __post_init__(self):
         if self.remat_policy not in (None, "dots", "mlp_only"):
@@ -224,7 +225,7 @@ def apply(params, cfg: TransformerConfig, tokens: jnp.ndarray,
         return blk_fn(carry, blk), None
 
     def stack_fn(blocks, h):
-        out, _ = jax.lax.scan(body, h, blocks)
+        out, _ = jax.lax.scan(body, h, blocks, unroll=cfg.scan_unroll)
         return out
 
     if cfg.pp_axis is not None:

@@ -1,0 +1,101 @@
+"""Long-context training benchmark: tokens/sec vs sequence length.
+
+Additive scope over the reference (SURVEY §5: long-context entirely
+absent there): GPT-style causal LM training at long sequence lengths via
+the Pallas flash-attention kernels, with ring attention over a ``seq``
+mesh axis when one is present (--sp N).
+
+Usage:
+  python examples/long_context_bench.py --model gpt2-small \
+      --seqs 2048,8192,32768
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/long_context_bench.py --model gpt2-tiny --sp 4 \
+      --seqs 256,512 --tokens-per-step 512
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import time
+from functools import partial
+
+import jax
+import numpy as np
+import optax
+
+import _bootstrap  # noqa: F401
+
+MODELS = {"gpt2-small": "gpt2_small", "gpt2-medium": "gpt2_medium",
+          "gpt2-tiny": "gpt2_tiny"}
+
+
+def measure(model: str, seq: int, tokens_per_step: int, sp: int,
+            iters: int) -> float:
+    from byteps_tpu.models import gpt2, transformer
+
+    cfg = dataclasses.replace(
+        getattr(gpt2, MODELS[model])(), max_seq=seq,
+        sp_axis="seq" if sp > 1 else None)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    batch = max(1, tokens_per_step // seq)
+    data = gpt2.synth_lm_batch(np.random.RandomState(0), batch, seq,
+                               cfg.vocab_size)
+    tx = optax.adamw(1e-4)
+
+    if sp > 1:
+        from byteps_tpu.models.transformer import param_specs
+        from byteps_tpu.parallel.mesh import make_mesh
+        from byteps_tpu.training import ShardedTrainer
+        mesh = make_mesh({"seq": sp}, devices=jax.devices()[:sp])
+        tr = ShardedTrainer(lambda p, b: gpt2.causal_lm_loss(p, cfg, b),
+                            params, param_specs(cfg), tx, mesh=mesh)
+        step = lambda b: tr.step(b)
+    else:
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def _step(p, s, b):
+            l, g = jax.value_and_grad(
+                lambda p, b: gpt2.causal_lm_loss(p, cfg, b))(p, b)
+            u, s = tx.update(g, s, p)
+            return optax.apply_updates(p, u), s, l
+
+        state = [tx.init(params), params]
+
+        def step(b):
+            state[1], state[0], l = _step(state[1], state[0], b)
+            return l
+
+    for _ in range(2):
+        l = step(data)
+    float(l)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        l = step(data)
+    float(l)
+    return batch * seq * iters / (time.perf_counter() - t0)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="gpt2-small", choices=sorted(MODELS))
+    ap.add_argument("--seqs", default="2048,4096,8192,16384,32768")
+    ap.add_argument("--tokens-per-step", type=int, default=8192)
+    ap.add_argument("--sp", type=int, default=1,
+                    help="sequence-parallel (ring) shards")
+    ap.add_argument("--iters", type=int, default=3)
+    args = ap.parse_args()
+
+    rows = {}
+    for seq in (int(s) for s in args.seqs.split(",")):
+        tps = measure(args.model, seq, args.tokens_per_step, args.sp,
+                      args.iters)
+        rows[str(seq)] = round(tps)
+        print(f"seq={seq:7d}  tokens/sec={tps:12.0f}")
+    print(json.dumps({"metric": f"{args.model}_long_context_tokens_per_sec",
+                      "value": rows[max(rows, key=int)], "unit": "tokens/sec",
+                      "by_seq": rows, "sp": args.sp}))
+
+
+if __name__ == "__main__":
+    main()

@@ -30,6 +30,8 @@ def main() -> None:
     ap.add_argument("--block-q", type=int, default=0,
                     help="flash block override (0 = kernel default)")
     ap.add_argument("--block-k", type=int, default=0)
+    ap.add_argument("--unroll", type=int, default=1,
+                    help="lax.scan unroll over layers")
     args = ap.parse_args()
 
     from byteps_tpu.models import bert
@@ -38,7 +40,7 @@ def main() -> None:
     cfg = dataclasses.replace(
         cfg, remat=args.remat != "none",
         remat_policy=args.remat if args.remat in ("dots", "mlp_only")
-        else None)
+        else None, scan_unroll=args.unroll)
 
     if args.block_q or args.block_k:
         import inspect
@@ -58,6 +60,7 @@ def main() -> None:
                            warm=3)
     print(json.dumps({"remat": args.remat, "batch": args.batch,
                       "block_q": args.block_q, "block_k": args.block_k,
+                      "unroll": args.unroll,
                       "samples_per_sec": round(sps, 2)}))
 
 

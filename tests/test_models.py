@@ -229,3 +229,26 @@ def test_remat_policy_validation():
         dataclasses.replace(cfg, remat=True, remat_policy="bogus")
     with pytest.raises(ValueError, match="ignored"):
         dataclasses.replace(cfg, remat=False, remat_policy="dots")
+
+
+def test_causal_lm_loss_keeps_full_length():
+    """causal_lm_loss must not shift the sequence to s-1: that silently
+    disqualified the flash kernels (seq % 128 != 0) — the full-length
+    form with a masked last target computes the identical loss."""
+    from byteps_tpu.models.transformer import lm_loss
+    cfg = gpt2.gpt2_tiny()
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(1, cfg.vocab_size, (2, 64)),
+        jnp.int32)
+    got = float(gpt2.causal_lm_loss(params, cfg, tokens))
+    want = float(lm_loss(params, cfg, (tokens[:, :-1], tokens[:, 1:])))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    # grads identical too (the extra masked position contributes nothing)
+    g1 = jax.grad(lambda p: gpt2.causal_lm_loss(p, cfg, tokens))(params)
+    g2 = jax.grad(lambda p: lm_loss(p, cfg, (tokens[:, :-1],
+                                             tokens[:, 1:])))(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7),
+        g1, g2)
