@@ -1,0 +1,80 @@
+"""Worker for the 2-process localhost distributed test (the analog of
+the reference's meta_test.py harness: real rendezvous, real collectives,
+one machine). Launched by tests/test_multiprocess.py with
+BPS_COORDINATOR_ADDRESS / BPS_NUM_PROCESSES / BPS_PROCESS_ID set and 2
+virtual CPU devices per process."""
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import jax.numpy as jnp
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import byteps_tpu as bps
+
+
+def main():
+    pid = int(os.environ["BPS_PROCESS_ID"])
+    bps.init()
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 4, len(jax.devices())
+    assert bps.size() == 4, bps.size()
+    assert bps.rank() == pid * 2, (bps.rank(), pid)
+
+    # --- trainer across processes: single-controller semantics — every
+    # process supplies the full GLOBAL batch; JAX assembles the
+    # cross-process array (2 rows per device over 4 devices, 2 hosts).
+    # Loss must equal the single-process value exactly, step for step.
+    W = np.random.RandomState(0).randn(4, 1).astype(np.float32)
+    x = np.random.RandomState(1).randn(8, 4).astype(np.float32)
+    local_batch = (x, x @ W)
+
+    def loss_fn(p, b):
+        xx, yy = b
+        return jnp.mean((xx @ p["w"] - yy) ** 2)
+
+    trainer = bps.DistributedTrainer(loss_fn, {"w": jnp.zeros((4, 1))},
+                                     optax.adam(0.05))
+    losses = [float(trainer.step(local_batch)) for _ in range(20)]
+
+    # single-process reference on the same data
+    tx = optax.adam(0.05)
+    p = {"w": jnp.zeros((4, 1))}
+    s = tx.init(p)
+
+    @jax.jit
+    def ref_step(p, s):
+        l, g = jax.value_and_grad(loss_fn)(p, local_batch)
+        u, s = tx.update(g, s, p)
+        return optax.apply_updates(p, u), s, l
+
+    ref = []
+    for _ in range(20):
+        p, s, l = ref_step(p, s)
+        ref.append(float(l))
+    np.testing.assert_allclose(losses, ref, rtol=1e-5)
+
+    # --- metric averaging across processes
+    from byteps_tpu.callbacks import metric_average
+    avg = metric_average({"m": float(pid)})
+    np.testing.assert_allclose(avg["m"], 0.5)
+
+    # --- broadcast: per-process divergent params converge to rank 0's
+    mine = {"w": jnp.full((4, 2), float(pid + 1))}
+    out = bps.broadcast_parameters(mine, root_rank=0)
+    np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
+
+    bps.shutdown()
+    print(f"MP_WORKER_OK pid={pid} first={losses[0]:.5f} last={losses[-1]:.5f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Two real JAX processes over a localhost coordinator — the analog of
+the reference's meta_test.py strategy (SURVEY §4: same binaries, real
+rendezvous/collectives, one machine, no cluster)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_training_localhost():
+    port = _free_port()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(root, "tests", "_mp_worker.py")
+    procs = []
+    try:
+        for pid in (0, 1):
+            env = dict(
+                os.environ,
+                XLA_FLAGS="--xla_force_host_platform_device_count=2",
+                JAX_PLATFORMS="cpu",
+                BPS_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                BPS_NUM_PROCESSES="2",
+                BPS_PROCESS_ID=str(pid),
+            )
+            procs.append(subprocess.Popen(
+                [sys.executable, worker], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=240)
+            except subprocess.TimeoutExpired:
+                for q in procs:          # kill BOTH, then salvage output
+                    q.kill()
+                out, _ = p.communicate()
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
+        assert "MP_WORKER_OK" in out, out[-2000:]
