@@ -56,6 +56,21 @@ def build(model: str, batch: int):
         data = (rng.randn(batch, 2048).astype(np.float32),
                 rng.randn(batch, 2048).astype(np.float32))
         loss_fn = mlp_loss
+    elif model == "moe":
+        from byteps_tpu.models import moe
+        # GPT-2-small-sized backbone with 8 experts: the largest MoE whose
+        # params + adam state fit one v5e chip (24-layer/1024-hidden x8
+        # experts needs ~30 GB)
+        cfg = moe.MoEConfig(num_experts=8, top_k=2, hidden=768, layers=12,
+                            heads=12, mlp_dim=3072, causal=True)
+        params = moe.init_moe_params(jax.random.PRNGKey(0), cfg)
+        from byteps_tpu.models import gpt2 as _gpt2
+        seq = min(cfg.max_seq, 512)
+        tokens = _gpt2.synth_lm_batch(rng, batch, seq, cfg.vocab_size)
+        targets = np.concatenate(
+            [tokens[:, 1:], np.full((batch, 1), -1, np.int32)], axis=1)
+        data = (tokens, targets)
+        loss_fn = lambda p, b: moe.moe_lm_loss(p, cfg, b)
     else:
         raise SystemExit(f"unknown model {model}")
     return params, data, loss_fn
