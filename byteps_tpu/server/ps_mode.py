@@ -67,6 +67,9 @@ class PSGradientExchange:
         round. Returns the summed tree."""
         treedef, keyed = self._plan(tree)
         leaves, _ = jax.tree_util.tree_flatten(tree)
+        for l in leaves:                 # start ALL D2H copies first so the
+            if hasattr(l, "copy_to_host_async"):   # transfers overlap instead
+                l.copy_to_host_async()             # of serializing per leaf
         flat = [np.asarray(l).reshape(-1) for l in leaves]
         self._round += 1
         bufs = []
