@@ -317,6 +317,9 @@ def supported(q_shape) -> bool:
     return s % 128 == 0 and d <= 256
 
 
+_warned_fallback = set()
+
+
 def attention(q, k, v, causal=False, scale=None, impl="auto"):
     """Dispatcher: Pallas flash kernels on TPU, blockwise JAX elsewhere.
 
@@ -330,4 +333,12 @@ def attention(q, k, v, causal=False, scale=None, impl="auto"):
     on_tpu = jax.default_backend() == "tpu"
     if impl == "flash" or (on_tpu and supported(q.shape)):
         return flash_attention(q, k, v, causal=causal, scale=scale)
+    if on_tpu and tuple(q.shape) not in _warned_fallback:
+        # a silent fall-through here once cost 28x at seq 8k (an s-1 shift
+        # broke seq % 128) — make the downgrade loud, once per shape
+        _warned_fallback.add(tuple(q.shape))
+        from ..common.logging import get_logger
+        get_logger().warning(
+            "attention %s falls back to naive O(s^2) on TPU (flash needs "
+            "seq %% 128 == 0 and head_dim <= 256)", tuple(q.shape))
     return local_attention(q, k, v, causal=causal, scale=scale)

@@ -78,3 +78,30 @@ def test_scale_override():
     ref = local_attention(q, k, v, scale=0.5)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_naive_fallback_warns_once_per_shape(monkeypatch):
+    """On TPU, silently downgrading to O(s^2) attention must be loud."""
+    import logging
+
+    import byteps_tpu.ops.flash_attention as fa
+    from byteps_tpu.common.logging import get_logger
+
+    monkeypatch.setattr(fa.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(fa, "_warned_fallback", set())
+    records = []
+    handler = logging.Handler()
+    handler.emit = lambda r: records.append(r.getMessage())
+    logger = get_logger()
+    logger.addHandler(handler)
+    prev_level = logger.level
+    logger.setLevel(logging.WARNING)    # env may have raised it to ERROR
+    try:
+        q = jnp.zeros((1, 65, 2, 8), jnp.float32)   # 65 % 128 != 0
+        fa.attention(q, q, q)
+        fa.attention(q, q, q)                        # same shape: no repeat
+        warns = [m for m in records if "falls back to naive" in m]
+        assert len(warns) == 1, records
+    finally:
+        logger.setLevel(prev_level)
+        logger.removeHandler(handler)
