@@ -24,16 +24,30 @@ def _conv_init(key, kh, kw, cin, cout):
 
 
 def _conv(x, w, stride=1, padding="SAME"):
+    """Conv at the activation dtype (weights cast to match — bf16 feeds
+    the MXU, which accumulates fp32 internally; fp32 convs take the slow
+    multi-pass path on TPU). Output stays at the activation dtype (a
+    fp32 preferred_element_type would break the conv's vjp rule)."""
     return jax.lax.conv_general_dilated(
-        x, w, (stride, stride), padding,
+        x, w.astype(x.dtype), (stride, stride), padding,
         dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
 def _bn(x, p, eps=1e-5):
-    mu = x.mean(axis=(0, 1, 2), keepdims=True)
-    var = x.var(axis=(0, 1, 2), keepdims=True)
-    xn = (x - mu) * jax.lax.rsqrt(var + eps)
-    return xn * p["scale"] + p["bias"]
+    """BatchNorm with fp32 statistics; returns the input's dtype."""
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(axis=(0, 1, 2), keepdims=True)
+    var = x32.var(axis=(0, 1, 2), keepdims=True)
+    xn = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (xn * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _net_dtype(dtype):
+    """None → bf16 on TPU (mixed precision), fp32 elsewhere."""
+    if dtype is not None:
+        return jnp.dtype(dtype)
+    return jnp.dtype(jnp.bfloat16 if jax.default_backend() == "tpu"
+                     else jnp.float32)
 
 
 def _bn_init(c):
@@ -70,30 +84,36 @@ def init_resnet50(rng, num_classes: int = 1000, stages=None):
 
 def _bottleneck(x, blk, stride):
     out = jax.nn.relu(_bn(_conv(x, blk["conv1"]), blk["bn1"]))
-    out = jax.nn.relu(_bn(_conv(out, blk["conv2"], stride=stride), blk["bn2"]))
+    out = jax.nn.relu(_bn(_conv(out, blk["conv2"], stride=stride),
+                          blk["bn2"]))
     out = _bn(_conv(out, blk["conv3"]), blk["bn3"])
     if "proj" in blk:
         x = _bn(_conv(x, blk["proj"], stride=stride), blk["proj_bn"])
     return jax.nn.relu(out + x)
 
 
-def resnet50_apply(params, x):
-    """x: [n, h, w, 3] → logits [n, classes]."""
+def resnet50_apply(params, x, dtype=None):
+    """x: [n, h, w, 3] → logits [n, classes] fp32.
+
+    dtype: activation/compute dtype; None → bf16 on TPU, fp32 elsewhere
+    (params stay fp32; convs accumulate fp32; BN statistics fp32)."""
+    dt = _net_dtype(dtype)
+    x = x.astype(dt)
     x = _conv(x, params["stem"]["conv"], stride=2)
     x = jax.nn.relu(_bn(x, params["stem"]["bn"]))
-    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
-                              (1, 2, 2, 1), "SAME")
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max,
+                              (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
     for si, stage in enumerate(params["stages"]):
         for bi, blk in enumerate(stage):
             # stride 2 on the first block of stages 1+ (standard ResNet)
             x = _bottleneck(x, blk, 2 if (bi == 0 and si > 0) else 1)
-    x = x.mean(axis=(1, 2))
+    x = x.astype(jnp.float32).mean(axis=(1, 2))
     return x @ params["fc_w"] + params["fc_b"]
 
 
-def resnet_loss(params, batch):
+def resnet_loss(params, batch, dtype=None):
     x, y = batch
-    lg = resnet50_apply(params, x)
+    lg = resnet50_apply(params, x, dtype=dtype)
     logp = jax.nn.log_softmax(lg)
     return -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
 

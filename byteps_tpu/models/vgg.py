@@ -8,7 +8,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .resnet import _conv, _conv_init
+from .resnet import _conv, _conv_init, _net_dtype
 
 # VGG-16: conv channel plan per block ('M' = 2x2 maxpool)
 VGG16_PLAN = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
@@ -39,25 +39,30 @@ def init_vgg16(rng, num_classes: int = 1000, in_hw: int = 224):
     return params
 
 
-def vgg16_apply(params, x):
+def vgg16_apply(params, x, dtype=None):
+    """dtype: activation/compute dtype; None → bf16 on TPU, fp32
+    elsewhere (params fp32, convs/matmuls accumulate fp32)."""
+    dt = _net_dtype(dtype)
+    x = x.astype(dt)
     ci = 0
     for item in VGG16_PLAN:
         if item == "M":
-            x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 2, 2, 1),
-                                      (1, 2, 2, 1), "VALID")
+            x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max,
+                                      (1, 2, 2, 1), (1, 2, 2, 1), "VALID")
             continue
         p = params["convs"][ci]
-        x = jax.nn.relu(_conv(x, p["w"]) + p["b"])
+        x = jax.nn.relu(_conv(x, p["w"]) + p["b"].astype(dt))
         ci += 1
     x = x.reshape(x.shape[0], -1)
     for i, p in enumerate(params["fcs"]):
-        x = x @ p["w"] + p["b"]
+        x = jnp.dot(x, p["w"].astype(dt),
+                    preferred_element_type=jnp.float32) + p["b"]
         if i < len(params["fcs"]) - 1:
-            x = jax.nn.relu(x)
+            x = jax.nn.relu(x).astype(dt)
     return x
 
 
-def vgg_loss(params, batch):
+def vgg_loss(params, batch, dtype=None):
     x, y = batch
-    logp = jax.nn.log_softmax(vgg16_apply(params, x))
+    logp = jax.nn.log_softmax(vgg16_apply(params, x, dtype=dtype))
     return -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
