@@ -1,0 +1,65 @@
+"""Launcher (bpslaunch-tpu): env contract, TPU metadata resolution,
+local exec — the reference's launcher/launch.py analog."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from byteps_tpu.launcher import launch
+
+
+def _args(**kw):
+    defaults = dict(coordinator=None, num_processes=None, process_id=None,
+                    hosts=None, numa=False, server=False, cmd=[])
+    defaults.update(kw)
+    return type("Args", (), defaults)()
+
+
+def test_build_env_explicit_flags(monkeypatch):
+    monkeypatch.delenv("BPS_ROLE", raising=False)
+    env = launch.build_env(_args(coordinator="10.0.0.1:8476",
+                                 num_processes=4, process_id=2))
+    assert env["BPS_COORDINATOR_ADDRESS"] == "10.0.0.1:8476"
+    assert env["BPS_NUM_PROCESSES"] == "4"
+    assert env["BPS_PROCESS_ID"] == "2"
+    assert env["BPS_ROLE"] == "worker"
+
+
+def test_build_env_server_role(monkeypatch):
+    monkeypatch.delenv("BPS_ROLE", raising=False)
+    assert launch.build_env(_args(server=True))["BPS_ROLE"] == "server"
+
+
+def test_tpu_metadata_resolution(monkeypatch):
+    """TPU pod metadata env resolves topology; flags override it."""
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host-a,host-b,host-c")
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    monkeypatch.delenv("BPS_COORDINATOR_PORT", raising=False)
+    env = launch.build_env(_args())
+    assert env["BPS_NUM_PROCESSES"] == "3"
+    assert env["BPS_PROCESS_ID"] == "1"
+    assert env["BPS_COORDINATOR_ADDRESS"] == "host-a:8476"
+    # explicit flag wins over metadata
+    env = launch.build_env(_args(coordinator="other:9"))
+    assert env["BPS_COORDINATOR_ADDRESS"] == "other:9"
+
+
+def test_run_local_execs_command_with_env(monkeypatch):
+    monkeypatch.delenv("BPS_ROLE", raising=False)
+    out = subprocess.run(
+        [sys.executable, "-m", "byteps_tpu.launcher.launch",
+         "--num-processes", "1", "--process-id", "0", "--",
+         sys.executable, "-c",
+         "import os; print(os.environ['BPS_PROCESS_ID'], "
+         "os.environ['BPS_ROLE'])"],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert out.stdout.strip().endswith("0 worker")
+
+
+def test_main_requires_command():
+    with pytest.raises(SystemExit):
+        launch.main(["--num-processes", "2"])
