@@ -27,7 +27,12 @@ except Exception:  # pragma: no cover - orbax is in the image, but be safe
 def save_checkpoint(path: str, params: Any, opt_state: Any = None,
                     step: int = 0, registry=None) -> None:
     """Save train state; registry declarations ride along so name→key
-    survives restarts (reference: ReDeclareTensor replay)."""
+    survives restarts (reference: ReDeclareTensor replay).
+
+    With gradient accumulation (``backward_passes_per_step=k``), save only
+    at sync boundaries (``step % k == 0``): between them the MultiSteps
+    accumulators hold per-replica local gradients, and a host read takes
+    one replica's values (see ShardedTrainer docstring)."""
     path = os.path.abspath(path)
     os.makedirs(path, exist_ok=True)
     meta = {"step": step}
