@@ -73,8 +73,10 @@ def numa_prefix(enabled: bool) -> List[str]:
 def run_local(args, cmd: List[str]) -> int:
     env = build_env(args)
     if args.server:
-        # standalone reduction server (reference: byteps.server import)
+        # standalone reduction server (reference: byteps.server import),
+        # reachable over TCP (reference: ps-lite van) on BPS_SERVER_PORT
         from ..server.engine import PSServer
+        from ..server.transport import PSTransportServer
         import signal
         import time
         n = int(env.get("BPS_NUM_PROCESSES", "1"))
@@ -82,8 +84,10 @@ def run_local(args, cmd: List[str]) -> int:
                        engine_threads=int(env.get("BPS_SERVER_ENGINE_THREAD", "4")),
                        enable_schedule=env.get("BPS_SERVER_ENABLE_SCHEDULE", "") == "1",
                        async_mode=env.get("BPS_ENABLE_ASYNC", "") == "1")
-        print(f"[bpslaunch-tpu] server up (workers={n}); Ctrl-C to stop",
-              file=sys.stderr)
+        tsrv = PSTransportServer(srv,
+                                 port=int(env.get("BPS_SERVER_PORT", "9090")))
+        print(f"[bpslaunch-tpu] server up on :{tsrv.port} (workers={n}); "
+              "Ctrl-C to stop", file=sys.stderr)
         stop = []
         signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
         try:
@@ -91,6 +95,7 @@ def run_local(args, cmd: List[str]) -> int:
                 time.sleep(1)
         except KeyboardInterrupt:
             pass
+        tsrv.close()
         srv.close()
         return 0
     full = numa_prefix(args.numa) + cmd

@@ -210,6 +210,17 @@ class Server {
 
   int InitKey(uint64_t key, uint64_t nbytes, int dtype, const void* init) {
     std::lock_guard<std::mutex> lk(map_mu_);
+    // Idempotent: only the FIRST init allocates; later workers' inits are
+    // no-ops (reference: init-push replies after all workers arrive but
+    // only the first allocates, server.cc:261-289). Re-initializing would
+    // wipe an in-flight round's accumulator and wedge the other workers.
+    auto it = stores_.find(key);
+    if (it != stores_.end()) {
+      std::lock_guard<std::mutex> klk(it->second.mu);
+      if (it->second.len != nbytes || it->second.dtype != dtype)
+        return -4;  // conflicting re-declaration
+      return 0;
+    }
     auto& ks = stores_[key];  // creates
     std::lock_guard<std::mutex> klk(ks.mu);
     ks.len = nbytes;

@@ -1,0 +1,233 @@
+"""TCP transport for the host reduction service — the reference's
+ps-lite "van" equivalent (reference: ps-lite ZMQ/TCP van, SURVEY §2.6;
+worker call sites ZPush/ZPull core_loops.cc:567-613).
+
+Wire protocol: one persistent connection per worker, length-prefixed
+binary frames:
+
+    request  := op:u8 | key:u64 | round:u64 | nbytes:u64 | timeout_ms:u64
+                | plen:u64 | dtype:u8[8] | payload[plen]
+    response := status:u8 | nbytes:u64 | payload[nbytes]
+
+ops: 1=INIT (``nbytes`` = store size, payload = optional initial value),
+2=PUSH (payload = data), 3=PULL (``nbytes`` = expected size, no payload;
+response carries the merged buffer), 4=CLOSE. status: 0=OK, 1=error
+(backend rejected the request; the error response carries a UTF-8
+message as payload and the connection stays usable), 2=timeout.
+
+``PSTransportServer`` fronts a ``PSServer``/``HostPSBackend`` (the
+native C++ summation engine) with a threaded socket server: one thread
+per worker connection; the engine's sticky key→thread queues do the
+summation exactly as in-process. ``RemotePSBackend`` is the worker-side
+client with the same interface as ``HostPSBackend`` (including
+``push_pull``'s per-key round counter), so ``PSGradientExchange`` and
+``AsyncPSWorker`` work unchanged across process/host boundaries.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common.naming import place_key
+
+_HDR = struct.Struct("!BQQQQQ8s")   # op, key, round, nbytes, timeout, plen, dtype
+_RSP = struct.Struct("!BQ")
+
+OP_INIT, OP_PUSH, OP_PULL, OP_CLOSE = 1, 2, 3, 4
+ST_OK, ST_ERR, ST_TIMEOUT = 0, 1, 2
+
+
+def _recv_exact(sock: socket.socket, n: int) -> memoryview:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("peer closed")
+        got += r
+    return memoryview(buf)
+
+
+def _send_req(sock: socket.socket, op: int, key: int, rnd: int, nbytes: int,
+              timeout_ms: int, dtype: str,
+              payload: Optional[memoryview]) -> None:
+    plen = 0 if payload is None else len(payload)
+    sock.sendall(_HDR.pack(op, key, rnd, nbytes, timeout_ms, plen,
+                           dtype.encode()[:8].ljust(8, b"\0")))
+    if plen:
+        sock.sendall(payload)
+
+
+def _recv_req(sock: socket.socket):
+    op, key, rnd, nbytes, timeout, plen, dt = _HDR.unpack(
+        _recv_exact(sock, _HDR.size))
+    payload = _recv_exact(sock, plen) if plen else None
+    return op, key, rnd, nbytes, timeout, dt.rstrip(b"\0").decode(), payload
+
+
+# ------------------------------------------------------------------ server
+
+class PSTransportServer:
+    """Threaded TCP front for a local summation backend."""
+
+    def __init__(self, backend, host: str = "0.0.0.0", port: int = 0):
+        self.backend = backend
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(64)
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True,
+                                               name="bps-ps-accept")
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True, name="bps-ps-conn").start()
+
+    def _handle(self, conn, op, key, rnd, nbytes, timeout, dtype, payload):
+        """One request; backend errors become ST_ERR/ST_TIMEOUT responses
+        (the connection survives — one bad request must not take down the
+        worker's whole data plane)."""
+        try:
+            if op == OP_INIT:
+                init = (np.frombuffer(payload, dtype=dtype)
+                        if payload is not None else None)
+                self.backend.init_key(key, nbytes, dtype, init=init)
+                conn.sendall(_RSP.pack(ST_OK, 0))
+            elif op == OP_PUSH:
+                self.backend.push(key, np.frombuffer(payload, dtype=dtype))
+                conn.sendall(_RSP.pack(ST_OK, 0))
+            elif op == OP_PULL:
+                out = np.empty(nbytes // np.dtype(dtype).itemsize,
+                               dtype=dtype)
+                self.backend.pull(key, out, round=int(rnd),
+                                  timeout_ms=int(timeout) or 30000)
+                conn.sendall(_RSP.pack(ST_OK, out.nbytes))
+                conn.sendall(out.data)          # zero-copy: contiguous
+            else:
+                conn.sendall(_RSP.pack(ST_ERR, 0))
+        except TimeoutError as e:
+            msg = str(e).encode()
+            conn.sendall(_RSP.pack(ST_TIMEOUT, len(msg)) + msg)
+        except Exception as e:  # backend rejections (bad length, key, …)
+            msg = f"{type(e).__name__}: {e}".encode()[:4096]
+            conn.sendall(_RSP.pack(ST_ERR, len(msg)) + msg)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                op, key, rnd, nbytes, timeout, dtype, payload = \
+                    _recv_req(conn)
+                if op == OP_CLOSE:
+                    conn.sendall(_RSP.pack(ST_OK, 0))
+                    return
+                self._handle(conn, op, key, rnd, nbytes, timeout, dtype,
+                             payload)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# ------------------------------------------------------------------ client
+
+class RemotePSBackend:
+    """Worker-side client; same interface as HostPSBackend, keys sharded
+    over N transport servers with the same placement hash (reference:
+    key→server placement global.cc:628-677)."""
+
+    def __init__(self, addrs: Sequence[str], hash_fn: str = "djb2",
+                 async_mode: bool = False):
+        self._socks: List[socket.socket] = []
+        self._locks: List[threading.Lock] = []
+        self.hash_fn = hash_fn
+        self.async_mode = async_mode
+        self._rounds: Dict[int, int] = {}
+        for addr in addrs:
+            host, port = addr.rsplit(":", 1)
+            s = socket.create_connection((host, int(port)))
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks.append(s)
+            self._locks.append(threading.Lock())
+
+    def _conn(self, key: int) -> Tuple[socket.socket, threading.Lock]:
+        i = place_key(key, len(self._socks), self.hash_fn)
+        return self._socks[i], self._locks[i]
+
+    def _rpc(self, op: int, key: int, rnd: int, nbytes: int,
+             timeout_ms: int, dtype: str, payload: Optional[memoryview],
+             pull_into: Optional[np.ndarray] = None) -> None:
+        sock, lock = self._conn(key)
+        with lock:
+            _send_req(sock, op, key, rnd, nbytes, timeout_ms, dtype, payload)
+            status, rbytes = _RSP.unpack(_recv_exact(sock, _RSP.size))
+            data = _recv_exact(sock, rbytes) if rbytes else memoryview(b"")
+            if status == ST_TIMEOUT:
+                raise TimeoutError(bytes(data).decode() or
+                                   f"pull({key}) timed out")
+            if status != ST_OK:
+                raise RuntimeError(f"PS server rejected key={key} op={op}: "
+                                   f"{bytes(data).decode()!r}")
+            if pull_into is not None:
+                np.copyto(pull_into,
+                          np.frombuffer(data, dtype=pull_into.dtype)
+                          .reshape(pull_into.shape))
+
+    def init_key(self, key: int, nbytes: int, dtype: str = "float32",
+                 init: Optional[np.ndarray] = None) -> None:
+        payload = (None if init is None else
+                   memoryview(np.ascontiguousarray(init)).cast("B"))
+        self._rpc(OP_INIT, key, 0, nbytes, 0, dtype, payload)
+
+    def push(self, key: int, data: np.ndarray) -> None:
+        self._rpc(OP_PUSH, key, 0, 0, 0, str(data.dtype),
+                  memoryview(np.ascontiguousarray(data)).cast("B"))
+
+    def pull(self, key: int, out: np.ndarray, round: int = 0,
+             timeout_ms: int = 30000) -> None:
+        self._rpc(OP_PULL, key, round, out.nbytes, timeout_ms,
+                  str(out.dtype), None, pull_into=out)
+
+    def push_pull(self, key: int, data: np.ndarray,
+                  timeout_ms: int = 30000) -> np.ndarray:
+        """One sync round from this worker's perspective: push, then pull
+        the round this push completes (per-key local round counter —
+        mirrors HostPSBackend.push_pull; round 0 would be a stale read)."""
+        self.push(key, data)
+        rnd = self._rounds.get(key, 0) + 1
+        self._rounds[key] = rnd
+        out = np.empty_like(data)
+        self.pull(key, out, rnd if not self.async_mode else 0, timeout_ms)
+        return out
+
+    def close(self) -> None:
+        for s, lock in zip(self._socks, self._locks):
+            try:
+                with lock:
+                    _send_req(s, OP_CLOSE, 0, 0, 0, 0, "", None)
+                    _recv_exact(s, _RSP.size)
+            except (ConnectionError, OSError):
+                pass
+            s.close()

@@ -174,3 +174,23 @@ def test_push_wrong_size_fails(server):
     server.init_key(30, 64, "float32")
     with pytest.raises(RuntimeError):
         server.push(30, np.zeros(100, np.float32))
+
+
+def test_init_key_idempotent_across_workers():
+    """Only the first init allocates; a second worker's init must NOT
+    wipe an in-flight round (regression: re-init zeroed the accumulator
+    and wedged the remaining workers' pulls)."""
+    be = PSServer(num_workers=2, engine_threads=1)
+    try:
+        x = np.ones(64, np.float32)
+        be.init_key(11, x.nbytes)
+        be.push(11, x)              # worker 1's push lands
+        be.init_key(11, x.nbytes)   # worker 2 joins late: no-op
+        be.push(11, x * 2)
+        out = np.empty_like(x)
+        be.pull(11, out, round=1, timeout_ms=5000)
+        np.testing.assert_allclose(out, 3.0)
+        with pytest.raises(RuntimeError):
+            be.init_key(11, x.nbytes * 2)   # conflicting re-declaration
+    finally:
+        be.close()

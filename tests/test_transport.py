@@ -1,0 +1,217 @@
+"""TCP transport to the host reduction service (the ps-lite van analog):
+framing, cross-connection summation, key sharding, gradient exchange
+over the wire, and a real cross-process server."""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.server.engine import HostPSBackend, PSServer
+from byteps_tpu.server.transport import PSTransportServer, RemotePSBackend
+
+
+@pytest.fixture
+def server2():
+    """Transport server fronting a 2-worker sync engine."""
+    be = PSServer(num_workers=2, engine_threads=2)
+    srv = PSTransportServer(be, host="127.0.0.1", port=0)
+    yield srv
+    srv.close()
+    be.close()
+
+
+def test_remote_push_pull_sums_two_workers(server2):
+    addr = f"127.0.0.1:{server2.port}"
+    w1 = RemotePSBackend([addr])
+    w2 = RemotePSBackend([addr])
+    a = np.arange(1024, dtype=np.float32)
+    w1.init_key(7, a.nbytes)
+    w2.init_key(7, a.nbytes)
+
+    out1 = np.empty_like(a)
+    out2 = np.empty_like(a)
+
+    def worker(be, out):
+        be.push(7, a)
+        be.pull(7, out, round=1)
+
+    t1 = threading.Thread(target=worker, args=(w1, out1))
+    t2 = threading.Thread(target=worker, args=(w2, out2))
+    t1.start(); t2.start(); t1.join(); t2.join()
+    np.testing.assert_allclose(out1, 2 * a)
+    np.testing.assert_allclose(out2, 2 * a)
+    w1.close(); w2.close()
+
+
+def test_remote_multiple_rounds(server2):
+    addr = f"127.0.0.1:{server2.port}"
+    w1, w2 = RemotePSBackend([addr]), RemotePSBackend([addr])
+    x = np.ones(256, np.float32)
+    for w in (w1, w2):
+        w.init_key(3, x.nbytes)
+    for rnd in range(1, 4):
+        outs = [np.empty_like(x), np.empty_like(x)]
+
+        def go(w, o):
+            w.push(3, x * rnd)
+            w.pull(3, o, round=rnd)
+
+        ts = [threading.Thread(target=go, args=(w, o))
+              for w, o in zip((w1, w2), outs)]
+        [t.start() for t in ts]; [t.join() for t in ts]
+        for o in outs:
+            np.testing.assert_allclose(o, 2.0 * rnd)
+    w1.close(); w2.close()
+
+
+def test_key_sharding_across_servers():
+    """Keys spread over two transport servers by the placement hash."""
+    be1 = PSServer(num_workers=1, engine_threads=1)
+    be2 = PSServer(num_workers=1, engine_threads=1)
+    s1 = PSTransportServer(be1, host="127.0.0.1")
+    s2 = PSTransportServer(be2, host="127.0.0.1")
+    try:
+        w = RemotePSBackend([f"127.0.0.1:{s1.port}", f"127.0.0.1:{s2.port}"])
+        data = {k: np.full(64, float(k), np.float32) for k in range(8)}
+        for k, v in data.items():
+            w.init_key(k, v.nbytes)
+            w.push(k, v)
+        for k, v in data.items():
+            out = np.empty_like(v)
+            w.pull(k, out, round=1)
+            np.testing.assert_allclose(out, v)
+        w.close()
+    finally:
+        s1.close(); s2.close(); be1.close(); be2.close()
+
+
+def test_gradient_exchange_over_wire(server2):
+    """PSGradientExchange works unchanged over RemotePSBackend."""
+    import jax.numpy as jnp
+    from byteps_tpu.server.ps_mode import PSGradientExchange
+
+    addr = f"127.0.0.1:{server2.port}"
+    w1, w2 = RemotePSBackend([addr]), RemotePSBackend([addr])
+    tree = {"a": jnp.ones((100, 30)), "b": jnp.full((64,), 2.0)}
+    ex1 = PSGradientExchange(w1, partition_bytes=4096)
+    ex2 = PSGradientExchange(w2, partition_bytes=4096)
+    res = [None, None]
+
+    def go(i, ex):
+        res[i] = ex.exchange(tree)
+
+    ts = [threading.Thread(target=go, args=(i, ex))
+          for i, ex in enumerate((ex1, ex2))]
+    [t.start() for t in ts]; [t.join() for t in ts]
+    for r in res:
+        np.testing.assert_allclose(np.asarray(r["a"]), 2.0)
+        np.testing.assert_allclose(np.asarray(r["b"]), 4.0)
+    w1.close(); w2.close()
+
+
+def test_cross_process_server():
+    """Workers in THIS process, server in a separate OS process via
+    bpslaunch-tpu --server (the reference's deployment shape)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    import socket as _socket
+    with _socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ, BPS_SERVER_PORT=str(port), BPS_NUM_PROCESSES="2")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "byteps_tpu.launcher.launch", "--server"],
+        env=env, cwd=root, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    try:
+        deadline = time.time() + 30
+        last = None
+        while time.time() < deadline:
+            try:
+                w1 = RemotePSBackend([f"127.0.0.1:{port}"])
+                break
+            except OSError as e:
+                last = e
+                time.sleep(0.3)
+        else:
+            raise AssertionError(f"server never came up: {last}")
+        w2 = RemotePSBackend([f"127.0.0.1:{port}"])
+        x = np.arange(512, dtype=np.float32)
+        outs = [np.empty_like(x), np.empty_like(x)]
+        for w in (w1, w2):
+            w.init_key(1, x.nbytes)
+
+        def go(w, o):
+            w.push(1, x)
+            w.pull(1, o, round=1)
+
+        ts = [threading.Thread(target=go, args=(w, o))
+              for w, o in zip((w1, w2), outs)]
+        [t.start() for t in ts]; [t.join() for t in ts]
+        for o in outs:
+            np.testing.assert_allclose(o, 2 * x)
+        w1.close(); w2.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=15)
+
+
+def test_error_frames_keep_connection_alive(server2):
+    """A rejected request returns a diagnostic error and the connection
+    (and other keys on it) keep working."""
+    addr = f"127.0.0.1:{server2.port}"
+    w = RemotePSBackend([addr])
+    good = np.ones(128, np.float32)
+    w.init_key(5, good.nbytes)
+    with pytest.raises(RuntimeError, match="rejected"):
+        w.push(5, np.ones(999, np.float32))        # wrong length
+    w.push(5, good)                                # connection survives
+    # num_workers=2: complete the round from a second connection
+    w2 = RemotePSBackend([addr])
+    w2.init_key(5, good.nbytes)
+    w2.push(5, good)
+    out = np.empty_like(good)
+    w.pull(5, out, round=1)
+    np.testing.assert_allclose(out, 2.0)
+    w.close(); w2.close()
+
+
+def test_pull_into_2d_array(server2):
+    addr = f"127.0.0.1:{server2.port}"
+    w1, w2 = RemotePSBackend([addr]), RemotePSBackend([addr])
+    a = np.arange(64, dtype=np.float32).reshape(8, 8)
+    for w in (w1, w2):
+        w.init_key(9, a.nbytes)
+    outs = [np.empty_like(a), np.empty_like(a)]
+
+    def go(w, o):
+        w.push(9, a)
+        w.pull(9, o, round=1)
+
+    ts = [threading.Thread(target=go, args=(w, o))
+          for w, o in zip((w1, w2), outs)]
+    [t.start() for t in ts]; [t.join() for t in ts]
+    for o in outs:
+        np.testing.assert_allclose(o, 2 * a)
+    w1.close(); w2.close()
+
+
+def test_push_pull_round_counter():
+    """push_pull tracks per-key rounds like HostPSBackend (round 0 would
+    be a stale read)."""
+    be = PSServer(num_workers=1, engine_threads=1)
+    srv = PSTransportServer(be, host="127.0.0.1")
+    try:
+        w = RemotePSBackend([f"127.0.0.1:{srv.port}"])
+        x = np.ones(32, np.float32)
+        w.init_key(2, x.nbytes)
+        for i in range(1, 4):
+            out = w.push_pull(2, x * i)
+            np.testing.assert_allclose(out, x * i)
+        w.close()
+    finally:
+        srv.close(); be.close()
