@@ -81,6 +81,8 @@ class Config:
     # --- PS / server mode (reference: server.cc:407-439) ---
     enable_async: bool = False           # BYTEPS_ENABLE_ASYNC
     enable_ps: bool = False              # route push_pull through host PS service
+    server_addrs: str = ""               # BPS_SERVER_ADDRS: host:port,... of
+                                         # standalone servers (empty → in-process)
     server_engine_threads: int = 4       # BYTEPS_SERVER_ENGINE_THREAD
     server_enable_schedule: bool = False # BYTEPS_SERVER_ENABLE_SCHEDULE
 
@@ -119,6 +121,7 @@ class Config:
             scheduling_credit=_env_int("BPS_SCHEDULING_CREDIT", "BYTEPS_SCHEDULING_CREDIT", 0),
             enable_async=_env_bool("BPS_ENABLE_ASYNC", "BYTEPS_ENABLE_ASYNC"),
             enable_ps=_env_bool("BPS_ENABLE_PS", "BYTEPS_ENABLE_PS"),
+            server_addrs=_env("BPS_SERVER_ADDRS", None, ""),
             server_engine_threads=_env_int("BPS_SERVER_ENGINE_THREAD", "BYTEPS_SERVER_ENGINE_THREAD", 4),
             server_enable_schedule=_env_bool("BPS_SERVER_ENABLE_SCHEDULE", "BYTEPS_SERVER_ENABLE_SCHEDULE"),
             key_hash_fn=_env("BPS_KEY_HASH_FN", "BYTEPS_KEY_HASH_FN", "djb2"),

@@ -43,6 +43,42 @@ class GlobalState:
             scheduling_credit=config.scheduling_credit)
         self.engine.timeline = self.timeline
         self.engine.debug_sample = config.debug_sample_tensor
+        self.ps_backend = None
+        if config.enable_ps:
+            # PS deployment (reference architecture): workers are
+            # independent processes with LOCAL meshes; the cross-worker
+            # hop is the host service, not a collective. In-process
+            # backend at world 1; TCP to standalone servers otherwise.
+            from ..server.ps_mode import PSGradientExchange
+            if config.server_addrs:
+                from ..server.transport import RemotePSBackend
+                addrs = [a.strip() for a in config.server_addrs.split(",")
+                         if a.strip()]
+                self.ps_backend = RemotePSBackend(
+                    addrs, hash_fn=config.key_hash_fn,
+                    async_mode=config.enable_async)
+            else:
+                if config.num_worker > 1:
+                    raise ValueError(
+                        "BPS_ENABLE_PS with BPS_NUM_WORKER>1 needs "
+                        "BPS_SERVER_ADDRS (standalone servers reachable by "
+                        "every worker) — a private in-process backend would "
+                        "wait forever for the other workers' pushes")
+                from ..server.engine import HostPSBackend
+                self.ps_backend = HostPSBackend(
+                    num_servers=1, num_workers=config.num_worker,
+                    engine_threads=config.server_engine_threads,
+                    enable_schedule=config.server_enable_schedule,
+                    async_mode=config.enable_async, hash_fn=config.key_hash_fn)
+            if not config.enable_async:
+                # sync PS: the eager push_pull takes the host hop. Async PS
+                # is driven by server.ps_mode.AsyncPSWorker (weight deltas,
+                # no barrier) against gs.ps_backend — summing GRADIENTS into
+                # the async store would accumulate forever.
+                self.engine.ps_exchange = PSGradientExchange(
+                    self.ps_backend, partition_bytes=config.partition_bytes,
+                    registry=self.registry)
+                self.engine.ps_world = config.num_worker
         self.dp = dp_size(self.mesh)
         self.step = 0
         log.info("BPS init: role=%s mesh=%s dp=%d partition_bytes=%d",
@@ -80,6 +116,8 @@ class GlobalState:
                 return
             if inst.timeline is not None:
                 inst.timeline.flush()
+            if inst.ps_backend is not None:
+                inst.ps_backend.close()
             cls._instance = None
 
     @classmethod
@@ -92,6 +130,8 @@ class GlobalState:
                 return None
             decls = [(d.name, d.priority, d.compression_kwargs)
                      for d in (inst.registry.get(n) for n in inst.registry.declared_names())]
+            if inst.ps_backend is not None:
+                inst.ps_backend.close()
             cls._instance = None
             return decls
 

@@ -162,6 +162,8 @@ class PushPullEngine:
         self.scheduling_credit = scheduling_credit
         self.timeline = None
         self.debug_sample = ""   # tensor-name substring to sample-log
+        self.ps_exchange = None  # PS mode: host exchange across workers
+        self.ps_world = 1        # worker-process count for PS averaging
         self._programs: Dict[Tuple, Tuple] = {}  # structure key → compiled plan
         self._bcast_fns: Dict[int, Callable] = {}
         # handle manager (reference: torch handle_manager.{cc,h} — int
@@ -232,6 +234,30 @@ class PushPullEngine:
         self._programs[key] = plan
         return plan
 
+    def _ps_hop(self, result, avg: bool, name: Optional[str]):
+        """PS mode's cross-worker hop (reference: PUSH/PULL stages after
+        the local NCCL reduce, core_loops.cc:538-618). ``result`` is the
+        locally reduced stacked tree — every replica row is identical, so
+        row 0 is exchanged through the host service (summed across worker
+        processes) and broadcast back to the stacked layout. avg=True:
+        each worker contributed its local mean; dividing the PS sum by
+        the worker count yields the global mean (equal local batches).
+
+        This hop is host-synchronous (D2H readback + RPCs): in PS mode
+        ``push_pull_async`` therefore degrades to synchronous dispatch —
+        the async overlap lever is the server engine's pipelining across
+        buckets, as in the reference."""
+        row0 = jax.tree_util.tree_map(
+            lambda x: np.asarray(x[0]) if x.ndim else np.asarray(x), result)
+        summed = self.ps_exchange.exchange(row0, name=name)
+        if avg and self.ps_world > 1:
+            summed = jax.tree_util.tree_map(
+                lambda x: x / self.ps_world, summed)
+        return jax.tree_util.tree_map(
+            lambda old, r: jax.device_put(
+                np.broadcast_to(r, old.shape), old.sharding),
+            result, summed)
+
     def push_pull(self, tree, average: Optional[bool] = None,
                   name: Optional[str] = None, sync: bool = True):
         """Reduce a pytree of [dp, ...] stacked arrays; returns same shapes
@@ -279,6 +305,8 @@ class PushPullEngine:
                 self.timeline.record(name or "push_pull", "DISPATCH",
                                      tb, time.time() - tb, key=bucket.index)
         result = jax.tree_util.tree_unflatten(treedef, out)
+        if self.ps_exchange is not None:
+            result = self._ps_hop(result, avg, name)
         if self.debug_sample and name and self.debug_sample in name:
             # numeric debugging sampler (reference: BYTEPS_DEBUG_SAMPLE_TENSOR
             # prints tensor values per stage, core_loops.cc:37-67)

@@ -38,16 +38,23 @@ class PSGradientExchange:
         self.partition_bytes = partition_bytes
         self.registry = registry or NameRegistry()
         self._plans: Dict = {}
-        self._round = 0
+        self._rounds: Dict[str, int] = {}
 
-    def _plan(self, tree):
+    def _plan(self, tree, name: Optional[str]):
         leaves, treedef = jax.tree_util.tree_flatten(tree)
-        key = (treedef, tuple((l.shape, str(l.dtype)) for l in leaves))
+        key = (name, treedef, tuple((l.shape, str(l.dtype)) for l in leaves))
         if key in self._plans:
             return self._plans[key]
+        # Distinct trees must land on distinct PS keys. Anonymous trees
+        # get position-stable auto names, so key assignment matches across
+        # workers as long as their exchange order matches — the same
+        # declaration-order contract the reference has (global.cc:412-429).
+        decl_name = name or f"grads{len(self._plans)}"
+        decl = (self.registry.get(decl_name)
+                if decl_name in self.registry.declared_names()
+                else self.registry.declare(decl_name))
         paths = [jax.tree_util.keystr(p)
                  for p, _ in jax.tree_util.tree_leaves_with_path(tree)]
-        decl = self.registry.declare(paths[0].split("[")[0] or "grads")
         specs = [LeafSpec(name=p, size=int(np.prod(l.shape)),
                           dtype=str(np.dtype(l.dtype)))
                  for p, l in zip(paths, leaves)]
@@ -58,20 +65,21 @@ class PSGradientExchange:
         for pskey, b in keyed:
             nbytes = b.size * np.dtype(b.dtype).itemsize
             self.backend.init_key(pskey, nbytes, b.dtype)
-        plan = (treedef, keyed)
+        plan = (decl_name, treedef, keyed)
         self._plans[key] = plan
         return plan
 
-    def exchange(self, tree):
+    def exchange(self, tree, name: Optional[str] = None):
         """Push all buckets (priority order), then pull each — one sync
-        round. Returns the summed tree."""
-        treedef, keyed = self._plan(tree)
+        round (per-name round counter). Returns the summed tree."""
+        decl_name, treedef, keyed = self._plan(tree, name)
         leaves, _ = jax.tree_util.tree_flatten(tree)
         for l in leaves:                 # start ALL D2H copies first so the
             if hasattr(l, "copy_to_host_async"):   # transfers overlap instead
                 l.copy_to_host_async()             # of serializing per leaf
         flat = [np.asarray(l).reshape(-1) for l in leaves]
-        self._round += 1
+        rnd = self._rounds.get(decl_name, 0) + 1
+        self._rounds[decl_name] = rnd
         bufs = []
         for pskey, b in keyed:
             buf = np.empty(b.size, dtype=b.dtype)
@@ -82,7 +90,7 @@ class PSGradientExchange:
             bufs.append(buf)
         out = [f.copy() for f in flat]
         for (pskey, b), buf in zip(keyed, bufs):
-            self.backend.pull(pskey, buf, round=self._round)
+            self.backend.pull(pskey, buf, round=rnd)
             for s in b.segments:
                 out[s.leaf_index][s.leaf_offset:s.leaf_offset + s.length] = \
                     buf[s.bucket_offset:s.bucket_offset + s.length]

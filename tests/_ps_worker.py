@@ -1,0 +1,42 @@
+"""Worker for the PS-mode cross-process test: independent worker
+processes (LOCAL meshes, no jax.distributed) synchronizing only through
+the TCP PS service — the reference's deployment architecture."""
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import byteps_tpu as bps
+
+
+def main():
+    wid = int(os.environ["BPS_WORKER_ID"])
+    bps.init()
+    # local 2-device mesh; NOT a cross-process mesh
+    assert bps.size() == 2, bps.size()
+
+    # stacked [dp, ...] eager push_pull: local mean + PS hop across the
+    # two worker processes
+    x = np.stack([np.full((64,), 1.0 + wid, np.float32),
+                  np.full((64,), 3.0 + wid, np.float32)])
+    out = bps.push_pull(x, average=True, name="grads")
+    # local means: w0 -> 2.0, w1 -> 3.0; global mean = 2.5 on BOTH workers
+    np.testing.assert_allclose(np.asarray(out), 2.5)
+
+    out2 = bps.push_pull(x, average=False, name="grads")
+    # local sums: w0 -> 4.0, w1 -> 6.0; PS sum = 10.0
+    np.testing.assert_allclose(np.asarray(out2), 10.0)
+    bps.shutdown()
+    print(f"PS_WORKER_OK wid={wid}")
+
+
+if __name__ == "__main__":
+    main()

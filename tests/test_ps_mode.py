@@ -43,7 +43,7 @@ def test_sync_exchange_two_workers_sum():
     exs = [PSGradientExchange(be, partition_bytes=400, registry=reg)
            for _ in range(2)]
     # pre-plan on one worker to avoid double init_key racing
-    exs[0]._plan(datas[0])
+    exs[0]._plan(datas[0], None)
     exs[1]._plans = exs[0]._plans
 
     def worker(w):

@@ -215,3 +215,114 @@ def test_push_pull_round_counter():
         w.close()
     finally:
         srv.close(); be.close()
+
+
+def test_ps_mode_env_wiring_single_worker():
+    """BPS_ENABLE_PS=1 routes eager push_pull through the host service
+    (world 1: values unchanged, path exercised)."""
+    import os as _os
+
+    import jax as _jax
+
+    import byteps_tpu as bps
+    from byteps_tpu.common.global_state import GlobalState
+
+    _os.environ["BPS_ENABLE_PS"] = "1"
+    try:
+        bps.init(config=bps.Config.from_env())
+        assert GlobalState.get().engine.ps_exchange is not None
+        dp = len(_jax.devices())
+        x = np.stack([np.full((32,), float(i + 1), np.float32)
+                      for i in range(dp)])
+        out = bps.push_pull(x, average=False, name="g")
+        np.testing.assert_allclose(np.asarray(out),
+                                   sum(range(1, dp + 1)))
+    finally:
+        bps.shutdown()
+        _os.environ.pop("BPS_ENABLE_PS", None)
+
+
+def test_ps_mode_two_worker_processes():
+    """Two INDEPENDENT worker processes (local meshes, no
+    jax.distributed) synchronizing only through the TCP PS service —
+    the reference's worker/server deployment architecture."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(root, "tests", "_ps_worker.py")
+    be = PSServer(num_workers=2, engine_threads=2)
+    srv = PSTransportServer(be, host="127.0.0.1")
+    procs, outs = [], []
+    try:
+        for wid in (0, 1):
+            env = dict(
+                os.environ,
+                XLA_FLAGS="--xla_force_host_platform_device_count=2",
+                JAX_PLATFORMS="cpu",
+                BPS_ENABLE_PS="1",
+                BPS_SERVER_ADDRS=f"127.0.0.1:{srv.port}",
+                BPS_NUM_WORKER="2",
+                BPS_WORKER_ID=str(wid),
+            )
+            env.pop("BPS_NUM_PROCESSES", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, worker], env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=180)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                out, _ = p.communicate()
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        srv.close()
+        be.close()
+    for wid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {wid} failed:\n{out[-4000:]}"
+        assert "PS_WORKER_OK" in out, out[-2000:]
+
+
+def test_ps_mode_multiworker_without_addrs_errors():
+    import os as _os
+
+    import byteps_tpu as bps
+
+    _os.environ["BPS_ENABLE_PS"] = "1"
+    _os.environ["BPS_NUM_WORKER"] = "2"
+    try:
+        with pytest.raises(ValueError, match="BPS_SERVER_ADDRS"):
+            bps.init(config=bps.Config.from_env())
+    finally:
+        bps.shutdown()
+        _os.environ.pop("BPS_ENABLE_PS", None)
+        _os.environ.pop("BPS_NUM_WORKER", None)
+
+
+def test_exchange_distinct_trees_get_distinct_keys(server2):
+    """Two different trees (named and anonymous) must not collide on
+    server keys."""
+    from byteps_tpu.server.ps_mode import PSGradientExchange
+
+    addr = f"127.0.0.1:{server2.port}"
+    w1, w2 = RemotePSBackend([addr]), RemotePSBackend([addr])
+    t1 = {"a": np.ones(100, np.float32)}
+    t2 = (np.full(50, 2.0, np.float32), np.full(60, 3.0, np.float32))
+    ex1 = PSGradientExchange(w1, partition_bytes=1 << 20)
+    ex2 = PSGradientExchange(w2, partition_bytes=1 << 20)
+    res = {}
+
+    def go(tag, ex):
+        res[tag, "g"] = ex.exchange(t1, name="gradsA")
+        res[tag, "o"] = ex.exchange(t2)          # anonymous
+
+    ts = [threading.Thread(target=go, args=(t, e))
+          for t, e in (("w1", ex1), ("w2", ex2))]
+    [t.start() for t in ts]; [t.join() for t in ts]
+    for tag in ("w1", "w2"):
+        np.testing.assert_allclose(res[tag, "g"]["a"], 2.0)
+        np.testing.assert_allclose(res[tag, "o"][0], 4.0)
+        np.testing.assert_allclose(res[tag, "o"][1], 6.0)
+    w1.close(); w2.close()
