@@ -1,0 +1,73 @@
+"""Shared async-SGD convergence harness for the PS async-mode tests
+(in-process backend and TCP transport variants)."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+
+from byteps_tpu.server.ps_mode import AsyncPSWorker
+
+TRUE_W_SEED, STEPS, LR = 2, 150, 0.05
+
+
+def true_weights():
+    return np.random.RandomState(TRUE_W_SEED).randn(8).astype(np.float32)
+
+
+def run_async_convergence(workers, applied_rounds, atol=0.05):
+    """Drive ``workers`` (AsyncPSWorker list) concurrently on the same
+    linear-regression task; assert the shared weights converge.
+
+    ``applied_rounds()`` must return how many async pushes the engine has
+    APPLIED (push RPCs ack at enqueue) — polled instead of sleeping so a
+    slow engine thread can't turn into a flaky stale read.
+    """
+    true_w = true_weights()
+
+    def loss_fn(w, batch):
+        x, y = batch
+        return ((x @ w - y) ** 2).mean()
+
+    grad_fn = jax.jit(jax.grad(loss_fn))
+    errors = []
+
+    def run(widx):
+        try:
+            wrng = np.random.RandomState(10 + widx)
+            for _ in range(STEPS):
+                w = np.asarray(workers[widx].pull_weights())
+                x = wrng.randn(16, 8).astype(np.float32)
+                y = x @ true_w
+                g = np.asarray(grad_fn(w, (x, y)))
+                workers[widx].push_delta(w - LR * g, w)
+        except Exception as e:  # propagate into the main thread
+            errors.append(e)
+
+    ts = [threading.Thread(target=run, args=(i,))
+          for i in range(len(workers))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errors:
+        raise errors[0]
+    want = STEPS * len(workers)
+    deadline = time.time() + 30
+    while applied_rounds() < want and time.time() < deadline:
+        time.sleep(0.01)
+    assert applied_rounds() >= want, "engine never drained the deltas"
+    final = np.asarray(workers[0].pull_weights())
+    np.testing.assert_allclose(final, true_w, atol=atol)
+
+
+def make_workers(backend_factory, n=2):
+    """(seed_backend, worker_backends, workers): seed initializes the
+    store; each worker gets its own backend connection."""
+    w0 = np.zeros(8, np.float32)
+    seed_be = backend_factory()
+    AsyncPSWorker(seed_be, w0, init_store=True)
+    worker_bes = [backend_factory() for _ in range(n)]
+    workers = [AsyncPSWorker(be, w0, init_store=False) for be in worker_bes]
+    return seed_be, worker_bes, workers

@@ -63,39 +63,14 @@ def test_sync_exchange_two_workers_sum():
 def test_async_workers_converge():
     """Two async workers train the same linear model without a barrier;
     the shared weights must still converge (async-SGD semantics)."""
-    rng = np.random.RandomState(2)
-    true_w = rng.randn(8).astype(np.float32)
+    from _async_sgd import make_workers, run_async_convergence
 
-    def loss_fn(w, batch):
-        x, y = batch
-        return ((x @ w - y) ** 2).mean()
-
-    grad_fn = jax.jit(jax.grad(loss_fn))
-    w0 = np.zeros(8, np.float32)
     be = HostPSBackend(num_servers=1, num_workers=2, engine_threads=1,
                        async_mode=True)
     try:
-        seed_worker = AsyncPSWorker(be, w0, init_store=True)
-        workers = [AsyncPSWorker(be, w0, init_store=False) for _ in range(2)]
-
-        def run(widx):
-            wrng = np.random.RandomState(10 + widx)
-            for _ in range(150):
-                w = np.asarray(workers[widx].pull_weights())
-                x = wrng.randn(16, 8).astype(np.float32)
-                y = x @ true_w
-                g = np.asarray(grad_fn(w, (x, y)))
-                new_w = w - 0.05 * g
-                workers[widx].push_delta(new_w, w)
-
-        ts = [threading.Thread(target=run, args=(i,)) for i in range(2)]
-        for t in ts:
-            t.start()
-        for t in ts:
-            t.join()
-        import time
-        time.sleep(0.2)  # let engine drain
-        final = np.asarray(workers[0].pull_weights())
-        np.testing.assert_allclose(final, true_w, atol=0.05)
+        # all AsyncPSWorkers share the single in-process backend
+        seed_be, _, workers = make_workers(lambda: be, n=2)
+        run_async_convergence(workers,
+                              applied_rounds=lambda: be.servers[0].round(0))
     finally:
         be.close()

@@ -326,3 +326,30 @@ def test_exchange_distinct_trees_get_distinct_keys(server2):
         np.testing.assert_allclose(res[tag, "o"][0], 4.0)
         np.testing.assert_allclose(res[tag, "o"][1], 6.0)
     w1.close(); w2.close()
+
+
+def test_async_ps_over_wire_converges():
+    """Async-SGD (weight-delta push, no barrier) with workers talking to
+    the engine over TCP — the reference's BYTEPS_ENABLE_ASYNC mode in
+    its networked deployment shape."""
+    from _async_sgd import make_workers, run_async_convergence
+
+    be = PSServer(num_workers=2, engine_threads=1, async_mode=True)
+    srv = PSTransportServer(be, host="127.0.0.1")
+    addr = f"127.0.0.1:{srv.port}"
+    backends = []
+
+    def factory():
+        r = RemotePSBackend([addr], async_mode=True)
+        backends.append(r)
+        return r
+
+    try:
+        _, _, workers = make_workers(factory, n=2)
+        run_async_convergence(workers,
+                              applied_rounds=lambda: be.round(0))
+    finally:
+        for r in backends:
+            r.close()
+        srv.close()
+        be.close()
