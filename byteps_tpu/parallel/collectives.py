@@ -247,9 +247,26 @@ class PushPullEngine:
         ``push_pull_async`` therefore degrades to synchronous dispatch —
         the async overlap lever is the server engine's pipelining across
         buckets, as in the reference."""
+        if self.timeline is not None:
+            # separate the wait-for-device-reduce from the actual D2H copy,
+            # else the copy span would absorb the whole async dispatch
+            t0 = time.time()
+            jax.block_until_ready(result)
+            self.timeline.record(name or "push_pull", "REDUCE_WAIT", t0,
+                                 time.time() - t0)
+            t0 = time.time()
         row0 = jax.tree_util.tree_map(
             lambda x: np.asarray(x[0]) if x.ndim else np.asarray(x), result)
+        if self.timeline is not None:
+            self.timeline.record(name or "push_pull", "COPYD2H", t0,
+                                 time.time() - t0)
+            t0 = time.time()
         summed = self.ps_exchange.exchange(row0, name=name)
+        if self.timeline is not None:
+            # one span for the PUSH+server-sum+PULL legs (reference stages
+            # PUSH/PULL, core_loops.cc:538-618)
+            self.timeline.record(name or "push_pull", "PS_PUSH_PULL", t0,
+                                 time.time() - t0)
         if avg and self.ps_world > 1:
             summed = jax.tree_util.tree_map(
                 lambda x: x / self.ps_world, summed)

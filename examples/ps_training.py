@@ -1,0 +1,123 @@
+"""Full PS deployment demo on one machine: a standalone reduction
+server process plus N independent worker processes (local meshes, no
+collectives between workers) — the reference's worker/server
+architecture (reference: docs/step-by-step-tutorial.md distributed mode;
+byteps.server role).
+
+Run:  python examples/ps_training.py [--workers 2] [--steps 30]
+
+The driver (this script) starts `bpslaunch-tpu --server`, then launches
+the workers with BPS_ENABLE_PS/BPS_SERVER_ADDRS set; each worker trains
+a small model with DistributedGradientTape + manual updates, syncing
+gradients only through the TCP host service, and reports its losses.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+import _bootstrap  # noqa: F401
+
+WORKER_SNIPPET = r"""
+import os, sys
+sys.path.insert(0, os.path.join(os.environ["BPS_REPO_ROOT"], "examples"))
+import _bootstrap  # repo root on sys.path + honor JAX_PLATFORMS
+import jax
+import numpy as np
+import jax.numpy as jnp
+import byteps_tpu as bps
+
+wid = int(os.environ["BPS_WORKER_ID"])
+steps = int(os.environ["DEMO_STEPS"])
+bps.init()
+rng = np.random.RandomState(wid)          # each worker: its OWN data shard
+W = np.random.RandomState(0).randn(8, 1).astype(np.float32)
+
+params = {"w": jnp.zeros((8, 1))}
+grad_fn = jax.jit(jax.grad(
+    lambda p, b: jnp.mean((b[0] @ p["w"] - b[1]) ** 2)))
+for step in range(steps):
+    x = rng.randn(32, 8).astype(np.float32)
+    g = grad_fn(params, (x, x @ W))
+    # stacked [1, ...] rows: world-local replica; PS hop averages across
+    # the worker processes
+    stacked = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[None], g)
+    avg = bps.push_pull(stacked, average=True, name="grads")
+    params = jax.tree_util.tree_map(
+        lambda p, a: p - 0.1 * jnp.asarray(a)[0], params, avg)
+loss = float(jnp.mean((np.random.RandomState(99).randn(64, 8).astype("f")
+                       @ params["w"]
+                       - np.random.RandomState(99).randn(64, 8).astype("f")
+                       @ W) ** 2))
+print(f"worker {wid}: final eval loss {loss:.5f}")
+bps.shutdown()
+"""
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=30)
+    args = ap.parse_args()
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    server_env = dict(os.environ, BPS_SERVER_PORT=str(port),
+                      BPS_NUM_PROCESSES=str(args.workers))
+    server = subprocess.Popen(
+        [sys.executable, "-m", "byteps_tpu.launcher.launch", "--server"],
+        env=server_env, cwd=root)
+    workers = []
+    try:
+        # wait until the server actually listens (it has to import the
+        # package first) — workers have no connect retry
+        import time
+        deadline = time.time() + 60
+        while True:
+            try:
+                socket.create_connection(("127.0.0.1", port),
+                                         timeout=1).close()
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise SystemExit("server never came up")
+                time.sleep(0.3)
+        for wid in range(args.workers):
+            env = dict(os.environ,
+                       BPS_REPO_ROOT=root,
+                       BPS_ENABLE_PS="1",
+                       BPS_SERVER_ADDRS=f"127.0.0.1:{port}",
+                       BPS_NUM_WORKER=str(args.workers),
+                       BPS_WORKER_ID=str(wid),
+                       DEMO_STEPS=str(args.steps))
+            workers.append(subprocess.Popen(
+                [sys.executable, "-c", WORKER_SNIPPET], env=env, cwd=root))
+        rc = 0
+        for w in workers:
+            rc = w.wait() or rc
+        if rc:
+            raise SystemExit(f"a worker failed (rc={rc})")
+        print(f"PS deployment demo OK: {args.workers} workers x "
+              f"{args.steps} steps through the TCP host service")
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.terminate()
+        for w in workers:
+            try:
+                w.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                w.kill()
+        server.terminate()
+        server.wait(timeout=15)
+
+
+if __name__ == "__main__":
+    main()
