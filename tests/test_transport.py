@@ -353,3 +353,30 @@ def test_async_ps_over_wire_converges():
             r.close()
         srv.close()
         be.close()
+
+
+def test_ps_backend_lifecycle_across_suspend_resume():
+    """suspend() must close the PS backend; resume() rebuilds it."""
+    import os as _os
+
+    import byteps_tpu as bps
+    from byteps_tpu.common.global_state import GlobalState
+
+    _os.environ["BPS_ENABLE_PS"] = "1"
+    try:
+        import jax as _jax
+        bps.init(config=bps.Config.from_env())
+        be1 = GlobalState.get().ps_backend
+        assert be1 is not None
+        dp = len(_jax.devices())
+        x = np.stack([np.ones(16, np.float32) / dp] * dp)
+        bps.push_pull(x, average=False, name="g")
+        bps.suspend()
+        bps.resume(config=bps.Config.from_env())
+        be2 = GlobalState.get().ps_backend
+        assert be2 is not None and be2 is not be1
+        out = bps.push_pull(x, average=False, name="g")
+        np.testing.assert_allclose(np.asarray(out)[0], 1.0)
+    finally:
+        bps.shutdown()
+        _os.environ.pop("BPS_ENABLE_PS", None)
