@@ -116,6 +116,13 @@ class GlobalState:
                 return
             if inst.timeline is not None:
                 inst.timeline.flush()
+            if inst.engine._handles:
+                log.warning(
+                    "shutdown with %d unsynchronized push_pull_async "
+                    "handle(s) — their results are lost%s",
+                    len(inst.engine._handles),
+                    "; in PS mode peers may block on the missing pushes"
+                    if inst.ps_backend is not None else "")
             if inst.ps_backend is not None:
                 inst.ps_backend.close()
             cls._instance = None

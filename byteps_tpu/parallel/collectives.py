@@ -234,6 +234,19 @@ class PushPullEngine:
         self._programs[key] = plan
         return plan
 
+    def _maybe_sample(self, result, name: Optional[str]) -> None:
+        """Numeric debugging sampler (reference: BYTEPS_DEBUG_SAMPLE_TENSOR
+        prints tensor values per stage, core_loops.cc:37-67). Runs on the
+        FINAL values — post-PS-hop on every path."""
+        if not (self.debug_sample and name and self.debug_sample in name):
+            return
+        from ..common.logging import get_logger
+        for p, leaf in jax.tree_util.tree_leaves_with_path(result):
+            arr = np.asarray(leaf)
+            get_logger().info("SAMPLE %s%s mean=%.6g std=%.6g first=%.6g",
+                              name, jax.tree_util.keystr(p),
+                              arr.mean(), arr.std(), arr.ravel()[0])
+
     def _ps_hop(self, result, avg: bool, name: Optional[str]):
         """PS mode's cross-worker hop (reference: PUSH/PULL stages after
         the local NCCL reduce, core_loops.cc:538-618). ``result`` is the
@@ -243,10 +256,10 @@ class PushPullEngine:
         each worker contributed its local mean; dividing the PS sum by
         the worker count yields the global mean (equal local batches).
 
-        This hop is host-synchronous (D2H readback + RPCs): in PS mode
-        ``push_pull_async`` therefore degrades to synchronous dispatch —
-        the async overlap lever is the server engine's pipelining across
-        buckets, as in the reference."""
+        This hop is host-synchronous (D2H readback + RPCs), so the sync
+        path runs it inline while ``push_pull_async`` defers it to
+        ``synchronize()`` — dispatch stays non-blocking and the device
+        reduce overlaps the caller's work (the cross-barrier pattern)."""
         if self.timeline is not None:
             # separate the wait-for-device-reduce from the actual D2H copy,
             # else the copy span would absorb the whole async dispatch
@@ -276,14 +289,18 @@ class PushPullEngine:
             result, summed)
 
     def push_pull(self, tree, average: Optional[bool] = None,
-                  name: Optional[str] = None, sync: bool = True):
+                  name: Optional[str] = None, sync: bool = True,
+                  _defer_ps: bool = False):
         """Reduce a pytree of [dp, ...] stacked arrays; returns same shapes
         with every replica slice equal to the reduction.
 
         ``sync=False`` (the async-handle path) skips the blocking
         telemetry/timeline readback — recording then happens at
         ``synchronize()`` so enabling the timeline doesn't silently
-        serialize the overlap it is meant to measure."""
+        serialize the overlap it is meant to measure. ``_defer_ps``
+        (internal, push_pull_async only) additionally postpones the PS
+        hop to ``synchronize()``; direct callers always get the full
+        cross-worker result."""
         avg = self.average if average is None else average
         _, progs, _ = self._plan(tree, avg, name)
         leaves, treedef = jax.tree_util.tree_flatten(tree)
@@ -323,16 +340,19 @@ class PushPullEngine:
                                      tb, time.time() - tb, key=bucket.index)
         result = jax.tree_util.tree_unflatten(treedef, out)
         if self.ps_exchange is not None:
-            result = self._ps_hop(result, avg, name)
-        if self.debug_sample and name and self.debug_sample in name:
-            # numeric debugging sampler (reference: BYTEPS_DEBUG_SAMPLE_TENSOR
-            # prints tensor values per stage, core_loops.cc:37-67)
-            from ..common.logging import get_logger
-            for p, leaf in jax.tree_util.tree_leaves_with_path(result):
-                arr = np.asarray(leaf)
-                get_logger().info("SAMPLE %s%s mean=%.6g std=%.6g first=%.6g",
-                                  name, jax.tree_util.keystr(p),
-                                  arr.mean(), arr.std(), arr.ravel()[0])
+            if _defer_ps:
+                # async handles: pin PS key-declaration order to program
+                # order NOW (workers may later synchronize in different
+                # orders); the blocking hop itself runs at synchronize()
+                row0_struct = jax.tree_util.tree_map(
+                    lambda x: np.empty(x.shape[1:] if x.ndim else x.shape,
+                                       x.dtype), result)
+                self.ps_exchange.plan_for(row0_struct, name=name)
+            else:
+                result = self._ps_hop(result, avg, name)
+                self._maybe_sample(result, name)
+        else:
+            self._maybe_sample(result, name)
         if sync and (self.telemetry is not None or self.timeline is not None):
             jax.block_until_ready(result)
             dt = time.time() - t0
@@ -351,26 +371,41 @@ class PushPullEngine:
         The collectives are enqueued on the device; the caller's host
         thread continues immediately (the cross-barrier overlap of the
         reference, minus the poller thread). Telemetry/timeline recording
-        is deferred to ``synchronize`` so it never blocks dispatch."""
-        result = self.push_pull(tree, average=average, name=name, sync=False)
+        is deferred to ``synchronize`` so it never blocks dispatch.
+
+        EVERY handle must be synchronized (torch contract: the result is
+        undefined before synchronize). In PS mode this is load-bearing
+        for the peers too: the cross-worker push happens at
+        ``synchronize()``, so an abandoned handle leaves other workers
+        waiting on this worker's contribution until their pull times out."""
+        result = self.push_pull(tree, average=average, name=name, sync=False,
+                                _defer_ps=True)
         h = self._next_handle
         self._next_handle += 1
         nbytes = sum(l.nbytes for l in jax.tree_util.tree_leaves(tree))
-        self._handles[h] = (result, time.time(), nbytes, name)
+        avg = self.average if average is None else average
+        self._handles[h] = (result, time.time(), nbytes, name, avg)
         return h
 
     def poll(self, handle: int) -> bool:
         """True once every array behind ``handle`` has finished computing
-        (reference: byteps_torch_poll → handle_manager PollHandle)."""
-        result, _, _, _ = self._handles[handle]
+        (reference: byteps_torch_poll → handle_manager PollHandle). In PS
+        mode "ready" means the device reduce finished; the host hop runs
+        at synchronize()."""
+        result, _, _, _, _ = self._handles[handle]
         return all(leaf.is_ready() for leaf in
                    jax.tree_util.tree_leaves(result)
                    if isinstance(leaf, jax.Array))
 
     def synchronize(self, handle: int):
         """Block until done and return the reduced tree; the handle is
-        released (reference: synchronize(handle), ops.py:204-236)."""
-        result, t0, nbytes, name = self._handles.pop(handle)
+        released (reference: synchronize(handle), ops.py:204-236). In PS
+        mode the deferred cross-worker host hop happens here."""
+        result, t0, nbytes, name, avg = self._handles.pop(handle)
+        if self.ps_exchange is not None:
+            result = self._ps_hop(result, avg, name)
+            self._maybe_sample(result, name)   # deferred with the hop;
+            # non-PS async already sampled at dispatch
         result = jax.block_until_ready(result)
         if self.telemetry is not None or self.timeline is not None:
             dt = time.time() - t0

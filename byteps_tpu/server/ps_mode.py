@@ -69,6 +69,13 @@ class PSGradientExchange:
         self._plans[key] = plan
         return plan
 
+    def plan_for(self, tree, name: Optional[str] = None) -> None:
+        """Pre-declare keys for ``tree`` NOW. Deferred-exchange callers
+        (async handles) use this at dispatch so key assignment follows
+        program order on every worker even if their synchronize order
+        later diverges (the declaration-order contract above)."""
+        self._plan(tree, name)
+
     def exchange(self, tree, name: Optional[str] = None):
         """Push all buckets (priority order), then pull each — one sync
         round (per-name round counter). Returns the summed tree."""

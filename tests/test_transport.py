@@ -380,3 +380,34 @@ def test_ps_backend_lifecycle_across_suspend_resume():
     finally:
         bps.shutdown()
         _os.environ.pop("BPS_ENABLE_PS", None)
+
+
+def test_async_handles_defer_ps_hop():
+    """push_pull_async in PS mode: dispatch returns immediately; the
+    host-service hop happens at synchronize() and still sums."""
+    import os as _os
+
+    import jax as _jax
+
+    import byteps_tpu as bps
+    from byteps_tpu.common.global_state import GlobalState
+
+    _os.environ["BPS_ENABLE_PS"] = "1"
+    try:
+        bps.init(config=bps.Config.from_env())
+        eng = GlobalState.get().engine
+        calls = []
+        orig = eng._ps_hop
+        eng._ps_hop = lambda *a, **k: (calls.append(1), orig(*a, **k))[1]
+        dp = len(_jax.devices())
+        x = np.stack([np.full(16, float(i), np.float32)
+                      for i in range(dp)])
+        h = bps.push_pull_async(x, average=False, name="g")
+        assert not calls, "hop must not run at dispatch"
+        out = bps.synchronize(h)
+        assert calls, "hop must run at synchronize"
+        np.testing.assert_allclose(np.asarray(out)[0],
+                                   sum(range(dp)))
+    finally:
+        bps.shutdown()
+        _os.environ.pop("BPS_ENABLE_PS", None)
