@@ -171,6 +171,10 @@ class PushPullEngine:
         # async so a handle just pins the dispatched output arrays)
         self._handles: Dict[int, object] = {}
         self._next_handle = 0
+        # handles whose PS host hop is deferred, in DISPATCH order —
+        # synchronize() drains this queue front-first so pushes pair
+        # across workers even when synchronize order diverges
+        self._ps_pending: List[int] = []
 
     # -- plan & compile one program set per tree structure -------------------
     def _plan(self, tree, average: bool, name: Optional[str] = None):
@@ -342,11 +346,12 @@ class PushPullEngine:
         if self.ps_exchange is not None:
             if _defer_ps:
                 # async handles: pin PS key-declaration order to program
-                # order NOW (workers may later synchronize in different
-                # orders); the blocking hop itself runs at synchronize()
+                # order NOW; the blocking hop itself runs at synchronize(),
+                # which drains deferred hops in dispatch order (so workers
+                # may synchronize in different orders safely)
                 row0_struct = jax.tree_util.tree_map(
-                    lambda x: np.empty(x.shape[1:] if x.ndim else x.shape,
-                                       x.dtype), result)
+                    lambda x: jax.ShapeDtypeStruct(
+                        x.shape[1:] if x.ndim else x.shape, x.dtype), result)
                 self.ps_exchange.plan_for(row0_struct, name=name)
             else:
                 result = self._ps_hop(result, avg, name)
@@ -374,17 +379,20 @@ class PushPullEngine:
         is deferred to ``synchronize`` so it never blocks dispatch.
 
         EVERY handle must be synchronized (torch contract: the result is
-        undefined before synchronize). In PS mode this is load-bearing
-        for the peers too: the cross-worker push happens at
-        ``synchronize()``, so an abandoned handle leaves other workers
-        waiting on this worker's contribution until their pull times out."""
-        result = self.push_pull(tree, average=average, name=name, sync=False,
+        undefined before synchronize). In PS mode the cross-worker pushes
+        happen at ``synchronize()``, which drains ALL deferred hops in
+        dispatch order — so synchronizing any later handle also pushes
+        this one's contribution, and divergent synchronize orders across
+        workers still pair pushes correctly."""
+        avg = self.average if average is None else average
+        result = self.push_pull(tree, average=avg, name=name, sync=False,
                                 _defer_ps=True)
         h = self._next_handle
         self._next_handle += 1
         nbytes = sum(l.nbytes for l in jax.tree_util.tree_leaves(tree))
-        avg = self.average if average is None else average
         self._handles[h] = (result, time.time(), nbytes, name, avg)
+        if self.ps_exchange is not None:
+            self._ps_pending.append(h)
         return h
 
     def poll(self, handle: int) -> bool:
@@ -397,15 +405,34 @@ class PushPullEngine:
                    jax.tree_util.tree_leaves(result)
                    if isinstance(leaf, jax.Array))
 
+    def _drain_ps_hops(self, handle: int) -> None:
+        """Run deferred PS host hops in DISPATCH order up to ``handle``.
+
+        Dispatch order is the same on every worker (same program), so
+        pushing in that order pairs each worker's round-k push with the
+        peers' round-k pushes regardless of synchronize() call order.
+        A handle is only dequeued after its hop succeeds: a pull timeout
+        (slow/crashed peer) leaves it pending with the device result
+        intact, so poll() keeps working and synchronize can be retried."""
+        while self._ps_pending:
+            h = self._ps_pending[0]
+            result, t0, nbytes, name, avg = self._handles[h]
+            hopped = self._ps_hop(result, avg, name)
+            self._maybe_sample(hopped, name)   # deferred with the hop;
+            # non-PS async already sampled at dispatch
+            self._handles[h] = (hopped, t0, nbytes, name, avg)
+            self._ps_pending.pop(0)
+            if h == handle:
+                break
+
     def synchronize(self, handle: int):
         """Block until done and return the reduced tree; the handle is
         released (reference: synchronize(handle), ops.py:204-236). In PS
-        mode the deferred cross-worker host hop happens here."""
+        mode the deferred cross-worker host hops happen here, drained in
+        dispatch order through this handle."""
+        if handle in self._ps_pending:
+            self._drain_ps_hops(handle)
         result, t0, nbytes, name, avg = self._handles.pop(handle)
-        if self.ps_exchange is not None:
-            result = self._ps_hop(result, avg, name)
-            self._maybe_sample(result, name)   # deferred with the hop;
-            # non-PS async already sampled at dispatch
         result = jax.block_until_ready(result)
         if self.telemetry is not None or self.timeline is not None:
             dt = time.time() - t0

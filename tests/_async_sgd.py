@@ -9,7 +9,7 @@ import numpy as np
 
 from byteps_tpu.server.ps_mode import AsyncPSWorker
 
-TRUE_W_SEED, STEPS, LR = 2, 150, 0.05
+TRUE_W_SEED, STEPS, LR = 2, 300, 0.05
 
 
 def true_weights():

@@ -34,6 +34,22 @@ def main():
     out2 = bps.push_pull(x, average=False, name="grads")
     # local sums: w0 -> 4.0, w1 -> 6.0; PS sum = 10.0
     np.testing.assert_allclose(np.asarray(out2), 10.0)
+
+    # async handles synchronized in DIVERGENT order across the workers:
+    # synchronize() drains deferred PS hops in dispatch order, so this
+    # must neither deadlock nor mispair rounds
+    a = np.stack([np.full((32,), 1.0 + wid, np.float32)] * 2)
+    b = np.stack([np.full((32,), 5.0 + wid, np.float32)] * 2)
+    ha = bps.push_pull_async(a, average=False, name="async_a")
+    hb = bps.push_pull_async(b, average=False, name="async_b")
+    first, second = (hb, ha) if wid == 0 else (ha, hb)
+    out_first = bps.synchronize(first)
+    out_second = bps.synchronize(second)
+    oa = out_second if wid == 0 else out_first
+    ob = out_first if wid == 0 else out_second
+    # a: local sums 2.0 / 4.0 -> PS sum 6.0; b: 10.0 / 12.0 -> 22.0
+    np.testing.assert_allclose(np.asarray(oa), 6.0)
+    np.testing.assert_allclose(np.asarray(ob), 22.0)
     bps.shutdown()
     print(f"PS_WORKER_OK wid={wid}")
 
