@@ -77,7 +77,8 @@ class GlobalState:
                 # the async store would accumulate forever.
                 self.engine.ps_exchange = PSGradientExchange(
                     self.ps_backend, partition_bytes=config.partition_bytes,
-                    registry=self.registry)
+                    registry=self.registry,
+                    min_compress_bytes=config.min_compress_bytes)
                 self.engine.ps_world = config.num_worker
         self.dp = dp_size(self.mesh)
         self.step = 0

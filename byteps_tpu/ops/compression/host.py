@@ -1,0 +1,312 @@
+"""Host-side (numpy) compressor codecs for the PS wire path.
+
+The reference runs ONE C++ compressor implementation in two places: on
+the worker's CPU staging buffer right before PUSH / after PULL
+(core_loops.cc:498-536, 620-648) and inside the server engine, which
+decompresses every worker's push, sums the dense values, and
+RE-compresses the merged result once per round (server.cc:86-113,
+registered from kwargs serialized worker→server, server.cc:222-252).
+
+These codecs play that role here: plain numpy on the host data path (the
+device path keeps the JAX/Pallas compressors in this package), shared by
+``PSGradientExchange`` (worker) and the host reduction service (server).
+Payloads are flat little-endian byte strings of deterministic size
+(``payload_nbytes``), so the TCP transport can frame them like any other
+buffer.
+
+Numerics mirror the JAX compressors in this package elementwise:
+onebit/topk are bit-exact; randomk draws indices from the reference's
+seeded XorShift128+ (utils.h:72-158); dithering drives its Bernoulli
+from the same RNG when a ``seed`` kwarg is given (the reference is only
+deterministic when seeded) and a fast numpy stream otherwise.
+
+Decorator chain (worker-only, like the reference's registry which skips
+momentum/ef on the server, compressor_registry.cc:40-56): momentum →
+error-feedback → compressor via ``create_host_chain``; the server
+registers the plain codec via ``create_host_codec``.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Optional
+
+import numpy as np
+
+from .dithering import LINEAR, MAX
+from .onebit import PACK
+from .rng import XorShift128Plus
+from .topk import resolve_k
+
+
+def serialize_kwargs(kwargs: Dict[str, str]) -> bytes:
+    """``k\\0v\\0...`` — the reference's wire form of the compression
+    kwargs dict (utils.h:33-46, pushed to the server at init,
+    operations.cc:396-408)."""
+    out = []
+    for k in sorted(kwargs):
+        out.append(str(k).encode())
+        out.append(str(kwargs[k]).encode())
+    return b"\0".join(out)
+
+
+def deserialize_kwargs(buf: bytes) -> Dict[str, str]:
+    if not buf:
+        return {}
+    parts = bytes(buf).split(b"\0")
+    if len(parts) % 2:
+        raise ValueError("malformed kwargs blob")
+    return {parts[i].decode(): parts[i + 1].decode()
+            for i in range(0, len(parts), 2)}
+
+
+class HostCodec:
+    """compress(np [size]) -> bytes of payload_nbytes(); decompress -> np."""
+
+    def __init__(self, size: int, dtype: str = "float32") -> None:
+        self.size = int(size)
+        self.dtype = np.dtype(dtype)
+
+    def compress(self, x: np.ndarray) -> bytes:
+        raise NotImplementedError
+
+    def decompress(self, buf) -> np.ndarray:
+        raise NotImplementedError
+
+    def payload_nbytes(self) -> int:
+        raise NotImplementedError
+
+
+class HostOnebit(HostCodec):
+    """Sign-bit packing 32:1, MSB-first uint32 words, optional L1-mean
+    scale (reference: impl/onebit.cc:34-67; bit-exact with
+    OnebitCompressor here)."""
+
+    def __init__(self, size: int, dtype: str = "float32",
+                 use_scale: bool = False) -> None:
+        super().__init__(size, dtype)
+        self.use_scale = use_scale
+        self.chunks = (size + PACK - 1) // PACK
+
+    def compress(self, x: np.ndarray) -> bytes:
+        x = np.asarray(x).reshape(-1)
+        bits = np.zeros(self.chunks * PACK, np.uint8)
+        bits[: self.size] = (x < 0)
+        # packbits is MSB-first per byte; big-endian u4 view keeps element
+        # 0 in the top bit of word 0, matching the JAX kernel
+        packed = np.packbits(bits).view(">u4").astype(np.uint32)
+        scale = np.float32(np.abs(x).mean()) if self.use_scale \
+            else np.float32(1.0)
+        return packed.tobytes() + struct.pack("<f", scale)
+
+    def decompress(self, buf) -> np.ndarray:
+        buf = bytes(buf)
+        packed = np.frombuffer(buf[:-4], np.uint32)
+        (scale,) = struct.unpack("<f", buf[-4:])
+        bits = np.unpackbits(
+            np.frombuffer(packed.astype(">u4").tobytes(), np.uint8))
+        signs = 1.0 - 2.0 * bits[: self.size].astype(np.float32)
+        return (signs * scale).astype(self.dtype)
+
+    def payload_nbytes(self) -> int:
+        return self.chunks * 4 + 4
+
+
+class _SparseCodec(HostCodec):
+    """(int32 indices | values) wire layout shared by topk/randomk."""
+
+    def __init__(self, size: int, dtype: str, k: int) -> None:
+        super().__init__(size, dtype)
+        self.k = min(int(k), size)
+
+    def _pack(self, idx: np.ndarray, vals: np.ndarray) -> bytes:
+        return idx.astype(np.int32).tobytes() + \
+            vals.astype(self.dtype).tobytes()
+
+    def decompress(self, buf) -> np.ndarray:
+        buf = bytes(buf)
+        idx = np.frombuffer(buf[: self.k * 4], np.int32)
+        vals = np.frombuffer(buf[self.k * 4:], self.dtype)
+        out = np.zeros(self.size, self.dtype)
+        out[idx] = vals
+        return out
+
+    def payload_nbytes(self) -> int:
+        return self.k * (4 + self.dtype.itemsize)
+
+
+class HostTopk(_SparseCodec):
+    """Largest-k magnitudes, ties to the lower index (matches
+    jax.lax.top_k; reference: impl/topk.h:26-37)."""
+
+    def compress(self, x: np.ndarray) -> bytes:
+        x = np.asarray(x).reshape(-1)
+        idx = np.argsort(-np.abs(x), kind="stable")[: self.k]
+        return self._pack(idx, x[idx])
+
+
+class HostRandomk(_SparseCodec):
+    """k coordinates with replacement from the reference's seeded
+    XorShift128+ (impl/randomk.cc; utils.h:72-92)."""
+
+    def __init__(self, size: int, dtype: str, k: int, seed: int = 0) -> None:
+        super().__init__(size, dtype, k)
+        self._rng = XorShift128Plus(seed)
+
+    def compress(self, x: np.ndarray) -> bytes:
+        x = np.asarray(x).reshape(-1)
+        idx = self._rng.randint_array(0, self.size, self.k)
+        return self._pack(idx, x[idx])
+
+
+class HostDithering(HostCodec):
+    """Stochastic quantization onto linear {i/s} or natural {2^(i-s)}
+    levels (reference: impl/dithering.{cc,h}); math mirrors
+    DitheringCompressor.quantize elementwise."""
+
+    def __init__(self, size: int, dtype: str = "float32", s: int = 4,
+                 seed: int = 0, ptype: int = LINEAR, ntype: int = MAX) -> None:
+        super().__init__(size, dtype)
+        self.s, self.ptype, self.ntype = int(s), int(ptype), int(ntype)
+        qmax = self.s if self.ptype == LINEAR else (1 << (self.s - 1))
+        self.qdtype = np.dtype(np.int8 if qmax <= 127 else np.int16)
+        # seeded → the reference's sequential RNG (bit-exact determinism);
+        # unseeded → fast vectorized numpy stream (reference unseeded mode
+        # is nondeterministic anyway)
+        self._xs = XorShift128Plus(seed) if seed else None
+        self._np_rng = None if seed else np.random.RandomState()
+
+    def _uniform(self, n: int) -> np.ndarray:
+        if self._xs is not None:
+            return np.array([self._xs.rand() for _ in range(n)], np.float64)
+        return self._np_rng.random_sample(n)
+
+    def compress(self, x: np.ndarray) -> bytes:
+        x = np.asarray(x, np.float32).reshape(-1)
+        u = self._uniform(self.size)
+        scale = (np.abs(x).max() if self.ntype == MAX
+                 else np.sqrt(np.sum(x * x)))
+        safe = scale if scale > 0 else 1.0
+        absx = np.abs(x)
+        if self.ptype == LINEAR:
+            normalized = absx / safe * self.s
+            floor = np.floor(normalized)
+            q = floor + (u < (normalized - floor))
+        else:
+            level = 1 << (self.s - 1)
+            normalized = absx / safe * level
+            c = np.ceil(normalized).astype(np.uint32)
+            # round-next-pow2 >> 1 (reference RoundNextPow2, utils.h)
+            v = np.maximum(c, 1).astype(np.uint32) - np.uint32(1)
+            for shift in (1, 2, 4, 8, 16):
+                v = v | (v >> np.uint32(shift))
+            fl = ((v.astype(np.uint64) + 1) >> np.uint64(1)).astype(np.float32)
+            length = np.where(fl != 0, fl, 1.0)
+            p = (normalized - fl) / length
+            q = fl + length * (u < p)
+        q = (np.sign(x) * q).astype(self.qdtype)
+        return q.tobytes() + struct.pack("<f", np.float32(scale))
+
+    def decompress(self, buf) -> np.ndarray:
+        buf = bytes(buf)
+        q = np.frombuffer(buf[:-4], self.qdtype).astype(np.float32)
+        (scale,) = struct.unpack("<f", buf[-4:])
+        denom = self.s if self.ptype == LINEAR else (1 << (self.s - 1))
+        return (q * scale / denom).astype(self.dtype)
+
+    def payload_nbytes(self) -> int:
+        return self.size * self.qdtype.itemsize + 4
+
+
+def create_host_codec(kwargs: Dict[str, str], size: int,
+                      dtype: str = "float32") -> Optional[HostCodec]:
+    """Plain compressor from string kwargs — what the SERVER registers
+    (reference: server.cc:222-252; decorators are worker-only)."""
+    ctype = kwargs.get("compressor_type")
+    if ctype is None:
+        return None
+    if ctype == "onebit":
+        scaled = str(kwargs.get("compressor_onebit_scaling",
+                                "false")).lower() in ("1", "true", "yes")
+        return HostOnebit(size, dtype, use_scale=scaled)
+    if ctype == "topk":
+        return HostTopk(size, dtype, k=resolve_k(kwargs, size, dtype))
+    if ctype == "randomk":
+        return HostRandomk(size, dtype, k=resolve_k(kwargs, size, dtype),
+                           seed=int(kwargs.get("seed", 0)))
+    if ctype == "dithering":
+        return HostDithering(
+            size, dtype, s=int(float(kwargs.get("compressor_k", 4))),
+            seed=int(kwargs.get("seed", 0)),
+            ptype=int(kwargs.get("dithering_partition", LINEAR)),
+            ntype=int(kwargs.get("dithering_normalize", MAX)))
+    raise ValueError(f"unknown compressor_type {ctype!r} for the host path")
+
+
+class HostErrorFeedback:
+    """Worker-side EF decorator: compress(g + e·lr_ratio); e = that − its
+    decompressed value (reference: error_feedback.h:26-46; the vanilla
+    variant's η_{t-1}/η_t scale arrives via ``set_lr`` instead of the
+    reference's mmap'd lr.s file, vanilla_error_feedback.h:26-38)."""
+
+    def __init__(self, inner: HostCodec) -> None:
+        self.inner = inner
+        self.size, self.dtype = inner.size, inner.dtype
+        self._error = np.zeros(inner.size, np.float32)
+        self._lr_prev = self._lr_now = 1.0
+
+    def set_lr(self, lr: float) -> None:
+        self._lr_prev, self._lr_now = self._lr_now, float(lr)
+
+    def compress(self, x: np.ndarray) -> bytes:
+        ratio = self._lr_prev / max(self._lr_now, 1e-30)
+        corrected = np.asarray(x, np.float32).reshape(-1) + \
+            self._error * ratio
+        buf = self.inner.compress(corrected.astype(self.dtype))
+        self._error = corrected - \
+            self.inner.decompress(buf).astype(np.float32)
+        return buf
+
+    def decompress(self, buf) -> np.ndarray:
+        return self.inner.decompress(buf)
+
+    def payload_nbytes(self) -> int:
+        return self.inner.payload_nbytes()
+
+
+class HostNesterovMomentum:
+    """Worker-side momentum decorator: m = μm + g; send g + μm
+    (reference: nesterov_momentum.h:26-34)."""
+
+    def __init__(self, inner, mu: float = 0.9) -> None:
+        self.inner = inner
+        self.size, self.dtype = inner.size, inner.dtype
+        self.mu = float(mu)
+        self._m = np.zeros(inner.size, np.float32)
+
+    def compress(self, x: np.ndarray) -> bytes:
+        g = np.asarray(x, np.float32).reshape(-1)
+        self._m = self.mu * self._m + g
+        return self.inner.compress((g + self.mu * self._m)
+                                   .astype(self.dtype))
+
+    def decompress(self, buf) -> np.ndarray:
+        return self.inner.decompress(buf)
+
+    def payload_nbytes(self) -> int:
+        return self.inner.payload_nbytes()
+
+
+def create_host_chain(kwargs: Dict[str, str], size: int,
+                      dtype: str = "float32"):
+    """Worker-side chain: momentum → ef → compressor, outermost first
+    (reference: CompressorRegistry::Create, compressor_registry.cc:40-56)."""
+    comp = create_host_codec(kwargs, size, dtype)
+    if comp is None:
+        return None
+    if kwargs.get("ef_type") == "vanilla":
+        comp = HostErrorFeedback(comp)
+    if kwargs.get("momentum_type") == "nesterov":
+        comp = HostNesterovMomentum(
+            comp, mu=float(kwargs.get("momentum_mu", 0.9)))
+    return comp

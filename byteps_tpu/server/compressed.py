@@ -1,0 +1,120 @@
+"""Server-side compressed-key handling for the host reduction service.
+
+The reference server registers a compressor per key from the kwargs the
+worker serializes at init (server.cc:222-252), decompresses every push
+before handing it to the summation engine, and re-compresses the merged
+buffer once per round so pulls ship compressed bytes back
+(server.cc:86-113). ``CompressedKeyStore`` is that logic here, wrapped
+around any dense backend (the native engine shards in-process, or the
+backend behind the TCP transport server).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..ops.compression.host import HostCodec, create_host_codec
+
+# recompressed rounds kept per key: all workers pull round r before r+2
+# can complete (they must push r+1 first), so 4 is comfortably safe for
+# the stochastic codecs where a recompute would yield different bytes
+_CACHE_ROUNDS = 4
+
+
+class CompressedKeyStore:
+    """Per-key codecs + once-per-round recompression cache."""
+
+    def __init__(self) -> None:
+        self._codecs: Dict[int, HostCodec] = {}
+        self._kwargs: Dict[int, Tuple] = {}
+        self._lock = threading.Lock()
+        # key -> {round: payload bytes}, insertion-ordered for eviction
+        self._cache: Dict[int, Dict[int, bytes]] = {}
+
+    def register(self, key: int, kwargs: Dict[str, str], size: int,
+                 dtype: str) -> Optional[HostCodec]:
+        """Idempotent per key (reference init-push arrives once per
+        worker). A re-registration with DIFFERENT kwargs is a
+        misconfigured worker whose payloads would be silently misparsed —
+        raise instead."""
+        ident = (tuple(sorted(kwargs.items())), int(size), str(dtype))
+        with self._lock:
+            codec = self._codecs.get(key)
+            if codec is not None:
+                if self._kwargs[key] != ident:
+                    raise ValueError(
+                        f"key {key} already registered with "
+                        f"{self._kwargs[key]}, re-register with {ident} "
+                        f"— workers disagree on compression config")
+                return codec
+            codec = create_host_codec(kwargs, size, dtype)
+            if codec is not None:
+                self._codecs[key] = codec
+                self._kwargs[key] = ident
+                self._cache[key] = {}
+            return codec
+
+    def cached(self, key: int, rnd: int) -> Optional[bytes]:
+        """Recompressed payload for a completed round, if still cached."""
+        if rnd == 0:
+            return None
+        with self._lock:
+            return self._cache.get(key, {}).get(rnd)
+
+    def has(self, key: int) -> bool:
+        return key in self._codecs
+
+    def codec(self, key: int) -> HostCodec:
+        return self._codecs[key]
+
+    def payload_nbytes(self, key: int) -> int:
+        return self._codecs[key].payload_nbytes()
+
+    def decompress(self, key: int, payload) -> np.ndarray:
+        return self._codecs[key].decompress(payload)
+
+    def recompress(self, key: int, dense: np.ndarray, rnd: int) -> bytes:
+        """Compress the merged buffer for ``rnd``; cached so every worker
+        pulling the same round gets byte-identical payloads even for
+        stochastic codecs. ``rnd`` 0 (async mode: latest) is never cached
+        — the store mutates between pulls."""
+        if rnd == 0:
+            return self._codecs[key].compress(dense)
+        with self._lock:
+            rounds = self._cache[key]
+            buf = rounds.get(rnd)
+            if buf is None:
+                buf = self._codecs[key].compress(dense)
+                rounds[rnd] = buf
+                while len(rounds) > _CACHE_ROUNDS:
+                    rounds.pop(next(iter(rounds)))
+            return buf
+
+    def reset(self) -> None:
+        with self._lock:
+            self._codecs.clear()
+            self._cache.clear()
+
+
+def compressed_push(store: CompressedKeyStore, backend, key: int,
+                    payload) -> None:
+    """Decompress → dense push into the summation engine (reference:
+    BytePSServerEngineThread decompress before SUM_RECV, server.cc:86-113)."""
+    backend.push(key, store.decompress(key, payload))
+
+
+def compressed_pull(store: CompressedKeyStore, backend, key: int,
+                    rnd: int, timeout_ms: int = 30000) -> bytes:
+    """Dense pull of the merged round → recompress (cached per round).
+    A cache hit means the round already completed and was compressed —
+    later pullers skip the dense copy out of the engine entirely."""
+    buf = store.cached(key, rnd)
+    if buf is not None:
+        return buf
+    codec = store.codec(key)
+    dense = np.empty(codec.size, codec.dtype)
+    backend.pull(key, dense, round=rnd, timeout_ms=timeout_ms)
+    return store.recompress(key, dense, rnd)

@@ -154,6 +154,8 @@ class HostPSBackend:
         self.hash_fn = hash_fn
         self.async_mode = async_mode
         self._rounds: Dict[int, int] = {}
+        from .compressed import CompressedKeyStore
+        self.compressed = CompressedKeyStore()
 
     def close(self) -> None:
         for s in self.servers:
@@ -164,7 +166,14 @@ class HostPSBackend:
         return self.servers[place_key(key, len(self.servers), self.hash_fn)]
 
     def init_key(self, key: int, nbytes: int, dtype: str = "float32",
-                 init: Optional[np.ndarray] = None) -> None:
+                 init: Optional[np.ndarray] = None,
+                 compression: Optional[Dict[str, str]] = None) -> None:
+        """``compression`` kwargs register a server-side codec for the key
+        (reference: server.cc:222-252); the dense store still holds
+        ``nbytes`` — pushes arrive compressed, are decompressed into it."""
+        if compression:
+            size = nbytes // np.dtype(dtype).itemsize
+            self.compressed.register(key, compression, size, dtype)
         self._shard(key).init_key(key, nbytes, dtype, init)
 
     def push(self, key: int, data: np.ndarray) -> None:
@@ -173,6 +182,20 @@ class HostPSBackend:
     def pull(self, key: int, out: np.ndarray, round: int = 0,
              timeout_ms: int = 30000) -> None:
         self._shard(key).pull(key, out, round, timeout_ms)
+
+    def push_bytes(self, key: int, payload) -> None:
+        """Compressed push: decompress server-side, dense-sum in the
+        engine (reference: decompress before SUM_RECV, server.cc:86-113)."""
+        from .compressed import compressed_push
+        compressed_push(self.compressed, self._shard(key), key, payload)
+
+    def pull_bytes(self, key: int, round: int = 0,
+                   timeout_ms: int = 30000) -> bytes:
+        """Compressed pull: merged dense round recompressed once, served
+        byte-identical to every worker."""
+        from .compressed import compressed_pull
+        return compressed_pull(self.compressed, self._shard(key), key,
+                               round, timeout_ms)
 
     def push_pull(self, key: int, data: np.ndarray,
                   timeout_ms: int = 30000) -> np.ndarray:

@@ -33,12 +33,19 @@ class PSGradientExchange:
     """Sync-mode bucketed gradient exchange through the host PS service."""
 
     def __init__(self, backend: HostPSBackend, partition_bytes: int = 4 << 20,
-                 registry: Optional[NameRegistry] = None) -> None:
+                 registry: Optional[NameRegistry] = None,
+                 min_compress_bytes: int = 65536) -> None:
         self.backend = backend
         self.partition_bytes = partition_bytes
         self.registry = registry or NameRegistry()
+        self.min_compress_bytes = min_compress_bytes
         self._plans: Dict = {}
         self._rounds: Dict[str, int] = {}
+        # per-PS-key worker compressor chain (momentum→ef→codec) — holds
+        # EF error / momentum state, so it outlives the plan cache entry
+        # (reference: per-partition compressor_list in BPSContext,
+        # common.h:202, operations.cc:380-385)
+        self._chains: Dict[int, object] = {}
 
     def _plan(self, tree, name: Optional[str]):
         leaves, treedef = jax.tree_util.tree_flatten(tree)
@@ -62,9 +69,21 @@ class PSGradientExchange:
         # per-bucket PS keys: declared_key<<16 | bucket (reference:
         # operations.cc:301-317)
         keyed = [(decl.key_for_partition(b.index), b) for b in buckets]
+        ckw = decl.compression_kwargs
+        compress = bool(ckw.get("compressor_type"))
         for pskey, b in keyed:
             nbytes = b.size * np.dtype(b.dtype).itemsize
-            self.backend.init_key(pskey, nbytes, b.dtype)
+            # tensors below the floor skip compression (reference:
+            # BYTEPS_MIN_COMPRESS_BYTES, operations.cc:362-364)
+            if compress and nbytes >= self.min_compress_bytes:
+                from ..ops.compression.host import create_host_chain
+                if pskey not in self._chains:
+                    self._chains[pskey] = create_host_chain(
+                        ckw, b.size, b.dtype)
+                self.backend.init_key(pskey, nbytes, b.dtype,
+                                      compression=ckw)
+            else:
+                self.backend.init_key(pskey, nbytes, b.dtype)
         plan = (decl_name, treedef, keyed)
         self._plans[key] = plan
         return plan
@@ -93,11 +112,23 @@ class PSGradientExchange:
             for s in b.segments:
                 buf[s.bucket_offset:s.bucket_offset + s.length] = \
                     flat[s.leaf_index][s.leaf_offset:s.leaf_offset + s.length]
-            self.backend.push(pskey, buf)
+            chain = self._chains.get(pskey)
+            if chain is not None:
+                # COMPRESS stage right before PUSH (reference:
+                # core_loops.cc:498-536): wire bytes are compressed; the
+                # server decompresses, dense-sums, recompresses the merge
+                self.backend.push_bytes(pskey, chain.compress(buf))
+            else:
+                self.backend.push(pskey, buf)
             bufs.append(buf)
         out = [f.copy() for f in flat]
         for (pskey, b), buf in zip(keyed, bufs):
-            self.backend.pull(pskey, buf, round=rnd)
+            chain = self._chains.get(pskey)
+            if chain is not None:
+                payload = self.backend.pull_bytes(pskey, round=rnd)
+                buf = chain.decompress(payload).astype(b.dtype)
+            else:
+                self.backend.pull(pskey, buf, round=rnd)
             for s in b.segments:
                 out[s.leaf_index][s.leaf_offset:s.leaf_offset + s.length] = \
                     buf[s.bucket_offset:s.bucket_offset + s.length]

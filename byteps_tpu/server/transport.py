@@ -11,7 +11,13 @@ binary frames:
 
 ops: 1=INIT (``nbytes`` = store size, payload = optional initial value),
 2=PUSH (payload = data), 3=PULL (``nbytes`` = expected size, no payload;
-response carries the merged buffer), 4=CLOSE. status: 0=OK, 1=error
+response carries the merged buffer), 4=CLOSE, 5=INIT_C (``nbytes`` =
+DENSE store size, payload = serialized compression kwargs — the server
+registers a codec for the key, reference server.cc:222-252), 6=PUSH_C
+(payload = compressed bytes; server decompresses then dense-sums),
+7=PULL_C (``nbytes`` unused/0 — the payload size is fixed by the key's
+codec; server recompresses the merged round once and serves identical
+bytes to every worker, reference server.cc:86-113). status: 0=OK, 1=error
 (backend rejected the request; the error response carries a UTF-8
 message as payload and the connection stays usable), 2=timeout.
 
@@ -39,6 +45,7 @@ _HDR = struct.Struct("!BQQQQQ8s")   # op, key, round, nbytes, timeout, plen, dty
 _RSP = struct.Struct("!BQ")
 
 OP_INIT, OP_PUSH, OP_PULL, OP_CLOSE = 1, 2, 3, 4
+OP_INIT_C, OP_PUSH_C, OP_PULL_C = 5, 6, 7
 ST_OK, ST_ERR, ST_TIMEOUT = 0, 1, 2
 
 
@@ -78,6 +85,8 @@ class PSTransportServer:
 
     def __init__(self, backend, host: str = "0.0.0.0", port: int = 0):
         self.backend = backend
+        from .compressed import CompressedKeyStore
+        self.compressed = CompressedKeyStore()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -119,6 +128,23 @@ class PSTransportServer:
                                   timeout_ms=int(timeout) or 30000)
                 conn.sendall(_RSP.pack(ST_OK, out.nbytes))
                 conn.sendall(out.data)          # zero-copy: contiguous
+            elif op == OP_INIT_C:
+                from ..ops.compression.host import deserialize_kwargs
+                kwargs = deserialize_kwargs(bytes(payload or b""))
+                size = nbytes // np.dtype(dtype).itemsize
+                self.compressed.register(key, kwargs, size, dtype)
+                self.backend.init_key(key, nbytes, dtype)
+                conn.sendall(_RSP.pack(ST_OK, 0))
+            elif op == OP_PUSH_C:
+                from .compressed import compressed_push
+                compressed_push(self.compressed, self.backend, key, payload)
+                conn.sendall(_RSP.pack(ST_OK, 0))
+            elif op == OP_PULL_C:
+                from .compressed import compressed_pull
+                buf = compressed_pull(self.compressed, self.backend, key,
+                                      int(rnd), int(timeout) or 30000)
+                conn.sendall(_RSP.pack(ST_OK, len(buf)))
+                conn.sendall(buf)
             else:
                 conn.sendall(_RSP.pack(ST_ERR, 0))
         except TimeoutError as e:
@@ -178,7 +204,7 @@ class RemotePSBackend:
 
     def _rpc(self, op: int, key: int, rnd: int, nbytes: int,
              timeout_ms: int, dtype: str, payload: Optional[memoryview],
-             pull_into: Optional[np.ndarray] = None) -> None:
+             pull_into: Optional[np.ndarray] = None) -> bytes:
         sock, lock = self._conn(key)
         with lock:
             _send_req(sock, op, key, rnd, nbytes, timeout_ms, dtype, payload)
@@ -194,9 +220,18 @@ class RemotePSBackend:
                 np.copyto(pull_into,
                           np.frombuffer(data, dtype=pull_into.dtype)
                           .reshape(pull_into.shape))
+                return b""          # dense pulls land in pull_into; don't
+                                    # re-copy megabytes for a discarded value
+            return bytes(data)
 
     def init_key(self, key: int, nbytes: int, dtype: str = "float32",
-                 init: Optional[np.ndarray] = None) -> None:
+                 init: Optional[np.ndarray] = None,
+                 compression: Optional[Dict[str, str]] = None) -> None:
+        if compression:
+            from ..ops.compression.host import serialize_kwargs
+            self._rpc(OP_INIT_C, key, 0, nbytes, 0, dtype,
+                      memoryview(serialize_kwargs(compression)))
+            return
         payload = (None if init is None else
                    memoryview(np.ascontiguousarray(init)).cast("B"))
         self._rpc(OP_INIT, key, 0, nbytes, 0, dtype, payload)
@@ -209,6 +244,17 @@ class RemotePSBackend:
              timeout_ms: int = 30000) -> None:
         self._rpc(OP_PULL, key, round, out.nbytes, timeout_ms,
                   str(out.dtype), None, pull_into=out)
+
+    def push_bytes(self, key: int, payload) -> None:
+        """Compressed push: ship the codec payload as-is; the server
+        decompresses and dense-sums (wire bytes stay compressed — the
+        bandwidth win the reference's inter-node compression is for)."""
+        self._rpc(OP_PUSH_C, key, 0, 0, 0, "uint8", memoryview(payload))
+
+    def pull_bytes(self, key: int, round: int = 0,
+                   timeout_ms: int = 30000) -> bytes:
+        return self._rpc(OP_PULL_C, key, round, 0, timeout_ms, "uint8",
+                         None)
 
     def push_pull(self, key: int, data: np.ndarray,
                   timeout_ms: int = 30000) -> np.ndarray:
