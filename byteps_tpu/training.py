@@ -14,11 +14,14 @@ whole point of the reference's pipeline.
 
 from __future__ import annotations
 
+import itertools
+import time
 from functools import partial
 from typing import Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -42,7 +45,11 @@ class DistributedTrainer:
         torch/__init__.py:83-113).
       reducer: collective strategy — plain psum by default, a compressing
         reducer from byteps_tpu.ops.compression otherwise.
+      name: stable tensor-declaration name for the PS exchange; defaults
+        to a per-process creation counter (identical across SPMD workers).
     """
+
+    _counter = itertools.count()
 
     def __init__(self, loss_fn: Callable, params, tx: optax.GradientTransformation,
                  mesh: Optional[Mesh] = None, partition_bytes: Optional[int] = None,
@@ -50,7 +57,7 @@ class DistributedTrainer:
                  reducer: Reducer = psum_reducer,
                  compression: Optional[dict] = None,
                  min_compress_bytes: Optional[int] = None,
-                 donate: bool = True) -> None:
+                 donate: bool = True, name: Optional[str] = None) -> None:
         if mesh is None:
             # a MirroredStrategy scope takes precedence over the global mesh
             from .strategy import current_strategy
@@ -68,6 +75,55 @@ class DistributedTrainer:
                                   if GlobalState.initialized() else 65536)
         self.mesh = mesh
         self.axes = data_axes(mesh)
+        self.backward_passes_per_step = backward_passes_per_step
+        # position-stable default name: every worker creates trainers in
+        # the same program order (SPMD), so the counter agrees across
+        # processes — pass an explicit ``name`` in elastic setups where a
+        # restarted worker would reset the counter
+        self._name = name or f"trainer{next(DistributedTrainer._counter)}"
+        gs = GlobalState._instance if GlobalState.initialized() else None
+        eng = gs.engine if gs is not None else None
+        self._ps_engine = (eng if eng is not None and
+                           getattr(eng, "ps_exchange", None) is not None
+                           else None)
+        if self._ps_engine is not None:
+            # PS deployment (BPS_ENABLE_PS, sync): the reference
+            # DistributedOptimizer split — framework computes grads, the
+            # push_pull hop syncs them across worker processes, the
+            # optimizer steps locally (torch/__init__.py:115-174). Here:
+            # jitted grad step with LOCAL-mesh pmean (the intra-node NCCL
+            # stage), host PS exchange (compressed when ``compression``
+            # kwargs are declared), jitted apply step. Accumulation for
+            # backward_passes_per_step happens host-side between sync
+            # boundaries, so no wire bandwidth is spent mid-window.
+            if reducer is not psum_reducer:
+                raise ValueError(
+                    "custom reducers run on the collective path and would "
+                    "be silently unused in PS mode — express lossy "
+                    "exchange via compression kwargs instead")
+            if compression:
+                gs.registry.declare(self._name, **compression)
+            # trainer-private exchange: same backend + registry (stable
+            # keys), but own plans/round counters and THIS trainer's
+            # partition/compression thresholds
+            from .server.ps_mode import PSGradientExchange
+            self._ps_exchange = PSGradientExchange(
+                gs.ps_backend, partition_bytes=partition_bytes,
+                registry=gs.registry, min_compress_bytes=min_compress_bytes)
+            self._ps_world = eng.ps_world
+            self.tx = tx          # plain inner optimizer: sync is the hop
+            replicated = NamedSharding(mesh, P())
+            self.params = jax.tree_util.tree_map(
+                lambda x: jax.device_put(jnp.array(x), replicated), params)
+            self._ostate_spec = P()
+            from .parallel.sharding import init_sharded_state
+            self.opt_state = init_sharded_state(self.tx, self.params,
+                                                self._ostate_spec, mesh)
+            self._loss_fn = loss_fn
+            self._grad_fn, self._apply_fn = self._build_ps_step(donate)
+            self._accum = None
+            self.step_count = 0
+            return
         # Size-1 data axes reduce to identity psums; dropping them skips the
         # whole bucket pack/unpack (pure HBM overhead on a single chip).
         # Lossy paths keep them — compression and custom reducers must see
@@ -127,6 +183,77 @@ class DistributedTrainer:
         donate_argnums = (0, 1) if donate else ()
         return jax.jit(shard_fn, donate_argnums=donate_argnums)
 
+    def _build_ps_step(self, donate: bool):
+        """Split step for PS deployments: grads and update are separate
+        XLA programs with the host exchange hop in between."""
+        axes, mesh, loss_fn, tx = self.axes, self.mesh, self._loss_fn, self.tx
+        batch_spec = P(axes) if axes else P()
+
+        def gstep(params, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            if axes:
+                # intra-worker stage (the reference's local NCCL reduce):
+                # grads leave this jit already averaged over the LOCAL mesh
+                grads = jax.lax.pmean(grads, axes)
+                loss = jax.lax.pmean(loss, axes)
+            return loss, grads
+
+        grad_fn = jax.jit(jax.shard_map(
+            gstep, mesh=mesh, in_specs=(P(), batch_spec),
+            out_specs=(P(), P()), check_vma=False))
+
+        def astep(params, opt_state, grads):
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        apply_fn = jax.jit(astep,
+                           donate_argnums=(0, 1) if donate else ())
+        return grad_fn, apply_fn
+
+    def _ps_step(self, batch) -> jnp.ndarray:
+        batch = self.shard_batch(batch)
+        loss, grads = self._grad_fn(self.params, batch)
+        k = self.backward_passes_per_step
+        i = self.step_count % k
+        self.step_count += 1
+        if k > 1:
+            # running mean over the window (matches optax.MultiSteps on
+            # the collective path); comm only at the sync boundary
+            host_g = jax.tree_util.tree_map(np.asarray, grads)
+            if i == 0:
+                self._accum = host_g
+            else:
+                self._accum = jax.tree_util.tree_map(
+                    lambda acc, g, n=i + 1: acc + (g - acc) / n,
+                    self._accum, host_g)
+            if i + 1 < k:
+                return loss
+            grads, self._accum = self._accum, None
+        # k==1 hands the jax arrays straight to exchange — it starts all
+        # copy_to_host_async transfers before reading any, so the D2H
+        # copies overlap instead of serializing per leaf
+        gs = GlobalState._instance
+        tl = gs.timeline if gs is not None else None
+        if tl is not None:
+            t0 = time.time()
+            jax.block_until_ready(grads)
+            tl.record(self._name, "REDUCE_WAIT", t0, time.time() - t0)
+            t0 = time.time()
+        summed = self._ps_exchange.exchange(grads, name=self._name)
+        if tl is not None:
+            tl.record(self._name, "PS_PUSH_PULL", t0, time.time() - t0)
+        if self._ps_world > 1:
+            summed = jax.tree_util.tree_map(
+                lambda x: x / self._ps_world, summed)
+        rep = NamedSharding(self.mesh, P())
+        gdev = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, rep), summed)
+        self.params, self.opt_state = self._apply_fn(
+            self.params, self.opt_state, gdev)
+        if tl is not None:
+            tl.set_step(self.step_count)
+        return loss
+
     def shard_batch(self, batch):
         """Place a host batch onto the mesh, split along the data axes."""
         from .data import shard_batch
@@ -134,6 +261,8 @@ class DistributedTrainer:
 
     def step(self, batch) -> jnp.ndarray:
         """One training step on a (host or device) global batch; returns loss."""
+        if self._ps_engine is not None:
+            return self._ps_step(batch)
         batch = self.shard_batch(batch)
         self.params, self.opt_state, loss = self._step_fn(
             self.params, self.opt_state, batch)
