@@ -30,7 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from .transformer import (TransformerConfig, _attention, _layernorm)
+from .transformer import (TransformerConfig, _attention, _layernorm,
+                          embed_lookup)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -195,7 +196,7 @@ def moe_apply(params, cfg: MoEConfig, tokens: jnp.ndarray,
             offset = 0
         positions = offset + jnp.arange(s)
     tp_size = jax.lax.axis_size(cfg.tp_axis) if cfg.tp_axis else 1
-    x = params["embed"]["tok"][tokens].astype(dt)
+    x = embed_lookup(params["embed"]["tok"], tokens).astype(dt)
     x = x + params["embed"]["pos"][positions].astype(dt)
 
     blk_fn = partial(_moe_block, cfg=cfg, tp_size=tp_size)

@@ -289,7 +289,13 @@ def _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
     out, lse = _flash_fwd(qt, kt, vt, causal, scale, bq, bk, interpret)
     # store lse as [b,h,s]: a trailing dim of 1 lane-pads to 128 on TPU,
     # bloating the saved residual 128x when it survives to the backward
-    return jnp.swapaxes(out, 1, 2), (qt, kt, vt, out, lse[..., 0])
+    from jax.ad_checkpoint import checkpoint_name
+    # named so a remat policy can pin the flash residuals while everything
+    # around them recomputes (remat_policy="save_attn"); name the SQUEEZED
+    # lse — pinning the [b,h,s,1] form would lane-pad 128x (comment above)
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse[..., 0], "flash_lse")
+    return jnp.swapaxes(out, 1, 2), (qt, kt, vt, out, lse)
 
 
 def _vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):

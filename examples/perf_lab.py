@@ -23,7 +23,8 @@ from bench import mlm_setup, time_plain_steps
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--remat", default="full",
-                    choices=["full", "none", "dots", "mlp_only"])
+                    choices=["full", "none", "dots", "mlp_only",
+                             "save_attn"])
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--seq", type=int, default=512)
     ap.add_argument("--iters", type=int, default=5)
@@ -39,7 +40,8 @@ def main() -> None:
     cfg = bert.bert_large(max_seq=args.seq)
     cfg = dataclasses.replace(
         cfg, remat=args.remat != "none",
-        remat_policy=args.remat if args.remat in ("dots", "mlp_only")
+        remat_policy=args.remat
+        if args.remat in ("dots", "mlp_only", "save_attn")
         else None, scan_unroll=args.unroll)
 
     if args.block_q or args.block_k:

@@ -199,7 +199,7 @@ class TestMLMGatheredHead:
         assert float(loss) == 0.0
 
 
-@pytest.mark.parametrize("policy", [None, "dots", "mlp_only"])
+@pytest.mark.parametrize("policy", [None, "dots", "mlp_only", "save_attn"])
 def test_remat_policies_match_no_remat(policy):
     """Every remat_policy computes the same function as remat=False."""
     import dataclasses
