@@ -141,13 +141,24 @@ class AsyncPSWorker:
     weight pull, no inter-worker barrier."""
 
     def __init__(self, backend: HostPSBackend, params, name: str = "model",
-                 init_store: bool = True) -> None:
+                 init_store: bool = True,
+                 registry: Optional[NameRegistry] = None) -> None:
         self.backend = backend
         leaves, self.treedef = jax.tree_util.tree_flatten(params)
         self.shapes = [l.shape for l in leaves]
         self.dtypes = [str(np.dtype(l.dtype)) for l in leaves]
         self.sizes = [int(np.prod(l.shape)) for l in leaves]
-        self.keys = list(range(len(leaves)))
+        if registry is not None:
+            # registry-assigned key space (declared_key<<16 | i) so several
+            # async workers / other declared tensors never collide on PS
+            # keys; the legacy bare range stays for single-model scripts
+            decl = (registry.get(name)
+                    if name in registry.declared_names()
+                    else registry.declare(name))
+            self.keys = [decl.key_for_partition(i)
+                         for i in range(len(leaves))]
+        else:
+            self.keys = list(range(len(leaves)))
         if init_store:
             for k, l in zip(self.keys, leaves):
                 arr = np.ascontiguousarray(np.asarray(l).reshape(-1))
@@ -169,3 +180,14 @@ class AsyncPSWorker:
         for k, nw, od in zip(self.keys, new_l, old_l):
             delta = np.asarray(nw).reshape(-1) - np.asarray(od).reshape(-1)
             self.backend.push(k, np.ascontiguousarray(delta))
+
+    def push_delta_tree(self, delta):
+        """Push pre-computed deltas (e.g. produced on-device inside the
+        jitted step, so the subtraction fuses and only ONE tree crosses
+        D2H instead of two)."""
+        for k, d in zip(self.keys, jax.tree_util.tree_leaves(delta)):
+            if hasattr(d, "copy_to_host_async"):
+                d.copy_to_host_async()
+        for k, d in zip(self.keys, jax.tree_util.tree_leaves(delta)):
+            self.backend.push(
+                k, np.ascontiguousarray(np.asarray(d).reshape(-1)))

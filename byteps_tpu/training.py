@@ -86,6 +86,47 @@ class DistributedTrainer:
         self._ps_engine = (eng if eng is not None and
                            getattr(eng, "ps_exchange", None) is not None
                            else None)
+        self._async_worker = None
+        if (gs is not None and gs.ps_backend is not None
+                and getattr(gs.ps_backend, "async_mode", False)):
+            # Async-PS (BPS_ENABLE_ASYNC): the reference async
+            # DistributedOptimizer — each worker steps its LOCAL optimizer,
+            # pushes the weight DELTA, and pulls fresh global weights, with
+            # no inter-worker barrier (torch/__init__.py:186-214,
+            # server.cc:310-314). Optimizer state stays worker-local.
+            if reducer is not psum_reducer:
+                raise ValueError(
+                    "custom reducers run on the collective path and would "
+                    "be silently unused in async-PS mode")
+            if compression:
+                raise ValueError(
+                    "compression is not supported in async-PS mode (the "
+                    "reference's async server folds raw weight deltas, "
+                    "server.cc:310-314) — drop BPS_ENABLE_ASYNC or the "
+                    "compression kwargs")
+            self.tx = tx
+            replicated = NamedSharding(mesh, P())
+            self.params = jax.tree_util.tree_map(
+                lambda x: jax.device_put(jnp.array(x), replicated), params)
+            self._ostate_spec = P()
+            from .parallel.sharding import init_sharded_state
+            self.opt_state = init_sharded_state(self.tx, self.params,
+                                                self._ostate_spec, mesh)
+            self._loss_fn = loss_fn
+            self._grad_fn, self._apply_fn = self._build_ps_step(donate=False)
+            from .server.ps_mode import AsyncPSWorker
+            # server-side init is idempotent (first init allocates, later
+            # inits are no-ops — NOT a rendezvous), so every worker seeds
+            # with the same initial values and proceeds immediately
+            self._async_worker = AsyncPSWorker(gs.ps_backend, self.params,
+                                               name=self._name,
+                                               init_store=True,
+                                               registry=gs.registry)
+            self._delta_fn = jax.jit(lambda new, old: jax.tree_util.tree_map(
+                jnp.subtract, new, old))
+            self._accum = None
+            self.step_count = 0
+            return
         if self._ps_engine is not None:
             # PS deployment (BPS_ENABLE_PS, sync): the reference
             # DistributedOptimizer split — framework computes grads, the
@@ -210,25 +251,34 @@ class DistributedTrainer:
                            donate_argnums=(0, 1) if donate else ())
         return grad_fn, apply_fn
 
-    def _ps_step(self, batch) -> jnp.ndarray:
-        batch = self.shard_batch(batch)
-        loss, grads = self._grad_fn(self.params, batch)
+    def _accumulate(self, grads):
+        """Host-side running mean over the backward_passes_per_step window
+        (matches optax.MultiSteps on the collective path). Returns None
+        mid-window — no comm, no update — and the accumulated grads at
+        the sync boundary. Increments step_count."""
         k = self.backward_passes_per_step
         i = self.step_count % k
         self.step_count += 1
-        if k > 1:
-            # running mean over the window (matches optax.MultiSteps on
-            # the collective path); comm only at the sync boundary
-            host_g = jax.tree_util.tree_map(np.asarray, grads)
-            if i == 0:
-                self._accum = host_g
-            else:
-                self._accum = jax.tree_util.tree_map(
-                    lambda acc, g, n=i + 1: acc + (g - acc) / n,
-                    self._accum, host_g)
-            if i + 1 < k:
-                return loss
-            grads, self._accum = self._accum, None
+        if k == 1:
+            return grads
+        host_g = jax.tree_util.tree_map(np.asarray, grads)
+        if i == 0:
+            self._accum = host_g
+        else:
+            self._accum = jax.tree_util.tree_map(
+                lambda acc, g, n=i + 1: acc + (g - acc) / n,
+                self._accum, host_g)
+        if i + 1 < k:
+            return None
+        out, self._accum = self._accum, None
+        return out
+
+    def _ps_step(self, batch) -> jnp.ndarray:
+        batch = self.shard_batch(batch)
+        loss, grads = self._grad_fn(self.params, batch)
+        grads = self._accumulate(grads)
+        if grads is None:
+            return loss
         # k==1 hands the jax arrays straight to exchange — it starts all
         # copy_to_host_async transfers before reading any, so the D2H
         # copies overlap instead of serializing per leaf
@@ -254,6 +304,37 @@ class DistributedTrainer:
             tl.set_step(self.step_count)
         return loss
 
+    def _async_ps_step(self, batch) -> jnp.ndarray:
+        """Async-PS step: local grads → local optimizer step → push the
+        weight delta → pull fresh global weights. No worker barrier; the
+        server folds deltas into the store as they arrive."""
+        batch = self.shard_batch(batch)
+        loss, grads = self._grad_fn(self.params, batch)
+        acc = self._accumulate(grads)
+        if acc is None:
+            return loss
+        if acc is not grads:     # host accumulation: back onto the mesh
+            rep = NamedSharding(self.mesh, P())
+            acc = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, rep), acc)
+        new_params, self.opt_state = self._apply_fn(
+            self.params, self.opt_state, acc)
+        gs = GlobalState._instance
+        tl = gs.timeline if gs is not None else None
+        t0 = time.time() if tl is not None else 0.0
+        # delta computed on-device (fused subtract, one tree over D2H)
+        self._async_worker.push_delta_tree(
+            self._delta_fn(new_params, self.params))
+        fresh = self._async_worker.pull_weights()
+        if tl is not None:
+            tl.record(self._name, "ASYNC_PS_PUSH_PULL", t0,
+                      time.time() - t0)
+            tl.set_step(self.step_count)
+        rep = NamedSharding(self.mesh, P())
+        self.params = jax.tree_util.tree_map(
+            lambda x: jax.device_put(np.asarray(x), rep), fresh)
+        return loss
+
     def shard_batch(self, batch):
         """Place a host batch onto the mesh, split along the data axes."""
         from .data import shard_batch
@@ -261,6 +342,8 @@ class DistributedTrainer:
 
     def step(self, batch) -> jnp.ndarray:
         """One training step on a (host or device) global batch; returns loss."""
+        if self._async_worker is not None:
+            return self._async_ps_step(batch)
         if self._ps_engine is not None:
             return self._ps_step(batch)
         batch = self.shard_batch(batch)

@@ -30,16 +30,22 @@ def main():
 
     tr = DistributedTrainer(loss_fn, {"w": np.zeros((8, 1), np.float32)},
                             optax.sgd(0.1))
-    assert tr._ps_engine is not None, "PS path not active"
+    async_mode = os.environ.get("BPS_ENABLE_ASYNC") == "1"
+    if async_mode:
+        assert tr._async_worker is not None, "async-PS path not active"
+    else:
+        assert tr._ps_engine is not None, "PS path not active"
     rng = np.random.RandomState(10 + wid)   # each worker: own data shard
     for _ in range(steps):
         x = rng.randn(64, 8).astype(np.float32)
         tr.step((x, x @ W))
     final = np.asarray(jax.tree_util.tree_leaves(tr.params)[0])
     err = float(np.abs(final - W).max())
-    assert err < 0.05, f"worker {wid} did not converge: {err}"
-    # both workers applied IDENTICAL averaged grads every step, so params
-    # must agree bit-for-bit; print a digest the parent compares
+    tol = 0.1 if async_mode else 0.05   # async: stale-delta noise
+    assert err < tol, f"worker {wid} did not converge: {err}"
+    # sync mode: both workers applied IDENTICAL averaged grads every step,
+    # so params agree bit-for-bit (parent compares digests); async mode
+    # has no such guarantee
     print(f"PS_TRAINER_OK wid={wid} digest={final.tobytes().hex()[:32]}")
     bps.shutdown()
 
