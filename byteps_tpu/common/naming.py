@@ -124,3 +124,20 @@ def place_key(key: int, num_servers: int, hash_fn: str = "djb2") -> int:
         raise ValueError(f"unknown BPS_KEY_HASH_FN {hash_fn!r}; "
                          f"choose from {sorted(HASH_FNS)}") from None
     return fn(key, num_servers)
+
+
+def log_key_placement(key: int, nbytes: int, shard: int,
+                      shard_bytes: dict, hash_fn: str) -> None:
+    """Record + log one key's server placement with per-server load
+    percentages (reference: global.cc:660-667 prints the accumulated
+    load share of every server as each key is assigned)."""
+    from .logging import get_logger
+    shard_bytes[shard] = shard_bytes.get(shard, 0) + int(nbytes)
+    log = get_logger()
+    if not log.isEnabledFor(10):        # DEBUG — skip the formatting cost
+        return
+    total = sum(shard_bytes.values()) or 1
+    loads = ", ".join(f"s{i}={100.0 * b / total:.0f}%"
+                      for i, b in sorted(shard_bytes.items()))
+    log.debug("PS key %d (%d B) -> server %d (%s hash); load: %s",
+              key, nbytes, shard, hash_fn, loads)

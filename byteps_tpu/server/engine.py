@@ -154,6 +154,8 @@ class HostPSBackend:
         self.hash_fn = hash_fn
         self.async_mode = async_mode
         self._rounds: Dict[int, int] = {}
+        self._shard_bytes: Dict[int, int] = {}
+        self._placed: set = set()
         from .compressed import CompressedKeyStore
         self.compressed = CompressedKeyStore()
 
@@ -161,9 +163,12 @@ class HostPSBackend:
         for s in self.servers:
             s.close()
 
-    def _shard(self, key: int) -> PSServer:
+    def _shard_index(self, key: int) -> int:
         from ..common.naming import place_key
-        return self.servers[place_key(key, len(self.servers), self.hash_fn)]
+        return place_key(key, len(self.servers), self.hash_fn)
+
+    def _shard(self, key: int) -> PSServer:
+        return self.servers[self._shard_index(key)]
 
     def init_key(self, key: int, nbytes: int, dtype: str = "float32",
                  init: Optional[np.ndarray] = None,
@@ -175,6 +180,11 @@ class HostPSBackend:
             size = nbytes // np.dtype(dtype).itemsize
             self.compressed.register(key, compression, size, dtype)
         self._shard(key).init_key(key, nbytes, dtype, init)
+        if key not in self._placed:      # re-inits are no-ops server-side;
+            self._placed.add(key)        # don't double-count the load stats
+            from ..common.naming import log_key_placement
+            log_key_placement(key, nbytes, self._shard_index(key),
+                              self._shard_bytes, self.hash_fn)
 
     def push(self, key: int, data: np.ndarray) -> None:
         self._shard(key).push(key, data)

@@ -152,9 +152,7 @@ class AsyncPSWorker:
             # registry-assigned key space (declared_key<<16 | i) so several
             # async workers / other declared tensors never collide on PS
             # keys; the legacy bare range stays for single-model scripts
-            decl = (registry.get(name)
-                    if name in registry.declared_names()
-                    else registry.declare(name))
+            decl = registry.declare(name)    # idempotent per name
             self.keys = [decl.key_for_partition(i)
                          for i in range(len(leaves))]
         else:

@@ -87,6 +87,11 @@ class PSTransportServer:
         self.backend = backend
         from .compressed import CompressedKeyStore
         self.compressed = CompressedKeyStore()
+        # per-key traffic log (reference: PS_KEY_LOG on the server,
+        # server.cc:408-409)
+        import os as _os
+        self._key_log = _os.environ.get(
+            "BPS_KEY_LOG", _os.environ.get("PS_KEY_LOG", "")) in ("1", "true")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -113,6 +118,13 @@ class PSTransportServer:
         (the connection survives — one bad request must not take down the
         worker's whole data plane)."""
         try:
+            if self._key_log and op in (OP_PUSH, OP_PULL, OP_PUSH_C):
+                # OP_PULL_C logs in its branch — its size is the codec
+                # payload, known only after the pull
+                from ..common.logging import get_logger
+                get_logger().info("PS_KEY_LOG op=%d key=%d bytes=%d rnd=%d",
+                                  op, key,
+                                  len(payload) if payload else nbytes, rnd)
             if op == OP_INIT:
                 init = (np.frombuffer(payload, dtype=dtype)
                         if payload is not None else None)
@@ -143,6 +155,11 @@ class PSTransportServer:
                 from .compressed import compressed_pull
                 buf = compressed_pull(self.compressed, self.backend, key,
                                       int(rnd), int(timeout) or 30000)
+                if self._key_log:
+                    from ..common.logging import get_logger
+                    get_logger().info(
+                        "PS_KEY_LOG op=%d key=%d bytes=%d rnd=%d",
+                        op, key, len(buf), rnd)
                 conn.sendall(_RSP.pack(ST_OK, len(buf)))
                 conn.sendall(buf)
             else:
@@ -191,6 +208,8 @@ class RemotePSBackend:
         self.hash_fn = hash_fn
         self.async_mode = async_mode
         self._rounds: Dict[int, int] = {}
+        self._shard_bytes: Dict[int, int] = {}
+        self._placed: set = set()
         for addr in addrs:
             host, port = addr.rsplit(":", 1)
             s = socket.create_connection((host, int(port)))
@@ -231,10 +250,18 @@ class RemotePSBackend:
             from ..ops.compression.host import serialize_kwargs
             self._rpc(OP_INIT_C, key, 0, nbytes, 0, dtype,
                       memoryview(serialize_kwargs(compression)))
-            return
-        payload = (None if init is None else
-                   memoryview(np.ascontiguousarray(init)).cast("B"))
-        self._rpc(OP_INIT, key, 0, nbytes, 0, dtype, payload)
+        else:
+            payload = (None if init is None else
+                       memoryview(np.ascontiguousarray(init)).cast("B"))
+            self._rpc(OP_INIT, key, 0, nbytes, 0, dtype, payload)
+        # count only after the server accepted, once per key (re-inits are
+        # no-ops server-side — don't skew the load stats)
+        if key not in self._placed:
+            self._placed.add(key)
+            from ..common.naming import log_key_placement, place_key
+            log_key_placement(key, nbytes,
+                              place_key(key, len(self._socks), self.hash_fn),
+                              self._shard_bytes, self.hash_fn)
 
     def push(self, key: int, data: np.ndarray) -> None:
         self._rpc(OP_PUSH, key, 0, 0, 0, str(data.dtype),

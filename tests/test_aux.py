@@ -91,3 +91,72 @@ def test_declared_priority_changes_bucket_order(mesh8):
     first_bucket = progs[0][2]
     specs_in_first = {s.leaf_index for s in first_bucket.segments}
     assert 0 in specs_in_first
+
+
+def _capture_bps_logs():
+    """The package logger doesn't propagate to root (own handler), so
+    caplog can't see it — attach a list handler directly."""
+    import logging
+
+    from byteps_tpu.common.logging import get_logger
+
+    class _H(logging.Handler):
+        def __init__(self):
+            super().__init__()
+            self.msgs = []
+
+        def emit(self, r):
+            self.msgs.append(r.getMessage())
+
+    h = _H()
+    get_logger().addHandler(h)
+    return h
+
+
+def test_key_placement_load_logging():
+    """Placement logging mirrors the reference's per-key server-load
+    lines (global.cc:660-667): running byte share per shard."""
+    import logging
+
+    from byteps_tpu.common.logging import get_logger
+    from byteps_tpu.common.naming import log_key_placement
+
+    sb = {}
+    h = _capture_bps_logs()
+    prev = get_logger().level
+    get_logger().setLevel(logging.DEBUG)
+    try:
+        log_key_placement(65536, 1024, 0, sb, "djb2")
+        log_key_placement(65537, 3072, 1, sb, "djb2")
+    finally:
+        get_logger().setLevel(prev)
+        get_logger().removeHandler(h)
+    assert sb == {0: 1024, 1: 3072}
+    assert any("server 1" in m and "s0=25%" in m and "s1=75%" in m
+               for m in h.msgs)
+
+
+def test_server_key_traffic_logging(monkeypatch):
+    """BPS_KEY_LOG=1 logs every push/pull with key and byte count on the
+    transport server (reference: PS_KEY_LOG, server.cc:408-409)."""
+    from byteps_tpu.common.logging import get_logger
+    from byteps_tpu.server.engine import PSServer
+    from byteps_tpu.server.transport import PSTransportServer, RemotePSBackend
+
+    monkeypatch.setenv("BPS_KEY_LOG", "1")
+    h = _capture_bps_logs()
+    be = PSServer(num_workers=1, engine_threads=1)
+    srv = PSTransportServer(be, host="127.0.0.1")
+    try:
+        w = RemotePSBackend([f"127.0.0.1:{srv.port}"])
+        x = np.ones(16, np.float32)
+        w.init_key(3, x.nbytes)
+        w.push_pull(3, x)
+        w.close()
+    finally:
+        srv.close()
+        be.close()
+        get_logger().removeHandler(h)
+    msgs = [m for m in h.msgs if "PS_KEY_LOG" in m]
+    assert any("op=2 key=3 bytes=64" in m for m in msgs)   # push
+    assert any("op=3 key=3" in m for m in msgs)            # pull
