@@ -112,6 +112,40 @@ def push_pull_async(tree, average: bool = True,
                                                     name=name)
 
 
+def push_pull_rowsparse(indices, rows, num_rows: int,
+                        average: bool = False,
+                        name: str = "rowsparse"):
+    """Row-sparse push_pull: each worker pushes only the touched
+    (row index, row value) pairs of a [num_rows, cols] table; returns
+    the dense summed table. Duplicate indices within a push sum
+    (scatter-add). The reference RESERVED this request type
+    (kRowSparsePushPull, common.h:267-271) but shipped no handler —
+    here it rides the PS path (BPS_ENABLE_PS=1, sync mode), where the
+    server scatters each worker's rows into the dense store and the
+    engine merges. Distinct tables need distinct ``name``s."""
+    gs = GlobalState.get()
+    eng = gs.engine
+    if eng.ps_exchange is None:
+        if gs.ps_backend is not None:
+            raise NotImplementedError(
+                "row-sparse push_pull needs SYNC PS mode — drop "
+                "BPS_ENABLE_ASYNC (the async store folds weight deltas, "
+                "not per-round gradient merges)")
+        raise NotImplementedError(
+            "row-sparse push_pull rides the PS path — run with "
+            "BPS_ENABLE_PS=1 (sync mode); the collective path has no "
+            "sparse win (XLA psum is dense)")
+    rsx = getattr(eng, "_rs_exchange", None)
+    if rsx is None:
+        from .server.ps_mode import RowSparseExchange
+        rsx = eng._rs_exchange = RowSparseExchange(gs.ps_backend,
+                                                   gs.registry)
+    out = rsx.exchange(indices, rows, num_rows, name)
+    if average and eng.ps_world > 1:
+        out = out / eng.ps_world
+    return out
+
+
 def poll(handle: int) -> bool:
     """True once the handle's reduction has completed on device."""
     return GlobalState.get().engine.poll(handle)
@@ -189,7 +223,7 @@ def __getattr__(name):
 __all__ = [
     "init", "shutdown", "suspend", "resume", "rank", "size", "local_rank",
     "local_size", "declare_tensor", "push_pull", "push_pull_async",
-    "poll", "synchronize", "broadcast_parameters",
+    "push_pull_rowsparse", "poll", "synchronize", "broadcast_parameters",
     "broadcast_optimizer_state", "get_pushpull_speed",
     "DistributedOptimizer", "DistributedTrainer", "MirroredStrategy",
     "DistributedDataParallel", "DistributedGradientTape", "Compression",

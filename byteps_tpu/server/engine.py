@@ -156,6 +156,7 @@ class HostPSBackend:
         self._rounds: Dict[int, int] = {}
         self._shard_bytes: Dict[int, int] = {}
         self._placed: set = set()
+        self._rs_cols: Dict[int, int] = {}   # row-sparse: pinned cols/key
         from .compressed import CompressedKeyStore
         self.compressed = CompressedKeyStore()
 
@@ -206,6 +207,16 @@ class HostPSBackend:
         from .compressed import compressed_pull
         return compressed_pull(self.compressed, self._shard(key), key,
                                round, timeout_ms)
+
+    def push_rowsparse(self, key: int, idx, rows, dense_nbytes: int,
+                       dtype=None) -> None:
+        """Row-sparse push: only touched rows cross into the store; the
+        server scatters to dense before the engine sums (reference:
+        reserved kRowSparsePushPull, common.h:267-271 — unimplemented
+        there). dtype defaults to the rows array's own dtype."""
+        from .rowsparse import rowsparse_push
+        rowsparse_push(self._shard(key), key, idx, rows, dense_nbytes,
+                       dtype, meta=self._rs_cols)
 
     def push_pull(self, key: int, data: np.ndarray,
                   timeout_ms: int = 30000) -> np.ndarray:

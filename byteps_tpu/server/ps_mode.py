@@ -189,3 +189,41 @@ class AsyncPSWorker:
         for k, d in zip(self.keys, jax.tree_util.tree_leaves(delta)):
             self.backend.push(
                 k, np.ascontiguousarray(np.asarray(d).reshape(-1)))
+
+
+class RowSparseExchange:
+    """Sync row-sparse exchange: push touched (idx, rows), pull the dense
+    merged table (reference: reserved kRowSparsePushPull,
+    common.h:267-271 — no handler existed there; here it is the PS
+    path's native sparse mode, implemented for embedding-style grads)."""
+
+    def __init__(self, backend: HostPSBackend,
+                 registry: Optional[NameRegistry] = None) -> None:
+        self.backend = backend
+        self.registry = registry or NameRegistry()
+        self._inited: Dict[int, tuple] = {}     # key -> (num_rows, cols)
+        self._rounds: Dict[int, int] = {}
+
+    def exchange(self, idx, rows, num_rows: int, name: str) -> np.ndarray:
+        """One sync round; returns the dense [num_rows, cols] sum across
+        workers. Distinct tables need distinct names (one PS key each)."""
+        idx = np.asarray(idx, np.int32).reshape(-1)
+        rows = np.asarray(rows)
+        if rows.ndim != 2:
+            raise ValueError(f"rows must be [n, cols]; got {rows.shape}")
+        cols, dtype = rows.shape[1], str(rows.dtype)
+        key = self.registry.declare(name).key_for_partition(0)
+        dense_nbytes = num_rows * cols * rows.dtype.itemsize
+        prev = self._inited.get(key)
+        if prev is None:
+            self.backend.init_key(key, dense_nbytes, dtype)
+            self._inited[key] = (num_rows, cols)
+        elif prev != (num_rows, cols):
+            raise ValueError(f"table {name!r} was {prev}, now "
+                             f"{(num_rows, cols)} — shape must be stable")
+        self.backend.push_rowsparse(key, idx, rows, dense_nbytes, dtype)
+        rnd = self._rounds.get(key, 0) + 1
+        self._rounds[key] = rnd
+        out = np.empty(num_rows * cols, rows.dtype)
+        self.backend.pull(key, out, round=rnd)
+        return out.reshape(num_rows, cols)

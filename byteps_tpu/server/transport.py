@@ -17,7 +17,11 @@ registers a codec for the key, reference server.cc:222-252), 6=PUSH_C
 (payload = compressed bytes; server decompresses then dense-sums),
 7=PULL_C (``nbytes`` unused/0 — the payload size is fixed by the key's
 codec; server recompresses the merged round once and serves identical
-bytes to every worker, reference server.cc:86-113). status: 0=OK, 1=error
+bytes to every worker, reference server.cc:86-113), 8=PUSH_RS
+(row-sparse push: ``nbytes`` = DENSE table byte size, payload =
+``n|idx|rows`` per server/rowsparse.py; server scatters to dense then
+engine-sums — the reference's reserved-but-unimplemented
+kRowSparsePushPull). status: 0=OK, 1=error
 (backend rejected the request; the error response carries a UTF-8
 message as payload and the connection stays usable), 2=timeout.
 
@@ -46,6 +50,8 @@ _RSP = struct.Struct("!BQ")
 
 OP_INIT, OP_PUSH, OP_PULL, OP_CLOSE = 1, 2, 3, 4
 OP_INIT_C, OP_PUSH_C, OP_PULL_C = 5, 6, 7
+OP_PUSH_RS = 8   # row-sparse push: nbytes = DENSE table size, payload =
+                 # n|idx|rows (server/rowsparse.py wire format)
 ST_OK, ST_ERR, ST_TIMEOUT = 0, 1, 2
 
 
@@ -92,6 +98,7 @@ class PSTransportServer:
         import os as _os
         self._key_log = _os.environ.get(
             "BPS_KEY_LOG", _os.environ.get("PS_KEY_LOG", "")) in ("1", "true")
+        self._rs_cols: Dict[int, int] = {}   # row-sparse: pinned cols/key
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -118,7 +125,8 @@ class PSTransportServer:
         (the connection survives — one bad request must not take down the
         worker's whole data plane)."""
         try:
-            if self._key_log and op in (OP_PUSH, OP_PULL, OP_PUSH_C):
+            if self._key_log and op in (OP_PUSH, OP_PULL, OP_PUSH_C,
+                                        OP_PUSH_RS):
                 # OP_PULL_C logs in its branch — its size is the codec
                 # payload, known only after the pull
                 from ..common.logging import get_logger
@@ -150,6 +158,12 @@ class PSTransportServer:
             elif op == OP_PUSH_C:
                 from .compressed import compressed_push
                 compressed_push(self.compressed, self.backend, key, payload)
+                conn.sendall(_RSP.pack(ST_OK, 0))
+            elif op == OP_PUSH_RS:
+                from .rowsparse import rowsparse_push, unpack_rows
+                idx, rows = unpack_rows(payload, dtype)
+                rowsparse_push(self.backend, key, idx, rows, int(nbytes),
+                               dtype, meta=self._rs_cols)
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PULL_C:
                 from .compressed import compressed_pull
@@ -277,6 +291,17 @@ class RemotePSBackend:
         decompresses and dense-sums (wire bytes stay compressed — the
         bandwidth win the reference's inter-node compression is for)."""
         self._rpc(OP_PUSH_C, key, 0, 0, 0, "uint8", memoryview(payload))
+
+    def push_rowsparse(self, key: int, idx, rows, dense_nbytes: int,
+                      dtype=None) -> None:
+        """Row-sparse push: only the touched rows cross the wire. dtype
+        defaults to the rows array's own dtype (mis-declaring it would
+        reinterpret the bytes server-side)."""
+        from .rowsparse import pack_rows
+        if dtype is None:
+            dtype = str(np.asarray(rows).dtype)
+        self._rpc(OP_PUSH_RS, key, 0, dense_nbytes, 0, dtype,
+                  memoryview(pack_rows(idx, rows)))
 
     def pull_bytes(self, key: int, round: int = 0,
                    timeout_ms: int = 30000) -> bytes:

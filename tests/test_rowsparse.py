@@ -1,0 +1,158 @@
+"""Row-sparse push_pull (reference: RESERVED kRowSparsePushPull,
+common.h:267-271 — no handler existed; implemented here on the PS
+path: sparse push, server-side scatter into the dense store, engine
+merge, dense pull)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import byteps_tpu as bps
+from byteps_tpu.server.engine import HostPSBackend, PSServer
+from byteps_tpu.server.rowsparse import (pack_rows, scatter_dense,
+                                         unpack_rows)
+from byteps_tpu.server.transport import PSTransportServer, RemotePSBackend
+
+ROWS, COLS = 50, 8
+
+
+def test_pack_unpack_roundtrip():
+    idx = np.array([3, 7, 3, 49], np.int32)
+    rows = np.random.RandomState(0).randn(4, COLS).astype(np.float32)
+    i2, r2 = unpack_rows(pack_rows(idx, rows), "float32")
+    np.testing.assert_array_equal(i2, idx)
+    np.testing.assert_array_equal(r2, rows)
+    # empty push
+    i0, r0 = unpack_rows(pack_rows(np.zeros(0, np.int32),
+                                   np.zeros((0, COLS), np.float32)),
+                         "float32")
+    assert i0.size == 0 and r0.size == 0
+
+
+def test_scatter_dense_duplicates_sum():
+    idx = np.array([1, 1, 2], np.int32)
+    rows = np.ones((3, COLS), np.float32)
+    d = scatter_dense(idx, rows, ROWS, "float32")
+    np.testing.assert_allclose(d[1], 2.0)
+    np.testing.assert_allclose(d[2], 1.0)
+    assert d[0].sum() == 0 and d.shape == (ROWS, COLS)
+
+
+def test_backend_two_worker_rowsparse_sum():
+    """Two sparse pushes merge like scatter-adds into one dense table."""
+    be = HostPSBackend(num_servers=1, num_workers=2, engine_threads=1)
+    try:
+        nbytes = ROWS * COLS * 4
+        be.init_key(5, nbytes, "float32")
+        ra = np.random.RandomState(1).randn(3, COLS).astype(np.float32)
+        rb = np.random.RandomState(2).randn(2, COLS).astype(np.float32)
+        ia = np.array([0, 10, 10], np.int32)   # duplicate within a push
+        ib = np.array([10, 49], np.int32)
+        be.push_rowsparse(5, ia, ra, nbytes)
+        be.push_rowsparse(5, ib, rb, nbytes)
+        out = np.empty(ROWS * COLS, np.float32)
+        be.pull(5, out, round=1)
+        want = scatter_dense(ia, ra, ROWS, "float32") + \
+            scatter_dense(ib, rb, ROWS, "float32")
+        np.testing.assert_allclose(out.reshape(ROWS, COLS), want, rtol=1e-6)
+    finally:
+        be.close()
+
+
+def test_transport_rowsparse_and_index_validation():
+    be = PSServer(num_workers=1, engine_threads=1)
+    srv = PSTransportServer(be, host="127.0.0.1")
+    try:
+        w = RemotePSBackend([f"127.0.0.1:{srv.port}"])
+        nbytes = ROWS * COLS * 4
+        w.init_key(9, nbytes, "float32")
+        rows = np.full((2, COLS), 3.0, np.float32)
+        w.push_rowsparse(9, np.array([4, 8], np.int32), rows, nbytes)
+        out = np.empty(ROWS * COLS, np.float32)
+        w.pull(9, out, round=1)
+        dense = out.reshape(ROWS, COLS)
+        np.testing.assert_allclose(dense[4], 3.0)
+        np.testing.assert_allclose(dense[8], 3.0)
+        assert abs(dense.sum() - 2 * COLS * 3.0) < 1e-4
+        # out-of-range index is rejected, connection survives
+        with pytest.raises(RuntimeError, match="out of range"):
+            w.push_rowsparse(9, np.array([ROWS], np.int32),
+                             np.ones((1, COLS), np.float32), nbytes)
+        w.push_rowsparse(9, np.array([0], np.int32),
+                         np.ones((1, COLS), np.float32), nbytes)
+        w.pull(9, out, round=2)
+        w.close()
+    finally:
+        srv.close()
+        be.close()
+
+
+def test_empty_push_joins_the_round():
+    """A worker with no touched rows still contributes (a zero table) so
+    the sync round completes instead of blocking the peers."""
+    be = HostPSBackend(num_servers=1, num_workers=2, engine_threads=1)
+    try:
+        nbytes = ROWS * COLS * 4
+        be.init_key(6, nbytes, "float32")
+        r = np.full((1, COLS), 2.0, np.float32)
+        be.push_rowsparse(6, np.array([7], np.int32), r, nbytes)
+        be.push_rowsparse(6, np.zeros(0, np.int32),
+                          np.zeros((0, COLS), np.float32), nbytes)
+        out = np.empty(ROWS * COLS, np.float32)
+        be.pull(6, out, round=1, timeout_ms=5000)
+        np.testing.assert_allclose(out.reshape(ROWS, COLS)[7], 2.0)
+    finally:
+        be.close()
+
+
+def test_cols_mismatch_and_dtype_derivation():
+    be = HostPSBackend(num_servers=1, num_workers=1, engine_threads=1)
+    try:
+        nbytes = ROWS * COLS * 8                      # float64 table
+        be.init_key(7, nbytes, "float64")
+        r64 = np.full((1, COLS), 1.5, np.float64)     # dtype derived
+        be.push_rowsparse(7, np.array([3], np.int32), r64, nbytes)
+        out = np.empty(ROWS * COLS, np.float64)
+        be.pull(7, out, round=1)
+        np.testing.assert_allclose(out.reshape(ROWS, COLS)[3], 1.5)
+        # a push with different cols is rejected (would scatter at wrong
+        # offsets), even when the byte math happens to divide
+        with pytest.raises(ValueError, match="cols"):
+            be.push_rowsparse(7, np.array([0], np.int32),
+                              np.ones((1, COLS // 2), np.float64), nbytes)
+    finally:
+        be.close()
+
+
+def test_public_api_rowsparse(monkeypatch):
+    """bps.push_pull_rowsparse through the PS-enabled runtime; the
+    collective runtime raises a clear error."""
+    monkeypatch.setenv("BPS_ENABLE_PS", "1")
+    bps.init(config=bps.Config.from_env())
+    try:
+        idx = np.array([2, 2, 30], np.int32)
+        rows = np.random.RandomState(3).randn(3, COLS).astype(np.float32)
+        out = bps.push_pull_rowsparse(idx, rows, ROWS, name="emb")
+        np.testing.assert_allclose(out, scatter_dense(idx, rows, ROWS,
+                                                      "float32"), rtol=1e-6)
+        # second round, same table
+        out2 = bps.push_pull_rowsparse(idx, rows * 2, ROWS, name="emb")
+        np.testing.assert_allclose(out2, 2 * out, rtol=1e-6)
+        # shape drift is rejected
+        with pytest.raises(ValueError, match="stable"):
+            bps.push_pull_rowsparse(idx, rows, ROWS + 1, name="emb")
+    finally:
+        bps.shutdown()
+        monkeypatch.delenv("BPS_ENABLE_PS", raising=False)
+
+    bps.init()
+    try:
+        with pytest.raises(NotImplementedError, match="BPS_ENABLE_PS"):
+            bps.push_pull_rowsparse(np.array([0], np.int32),
+                                    np.ones((1, COLS), np.float32), ROWS)
+    finally:
+        bps.shutdown()
