@@ -24,7 +24,6 @@ shapes allow, pure-JAX blockwise otherwise (CPU tests, odd shapes).
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

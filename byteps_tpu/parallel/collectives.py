@@ -29,7 +29,6 @@ Two forms are provided:
 from __future__ import annotations
 
 import time
-from functools import partial
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax

@@ -19,7 +19,7 @@ Two modes, mirroring the reference's two PS deployments:
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 import jax
 import numpy as np

@@ -16,8 +16,7 @@ from __future__ import annotations
 
 import itertools
 import time
-from functools import partial
-from typing import Callable, Optional, Sequence, Tuple
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
