@@ -84,17 +84,47 @@ def run_local(args, cmd: List[str]) -> int:
                        engine_threads=int(env.get("BPS_SERVER_ENGINE_THREAD", "4")),
                        enable_schedule=env.get("BPS_SERVER_ENABLE_SCHEDULE", "") == "1",
                        async_mode=env.get("BPS_ENABLE_ASYNC", "") == "1")
+        # PS-state checkpointing (ours — the reference loses the async
+        # store on server death): restore the BACKEND before the
+        # transport starts accepting, so a fast-reconnecting worker's
+        # INIT can't allocate a key first and pin its own stale values
+        # (server-side init is first-wins)
+        snap = env.get("BPS_SERVER_SNAPSHOT", "")
+        snap_secs = int(env.get("BPS_SERVER_SNAPSHOT_SECS", "60"))
+        meta = {}
+        if snap and os.path.exists(snap):
+            from ..server.transport import restore_snapshot
+            meta = restore_snapshot(srv, snap)
+            print(f"[bpslaunch-tpu] restored {len(meta)} PS keys from "
+                  f"{snap}", file=sys.stderr)
         tsrv = PSTransportServer(srv,
-                                 port=int(env.get("BPS_SERVER_PORT", "9090")))
+                                 port=int(env.get("BPS_SERVER_PORT", "9090")),
+                                 key_meta=meta)
         print(f"[bpslaunch-tpu] server up on :{tsrv.port} (workers={n}); "
               "Ctrl-C to stop", file=sys.stderr)
         stop = []
         signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+        last_snap = time.time()
+
+        def try_snapshot():
+            # best-effort: a full disk must degrade the checkpoint, not
+            # kill the live data plane
+            try:
+                tsrv.snapshot(snap)
+            except Exception as e:
+                print(f"[bpslaunch-tpu] snapshot failed: {e}",
+                      file=sys.stderr)
+
         try:
             while not stop:
                 time.sleep(1)
+                if snap and time.time() - last_snap >= snap_secs:
+                    try_snapshot()
+                    last_snap = time.time()
         except KeyboardInterrupt:
             pass
+        if snap:
+            try_snapshot()
         tsrv.close()
         srv.close()
         return 0

@@ -55,6 +55,16 @@ OP_PUSH_RS = 8   # row-sparse push: nbytes = DENSE table size, payload =
 ST_OK, ST_ERR, ST_TIMEOUT = 0, 1, 2
 
 
+def _as_bytes(arr) -> memoryview:
+    """Byte view of any numpy array — dtypes outside the buffer protocol
+    (bfloat16) go through a uint8 reinterpret."""
+    a = np.ascontiguousarray(arr)
+    try:
+        return memoryview(a).cast("B")
+    except (ValueError, TypeError):
+        return memoryview(a.view(np.uint8))
+
+
 def _recv_exact(sock: socket.socket, n: int) -> memoryview:
     buf = bytearray(n)
     view = memoryview(buf)
@@ -89,7 +99,8 @@ def _recv_req(sock: socket.socket):
 class PSTransportServer:
     """Threaded TCP front for a local summation backend."""
 
-    def __init__(self, backend, host: str = "0.0.0.0", port: int = 0):
+    def __init__(self, backend, host: str = "0.0.0.0", port: int = 0,
+                 key_meta=None):
         self.backend = backend
         from .compressed import CompressedKeyStore
         self.compressed = CompressedKeyStore()
@@ -99,6 +110,11 @@ class PSTransportServer:
         self._key_log = _os.environ.get(
             "BPS_KEY_LOG", _os.environ.get("PS_KEY_LOG", "")) in ("1", "true")
         self._rs_cols: Dict[int, int] = {}   # row-sparse: pinned cols/key
+        # key -> (nbytes, dtype), recorded at INIT/INIT_C so the store can
+        # be snapshotted (the reference has NO PS-state checkpoint —
+        # docs/rationale.md leaves server recovery as future work);
+        # seeded with restore_snapshot's meta when recovering
+        self._key_meta: Dict[int, Tuple[int, str]] = dict(key_meta or {})
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -137,6 +153,7 @@ class PSTransportServer:
                 init = (np.frombuffer(payload, dtype=dtype)
                         if payload is not None else None)
                 self.backend.init_key(key, nbytes, dtype, init=init)
+                self._key_meta[key] = (int(nbytes), dtype)
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PUSH:
                 self.backend.push(key, np.frombuffer(payload, dtype=dtype))
@@ -147,13 +164,14 @@ class PSTransportServer:
                 self.backend.pull(key, out, round=int(rnd),
                                   timeout_ms=int(timeout) or 30000)
                 conn.sendall(_RSP.pack(ST_OK, out.nbytes))
-                conn.sendall(out.data)          # zero-copy: contiguous
+                conn.sendall(_as_bytes(out))    # zero-copy: contiguous
             elif op == OP_INIT_C:
                 from ..ops.compression.host import deserialize_kwargs
                 kwargs = deserialize_kwargs(bytes(payload or b""))
                 size = nbytes // np.dtype(dtype).itemsize
                 self.compressed.register(key, kwargs, size, dtype)
                 self.backend.init_key(key, nbytes, dtype)
+                self._key_meta[key] = (int(nbytes), dtype)
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PUSH_C:
                 from .compressed import compressed_push
@@ -200,12 +218,81 @@ class PSTransportServer:
         finally:
             conn.close()
 
+    def snapshot(self, path: str, timeout_ms: int = 250) -> int:
+        """Best-effort dump of every known key's latest merged value to an
+        .npz (the reference has no PS-state checkpoint — server death
+        loses the async-mode weights; this closes that gap). Returns the
+        number of keys saved. Keys whose pull fails or times out (e.g. a
+        sync-mode key with no completed round yet — async pulls return
+        immediately) are skipped with a warning; the short per-key
+        timeout bounds the stall a sync-mode snapshot can cause."""
+        return snapshot_store(self.backend, list(self._key_meta.items()),
+                              path, timeout_ms)
+
+    def restore(self, path: str) -> int:
+        """Re-seed the store from a snapshot. NOTE: this server accepts
+        connections from construction — to guarantee a reconnecting
+        worker's INIT can't land first and pin its own values, restore
+        the BACKEND before constructing the transport
+        (``restore_snapshot`` + the ``key_meta`` ctor arg, as
+        bpslaunch-tpu --server does)."""
+        meta = restore_snapshot(self.backend, path)
+        self._key_meta.update(meta)
+        return len(meta)
+
     def close(self) -> None:
         self._stop.set()
         try:
             self._sock.close()
         except OSError:
             pass
+
+
+# ------------------------------------------------------- state snapshots
+
+def snapshot_store(backend, key_meta, path: str,
+                   timeout_ms: int = 250) -> int:
+    """Dump ``key_meta`` (iterable of (key, (nbytes, dtype))) from
+    ``backend`` to ``path`` atomically. Entries are named
+    ``k<key>|<dtype>`` with raw-byte payloads, so dtypes numpy can't
+    round-trip through npz (bfloat16) survive."""
+    import os as _os
+
+    from ..common.logging import get_logger
+    arrays = {}
+    for key, (nbytes, dtype) in sorted(key_meta):
+        buf = np.empty(nbytes // np.dtype(dtype).itemsize, dtype)
+        try:
+            # round 0 = latest published value
+            backend.pull(key, buf, round=0, timeout_ms=timeout_ms)
+        except Exception as e:
+            get_logger().warning("snapshot: skipping key %d: %s", key, e)
+            continue
+        arrays[f"k{key}|{dtype}"] = buf.view(np.uint8)
+    tmp = f"{path}.tmp.npz"
+    np.savez(tmp, **arrays)
+    _os.replace(tmp, path)         # atomic: readers never see a torn file
+    get_logger().info("snapshot: %d keys -> %s", len(arrays), path)
+    return len(arrays)
+
+
+def restore_snapshot(backend, path: str):
+    """Re-seed ``backend`` from a snapshot; returns the key→(nbytes,
+    dtype) meta restored. Run this BEFORE the transport server starts
+    accepting, or a fast-reconnecting worker's INIT can allocate the key
+    first and the restored value is silently dropped (server-side init
+    is first-wins)."""
+    from ..common.logging import get_logger
+    data = np.load(path)
+    meta = {}
+    for name in data.files:
+        keypart, dtype = name[1:].split("|", 1)
+        key = int(keypart)
+        arr = np.frombuffer(data[name].tobytes(), np.dtype(dtype))
+        backend.init_key(key, arr.nbytes, dtype, init=arr)
+        meta[key] = (arr.nbytes, dtype)
+    get_logger().info("restore: %d keys <- %s", len(meta), path)
+    return meta
 
 
 # ------------------------------------------------------------------ client
@@ -265,8 +352,7 @@ class RemotePSBackend:
             self._rpc(OP_INIT_C, key, 0, nbytes, 0, dtype,
                       memoryview(serialize_kwargs(compression)))
         else:
-            payload = (None if init is None else
-                       memoryview(np.ascontiguousarray(init)).cast("B"))
+            payload = None if init is None else _as_bytes(init)
             self._rpc(OP_INIT, key, 0, nbytes, 0, dtype, payload)
         # count only after the server accepted, once per key (re-inits are
         # no-ops server-side — don't skew the load stats)
@@ -278,8 +364,7 @@ class RemotePSBackend:
                               self._shard_bytes, self.hash_fn)
 
     def push(self, key: int, data: np.ndarray) -> None:
-        self._rpc(OP_PUSH, key, 0, 0, 0, str(data.dtype),
-                  memoryview(np.ascontiguousarray(data)).cast("B"))
+        self._rpc(OP_PUSH, key, 0, 0, 0, str(data.dtype), _as_bytes(data))
 
     def pull(self, key: int, out: np.ndarray, round: int = 0,
              timeout_ms: int = 30000) -> None:
