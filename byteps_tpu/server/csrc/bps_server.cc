@@ -206,9 +206,50 @@ class Server {
       engines_.emplace_back(new EngineThread(this, i, schedule));
   }
 
-  ~Server() { engines_.clear(); }
+  // Shutdown protocol: destroying the server while another thread is
+  // blocked in Pull (e.g. a transport handler waiting on a round) must
+  // not free the stores under it. dying_ flips first; every public entry
+  // holds an inflight count; waiting pulls are woken to observe dying_
+  // and return -5; the destructor drains inflight before freeing.
+  struct CallGuard {
+    std::atomic<int>& c;
+    explicit CallGuard(std::atomic<int>& c) : c(c) { ++c; }
+    ~CallGuard() { --c; }
+  };
+
+  // Phase 1, callable separately: refuse new calls and wake blocked
+  // pulls WITHOUT freeing, so a caller can drain its own layer first
+  // (engine.py holds a Python-side inflight count around ctypes calls —
+  // the C++ guard alone can't cover a call that reads the handle just
+  // before destroy frees it).
+  void BeginShutdown() {
+    dying_.store(true);
+    std::lock_guard<std::mutex> lk(map_mu_);
+    for (auto& kv : stores_) {
+      // take the key mutex between the dying_ store and the notify: a
+      // Pull that read dying_=false under ks->mu must observe the store
+      // before it can block, or the notify is lost and close() stalls
+      // for the pull's full timeout
+      std::lock_guard<std::mutex> klk(kv.second.mu);
+      kv.second.cv.notify_all();
+    }
+  }
+
+  ~Server() {
+    BeginShutdown();
+    while (inflight_.load() != 0) {
+      {
+        std::lock_guard<std::mutex> lk(map_mu_);
+        for (auto& kv : stores_) kv.second.cv.notify_all();
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+    engines_.clear();
+  }
 
   int InitKey(uint64_t key, uint64_t nbytes, int dtype, const void* init) {
+    if (dying_.load()) return -5;
+    CallGuard g(inflight_);
     std::lock_guard<std::mutex> lk(map_mu_);
     // Idempotent: only the FIRST init allocates; later workers' inits are
     // no-ops (reference: init-push replies after all workers arrive but
@@ -254,6 +295,8 @@ class Server {
   }
 
   int Push(uint64_t key, const void* data, uint64_t nbytes) {
+    if (dying_.load()) return -5;
+    CallGuard g(inflight_);
     KeyStore* ks = Find(key);
     if (ks == nullptr || nbytes != ks->len) return -1;
     Task t;
@@ -306,6 +349,8 @@ class Server {
   // publish needs every worker's push, which follows their pull).
   int Pull(uint64_t key, void* dst, uint64_t nbytes, uint64_t want_round,
            int timeout_ms) {
+    if (dying_.load()) return -5;
+    CallGuard g(inflight_);
     KeyStore* ks = Find(key);
     if (ks == nullptr || nbytes > ks->len) return -1;
     std::unique_lock<std::mutex> lk(ks->mu);
@@ -317,13 +362,17 @@ class Server {
     uint64_t want = want_round == 0 ? (ks->round > 0 ? ks->round : 1)
                                     : want_round;
     bool ok = ks->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
-                              [&] { return ks->round >= want; });
+                              [&] { return dying_.load() ||
+                                           ks->round >= want; });
+    if (dying_.load()) return -5;  // woken by the destructor
     if (!ok) return -2;  // timeout
     std::memcpy(dst, ks->merged.data(), nbytes);
     return 0;
   }
 
   uint64_t Round(uint64_t key) {
+    if (dying_.load()) return 0;
+    CallGuard g(inflight_);
     KeyStore* ks = Find(key);
     if (ks == nullptr) return 0;
     std::lock_guard<std::mutex> lk(ks->mu);
@@ -331,6 +380,8 @@ class Server {
   }
 
   int PushCount(uint64_t key) {
+    if (dying_.load()) return -5;
+    CallGuard g(inflight_);
     KeyStore* ks = Find(key);
     if (ks == nullptr) return -1;
     std::lock_guard<std::mutex> lk(ks->mu);
@@ -347,6 +398,8 @@ class Server {
     return ks == nullptr ? -1 : ks->tid;
   }
 
+  std::atomic<bool> dying_{false};
+  std::atomic<int> inflight_{0};
   int num_workers_;
   bool async_;
   std::mutex map_mu_;
@@ -395,6 +448,8 @@ void* bps_server_create(int num_workers, int num_threads, int enable_schedule,
 }
 
 void bps_server_destroy(void* h) { delete (Server*)h; }
+
+void bps_server_begin_shutdown(void* h) { ((Server*)h)->BeginShutdown(); }
 
 int bps_server_init_key(void* h, uint64_t key, uint64_t nbytes, int dtype,
                         const void* init) {

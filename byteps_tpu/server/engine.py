@@ -41,6 +41,7 @@ def _lib() -> ctypes.CDLL:
     lib.bps_server_create.restype = ctypes.c_void_p
     lib.bps_server_create.argtypes = [ctypes.c_int] * 4
     lib.bps_server_destroy.argtypes = [ctypes.c_void_p]
+    lib.bps_server_begin_shutdown.argtypes = [ctypes.c_void_p]
     lib.bps_server_init_key.restype = ctypes.c_int
     lib.bps_server_init_key.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
@@ -73,11 +74,18 @@ def reduce_sum_inplace(dst: np.ndarray, src: np.ndarray) -> None:
                           dst.nbytes, dt)
 
 
+class ServerClosed(RuntimeError):
+    """The server is shutting down — transient from a client's view (a
+    supervisor may restart it); the transport maps this to a GONE frame
+    so workers reconnect instead of failing."""
+
+
 class PSServer:
     """One native server shard (reference: byteps_server(), server.cc:441-514)."""
 
     def __init__(self, num_workers: int, engine_threads: int = 4,
                  enable_schedule: bool = False, async_mode: bool = False):
+        import threading
         self._lib = _lib()
         self._h = self._lib.bps_server_create(
             num_workers, engine_threads, int(enable_schedule), int(async_mode))
@@ -85,10 +93,40 @@ class PSServer:
             raise RuntimeError("bps_server_create failed")
         self.num_workers = num_workers
         self.async_mode = async_mode
+        # close() may race concurrent callers (transport handler threads
+        # blocked in pull): a Python-side inflight count plus the native
+        # two-phase shutdown (begin_shutdown wakes + refuses, destroy
+        # frees only after the drain) makes close() safe under load
+        self._cv = threading.Condition()
+        self._inflight = 0
+        self._closed = False
+
+    def _enter(self):
+        with self._cv:
+            if self._closed:
+                raise ServerClosed("server closed")
+            self._inflight += 1
+
+    def _exit(self):
+        with self._cv:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._cv.notify_all()
 
     def close(self) -> None:
-        if self._h:
-            self._lib.bps_server_destroy(self._h)
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            h = self._h
+        if h:
+            # wake blocked pulls (they return rc=-5), then wait for every
+            # in-flight ctypes call to leave before freeing the handle
+            self._lib.bps_server_begin_shutdown(h)
+            with self._cv:
+                while self._inflight:
+                    self._cv.wait(timeout=1.0)
+            self._lib.bps_server_destroy(h)
             self._h = None
 
     def __del__(self):  # noqa: D105
@@ -100,15 +138,28 @@ class PSServer:
     def init_key(self, key: int, nbytes: int, dtype: str = "float32",
                  init: Optional[np.ndarray] = None) -> None:
         ptr = init.ctypes.data_as(ctypes.c_void_p) if init is not None else None
-        rc = self._lib.bps_server_init_key(self._h, key, nbytes,
-                                           _DTYPES[dtype], ptr)
+        self._enter()
+        try:
+            rc = self._lib.bps_server_init_key(self._h, key, nbytes,
+                                               _DTYPES[dtype], ptr)
+        finally:
+            self._exit()
+        if rc == -5:
+            raise ServerClosed(f"init_key({key}): server shutting down")
         if rc != 0:
             raise RuntimeError(f"init_key({key}) failed rc={rc}")
 
     def push(self, key: int, data: np.ndarray) -> None:
         data = np.ascontiguousarray(data)
-        rc = self._lib.bps_server_push(
-            self._h, key, data.ctypes.data_as(ctypes.c_void_p), data.nbytes)
+        self._enter()
+        try:
+            rc = self._lib.bps_server_push(
+                self._h, key, data.ctypes.data_as(ctypes.c_void_p),
+                data.nbytes)
+        finally:
+            self._exit()
+        if rc == -5:
+            raise ServerClosed(f"push({key}): server shutting down")
         if rc != 0:
             raise RuntimeError(f"push({key}) failed rc={rc} "
                                f"(len mismatch or key not initialised)")
@@ -117,23 +168,41 @@ class PSServer:
              timeout_ms: int = 30000) -> None:
         """Pull round ``round`` (1-based; 0 = latest published). Sync-mode
         callers should pass the round their push contributed to."""
-        rc = self._lib.bps_server_pull(
-            self._h, key, out.ctypes.data_as(ctypes.c_void_p), out.nbytes,
-            round, timeout_ms)
+        self._enter()
+        try:
+            rc = self._lib.bps_server_pull(
+                self._h, key, out.ctypes.data_as(ctypes.c_void_p),
+                out.nbytes, round, timeout_ms)
+        finally:
+            self._exit()
         if rc == -2:
             raise TimeoutError(f"pull({key}) round={round} timed out "
                                f"after {timeout_ms}ms")
+        if rc == -5:
+            raise ServerClosed(f"pull({key}): server shutting down")
         if rc != 0:
             raise RuntimeError(f"pull({key}) failed rc={rc}")
 
     def round(self, key: int) -> int:
-        return self._lib.bps_server_round(self._h, key)
+        self._enter()
+        try:
+            return self._lib.bps_server_round(self._h, key)
+        finally:
+            self._exit()
 
     def engine_load(self, tid: int) -> int:
-        return self._lib.bps_server_engine_load(self._h, tid)
+        self._enter()
+        try:
+            return self._lib.bps_server_engine_load(self._h, tid)
+        finally:
+            self._exit()
 
     def key_thread(self, key: int) -> int:
-        return self._lib.bps_server_key_thread(self._h, key)
+        self._enter()
+        try:
+            return self._lib.bps_server_key_thread(self._h, key)
+        finally:
+            self._exit()
 
 
 class HostPSBackend:

@@ -52,7 +52,7 @@ OP_INIT, OP_PUSH, OP_PULL, OP_CLOSE = 1, 2, 3, 4
 OP_INIT_C, OP_PUSH_C, OP_PULL_C = 5, 6, 7
 OP_PUSH_RS = 8   # row-sparse push: nbytes = DENSE table size, payload =
                  # n|idx|rows (server/rowsparse.py wire format)
-ST_OK, ST_ERR, ST_TIMEOUT = 0, 1, 2
+ST_OK, ST_ERR, ST_TIMEOUT, ST_GONE = 0, 1, 2, 3
 
 
 def _as_bytes(arr) -> memoryview:
@@ -199,9 +199,16 @@ class PSTransportServer:
         except TimeoutError as e:
             msg = str(e).encode()
             conn.sendall(_RSP.pack(ST_TIMEOUT, len(msg)) + msg)
-        except Exception as e:  # backend rejections (bad length, key, …)
-            msg = f"{type(e).__name__}: {e}".encode()[:4096]
-            conn.sendall(_RSP.pack(ST_ERR, len(msg)) + msg)
+        except Exception as e:
+            from .engine import ServerClosed
+            if isinstance(e, ServerClosed):
+                # shutting down: tell the worker to reconnect (a
+                # supervisor restart + snapshot restore is transparent)
+                msg = str(e).encode()
+                conn.sendall(_RSP.pack(ST_GONE, len(msg)) + msg)
+            else:   # backend rejections (bad length, key, …)
+                msg = f"{type(e).__name__}: {e}".encode()[:4096]
+                conn.sendall(_RSP.pack(ST_ERR, len(msg)) + msg)
 
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
@@ -300,42 +307,140 @@ def restore_snapshot(backend, path: str):
 class RemotePSBackend:
     """Worker-side client; same interface as HostPSBackend, keys sharded
     over N transport servers with the same placement hash (reference:
-    key→server placement global.cc:628-677)."""
+    key→server placement global.cc:628-677).
+
+    Fault tolerance (ours — ps-lite aborts on van failure): a dropped
+    connection triggers reconnect-with-backoff for up to
+    ``reconnect_secs`` (BPS_RECONNECT_SECS, default 30; 0 disables).
+    Recorded ``init_key`` calls are REPLAYED on the fresh connection so a
+    restarted server re-learns the key table (values come from its
+    snapshot, see BPS_SERVER_SNAPSHOT — without one, async training
+    restarts from the replayed init values). Clean recovery is an
+    async-PS property: sync rounds reset with the server while the
+    worker's round counters don't, so a sync-mode reconnect can stall
+    on pulls (documented limitation). Retried pushes are AT-LEAST-ONCE:
+    if the server applied a push (and snapshotted it) but died before
+    acking, the resend applies it again — one duplicated gradient
+    step's worth of noise, the usual trade for async-SGD recovery."""
 
     def __init__(self, addrs: Sequence[str], hash_fn: str = "djb2",
-                 async_mode: bool = False):
-        self._socks: List[socket.socket] = []
+                 async_mode: bool = False,
+                 reconnect_secs: Optional[float] = None):
+        import os as _os
+        self._addrs = [a.rsplit(":", 1) for a in addrs]
+        self._socks: List[Optional[socket.socket]] = []
         self._locks: List[threading.Lock] = []
         self.hash_fn = hash_fn
         self.async_mode = async_mode
+        self.reconnect_secs = (
+            float(_os.environ.get("BPS_RECONNECT_SECS", "30"))
+            if reconnect_secs is None else reconnect_secs)
         self._rounds: Dict[int, int] = {}
         self._shard_bytes: Dict[int, int] = {}
         self._placed: set = set()
-        for addr in addrs:
-            host, port = addr.rsplit(":", 1)
-            s = socket.create_connection((host, int(port)))
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._socks.append(s)
+        # init_key replay log per shard index: key -> args
+        self._inits: List[Dict[int, tuple]] = [dict() for _ in addrs]
+        for i in range(len(addrs)):
+            self._socks.append(self._dial(i))
             self._locks.append(threading.Lock())
 
-    def _conn(self, key: int) -> Tuple[socket.socket, threading.Lock]:
+    def _dial(self, i: int) -> socket.socket:
+        host, port = self._addrs[i]
+        s = socket.create_connection((host, int(port)))
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def _conn(self, key: int) -> Tuple[int, threading.Lock]:
         i = place_key(key, len(self._socks), self.hash_fn)
-        return self._socks[i], self._locks[i]
+        return i, self._locks[i]
+
+    def _reconnect(self, i: int, deadline: float) -> None:
+        """Redial shard ``i`` with backoff until ``deadline``, then replay
+        its init_key log (a restarted server has an empty key table; its
+        values come from the snapshot, which restore seeds BEFORE
+        accepting — so replayed inits are no-ops there). Raises
+        ConnectionError when the budget runs out."""
+        import time as _time
+
+        from ..common.logging import get_logger
+        delay = 0.1
+        while True:
+            try:
+                old_sock = self._socks[i]
+                self._socks[i] = self._dial(i)
+                if old_sock is not None:    # don't leak one fd per retry
+                    try:
+                        old_sock.close()
+                    except OSError:
+                        pass
+                break
+            except OSError as e:
+                if _time.time() + delay > deadline:
+                    raise ConnectionError(
+                        f"PS server {':'.join(self._addrs[i])} unreachable "
+                        f"for {self.reconnect_secs:.0f}s: {e}") from e
+                _time.sleep(delay)
+                delay = min(delay * 2, 2.0)
+        get_logger().warning("reconnected to PS server %s; replaying %d "
+                             "key inits", ":".join(self._addrs[i]),
+                             len(self._inits[i]))
+        sock = self._socks[i]
+        for args in self._inits[i].values():
+            self._send_init(sock, *args)
+
+    def _send_init(self, sock, key, nbytes, dtype, init, compression):
+        if compression:
+            from ..ops.compression.host import serialize_kwargs
+            self._roundtrip(sock, OP_INIT_C, key, 0, nbytes, 0, dtype,
+                            memoryview(serialize_kwargs(compression)))
+        else:
+            payload = None if init is None else _as_bytes(init)
+            self._roundtrip(sock, OP_INIT, key, 0, nbytes, 0, dtype, payload)
+
+    @staticmethod
+    def _roundtrip(sock, op, key, rnd, nbytes, timeout_ms, dtype, payload):
+        _send_req(sock, op, key, rnd, nbytes, timeout_ms, dtype, payload)
+        status, rbytes = _RSP.unpack(_recv_exact(sock, _RSP.size))
+        data = _recv_exact(sock, rbytes) if rbytes else memoryview(b"")
+        if status == ST_TIMEOUT:
+            raise TimeoutError(bytes(data).decode() or
+                               f"pull({key}) timed out")
+        if status == ST_GONE:
+            # server announced shutdown mid-request — treat like a dropped
+            # connection so _rpc's reconnect path takes over
+            raise ConnectionError(bytes(data).decode() or "server gone")
+        if status != ST_OK:
+            raise RuntimeError(f"PS server rejected key={key} op={op}: "
+                               f"{bytes(data).decode()!r}")
+        return data
 
     def _rpc(self, op: int, key: int, rnd: int, nbytes: int,
              timeout_ms: int, dtype: str, payload: Optional[memoryview],
              pull_into: Optional[np.ndarray] = None) -> bytes:
-        sock, lock = self._conn(key)
+        import time as _time
+        i, lock = self._conn(key)
         with lock:
-            _send_req(sock, op, key, rnd, nbytes, timeout_ms, dtype, payload)
-            status, rbytes = _RSP.unpack(_recv_exact(sock, _RSP.size))
-            data = _recv_exact(sock, rbytes) if rbytes else memoryview(b"")
-            if status == ST_TIMEOUT:
-                raise TimeoutError(bytes(data).decode() or
-                                   f"pull({key}) timed out")
-            if status != ST_OK:
-                raise RuntimeError(f"PS server rejected key={key} op={op}: "
-                                   f"{bytes(data).decode()!r}")
+            try:
+                data = self._roundtrip(self._socks[i], op, key, rnd, nbytes,
+                                       timeout_ms, dtype, payload)
+            except (ConnectionError, OSError):
+                if self.reconnect_secs <= 0:
+                    raise
+                # the retry itself can land on a still-dying server (GONE
+                # frames) — keep reconnecting until the ONE shared budget
+                # runs out (redials inside _reconnect draw on it too)
+                deadline = _time.time() + self.reconnect_secs
+                while True:
+                    try:
+                        self._reconnect(i, deadline)
+                        data = self._roundtrip(self._socks[i], op, key, rnd,
+                                               nbytes, timeout_ms, dtype,
+                                               payload)
+                        break
+                    except (ConnectionError, OSError):
+                        if _time.time() >= deadline:
+                            raise
+                        _time.sleep(0.2)
             if pull_into is not None:
                 np.copyto(pull_into,
                           np.frombuffer(data, dtype=pull_into.dtype)
@@ -354,6 +459,14 @@ class RemotePSBackend:
         else:
             payload = None if init is None else _as_bytes(init)
             self._rpc(OP_INIT, key, 0, nbytes, 0, dtype, payload)
+        # record for replay after a reconnect (restarted server has an
+        # empty key table) — only once ACCEPTED, or a rejected conflicting
+        # re-declaration would poison the replay log; keep a copy of init
+        # (the caller may mutate it)
+        i, _ = self._conn(key)
+        self._inits[i][key] = (key, nbytes, dtype,
+                               None if init is None else np.array(init),
+                               dict(compression) if compression else None)
         # count only after the server accepted, once per key (re-inits are
         # no-ops server-side — don't skew the load stats)
         if key not in self._placed:

@@ -194,3 +194,40 @@ def test_init_key_idempotent_across_workers():
             be.init_key(11, x.nbytes * 2)   # conflicting re-declaration
     finally:
         be.close()
+
+
+def test_close_wakes_blocked_pull():
+    """Destroying the server while another thread is blocked in a pull
+    must wake it with ServerClosed — not free the stores under it (the
+    two-phase shutdown protocol: begin_shutdown → drain → destroy)."""
+    import threading
+    import time
+
+    from byteps_tpu.server.engine import PSServer, ServerClosed
+
+    be = PSServer(num_workers=2, engine_threads=1)   # round never completes
+    x = np.ones(64, np.float32)
+    be.init_key(1, x.nbytes)
+    be.push(1, x)                                    # 1 of 2 pushes
+    errs = []
+
+    def puller():
+        out = np.empty_like(x)
+        try:
+            be.pull(1, out, round=1, timeout_ms=20000)
+        except Exception as e:
+            errs.append(e)
+
+    t = threading.Thread(target=puller)
+    t.start()
+    time.sleep(0.3)                                  # ensure it's waiting
+    t0 = time.time()
+    be.close()                                       # must not segfault
+    t.join(timeout=10)
+    assert not t.is_alive(), "blocked pull never woke"
+    assert time.time() - t0 < 5, "close stalled on the blocked pull"
+    assert errs and isinstance(errs[0], ServerClosed), errs
+    # post-close calls fail cleanly, not by NULL deref
+    import pytest as _pytest
+    with _pytest.raises(ServerClosed):
+        be.push(1, x)

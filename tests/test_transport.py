@@ -472,3 +472,74 @@ def test_snapshot_restore_roundtrip(tmp_path):
     finally:
         srv2.close()
         be2.close()
+
+
+def test_worker_reconnects_after_server_restart(tmp_path):
+    """A dropped connection triggers reconnect + init replay: the worker
+    survives a full server restart (values via snapshot restore) —
+    ps-lite aborts in this situation."""
+    from byteps_tpu.server.transport import restore_snapshot
+
+    path = str(tmp_path / "state.npz")
+    w0 = np.linspace(0, 1, 32).astype(np.float32)
+
+    be = PSServer(num_workers=1, engine_threads=1, async_mode=True)
+    srv = PSTransportServer(be, host="127.0.0.1")
+    port = srv.port
+    w = RemotePSBackend([f"127.0.0.1:{port}"], async_mode=True,
+                        reconnect_secs=20)
+    try:
+        w.init_key(1, w0.nbytes, "float32", init=w0)
+        w.push(1, np.ones(32, np.float32))
+        out = np.empty(32, np.float32)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            w.pull(1, out)
+            if abs(out[0] - 1.0) < 1e-6:
+                break
+            time.sleep(0.01)
+        srv.snapshot(path)
+        # hard server death: close transport AND backend
+        srv.close()
+        be.close()
+
+        # restart on the SAME port with restored state (in the background
+        # after a delay, so the worker's next op sees a dead connection
+        # first and has to retry)
+        def restart():
+            time.sleep(1.0)
+            be2 = PSServer(num_workers=1, engine_threads=1, async_mode=True)
+            meta = restore_snapshot(be2, path)
+            deadline_ = time.time() + 15
+            while True:          # old listener may linger briefly in the
+                try:             # kernel — retry the bind like a real
+                    restart.srv = PSTransportServer(   # supervisor would
+                        be2, host="127.0.0.1", port=port, key_meta=meta)
+                    break
+                except OSError:
+                    if time.time() > deadline_:
+                        raise
+                    time.sleep(0.2)
+            restart.be = be2
+
+        t = threading.Thread(target=restart)
+        t.start()
+        # worker keeps going: this pull must ride through the outage
+        out2 = np.empty(32, np.float32)
+        w.pull(1, out2)
+        np.testing.assert_allclose(out2, w0 + 1, rtol=1e-6)
+        w.push(1, np.ones(32, np.float32))     # and keep training
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            w.pull(1, out2)
+            if abs(out2[0] - 2.0) < 1e-6:
+                break
+            time.sleep(0.01)
+        np.testing.assert_allclose(out2, w0 + 2, rtol=1e-6)
+        t.join()
+    finally:
+        w.close()
+        for obj in ("srv", "be"):
+            o = getattr(restart, obj, None)
+            if o is not None:
+                o.close()
