@@ -8,8 +8,10 @@ Run:  python examples/ps_training.py [--workers 2] [--steps 30]
 
 The driver (this script) starts `bpslaunch-tpu --server`, then launches
 the workers with BPS_ENABLE_PS/BPS_SERVER_ADDRS set; each worker trains
-a small model with DistributedGradientTape + manual updates, syncing
-gradients only through the TCP host service, and reports its losses.
+a small model with DistributedTrainer — which detects the PS deployment
+itself — syncing only through the TCP host service. Flags:
+--async-mode (weight-delta async-SGD, no barrier) and --compress
+(topk + error-feedback compressed wire).
 """
 
 from __future__ import annotations
@@ -28,32 +30,38 @@ sys.path.insert(0, os.path.join(os.environ["BPS_REPO_ROOT"], "examples"))
 import _bootstrap  # repo root on sys.path + honor JAX_PLATFORMS
 import jax
 import numpy as np
-import jax.numpy as jnp
+import optax
 import byteps_tpu as bps
+from byteps_tpu.training import DistributedTrainer
 
 wid = int(os.environ["BPS_WORKER_ID"])
 steps = int(os.environ["DEMO_STEPS"])
 bps.init()
-rng = np.random.RandomState(wid)          # each worker: its OWN data shard
 W = np.random.RandomState(0).randn(8, 1).astype(np.float32)
 
-params = {"w": jnp.zeros((8, 1))}
-grad_fn = jax.jit(jax.grad(
-    lambda p, b: jnp.mean((b[0] @ p["w"] - b[1]) ** 2)))
+def loss_fn(p, b):
+    x, y = b
+    return ((x @ p["w"] - y) ** 2).mean()
+
+# the trainer detects BPS_ENABLE_PS / BPS_ENABLE_ASYNC and picks the
+# right split itself: jitted grads -> host-service hop -> jitted update
+# (sync), or local optimizer step -> weight-delta push -> fresh pull
+# (async). Compression kwargs ride the PS wire when given.
+compression = None
+if os.environ.get("DEMO_COMPRESS") == "1":
+    compression = {"compressor_type": "topk", "compressor_k": "0.5",
+                   "ef_type": "vanilla"}
+tr = DistributedTrainer(loss_fn, {"w": np.zeros((8, 1), np.float32)},
+                        optax.sgd(0.05), compression=compression,
+                        min_compress_bytes=0 if compression else None)
+rng = np.random.RandomState(10 + wid)     # each worker: its OWN data shard
 for step in range(steps):
-    x = rng.randn(32, 8).astype(np.float32)
-    g = grad_fn(params, (x, x @ W))
-    # stacked [1, ...] rows: world-local replica; PS hop averages across
-    # the worker processes
-    stacked = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[None], g)
-    avg = bps.push_pull(stacked, average=True, name="grads")
-    params = jax.tree_util.tree_map(
-        lambda p, a: p - 0.1 * jnp.asarray(a)[0], params, avg)
-loss = float(jnp.mean((np.random.RandomState(99).randn(64, 8).astype("f")
-                       @ params["w"]
-                       - np.random.RandomState(99).randn(64, 8).astype("f")
-                       @ W) ** 2))
-print(f"worker {wid}: final eval loss {loss:.5f}")
+    x = rng.randn(64, 8).astype(np.float32)
+    loss = tr.step((x, x @ W))   # returned loss: printed in the summary
+err = float(np.abs(np.asarray(tr.params["w"]) - W).max())
+mode = "async" if os.environ.get("BPS_ENABLE_ASYNC") == "1" else "sync"
+print(f"worker {wid}: {mode} PS training done, final loss "
+      f"{float(loss):.5f}, max weight err {err:.5f}")
 bps.shutdown()
 """
 
@@ -62,7 +70,14 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--workers", type=int, default=2)
     ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--async-mode", action="store_true",
+                    help="async-SGD: weight-delta push, no worker barrier")
+    ap.add_argument("--compress", action="store_true",
+                    help="topk+error-feedback compressed PS wire")
     args = ap.parse_args()
+    if args.async_mode and args.compress:
+        ap.error("--compress is incompatible with --async-mode (the async "
+                 "server folds raw weight deltas)")
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     with socket.socket() as s:
@@ -71,6 +86,8 @@ def main() -> None:
 
     server_env = dict(os.environ, BPS_SERVER_PORT=str(port),
                       BPS_NUM_PROCESSES=str(args.workers))
+    if args.async_mode:
+        server_env["BPS_ENABLE_ASYNC"] = "1"
     server = subprocess.Popen(
         [sys.executable, "-m", "byteps_tpu.launcher.launch", "--server"],
         env=server_env, cwd=root)
@@ -97,6 +114,10 @@ def main() -> None:
                        BPS_NUM_WORKER=str(args.workers),
                        BPS_WORKER_ID=str(wid),
                        DEMO_STEPS=str(args.steps))
+            if args.async_mode:
+                env["BPS_ENABLE_ASYNC"] = "1"
+            if args.compress:
+                env["DEMO_COMPRESS"] = "1"
             workers.append(subprocess.Popen(
                 [sys.executable, "-c", WORKER_SNIPPET], env=env, cwd=root))
         rc = 0
