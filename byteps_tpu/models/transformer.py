@@ -56,6 +56,10 @@ class TransformerConfig:
     pp_axis: Optional[str] = None # mesh axis for pipeline (layer) stages
     pp_microbatches: int = 0      # GPipe microbatches (0 → pipeline size)
     scan_unroll: int = 1          # lax.scan unroll factor over layers
+    lm_head_chunk: int = 0        # >0: chunked cross-entropy — the LM
+    # head + softmax run per sequence chunk under jax.checkpoint, so the
+    # [s, vocab] logits never materialize (13 GB at GPT-2 seq 64k; the
+    # enabler for very long contexts on one chip). 0 = full head.
 
     def __post_init__(self):
         if self.remat_policy not in (None, "dots", "mlp_only", "save_attn"):
@@ -305,6 +309,38 @@ def logits(params, cfg: TransformerConfig, hidden: jnp.ndarray) -> jnp.ndarray:
                       preferred_element_type=jnp.float32)
 
 
+_warned_chunk: set = set()
+
+
+def _chunked_nll_sum(h, emb, targets, mask, chunk: int, dt) -> jnp.ndarray:
+    """Masked NLL sum with the LM head applied per sequence chunk.
+
+    Each chunk's logits/log-softmax live only inside a jax.checkpoint
+    region of a lax.scan: the forward keeps no [s, vocab] tensor and the
+    backward recomputes one [chunk, vocab] block at a time — O(chunk·V)
+    memory instead of O(s·V)."""
+    b, s, hid = h.shape
+    n = s // chunk
+    hc = jnp.moveaxis(h.reshape(b, n, chunk, hid), 1, 0)
+    tc = jnp.moveaxis(targets.reshape(b, n, chunk), 1, 0)
+    mc = jnp.moveaxis(mask.reshape(b, n, chunk), 1, 0)
+
+    @jax.checkpoint
+    def one(hb, tb, mb):
+        lg = jnp.einsum("bch,vh->bcv", hb.astype(dt), emb.astype(dt),
+                        preferred_element_type=jnp.float32)
+        logp = jax.nn.log_softmax(lg, axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, jnp.where(mb, tb, 0)[..., None], axis=-1)[..., 0]
+        return (nll * mb).sum()
+
+    def body(acc, xs):
+        return acc + one(*xs), None
+
+    total, _ = jax.lax.scan(body, jnp.float32(0.0), (hc, tc, mc))
+    return total
+
+
 def lm_loss(params, cfg: TransformerConfig, batch) -> jnp.ndarray:
     """Cross-entropy LM loss. batch = (tokens, targets); targets < 0 are
     ignored (the MLM mask convention).
@@ -315,12 +351,28 @@ def lm_loss(params, cfg: TransformerConfig, batch) -> jnp.ndarray:
     counts unevenly and bias the gradient."""
     tokens, targets = batch
     h = apply(params, cfg, tokens)
-    lg = logits(params, cfg, h)
-    logp = jax.nn.log_softmax(lg, axis=-1)
     mask = (targets >= 0)
-    tgt = jnp.where(mask, targets, 0)
-    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-    nll_sum = (nll * mask).sum()
+    s = h.shape[1]
+    chunk = cfg.lm_head_chunk
+    if chunk and s > chunk and s % chunk:
+        # silent fallback would materialize the [s, vocab] logits the
+        # user configured the chunking to avoid — warn once per shape
+        if (s, chunk) not in _warned_chunk:
+            _warned_chunk.add((s, chunk))
+            from ..common.logging import get_logger
+            get_logger().warning(
+                "lm_head_chunk=%d does not divide seq %d — falling back "
+                "to the FULL [s, vocab] head (O(s·vocab) memory); pick a "
+                "divisor of the sequence length", chunk, s)
+    if chunk and s > chunk and s % chunk == 0:
+        nll_sum = _chunked_nll_sum(h, params["embed"]["tok"], targets,
+                                   mask, chunk, jnp.dtype(cfg.dtype))
+    else:
+        lg = logits(params, cfg, h)
+        logp = jax.nn.log_softmax(lg, axis=-1)
+        tgt = jnp.where(mask, targets, 0)
+        nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+        nll_sum = (nll * mask).sum()
     cnt = mask.sum().astype(jnp.float32)
     if cfg.sp_axis is not None:
         nll_sum = jax.lax.psum(nll_sum, cfg.sp_axis)

@@ -37,7 +37,10 @@ def measure(model: str, seq: int, tokens_per_step: int, sp: int,
 
     cfg = dataclasses.replace(
         getattr(gpt2, MODELS[model])(), max_seq=seq,
-        sp_axis="seq" if sp > 1 else None)
+        sp_axis="seq" if sp > 1 else None,
+        # past 16k the [s, vocab] logits dominate HBM (13 GB at 64k) —
+        # chunked cross-entropy keeps the head at O(chunk·vocab)
+        lm_head_chunk=2048 if seq > 16384 else 0)
     params = transformer.init_params(jax.random.PRNGKey(0), cfg)
     batch = max(1, tokens_per_step // seq)
     data = gpt2.synth_lm_batch(np.random.RandomState(0), batch, seq,

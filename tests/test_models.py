@@ -252,3 +252,32 @@ def test_causal_lm_loss_keeps_full_length():
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7),
         g1, g2)
+
+
+def test_chunked_lm_head_matches_full():
+    """lm_head_chunk computes the identical loss AND gradients to the
+    full [s, vocab] head — only the memory profile changes."""
+    import dataclasses
+
+    from byteps_tpu.models import gpt2
+
+    cfg_full = gpt2.gpt2_tiny()    # max_seq 64 built in
+    cfg_chunk = dataclasses.replace(cfg_full, lm_head_chunk=16)
+    params = transformer.init_params(jax.random.PRNGKey(3), cfg_full)
+    tokens = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg_full.vocab_size, (2, 64)))
+
+    def loss(c):
+        return lambda p: gpt2.causal_lm_loss(p, c, tokens)
+
+    lf, gf = jax.value_and_grad(loss(cfg_full))(params)
+    lc, gc = jax.value_and_grad(loss(cfg_chunk))(params)
+    np.testing.assert_allclose(float(lf), float(lc), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(gf),
+                    jax.tree_util.tree_leaves(gc)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)
+    # chunk not dividing s falls back to the full head (same value)
+    cfg_odd = dataclasses.replace(cfg_full, lm_head_chunk=17)
+    np.testing.assert_allclose(
+        float(loss(cfg_odd)(params)), float(lf), rtol=1e-6)
