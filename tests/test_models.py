@@ -281,3 +281,26 @@ def test_chunked_lm_head_matches_full():
     cfg_odd = dataclasses.replace(cfg_full, lm_head_chunk=17)
     np.testing.assert_allclose(
         float(loss(cfg_odd)(params)), float(lf), rtol=1e-6)
+
+
+def test_chunked_lm_head_composes_with_sequence_parallel():
+    """lm_head_chunk under SP: each rank chunks its LOCAL sequence shard;
+    the psum'd global loss must match the unsharded full-head loss."""
+    import dataclasses
+
+    from byteps_tpu.models import gpt2
+
+    cfg_ref = gpt2.gpt2_tiny()
+    cfg_sp = dataclasses.replace(cfg_ref, sp_axis="seq", lm_head_chunk=8)
+    params = transformer.init_params(jax.random.PRNGKey(5), cfg_ref)
+    tokens = jnp.asarray(np.random.RandomState(6).randint(
+        1, cfg_ref.vocab_size, (2, 64)))
+    want = float(gpt2.causal_lm_loss(params, cfg_ref, tokens))
+
+    mesh = make_mesh({"seq": 4}, devices=jax.devices()[:4])
+    fn = jax.jit(jax.shard_map(
+        lambda p, t: gpt2.causal_lm_loss(p, cfg_sp, t),
+        mesh=mesh, in_specs=(P(), P(None, "seq")), out_specs=P(),
+        check_vma=False))
+    got = float(fn(params, tokens))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
