@@ -20,10 +20,12 @@ seeded XorShift128+ (utils.h:72-158); dithering drives its Bernoulli
 from the same RNG when a ``seed`` kwarg is given (the reference is only
 deterministic when seeded) and a fast numpy stream otherwise.
 
-Decorator chain (worker-only, like the reference's registry which skips
-momentum/ef on the server, compressor_registry.cc:40-56): momentum →
-error-feedback → compressor via ``create_host_chain``; the server
-registers the plain codec via ``create_host_codec``.
+Decorator chains mirror the reference's registry
+(compressor_registry.cc:40-56), whose SERVER build skips only
+``momentum_type`` — error feedback IS part of the server's chain, so the
+reference compensates the merged-buffer recompression error. Workers use
+``create_host_chain`` (momentum → ef → compressor); servers use
+``create_server_chain`` (ef → compressor).
 """
 
 from __future__ import annotations
@@ -220,8 +222,9 @@ class HostDithering(HostCodec):
 
 def create_host_codec(kwargs: Dict[str, str], size: int,
                       dtype: str = "float32") -> Optional[HostCodec]:
-    """Plain compressor from string kwargs — what the SERVER registers
-    (reference: server.cc:222-252; decorators are worker-only)."""
+    """Plain compressor from string kwargs, no decorators (servers add
+    error feedback via ``create_server_chain``; workers add momentum+ef
+    via ``create_host_chain``)."""
     ctype = kwargs.get("compressor_type")
     if ctype is None:
         return None
@@ -295,6 +298,21 @@ class HostNesterovMomentum:
 
     def payload_nbytes(self) -> int:
         return self.inner.payload_nbytes()
+
+
+def create_server_chain(kwargs: Dict[str, str], size: int,
+                        dtype: str = "float32"):
+    """Server-side chain: ef → compressor. The reference server's
+    CompressorRegistry::Create skips ONLY momentum_type
+    (compressor_registry.cc:40-56), so when ``ef_type`` is configured
+    the merged buffer's recompression error is compensated round over
+    round server-side, exactly like the reference."""
+    comp = create_host_codec(kwargs, size, dtype)
+    if comp is None:
+        return None
+    if kwargs.get("ef_type") == "vanilla":
+        comp = HostErrorFeedback(comp)
+    return comp
 
 
 def create_host_chain(kwargs: Dict[str, str], size: int,

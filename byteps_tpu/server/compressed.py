@@ -16,7 +16,8 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-from ..ops.compression.host import HostCodec, create_host_codec
+from ..ops.compression.host import (HostCodec, HostErrorFeedback,
+                                    create_server_chain)
 
 # recompressed rounds kept per key: all workers pull round r before r+2
 # can complete (they must push r+1 first), so 4 is comfortably safe for
@@ -50,7 +51,10 @@ class CompressedKeyStore:
                         f"{self._kwargs[key]}, re-register with {ident} "
                         f"— workers disagree on compression config")
                 return codec
-            codec = create_host_codec(kwargs, size, dtype)
+            # server chain = ef → compressor (the reference's server
+            # registry skips only momentum, compressor_registry.cc:40-56,
+            # so recompression error is EF-compensated when configured)
+            codec = create_server_chain(kwargs, size, dtype)
             if codec is not None:
                 self._codecs[key] = codec
                 self._kwargs[key] = ident
@@ -80,9 +84,15 @@ class CompressedKeyStore:
         """Compress the merged buffer for ``rnd``; cached so every worker
         pulling the same round gets byte-identical payloads even for
         stochastic codecs. ``rnd`` 0 (async mode: latest) is never cached
-        — the store mutates between pulls."""
+        — the store mutates between pulls — and bypasses error-feedback
+        state (compressing every pull would advance the EF accumulator
+        many times per merge; EF's round-over-round compensation only
+        makes sense for the once-per-round sync path)."""
         if rnd == 0:
-            return self._codecs[key].compress(dense)
+            codec = self._codecs[key]
+            if isinstance(codec, HostErrorFeedback):
+                codec = codec.inner
+            return codec.compress(dense)
         with self._lock:
             rounds = self._cache[key]
             buf = rounds.get(rnd)

@@ -211,9 +211,17 @@ class Server {
   // not free the stores under it. dying_ flips first; every public entry
   // holds an inflight count; waiting pulls are woken to observe dying_
   // and return -5; the destructor drains inflight before freeing.
+  // Publish the inflight increment BEFORE reading dying_: a caller that
+  // passes the dying_ check is then guaranteed visible to the
+  // destructor's drain loop (check-then-increment would let the drain
+  // loop observe 0 between the two and free stores_ under the caller).
   struct CallGuard {
     std::atomic<int>& c;
-    explicit CallGuard(std::atomic<int>& c) : c(c) { ++c; }
+    bool refused;
+    CallGuard(std::atomic<int>& c, std::atomic<bool>& dying) : c(c) {
+      ++c;
+      refused = dying.load();
+    }
     ~CallGuard() { --c; }
   };
 
@@ -248,8 +256,8 @@ class Server {
   }
 
   int InitKey(uint64_t key, uint64_t nbytes, int dtype, const void* init) {
-    if (dying_.load()) return -5;
-    CallGuard g(inflight_);
+    CallGuard g(inflight_, dying_);
+    if (g.refused) return -5;
     std::lock_guard<std::mutex> lk(map_mu_);
     // Idempotent: only the FIRST init allocates; later workers' inits are
     // no-ops (reference: init-push replies after all workers arrive but
@@ -295,8 +303,8 @@ class Server {
   }
 
   int Push(uint64_t key, const void* data, uint64_t nbytes) {
-    if (dying_.load()) return -5;
-    CallGuard g(inflight_);
+    CallGuard g(inflight_, dying_);
+    if (g.refused) return -5;
     KeyStore* ks = Find(key);
     if (ks == nullptr || nbytes != ks->len) return -1;
     Task t;
@@ -349,8 +357,8 @@ class Server {
   // publish needs every worker's push, which follows their pull).
   int Pull(uint64_t key, void* dst, uint64_t nbytes, uint64_t want_round,
            int timeout_ms) {
-    if (dying_.load()) return -5;
-    CallGuard g(inflight_);
+    CallGuard g(inflight_, dying_);
+    if (g.refused) return -5;
     KeyStore* ks = Find(key);
     if (ks == nullptr || nbytes > ks->len) return -1;
     std::unique_lock<std::mutex> lk(ks->mu);
@@ -371,8 +379,8 @@ class Server {
   }
 
   uint64_t Round(uint64_t key) {
-    if (dying_.load()) return 0;
-    CallGuard g(inflight_);
+    CallGuard g(inflight_, dying_);
+    if (g.refused) return 0;
     KeyStore* ks = Find(key);
     if (ks == nullptr) return 0;
     std::lock_guard<std::mutex> lk(ks->mu);
@@ -380,8 +388,8 @@ class Server {
   }
 
   int PushCount(uint64_t key) {
-    if (dying_.load()) return -5;
-    CallGuard g(inflight_);
+    CallGuard g(inflight_, dying_);
+    if (g.refused) return -5;
     KeyStore* ks = Find(key);
     if (ks == nullptr) return -1;
     std::lock_guard<std::mutex> lk(ks->mu);
@@ -394,6 +402,8 @@ class Server {
   }
 
   int KeyThread(uint64_t key) {
+    CallGuard g(inflight_, dying_);
+    if (g.refused) return -1;
     KeyStore* ks = Find(key);
     return ks == nullptr ? -1 : ks->tid;
   }

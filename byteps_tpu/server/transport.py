@@ -10,7 +10,10 @@ binary frames:
     response := status:u8 | nbytes:u64 | payload[nbytes]
 
 ops: 1=INIT (``nbytes`` = store size, payload = optional initial value),
-2=PUSH (payload = data), 3=PULL (``nbytes`` = expected size, no payload;
+2=PUSH (payload = data; ``round`` carries a dedup token
+``worker_incarnation<<32 | per-key seq`` so a push retried after a
+dropped ACK is applied exactly once — see ``RemotePSBackend``),
+3=PULL (``nbytes`` = expected size, no payload;
 response carries the merged buffer), 4=CLOSE, 5=INIT_C (``nbytes`` =
 DENSE store size, payload = serialized compression kwargs — the server
 registers a codec for the key, reference server.cc:222-252), 6=PUSH_C
@@ -39,6 +42,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -53,6 +57,36 @@ OP_INIT_C, OP_PUSH_C, OP_PULL_C = 5, 6, 7
 OP_PUSH_RS = 8   # row-sparse push: nbytes = DENSE table size, payload =
                  # n|idx|rows (server/rowsparse.py wire format)
 ST_OK, ST_ERR, ST_TIMEOUT, ST_GONE = 0, 1, 2, 3
+
+# applied seqs kept as an exact set above a contiguous floor — bounds
+# memory while letting out-of-order same-key pushes through
+_DEDUP_WINDOW = 256
+
+
+class _DedupState:
+    """Per-(key, worker-incarnation) push-dedup record."""
+
+    __slots__ = ("floor", "applied", "claims", "ts")
+
+    def __init__(self) -> None:
+        self.floor = 0          # every seq <= floor is applied
+        self.applied: set = set()   # applied seqs above floor
+        self.claims: set = set()    # seqs whose apply is in flight
+        self.ts = 0.0
+
+    def is_applied(self, seq: int) -> bool:
+        return seq <= self.floor or seq in self.applied
+
+    def record(self, seq: int) -> None:
+        self.applied.add(seq)
+        # advance the contiguous floor, then cap the exact window
+        while (self.floor + 1) in self.applied:
+            self.floor += 1
+            self.applied.discard(self.floor)
+        while len(self.applied) > _DEDUP_WINDOW:
+            low = min(self.applied)
+            self.applied.discard(low)
+            self.floor = max(self.floor, low)
 
 
 def _as_bytes(arr) -> memoryview:
@@ -115,6 +149,29 @@ class PSTransportServer:
         # docs/rationale.md leaves server recovery as future work);
         # seeded with restore_snapshot's meta when recovering
         self._key_meta: Dict[int, Tuple[int, str]] = dict(key_meta or {})
+        # (key, worker_incarnation) -> _DedupState. A push retried after
+        # a lost ACK carries the same token and is acknowledged without
+        # re-applying — without this, a sync-mode reconnect could
+        # double-count one worker's gradient in the round's sum (the
+        # per-round push counter would fill early with another worker
+        # missing). Applied seqs are EXACT-membership (recent set +
+        # contiguous floor), not a high-water mark, so concurrent
+        # same-key pushes whose frames land out of order are both
+        # applied. ``claims`` marks seqs whose apply is IN FLIGHT, so a
+        # retry racing the original apply (conn reset mid-sum, instant
+        # redial) blocks on its outcome instead of re-applying
+        # concurrently. Applied seqs are recorded only after a
+        # successful apply: a dedup hit always means the payload reached
+        # the store. Entries for dead incarnations are swept after
+        # ``BPS_PUSH_DEDUP_TTL_SECS`` (default 600 — far beyond any
+        # retry window) of inactivity so elastic worker churn can't grow
+        # the table without bound.
+        self._push_seen: Dict[Tuple[int, int], _DedupState] = {}
+        self._push_lock = threading.Lock()
+        self._push_cv = threading.Condition(self._push_lock)
+        self._dedup_ttl = float(_os.environ.get(
+            "BPS_PUSH_DEDUP_TTL_SECS", "600"))
+        self._dedup_sweep_at = 0.0
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -156,7 +213,10 @@ class PSTransportServer:
                 self._key_meta[key] = (int(nbytes), dtype)
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PUSH:
-                self.backend.push(key, np.frombuffer(payload, dtype=dtype))
+                self._apply_push_once(
+                    key, rnd,
+                    lambda: self.backend.push(
+                        key, np.frombuffer(payload, dtype=dtype)))
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PULL:
                 out = np.empty(nbytes // np.dtype(dtype).itemsize,
@@ -175,13 +235,19 @@ class PSTransportServer:
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PUSH_C:
                 from .compressed import compressed_push
-                compressed_push(self.compressed, self.backend, key, payload)
+                self._apply_push_once(
+                    key, rnd,
+                    lambda: compressed_push(self.compressed, self.backend,
+                                            key, payload))
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PUSH_RS:
                 from .rowsparse import rowsparse_push, unpack_rows
                 idx, rows = unpack_rows(payload, dtype)
-                rowsparse_push(self.backend, key, idx, rows, int(nbytes),
-                               dtype, meta=self._rs_cols)
+                self._apply_push_once(
+                    key, rnd,
+                    lambda: rowsparse_push(self.backend, key, idx, rows,
+                                           int(nbytes), dtype,
+                                           meta=self._rs_cols))
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PULL_C:
                 from .compressed import compressed_pull
@@ -209,6 +275,57 @@ class PSTransportServer:
             else:   # backend rejections (bad length, key, …)
                 msg = f"{type(e).__name__}: {e}".encode()[:4096]
                 conn.sendall(_RSP.pack(ST_ERR, len(msg)) + msg)
+
+    def _apply_push_once(self, key: int, rnd: int, apply_fn) -> None:
+        """Run ``apply_fn`` exactly once per dedup token. Tokenless pushes
+        (rnd=0: legacy frames, raw clients) apply unconditionally. A
+        duplicate of an APPLIED seq is acknowledged without re-applying; a
+        duplicate racing the original's in-flight apply (conn reset
+        mid-sum + instant redial) WAITS for that apply's outcome — ack if
+        it succeeded, apply itself if it failed. Applied seqs are exact
+        membership (not a high-water mark), so two threads pushing the
+        same key through one backend both count even when their frames
+        land out of order. The applied mark is recorded only after the
+        backend accepted the payload, so a dedup hit can never mask a
+        push lost mid-apply (that stalls the round loudly instead)."""
+        if not rnd:
+            apply_fn()
+            return
+        ident = (key, rnd >> 32)
+        seq = rnd & 0xFFFFFFFF
+        now = time.time()
+        with self._push_lock:
+            if now >= self._dedup_sweep_at:
+                self._dedup_sweep_at = now + self._dedup_ttl / 4
+                dead = [k for k, st in self._push_seen.items()
+                        if now - st.ts > self._dedup_ttl and not st.claims]
+                for k in dead:
+                    del self._push_seen[k]
+            st = self._push_seen.get(ident)
+            if st is None:
+                st = self._push_seen[ident] = _DedupState()
+            while True:
+                if st.is_applied(seq):
+                    st.ts = now
+                    return                        # duplicate, already applied
+                if seq not in st.claims:
+                    st.claims.add(seq)            # we own the apply
+                    break
+                self._push_cv.wait(1.0)   # original in flight: await outcome
+        try:
+            apply_fn()
+        except BaseException:
+            with self._push_lock:
+                # retract the claim so the waiting retry (or a later
+                # resend) applies it instead
+                st.claims.discard(seq)
+                self._push_cv.notify_all()
+            raise
+        with self._push_lock:
+            st.record(seq)
+            st.ts = time.time()
+            st.claims.discard(seq)
+            self._push_cv.notify_all()
 
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
@@ -318,10 +435,16 @@ class RemotePSBackend:
     restarts from the replayed init values). Clean recovery is an
     async-PS property: sync rounds reset with the server while the
     worker's round counters don't, so a sync-mode reconnect can stall
-    on pulls (documented limitation). Retried pushes are AT-LEAST-ONCE:
-    if the server applied a push (and snapshotted it) but died before
-    acking, the resend applies it again — one duplicated gradient
-    step's worth of noise, the usual trade for async-SGD recovery."""
+    on pulls (documented limitation). Retried pushes carry a
+    ``worker_incarnation<<32 | per-key seq`` dedup token: a push whose
+    ACK was lost is re-sent but applied exactly once by a surviving
+    server, so a sync-mode connection blip cannot double-count this
+    worker's gradient in the round. The incarnation id is fresh per
+    RemotePSBackend instance, so a RESTARTED worker's pushes are never
+    mistaken for its predecessor's. Only a server that itself restarted
+    (losing the dedup table) can re-apply a retried push — and that
+    path already resets rounds, which async mode absorbs as one
+    duplicated delta and sync mode surfaces as the documented stall."""
 
     def __init__(self, addrs: Sequence[str], hash_fn: str = "djb2",
                  async_mode: bool = False,
@@ -336,6 +459,11 @@ class RemotePSBackend:
             float(_os.environ.get("BPS_RECONNECT_SECS", "30"))
             if reconnect_secs is None else reconnect_secs)
         self._rounds: Dict[int, int] = {}
+        # push dedup: fresh nonzero 32-bit incarnation id + per-key seq
+        # (seq lives in the frame's ``round`` field, unused by pushes)
+        self._wid = int.from_bytes(_os.urandom(4), "big") or 1
+        self._push_seq: Dict[int, int] = {}
+        self._push_seq_lock = threading.Lock()
         self._shard_bytes: Dict[int, int] = {}
         self._placed: set = set()
         # init_key replay log per shard index: key -> args
@@ -476,8 +604,24 @@ class RemotePSBackend:
                               place_key(key, len(self._socks), self.hash_fn),
                               self._shard_bytes, self.hash_fn)
 
+    def _push_token(self, key: int) -> int:
+        with self._push_seq_lock:
+            seq = self._push_seq.get(key, 0) + 1
+            if seq > 0xFFFFFFFF:
+                # seq field exhausted: roll to a fresh incarnation (the
+                # server tracks (incarnation, seq) pairs, so this resets
+                # dedup cleanly instead of wrapping into "already seen"
+                # territory where every push would be dropped as a retry)
+                import os as _os
+                self._wid = int.from_bytes(_os.urandom(4), "big") or 1
+                self._push_seq.clear()
+                seq = 1
+            self._push_seq[key] = seq
+        return (self._wid << 32) | seq
+
     def push(self, key: int, data: np.ndarray) -> None:
-        self._rpc(OP_PUSH, key, 0, 0, 0, str(data.dtype), _as_bytes(data))
+        self._rpc(OP_PUSH, key, self._push_token(key), 0, 0,
+                  str(data.dtype), _as_bytes(data))
 
     def pull(self, key: int, out: np.ndarray, round: int = 0,
              timeout_ms: int = 30000) -> None:
@@ -488,7 +632,8 @@ class RemotePSBackend:
         """Compressed push: ship the codec payload as-is; the server
         decompresses and dense-sums (wire bytes stay compressed — the
         bandwidth win the reference's inter-node compression is for)."""
-        self._rpc(OP_PUSH_C, key, 0, 0, 0, "uint8", memoryview(payload))
+        self._rpc(OP_PUSH_C, key, self._push_token(key), 0, 0, "uint8",
+                  memoryview(payload))
 
     def push_rowsparse(self, key: int, idx, rows, dense_nbytes: int,
                       dtype=None) -> None:
@@ -498,8 +643,8 @@ class RemotePSBackend:
         from .rowsparse import pack_rows
         if dtype is None:
             dtype = str(np.asarray(rows).dtype)
-        self._rpc(OP_PUSH_RS, key, 0, dense_nbytes, 0, dtype,
-                  memoryview(pack_rows(idx, rows)))
+        self._rpc(OP_PUSH_RS, key, self._push_token(key), dense_nbytes, 0,
+                  dtype, memoryview(pack_rows(idx, rows)))
 
     def pull_bytes(self, key: int, round: int = 0,
                    timeout_ms: int = 30000) -> bytes:

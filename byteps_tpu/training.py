@@ -14,7 +14,6 @@ whole point of the reference's pipeline.
 
 from __future__ import annotations
 
-import itertools
 import time
 from typing import Callable, Optional
 
@@ -45,10 +44,33 @@ class DistributedTrainer:
       reducer: collective strategy — plain psum by default, a compressing
         reducer from byteps_tpu.ops.compression otherwise.
       name: stable tensor-declaration name for the PS exchange; defaults
-        to a per-process creation counter (identical across SPMD workers).
+        to a hash of the parameter tree's structure+shapes+dtypes (stable
+        across restarts, unlike a bare creation counter). When several
+        trainers share a structure, later ones get positional suffixes
+        (-1, -2, …) by per-structure creation order — deterministic
+        given the same program order, but a worker restarted MID-JOB
+        replays that order from zero, so elastic PS setups with multiple
+        same-structure trainers must pass explicit names.
     """
 
-    _counter = itertools.count()
+    # per-structure-hash creation counts (never pruned: freeing a name on
+    # GC would let a later same-structure trainer reuse it against a
+    # live PS server still holding the dead trainer's keys)
+    _name_counts: dict = {}
+
+    @staticmethod
+    def _default_name(params) -> str:
+        """Structure-derived default so a restarted worker maps onto the
+        same PS keys regardless of trainer creation order — a counter
+        default would silently alias one trainer's gradients onto
+        another's equal-sized buckets after a mid-job restart."""
+        import hashlib
+        leaves = jax.tree_util.tree_leaves(params)
+        treedef = jax.tree_util.tree_structure(params)
+        sig = str(treedef) + "|" + "|".join(
+            f"{tuple(getattr(l, 'shape', ()))}:"
+            f"{getattr(l, 'dtype', type(l).__name__)}" for l in leaves)
+        return "trainer-" + hashlib.sha1(sig.encode()).hexdigest()[:10]
 
     def __init__(self, loss_fn: Callable, params, tx: optax.GradientTransformation,
                  mesh: Optional[Mesh] = None, partition_bytes: Optional[int] = None,
@@ -75,12 +97,30 @@ class DistributedTrainer:
         self.mesh = mesh
         self.axes = data_axes(mesh)
         self.backward_passes_per_step = backward_passes_per_step
-        # position-stable default name: every worker creates trainers in
-        # the same program order (SPMD), so the counter agrees across
-        # processes — pass an explicit ``name`` in elastic setups where a
-        # restarted worker would reset the counter
-        self._name = name or f"trainer{next(DistributedTrainer._counter)}"
         gs = GlobalState._instance if GlobalState.initialized() else None
+        if name is None:
+            # structure-derived default: stable across restarts and
+            # creation order. Same-structure trainers get positional
+            # suffixes (base, base-1, base-2, … in creation order) — a
+            # restart replays the same sequence ONLY if the whole
+            # program replays, so warn when the PS backend can
+            # transparently reconnect (a worker restarted mid-job could
+            # alias an earlier same-structure trainer's keys).
+            base = self._default_name(params)
+            n = DistributedTrainer._name_counts.get(base, 0)
+            DistributedTrainer._name_counts[base] = n + 1
+            name = base if n == 0 else f"{base}-{n}"
+            if n > 0:
+                pb = gs.ps_backend if gs is not None else None
+                if pb is not None and getattr(pb, "reconnect_secs", 0) > 0:
+                    from .common.logging import get_logger
+                    get_logger().warning(
+                        "multiple trainers share a parameter structure and "
+                        "rely on creation-order default names (%s) while PS "
+                        "reconnect is enabled — pass explicit name= so a "
+                        "restarted worker cannot alias another trainer's "
+                        "keys", name)
+        self._name = name
         eng = gs.engine if gs is not None else None
         self._ps_engine = (eng if eng is not None and
                            getattr(eng, "ps_exchange", None) is not None

@@ -127,9 +127,37 @@ def test_host_chain_order():
     assert isinstance(chain, HostNesterovMomentum)
     assert isinstance(chain.inner, HostErrorFeedback)
     assert isinstance(chain.inner.inner, _OB)
-    # server side gets the PLAIN codec only
+    # server side: ef → codec, NO momentum (the reference's server
+    # registry skips only momentum_type, compressor_registry.cc:40-56)
+    from byteps_tpu.ops.compression.host import create_server_chain
+    srv = create_server_chain({"compressor_type": "onebit",
+                               "ef_type": "vanilla",
+                               "momentum_type": "nesterov"}, SIZE)
+    assert isinstance(srv, HostErrorFeedback)
+    assert isinstance(srv.inner, _OB)
+    # the bare-codec factory stays undecorated
     assert isinstance(create_host_codec({"compressor_type": "onebit",
                                          "ef_type": "vanilla"}, SIZE), _OB)
+
+
+def test_server_recompression_gets_error_feedback():
+    """With ef_type configured, the server's once-per-round recompression
+    is EF-compensated: over rounds, the average served payload approaches
+    the average merged value (without EF, topk would NEVER serve the
+    dropped coordinates)."""
+    from byteps_tpu.server.compressed import CompressedKeyStore
+
+    kw = {"compressor_type": "topk", "compressor_k": str(SIZE // 4),
+          "ef_type": "vanilla"}
+    store = CompressedKeyStore()
+    codec = store.register(3, kw, SIZE, "float32")
+    assert isinstance(codec, HostErrorFeedback)
+    g = np.random.RandomState(11).randn(SIZE).astype(np.float32)
+    acc = np.zeros(SIZE)
+    rounds = 200
+    for r in range(1, rounds + 1):
+        acc += store.decompress(3, store.recompress(3, g, r))
+    np.testing.assert_allclose(acc / rounds, g, atol=0.05)
 
 
 def test_backend_compressed_two_worker_sum():

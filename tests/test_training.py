@@ -145,3 +145,21 @@ def test_sharded_trainer_gradient_accumulation(mesh8):
                                    rtol=1e-5, atol=1e-6)
     finally:
         bps.shutdown()
+
+
+def test_trainer_default_name_is_structure_stable():
+    """Default PS name derives from the param tree's structure, not a
+    creation counter — a restarted worker maps onto the same keys no
+    matter how many trainers preceded it in the old process."""
+    import numpy as np
+    from byteps_tpu.training import DistributedTrainer
+
+    p = {"w": np.zeros((4, 4), np.float32), "b": np.zeros((4,), np.float32)}
+    n1 = DistributedTrainer._default_name(p)
+    n2 = DistributedTrainer._default_name(
+        {"w": np.zeros((4, 4), np.float32),
+         "b": np.zeros((4,), np.float32)})
+    assert n1 == n2 and n1.startswith("trainer-")
+    assert n1 != DistributedTrainer._default_name(
+        {"w": np.zeros((8, 4), np.float32),
+         "b": np.zeros((4,), np.float32)})

@@ -543,3 +543,130 @@ def test_worker_reconnects_after_server_restart(tmp_path):
             o = getattr(restart, obj, None)
             if o is not None:
                 o.close()
+
+
+def test_duplicate_push_retry_is_deduplicated(server2):
+    """A push retried after a lost ACK (same dedup token) must be applied
+    once: without dedup, the round's push counter fills with one worker
+    doubled and the other missing — silent gradient corruption."""
+    from byteps_tpu.server.transport import OP_PUSH, _as_bytes
+
+    addr = f"127.0.0.1:{server2.port}"
+    w1, w2 = RemotePSBackend([addr]), RemotePSBackend([addr])
+    a = np.arange(64, dtype=np.float32)
+    b = 10 * np.ones(64, np.float32)
+    w1.init_key(5, a.nbytes)
+    w2.init_key(5, a.nbytes)
+
+    w1.push(5, a)                          # consumes seq 1
+    # simulate the reconnect retry: identical frame, identical token
+    dup_token = (w1._wid << 32) | 1
+    w1._rpc(OP_PUSH, 5, dup_token, 0, 0, "float32", _as_bytes(a))
+    w2.push(5, b)
+
+    out = np.empty_like(a)
+    w1.pull(5, out, round=1, timeout_ms=5000)
+    np.testing.assert_allclose(out, a + b)   # NOT 2a + b
+    w1.close(); w2.close()
+
+
+def test_untokened_pushes_keep_at_least_once_semantics(server2):
+    """rnd=0 pushes (legacy frames / raw clients) bypass dedup: two sends
+    are two contributions."""
+    from byteps_tpu.server.transport import OP_PUSH, _as_bytes
+
+    addr = f"127.0.0.1:{server2.port}"
+    w = RemotePSBackend([addr])
+    a = np.ones(32, np.float32)
+    w.init_key(9, a.nbytes)
+    w._rpc(OP_PUSH, 9, 0, 0, 0, "float32", _as_bytes(a))
+    w._rpc(OP_PUSH, 9, 0, 0, 0, "float32", _as_bytes(a))
+    out = np.empty_like(a)
+    w.pull(9, out, round=1, timeout_ms=5000)
+    np.testing.assert_allclose(out, 2 * a)
+    w.close()
+
+
+def test_dedup_tokens_are_per_incarnation():
+    """A RESTARTED worker (fresh RemotePSBackend) starts seq over but with
+    a new incarnation id, so its first pushes are never mistaken for its
+    predecessor's."""
+    be = PSServer(num_workers=2, engine_threads=1)
+    srv = PSTransportServer(be, host="127.0.0.1", port=0)
+    try:
+        addr = f"127.0.0.1:{srv.port}"
+        w1 = RemotePSBackend([addr])
+        a = np.ones(16, np.float32)
+        w1.init_key(2, a.nbytes)
+        w1.push(2, a)          # seq 1 under incarnation 1
+        w1.close()
+        w1b = RemotePSBackend([addr])   # restart: seq resets to 1
+        w1b.push(2, 2 * a)
+        out = np.empty_like(a)
+        w1b.pull(2, out, round=1, timeout_ms=5000)
+        np.testing.assert_allclose(out, 3 * a)
+        w1b.close()
+    finally:
+        srv.close()
+        be.close()
+
+
+def test_duplicate_racing_inflight_apply_blocks_then_dedups(server2):
+    """A retry arriving while the ORIGINAL apply is still running (conn
+    reset mid-sum + instant redial) must wait for its outcome, not apply
+    concurrently — both orderings must yield exactly one contribution."""
+    from byteps_tpu.server.transport import OP_PUSH, _as_bytes
+
+    addr = f"127.0.0.1:{server2.port}"
+    w1, w2 = RemotePSBackend([addr]), RemotePSBackend([addr])
+    a = np.ones(128, np.float32)
+    w1.init_key(21, a.nbytes)
+    w2.init_key(21, a.nbytes)
+
+    # make the backend push slow so the duplicate lands mid-apply
+    real_push = server2.backend.push
+
+    def slow_push(key, data):
+        time.sleep(0.3)
+        real_push(key, data)
+
+    server2.backend.push = slow_push
+    try:
+        tok = (w1._wid << 32) | 1
+        t = threading.Thread(
+            target=lambda: w1._rpc(OP_PUSH, 21, tok, 0, 0, "float32",
+                                   _as_bytes(a)))
+        t.start()
+        time.sleep(0.05)            # original is inside slow_push now
+        # the "retry" on a second connection (w2 hashes key 21 to the same
+        # server; craft the same token)
+        w2._rpc(OP_PUSH, 21, tok, 0, 0, "float32", _as_bytes(a))
+        t.join()
+    finally:
+        server2.backend.push = real_push
+    w2.push(21, 2 * a)              # second worker's real contribution
+    out = np.empty_like(a)
+    w1.pull(21, out, round=1, timeout_ms=5000)
+    np.testing.assert_allclose(out, 3 * a)   # one a + one 2a, NOT 4a
+    w1.close(); w2.close()
+
+
+def test_out_of_order_tokened_pushes_both_apply(server2):
+    """Exact-membership dedup: two same-key pushes whose frames land in
+    reverse seq order are BOTH contributions (a high-water mark would
+    silently drop the late-arriving earlier seq)."""
+    from byteps_tpu.server.transport import OP_PUSH, _as_bytes
+
+    addr = f"127.0.0.1:{server2.port}"
+    w = RemotePSBackend([addr])
+    a = np.ones(32, np.float32)
+    w.init_key(31, a.nbytes)
+    tok1 = (w._wid << 32) | 1
+    tok2 = (w._wid << 32) | 2
+    # seq 2 lands first, then seq 1
+    w._rpc(OP_PUSH, 31, tok2, 0, 0, "float32", _as_bytes(2 * a))
+    w._rpc(OP_PUSH, 31, tok1, 0, 0, "float32", _as_bytes(a))
+    out = np.empty_like(a)
+    w.pull(31, out, round=1, timeout_ms=5000)
+    np.testing.assert_allclose(out, 3 * a)
+    w.close()
