@@ -117,13 +117,25 @@ def main() -> None:
     float(loss)                             # chained deps -> full timing
     fw_sps = batch * iters / (time.perf_counter() - t0)
 
-    print(json.dumps({
+    # absolute chip accountability: analytic model FLOPs (no remat
+    # recompute counted) against the chip's bf16 peak — "1.0 vs baseline"
+    # alone can't hide an underutilized chip
+    from byteps_tpu.models.flops import (chip_peak_flops,
+                                         transformer_train_flops_per_sample)
+    fps = transformer_train_flops_per_sample(
+        cfg, seq, lm_positions=max(1, int(0.2 * seq)))
+    peak = chip_peak_flops()
+    line = {
         "metric": "bert_large_mlm_train_throughput" if on_tpu
                   else "bert_tiny_cpu_smoke",
         "value": round(fw_sps, 2),
         "unit": "samples/sec/chip",
         "vs_baseline": round(fw_sps / plain_sps, 4),
-    }))
+        "tflops": round(fw_sps * fps / 1e12, 2),
+    }
+    if peak:
+        line["mfu"] = round(fw_sps * fps / peak, 4)
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

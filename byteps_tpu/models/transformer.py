@@ -50,6 +50,12 @@ class TransformerConfig:
     # "mlp_only": checkpoint only the MLP half; attention residuals
     #   (qkv, flash out+lse) are kept so the backward never re-runs the
     #   attention forward. ~300MB/layer at batch 64 seq 512.
+    remat_layers: int = -1        # how many of the layers to checkpoint
+    # (-1 = all). Layers beyond the first ``remat_layers`` keep their
+    # activations resident and skip the backward's forward-recompute —
+    # full remat executes ~4/3× the model FLOPs, so un-rematting the k
+    # layers that fit in leftover HBM buys back k/L of that 33% overhead
+    # (the single biggest MFU lever on one chip; see docs/performance.md).
     attn_impl: str = "auto"       # auto | flash (Pallas) | naive
     tp_axis: Optional[str] = None # mesh axis for tensor parallelism
     sp_axis: Optional[str] = None # mesh axis for ring-attention seq shards
@@ -67,6 +73,13 @@ class TransformerConfig:
                              f"'save_attn', got {self.remat_policy!r}")
         if self.remat_policy is not None and not self.remat:
             raise ValueError("remat_policy set but remat=False — the policy "
+                             "would be silently ignored")
+        if self.remat_layers != -1 and not (0 <= self.remat_layers
+                                            <= self.layers):
+            raise ValueError(f"remat_layers must be -1 or 0..{self.layers}, "
+                             f"got {self.remat_layers}")
+        if self.remat_layers != -1 and not self.remat:
+            raise ValueError("remat_layers set but remat=False — the knob "
                              "would be silently ignored")
 
     @property
@@ -254,10 +267,11 @@ def apply(params, cfg: TransformerConfig, tokens: jnp.ndarray,
     x = embed_lookup(params["embed"]["tok"], tokens).astype(dt)
     x = x + params["embed"]["pos"][positions].astype(dt)
 
+    plain_fn = partial(_block, cfg=cfg, tp_size=tp_size)
     if cfg.remat and cfg.remat_policy == "mlp_only":
         blk_fn = partial(_block, cfg=cfg, tp_size=tp_size, remat_mlp=True)
     else:
-        blk_fn = partial(_block, cfg=cfg, tp_size=tp_size)
+        blk_fn = plain_fn
         if cfg.remat:
             if cfg.remat_policy == "dots":
                 policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
@@ -274,8 +288,23 @@ def apply(params, cfg: TransformerConfig, tokens: jnp.ndarray,
     def body(carry, blk):
         return blk_fn(carry, blk), None
 
+    def plain_body(carry, blk):
+        return plain_fn(carry, blk), None
+
     def stack_fn(blocks, h):
-        out, _ = jax.lax.scan(body, h, blocks, unroll=cfg.scan_unroll)
+        k = cfg.remat_layers
+        if not cfg.remat or k == -1 or k >= cfg.layers or cfg.pp_axis:
+            # uniform policy across the stack (pp stages keep it uniform
+            # too: their layer shard sizes vary with the stage count)
+            out, _ = jax.lax.scan(body, h, blocks, unroll=cfg.scan_unroll)
+            return out
+        # partial remat: first k layers checkpointed, the rest keep
+        # activations resident (two scans; compile time stays O(1))
+        rem = jax.tree_util.tree_map(lambda x: x[:k], blocks)
+        res = jax.tree_util.tree_map(lambda x: x[k:], blocks)
+        if k:
+            h, _ = jax.lax.scan(body, h, rem, unroll=cfg.scan_unroll)
+        out, _ = jax.lax.scan(plain_body, h, res, unroll=cfg.scan_unroll)
         return out
 
     if cfg.pp_axis is not None:

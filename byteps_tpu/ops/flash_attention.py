@@ -40,10 +40,35 @@ def _pick_block(s: int, want: int) -> int:
     return s
 
 
+def _head_tile(h: int, nq: int, nk: int, bq: int, bk: int, d: int,
+               interpret: bool, mats: int = 1) -> int:
+    """Heads per kernel program. Short sequences (one block pair per
+    (b, h)) leave each program ~0.2 GFLOP — a 1024-program grid was
+    overhead-bound (measured: BERT-large seq-512 fwd call 2.0 ms vs
+    ~0.5 ms of matmul work; ht=8 recovered ~8%). Longer sequences get
+    enough work per program from the block loops, and head-tiling would
+    multiply the VMEM footprint, so keep 1. ``mats`` = number of
+    [bq, bk] fp32 temporaries live per unrolled head (1 fwd; 3 bwd —
+    the Mosaic stack allocator keeps each unrolled iteration's
+    temporaries live, and the scoped-vmem limit is 16M). BPS_FLASH_HT
+    overrides (0 = auto)."""
+    import os as _os
+    env = int(_os.environ.get("BPS_FLASH_HT", "0"))
+    if env:
+        return env if h % env == 0 else 1
+    if interpret or nq != 1 or nk != 1:
+        return 1
+    for cand in (8, 4, 2):
+        vmem = cand * (mats * bq * bk * 4 + 8 * max(bq, bk) * d)
+        if h % cand == 0 and vmem < 10 << 20:
+            return cand
+    return 1
+
+
 # --------------------------------------------------------------- forward
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc, m_scr, l_scr, *, scale, causal, bq, bk, nk):
+                acc, m_scr, l_scr, *, scale, causal, bq, bk, nk, ht):
     kb = pl.program_id(3)
     qb = pl.program_id(2)
 
@@ -58,33 +83,43 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(run)
     def _block():
-        q = q_ref[0, 0]                      # [bq, d]
-        k = k_ref[0, 0]                      # [bk, d]
-        v = v_ref[0, 0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # [bq, bk]
-        if causal:
-            rows = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(rows >= cols, s, _NEG_INF)
-        m_prev = m_scr[:, :1]                               # [bq, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = l_scr[:, :1] * alpha + jnp.sum(p, -1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)             # [bq, d]
-        acc[...] = acc[...] * alpha + pv
-        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+        # ``ht`` heads per program (unrolled): amortizes grid/dispatch
+        # overhead — at seq 512 the per-(b,h) program is only ~0.2 GFLOP
+        # and a 1024-program grid was overhead-bound (measured 2.0 ms vs
+        # ~0.5 ms of matmul work per BERT-large layer call)
+        for t in range(ht):
+            q = q_ref[0, t]                  # [bq, d]
+            k = k_ref[0, t]                  # [bk, d]
+            v = v_ref[0, t]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale   # [bq, bk]
+            if causal:
+                rows = qb * bq + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 0)
+                cols = kb * bk + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 1)
+                s = jnp.where(rows >= cols, s, _NEG_INF)
+            r = slice(t * bq, (t + 1) * bq)
+            m_prev = m_scr[r, :1]                             # [bq, 1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = l_scr[r, :1] * alpha + jnp.sum(p, -1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)           # [bq, d]
+            acc[r] = acc[r] * alpha + pv
+            m_scr[r] = jnp.broadcast_to(m_new, (bq, 128))
+            l_scr[r] = jnp.broadcast_to(l_new, (bq, 128))
 
     @pl.when(kb == nk - 1)
     def _finish():
-        l = jnp.maximum(l_scr[:, :1], 1e-30)
-        o_ref[0, 0] = (acc[...] / l).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_scr[:, :1] + jnp.log(l)
+        for t in range(ht):
+            r = slice(t * bq, (t + 1) * bq)
+            l = jnp.maximum(l_scr[r, :1], 1e-30)
+            o_ref[0, t] = (acc[r] / l).astype(o_ref.dtype)
+            lse_ref[0, t] = m_scr[r, :1] + jnp.log(l)
 
 
 def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret, out_dtype=None):
@@ -95,39 +130,64 @@ def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret, out_dtype=None):
     not accumulate one bf16 rounding per ring step."""
     b, h, s, d = q.shape
     nq, nk = s // bq, s // bk
-    grid = (b, h, nq, nk)
+    ht = _head_tile(h, nq, nk, bq, bk, d, interpret)
+    grid = (b, h // ht, nq, nk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk, nk=nk)
+                               bq=bq, bk=bk, nk=nk, ht=ht)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
+            pl.BlockSpec((1, ht, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, ht, bk, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
+            pl.BlockSpec((1, ht, bk, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, ht, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, ht, bq, 1), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s, d), out_dtype or q.dtype),
             jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((bq, d), jnp.float32),
-            pltpu.VMEM((bq, 128), jnp.float32),
-            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((ht * bq, d), jnp.float32),
+            pltpu.VMEM((ht * bq, 128), jnp.float32),
+            pltpu.VMEM((ht * bq, 128), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
     return out, lse
 
 
+def _xla_fwd(qt, kt, vt, causal, scale, out_dtype=None):
+    """[b,h,s,d] → (out, lse [b,h,s,1] fp32) with plain XLA ops.
+
+    At moderate sequence lengths the XLA-fused softmax-attention forward
+    beats the Pallas forward kernel (measured: BERT-large seq 512 fwd
+    261→239 ms — the [s,s] scores fit HBM easily and XLA's fusion wins),
+    while the flash BACKWARD kernels still beat XLA's backward (which
+    must materialize softmax gradients). The hybrid uses this forward +
+    the same (out, lse) residual contract the Pallas backward needs."""
+    s = jax.lax.dot_general(qt, kt, (((3,), (3,)), ((0, 1), (0, 1))),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        s = jnp.where(rows >= cols, s, _NEG_INF)
+    m = jnp.max(s, -1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, -1, keepdims=True)
+    out = jax.lax.dot_general((p / l).astype(vt.dtype), vt,
+                              (((3,), (2,)), ((0, 1), (0, 1))),
+                              preferred_element_type=jnp.float32)
+    return out.astype(out_dtype or qt.dtype), m + jnp.log(l)
+
+
 # -------------------------------------------------------------- backward
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc, *, scale, causal, bq, bk, nk):
+               dq_acc, *, scale, causal, bq, bk, nk, ht):
     kb = pl.program_id(3)
     qb = pl.program_id(2)
 
@@ -139,36 +199,41 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(run)
     def _block():
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
-        do = do_ref[0, 0]
-        lse = lse_ref[0, 0]                                 # [bq, 1]
-        delta = delta_ref[0, 0]                             # [bq, 1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        p = jnp.exp(s - lse)                                # [bq, bk]
-        if causal:
-            rows = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            p = jnp.where(rows >= cols, p, 0.0)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)             # [bq, bk]
-        ds = (p * (dp - delta)).astype(k.dtype)
-        dq_acc[...] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+        for t in range(ht):                  # heads per program (see fwd)
+            q = q_ref[0, t]
+            k = k_ref[0, t]
+            v = v_ref[0, t]
+            do = do_ref[0, t]
+            lse = lse_ref[0, t]                             # [bq, 1]
+            delta = delta_ref[0, t]                         # [bq, 1]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            p = jnp.exp(s - lse)                            # [bq, bk]
+            if causal:
+                rows = qb * bq + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 0)
+                cols = kb * bk + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 1)
+                p = jnp.where(rows >= cols, p, 0.0)
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)         # [bq, bk]
+            ds = (p * (dp - delta)).astype(k.dtype)
+            r = slice(t * bq, (t + 1) * bq)
+            dq_acc[r] += jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
 
     @pl.when(kb == nk - 1)
     def _finish():
-        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+        for t in range(ht):
+            dq_ref[0, t] = dq_acc[t * bq:(t + 1) * bq].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc,
-                *, scale, causal, bq, bk, nq):
+                *, scale, causal, bq, bk, nq, ht):
     qb = pl.program_id(3)
     kb = pl.program_id(2)
 
@@ -181,36 +246,42 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _block():
-        q = q_ref[0, 0]                                     # [bq, d]
-        k = k_ref[0, 0]                                     # [bk, d]
-        v = v_ref[0, 0]
-        do = do_ref[0, 0]                                   # [bq, d]
-        lse = lse_ref[0, 0]                                 # [bq, 1]
-        delta = delta_ref[0, 0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale     # [bq, bk]
-        p = jnp.exp(s - lse)
-        if causal:
-            rows = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            p = jnp.where(rows >= cols, p, 0.0)
-        pt = p.astype(do.dtype)
-        dv_acc[...] += jax.lax.dot_general(
-            pt, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)             # [bk, d]
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)             # [bq, bk]
-        ds = (p * (dp - delta)).astype(q.dtype)
-        dk_acc[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale     # [bk, d]
+        for t in range(ht):                  # heads per program (see fwd)
+            q = q_ref[0, t]                                 # [bq, d]
+            k = k_ref[0, t]                                 # [bk, d]
+            v = v_ref[0, t]
+            do = do_ref[0, t]                               # [bq, d]
+            lse = lse_ref[0, t]                             # [bq, 1]
+            delta = delta_ref[0, t]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [bq, bk]
+            p = jnp.exp(s - lse)
+            if causal:
+                rows = qb * bq + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 0)
+                cols = kb * bk + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 1)
+                p = jnp.where(rows >= cols, p, 0.0)
+            pt = p.astype(do.dtype)
+            r = slice(t * bk, (t + 1) * bk)
+            dv_acc[r] += jax.lax.dot_general(
+                pt, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)         # [bk, d]
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)         # [bq, bk]
+            ds = (p * (dp - delta)).astype(q.dtype)
+            dk_acc[r] += jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [bk, d]
 
     @pl.when(qb == nq - 1)
     def _finish():
-        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
-        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+        for t in range(ht):
+            r = slice(t * bk, (t + 1) * bk)
+            dk_ref[0, t] = dk_acc[r].astype(dk_ref.dtype)
+            dv_ref[0, t] = dv_acc[r].astype(dv_ref.dtype)
 
 
 def _flash_bwd(q, k, v, out, lse, do, causal, scale, bq, bk, interpret,
@@ -221,35 +292,36 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, bq, bk, interpret,
         delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                         axis=-1, keepdims=True)             # [b,h,s,1]
 
-    qspec = pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0))
-    kspec = pl.BlockSpec((1, 1, bk, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0))
-    r1spec = pl.BlockSpec((1, 1, bq, 1), lambda ib, ih, iq, ik: (ib, ih, iq, 0))
+    ht = _head_tile(h, nq, nk, bq, bk, d, interpret, mats=3)
+    qspec = pl.BlockSpec((1, ht, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0))
+    kspec = pl.BlockSpec((1, ht, bk, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0))
+    r1spec = pl.BlockSpec((1, ht, bq, 1), lambda ib, ih, iq, ik: (ib, ih, iq, 0))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk),
-        grid=(b, h, nq, nk),
+                          bq=bq, bk=bk, nk=nk, ht=ht),
+        grid=(b, h // ht, nq, nk),
         in_specs=[qspec, kspec, kspec, qspec, r1spec, r1spec],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((ht * bq, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
     # dk/dv: kv block is the outer (carried) grid dim, q block inner
-    qspec2 = pl.BlockSpec((1, 1, bq, d), lambda ib, ih, ik, iq: (ib, ih, iq, 0))
-    kspec2 = pl.BlockSpec((1, 1, bk, d), lambda ib, ih, ik, iq: (ib, ih, ik, 0))
-    r1spec2 = pl.BlockSpec((1, 1, bq, 1), lambda ib, ih, ik, iq: (ib, ih, iq, 0))
+    qspec2 = pl.BlockSpec((1, ht, bq, d), lambda ib, ih, ik, iq: (ib, ih, iq, 0))
+    kspec2 = pl.BlockSpec((1, ht, bk, d), lambda ib, ih, ik, iq: (ib, ih, ik, 0))
+    r1spec2 = pl.BlockSpec((1, ht, bq, 1), lambda ib, ih, ik, iq: (ib, ih, iq, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq),
-        grid=(b, h, nk, nq),
+                          bq=bq, bk=bk, nq=nq, ht=ht),
+        grid=(b, h // ht, nk, nq),
         in_specs=[qspec2, kspec2, kspec2, qspec2, r1spec2, r1spec2],
         out_specs=[kspec2, kspec2],
         out_shape=[jax.ShapeDtypeStruct((b, h, s, d), k.dtype),
                    jax.ShapeDtypeStruct((b, h, s, d), v.dtype)],
-        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
-                        pltpu.VMEM((bk, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((ht * bk, d), jnp.float32),
+                        pltpu.VMEM((ht * bk, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
@@ -257,17 +329,21 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, bq, bk, interpret,
 
 # ------------------------------------------------------------ public API
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(q, k, v, causal=False, scale=None,
-                    block_q=512, block_k=512, interpret=False):
+                    block_q=512, block_k=512, interpret=False,
+                    fwd_xla=False):
     """Pallas flash attention. q,k,v: [b, s, heads, d] → [b, s, heads, d].
 
     seq must be divisible by the (auto-shrunk) block sizes. Differentiable
     via the flash backward kernels. 512 blocks measured ~29% faster than
     256 on BERT-large seq-512 (fewer grid steps, full-width MXU tiles);
     VMEM stays comfortable through d=256 (p-block 1MB + acc 512KB).
+    ``fwd_xla`` swaps the forward for the XLA-fused one (see ``_xla_fwd``)
+    while keeping the flash backward — the "hybrid" impl.
     """
-    out, _ = _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret)
+    out, _ = _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret,
+                       fwd_xla)
     return out
 
 
@@ -280,12 +356,16 @@ def _resolve(q, scale, block_q, block_k):
     return scale, bq, bk
 
 
-def _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
+def _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret,
+              fwd_xla=False):
     scale, bq, bk = _resolve(q, scale, block_q, block_k)
     qt = jnp.swapaxes(q, 1, 2)       # [b, h, s, d]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    out, lse = _flash_fwd(qt, kt, vt, causal, scale, bq, bk, interpret)
+    if fwd_xla:
+        out, lse = _xla_fwd(qt, kt, vt, causal, scale)
+    else:
+        out, lse = _flash_fwd(qt, kt, vt, causal, scale, bq, bk, interpret)
     # store lse as [b,h,s]: a trailing dim of 1 lane-pads to 128 on TPU,
     # bloating the saved residual 128x when it survives to the backward
     from jax.ad_checkpoint import checkpoint_name
@@ -297,12 +377,14 @@ def _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
     return jnp.swapaxes(out, 1, 2), (qt, kt, vt, out, lse)
 
 
-def _vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, res = _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret)
+def _vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+             fwd_xla=False):
+    out, res = _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret,
+                         fwd_xla)
     return out, res
 
 
-def _vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _vjp_bwd(causal, scale, block_q, block_k, interpret, fwd_xla, res, g):
     qt, kt, vt, out, lse = res
     scale, bq, bk = _resolve(jnp.swapaxes(qt, 1, 2), scale, block_q, block_k)
     do = jnp.swapaxes(g, 1, 2)
@@ -328,14 +410,23 @@ _warned_fallback = set()
 def attention(q, k, v, causal=False, scale=None, impl="auto"):
     """Dispatcher: Pallas flash kernels on TPU, blockwise JAX elsewhere.
 
-    impl: "auto" | "flash" | "naive".
+    impl: "auto" | "flash" | "hybrid" | "naive". "hybrid" = XLA-fused
+    forward + flash backward kernels: wins on FORWARD-dominated work
+    (inference/eval: BERT-large seq-512 fwd measured 261→239 ms) but
+    loses on the rematted train step (69.0 vs 73.7 samples/s — the
+    recompute re-materializes the [s,s] scores inside the backward),
+    so "auto" stays pure flash and hybrid is opt-in.
     """
-    if impl not in ("auto", "flash", "naive"):
-        raise ValueError(f"attn impl must be auto|flash|naive, got {impl!r}")
+    if impl not in ("auto", "flash", "hybrid", "naive"):
+        raise ValueError(
+            f"attn impl must be auto|flash|hybrid|naive, got {impl!r}")
     from ..parallel.ring import local_attention
     if impl == "naive":
         return local_attention(q, k, v, causal=causal, scale=scale)
     on_tpu = jax.default_backend() == "tpu"
+    if impl == "hybrid":
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               fwd_xla=True)
     if impl == "flash" or (on_tpu and supported(q.shape)):
         return flash_attention(q, k, v, causal=causal, scale=scale)
     if on_tpu and tuple(q.shape) not in _warned_fallback:

@@ -304,3 +304,48 @@ def test_chunked_lm_head_composes_with_sequence_parallel():
         check_vma=False))
     got = float(fn(params, tokens))
     np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_flops_accounting():
+    """Analytic FLOPs: hand-computed BERT-large seq-512 numbers."""
+    from byteps_tpu.models import bert
+    from byteps_tpu.models.flops import (transformer_fwd_flops_per_sample,
+                                         transformer_train_flops_per_sample)
+    cfg = bert.bert_large(max_seq=512)
+    s, h, m = 512, 1024, 4096
+    per_layer = 8 * s * h * h + 4 * s * h * m + 4 * s * s * h
+    head = 2 * 102 * h * cfg.vocab_size
+    want = 24 * per_layer + head
+    assert transformer_fwd_flops_per_sample(cfg, 512, 102) == want
+    assert transformer_train_flops_per_sample(cfg, 512, 102) == 3.0 * want
+
+
+def test_remat_layers_validation_and_exactness():
+    """remat_layers must be gated on remat=True; partial remat computes
+    the same loss/grads as full remat."""
+    import dataclasses
+    import pytest as _pt
+    from byteps_tpu.models import transformer as T
+
+    with _pt.raises(ValueError, match="remat_layers"):
+        T.TransformerConfig(layers=4, remat=False, remat_layers=2)
+    with _pt.raises(ValueError, match="remat_layers"):
+        T.TransformerConfig(layers=4, remat_layers=9)
+
+    cfg = T.TransformerConfig(vocab_size=128, hidden=64, layers=4, heads=4,
+                              mlp_dim=128, max_seq=32, attn_impl="naive")
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 128)
+    tgt = jnp.where(jax.random.uniform(jax.random.PRNGKey(2), (2, 32)) < 0.2,
+                    tok, -1)
+
+    def loss(cfgv):
+        return lambda p: T.lm_loss(p, cfgv, (tok, tgt))
+
+    l_full, g_full = jax.value_and_grad(loss(cfg))(params)
+    cfg2 = dataclasses.replace(cfg, remat_layers=2)
+    l_part, g_part = jax.value_and_grad(loss(cfg2))(params)
+    assert jnp.allclose(l_full, l_part, rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        g_full, g_part)
