@@ -97,6 +97,9 @@ class Config:
     trace_start_step: int = 10
     trace_end_step: int = 20
     trace_dir: str = "."
+    trace_profiler: bool = False         # BPS_TRACE_PROFILER: also capture
+                                         # a jax.profiler device trace over
+                                         # the same step window
     telemetry_on: bool = False
     debug_sample_tensor: str = ""        # BYTEPS_DEBUG_SAMPLE_TENSOR
 
@@ -130,6 +133,7 @@ class Config:
             trace_start_step=_env_int("BPS_TRACE_START_STEP", "BYTEPS_TRACE_START_STEP", 10),
             trace_end_step=_env_int("BPS_TRACE_END_STEP", "BYTEPS_TRACE_END_STEP", 20),
             trace_dir=_env("BPS_TRACE_DIR", "BYTEPS_TRACE_DIR", "."),
+            trace_profiler=_env_bool("BPS_TRACE_PROFILER", None),
             telemetry_on=_env_bool("BPS_TELEMETRY_ON", "BYTEPS_TELEMETRY_ON"),
             debug_sample_tensor=_env("BPS_DEBUG_SAMPLE_TENSOR", "BYTEPS_DEBUG_SAMPLE_TENSOR", ""),
             log_level=_env("BPS_LOG_LEVEL", "BYTEPS_LOG_LEVEL", "INFO"),

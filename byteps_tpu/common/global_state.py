@@ -79,6 +79,7 @@ class GlobalState:
                     self.ps_backend, partition_bytes=config.partition_bytes,
                     registry=self.registry,
                     min_compress_bytes=config.min_compress_bytes)
+                self.engine.ps_exchange.timeline = self.timeline
                 self.engine.ps_world = config.num_worker
         self.dp = dp_size(self.mesh)
         self.step = 0
@@ -124,6 +125,8 @@ class GlobalState:
                     len(inst.engine._handles),
                     "; in PS mode peers may block on the missing pushes"
                     if inst.ps_backend is not None else "")
+            if inst.engine.ps_exchange is not None:
+                inst.engine.ps_exchange.close()
             if inst.ps_backend is not None:
                 inst.ps_backend.close()
             cls._instance = None
@@ -138,6 +141,8 @@ class GlobalState:
                 return None
             decls = [(d.name, d.priority, d.compression_kwargs)
                      for d in (inst.registry.get(n) for n in inst.registry.declared_names())]
+            if inst.engine.ps_exchange is not None:
+                inst.engine.ps_exchange.close()
             if inst.ps_backend is not None:
                 inst.ps_backend.close()
             cls._instance = None

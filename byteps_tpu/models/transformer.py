@@ -61,6 +61,10 @@ class TransformerConfig:
     sp_axis: Optional[str] = None # mesh axis for ring-attention seq shards
     pp_axis: Optional[str] = None # mesh axis for pipeline (layer) stages
     pp_microbatches: int = 0      # GPipe microbatches (0 → pipeline size)
+    pp_interleave: int = 1        # virtual chunks per pipeline rank (>1 =
+    # interleaved/circular schedule: bubble shrinks interleave-fold; the
+    # stacked layer params must be laid out with
+    # parallel.pipeline.interleave_permutation)
     scan_unroll: int = 1          # lax.scan unroll factor over layers
     lm_head_chunk: int = 0        # >0: chunked cross-entropy — the LM
     # head + softmax run per sequence chunk under jax.checkpoint, so the
@@ -81,6 +85,11 @@ class TransformerConfig:
         if self.remat_layers != -1 and not self.remat:
             raise ValueError("remat_layers set but remat=False — the knob "
                              "would be silently ignored")
+        if self.pp_interleave < 1:
+            raise ValueError(f"pp_interleave must be >= 1, "
+                             f"got {self.pp_interleave}")
+        if self.pp_interleave > 1 and self.pp_axis is None:
+            raise ValueError("pp_interleave > 1 needs pp_axis")
 
     @property
     def head_dim(self) -> int:
@@ -308,18 +317,28 @@ def apply(params, cfg: TransformerConfig, tokens: jnp.ndarray,
         return out
 
     if cfg.pp_axis is not None:
-        # GPipe over the pipe axis: params["blocks"] arrives as this
+        # Pipeline over the pipe axis: params["blocks"] arrives as this
         # stage's layer shard; microbatch the batch dim and stream.
-        from ..parallel.pipeline import pipeline
+        from ..parallel.pipeline import pipeline, pipeline_interleaved
         pn = jax.lax.axis_size(cfg.pp_axis)
-        if cfg.layers % pn:
-            raise ValueError(
-                f"{cfg.layers} layers not divisible by {pn} pipeline stages")
+        V = cfg.pp_interleave
+        if cfg.layers % (pn * V):
+            raise ValueError(f"{cfg.layers} layers not divisible by "
+                             f"{pn} stages x {V} chunks")
         n_micro = cfg.pp_microbatches or pn
         if b % n_micro:
             raise ValueError(f"batch {b} not divisible by {n_micro} microbatches")
         xm = x.reshape(n_micro, b // n_micro, *x.shape[1:])
-        xm = pipeline(stack_fn, params["blocks"], xm, cfg.pp_axis)
+        if V > 1:
+            # interleaved layout contract: the caller permuted the stacked
+            # layers with interleave_permutation, so this rank's [L/pn]
+            # shard reshapes to [V, Lc] chunks in ring order
+            chunked = jax.tree_util.tree_map(
+                lambda p: p.reshape(V, p.shape[0] // V, *p.shape[1:]),
+                params["blocks"])
+            xm = pipeline_interleaved(stack_fn, chunked, xm, cfg.pp_axis)
+        else:
+            xm = pipeline(stack_fn, params["blocks"], xm, cfg.pp_axis)
         x = xm.reshape(b, *x.shape[1:])   # valid on the last stage only
     else:
         x = stack_fn(params["blocks"], x)

@@ -317,6 +317,7 @@ class PushPullEngine:
         credit = self.scheduling_credit if sync else 0
         inflight: List[Tuple[int, list]] = []   # (bucket bytes, results)
         inflight_bytes = 0
+        bucket_runs: List[Tuple[int, float, tuple]] = []  # (key, t, results)
         for fn, leaf_idxs, bucket in progs:
             if credit > 0 and inflight and inflight_bytes > credit:
                 tc = time.time()
@@ -341,6 +342,18 @@ class PushPullEngine:
             if self.timeline is not None:
                 self.timeline.record(name or "push_pull", "DISPATCH",
                                      tb, time.time() - tb, key=bucket.index)
+                bucket_runs.append((bucket.index, tb, results))
+        if sync and self.timeline is not None:
+            # per-bucket REDUCE rows: dispatch → device completion (queue
+            # wait + execution — the reference's per-key stage intervals,
+            # scheduled_queue.cc:105-123). Measured BEFORE any PS hop so
+            # the rows never absorb the blocking host exchange; buckets
+            # complete in dispatch order on TPU, so blocking in order
+            # gives each bucket its own completion time.
+            for bidx, tb, res in bucket_runs:
+                jax.block_until_ready(res)
+                self.timeline.record(name or "push_pull", "REDUCE",
+                                     tb, time.time() - tb, key=bidx)
         result = jax.tree_util.tree_unflatten(treedef, out)
         if self.ps_exchange is not None:
             if _defer_ps:

@@ -9,20 +9,31 @@ shard_map'd, jitted step: `lax.scan` drives the ticks, so compile time is
 O(1) in microbatch count, and XLA overlaps each tick's ppermute with the
 next tick's compute.
 
-Schedule: plain GPipe with ``n_micro + n_stages - 1`` ticks; the bubble
-fraction is ``(n_stages-1)/(n_micro+n_stages-1)`` — raise the microbatch
-count to amortize it. All stages execute the same ``stage_fn`` (SPMD);
-non-final ranks produce dummy outputs that carry zero cotangent, so
-gradients are exact without any per-stage program.
+Two schedules:
 
-Reference (public technique): GPipe (Huang et al. 2019); the
-collective-permute formulation follows the standard JAX SPMD pipelining
-pattern (scaling-book §pipelining).
+  - ``pipeline``: plain GPipe with ``n_micro + n_stages - 1`` ticks;
+    bubble fraction ``(n_stages-1)/(n_micro+n_stages-1)`` — raise the
+    microbatch count to amortize it.
+  - ``pipeline_interleaved``: circular/interleaved schedule (the
+    Megatron-LM "virtual pipeline", Narayanan et al. 2021): each rank
+    holds ``V`` non-contiguous layer chunks and microbatches loop the
+    ring ``V`` times, cutting the bubble to
+    ``(n_stages-1)/(V·n_micro+n_stages-1)`` at the cost of V× the
+    ppermute traffic. See ``interleave_permutation`` for the parameter
+    layout contract.
+
+All stages execute the same ``stage_fn`` (SPMD); non-final ranks produce
+dummy outputs that carry zero cotangent, so gradients are exact without
+any per-stage program.
+
+Reference (public techniques): GPipe (Huang et al. 2019), interleaved
+1F1B (Narayanan et al. 2021); the collective-permute formulation follows
+the standard JAX SPMD pipelining pattern (scaling-book §pipelining).
 """
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, List
 
 import jax
 import jax.numpy as jnp
@@ -80,6 +91,112 @@ def pipeline(stage_fn: Callable, stage_params, inputs: jnp.ndarray,
     (state, outputs), _ = jax.lax.scan(tick, (state, outputs),
                                        jnp.arange(n_micro + n - 1))
     return outputs
+
+
+def pipeline_interleaved(stage_fn: Callable, chunk_params,
+                         inputs: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Interleaved (circular) pipeline over ``axis_name``.
+
+    Call inside shard_map. Each rank holds ``V`` layer CHUNKS
+    (``chunk_params`` leaves have leading dim V) and each microbatch
+    loops the ring V times — rank r's chunk v runs the semantic layers
+    ``(v·n + r)·Lc .. +Lc`` (use ``interleave_permutation`` to lay the
+    stacked params out so contiguous sharding yields exactly that).
+
+    Schedule: microbatches stream in groups of n; rank r at tick t works
+    on ``local = t - r``; group ``local // (V·n)``, chunk
+    ``(local % (V·n)) // n``, in-group microbatch ``local % n``. One
+    ppermute r→r+1 per tick carries every hop, including the
+    wrap-around from rank n-1's chunk v to rank 0's chunk v+1 (the
+    arithmetic makes them land one tick apart). Total ticks
+    ``V·m + n - 1`` of 1/V stage-time each → bubble
+    ``(n-1)/(V·m + n - 1)``.
+
+    Args:
+      stage_fn: ``stage_fn(one_chunk_params, x) -> y`` (shape-preserving).
+      chunk_params: pytree with leading dim V on every leaf.
+      inputs: ``[n_micro, mb, ...]``; n_micro must be a multiple of n.
+      axis_name: pipeline mesh axis.
+
+    Returns:
+      ``[n_micro, mb, ...]``, valid on the LAST stage only.
+    """
+    n = jax.lax.axis_size(axis_name)
+    V = jax.tree_util.tree_leaves(chunk_params)[0].shape[0]
+    if n == 1:
+        def whole(params, x):
+            for v in range(V):
+                x = stage_fn(jax.tree_util.tree_map(lambda p: p[v], params), x)
+            return x
+        return _scan_micro(whole, chunk_params, inputs)
+    stage = jax.lax.axis_index(axis_name)
+    m = inputs.shape[0]
+    if m % n:
+        raise ValueError(f"interleaved pipeline needs n_micro % n_stages "
+                         f"== 0; got {m} % {n}")
+    cycle = V * n
+    total_busy = (m // n) * cycle
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    state = jnp.zeros_like(inputs[0])
+    outputs = jnp.zeros_like(inputs)
+
+    def tick(carry, t):
+        state, outputs = carry
+        local = jnp.clip(t - stage, 0, total_busy - 1)
+        g = local // cycle
+        rem = local % cycle
+        v = rem // n
+        micro = g * n + rem % n
+        params_v = jax.tree_util.tree_map(
+            lambda p: jax.lax.dynamic_index_in_dim(p, v, 0, keepdims=False),
+            chunk_params)
+        inp = jax.lax.dynamic_index_in_dim(inputs, micro, 0, keepdims=False)
+        x = jnp.where(jnp.logical_and(stage == 0, v == 0), inp, state)
+        y = stage_fn(params_v, x)
+        valid = jnp.logical_and(t >= stage, t - stage < total_busy)
+        commit = jnp.logical_and(
+            valid, jnp.logical_and(stage == n - 1, v == V - 1))
+        cur = jax.lax.dynamic_index_in_dim(outputs, micro, 0, keepdims=False)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(commit, y, cur), micro, 0)
+        state = jax.lax.ppermute(y, axis_name, perm)
+        return (state, outputs), None
+
+    (state, outputs), _ = jax.lax.scan(tick, (state, outputs),
+                                       jnp.arange(total_busy + n - 1))
+    return outputs
+
+
+def interleave_permutation(n_layers: int, n_stages: int,
+                           interleave: int) -> List[int]:
+    """Leading-dim permutation for the interleaved layout.
+
+    ``stacked_blocks[perm]`` reordered this way and then sharded
+    contiguously over the pipe axis gives rank r a [L/n]-layer shard
+    whose reshape to [V, L/(n·V), ...] puts semantic layers
+    ``(v·n + r)·Lc .. +Lc`` at chunk v — the layout
+    ``pipeline_interleaved`` runs. Apply the INVERSE (np.argsort) to
+    bring parameter/gradient trees back to semantic order for
+    checkpointing."""
+    L, n, V = n_layers, n_stages, interleave
+    if L % (n * V):
+        raise ValueError(f"{L} layers not divisible by stages×interleave "
+                         f"{n}×{V}")
+    Lc = L // (n * V)
+    perm = []
+    for r in range(n):          # shard-major: rank r's rows, chunk order
+        for v in range(V):
+            start = (v * n + r) * Lc
+            perm.extend(range(start, start + Lc))
+    return perm
+
+
+def bubble_fraction(n_stages: int, n_micro: int, interleave: int = 1) -> float:
+    """Idle fraction of the pipeline schedule (per direction)."""
+    if n_stages <= 1:
+        return 0.0
+    return (n_stages - 1) / (interleave * n_micro + n_stages - 1)
 
 
 def _scan_micro(stage_fn, stage_params, inputs):

@@ -19,7 +19,10 @@ Two modes, mirroring the reference's two PS deployments:
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
 
 import jax
 import numpy as np
@@ -30,22 +33,47 @@ from .engine import HostPSBackend
 
 
 class PSGradientExchange:
-    """Sync-mode bucketed gradient exchange through the host PS service."""
+    """Sync-mode bucketed gradient exchange through the host PS service.
+
+    The exchange is PIPELINED per bucket (BPS_PS_PIPELINE threads,
+    default 4; ≤1 = serial): bucket k+1's pack+push runs while bucket
+    k's pull is blocked on the server's merge, and the pull lands as
+    soon as that merge publishes — the reference's free-running
+    push/pull loops (core_loops.cc:538-618) rather than a
+    push-everything-then-pull-everything barrier. Requires a transport
+    with >1 connection per shard (RemotePSBackend pools,
+    BPS_PS_CONNS) so a round-blocked PULL doesn't stall later PUSH
+    frames; the in-process backend is natively concurrent."""
 
     def __init__(self, backend: HostPSBackend, partition_bytes: int = 4 << 20,
                  registry: Optional[NameRegistry] = None,
-                 min_compress_bytes: int = 65536) -> None:
+                 min_compress_bytes: int = 65536,
+                 pipeline_depth: Optional[int] = None) -> None:
         self.backend = backend
         self.partition_bytes = partition_bytes
         self.registry = registry or NameRegistry()
         self.min_compress_bytes = min_compress_bytes
+        self.pipeline_depth = (int(os.environ.get("BPS_PS_PIPELINE", "4"))
+                               if pipeline_depth is None else pipeline_depth)
+        self.timeline = None            # set by GlobalState when tracing
         self._plans: Dict = {}
         self._rounds: Dict[str, int] = {}
+        self._push_ex: Optional[ThreadPoolExecutor] = None
+        self._pull_ex: Optional[ThreadPoolExecutor] = None
         # per-PS-key worker compressor chain (momentum→ef→codec) — holds
         # EF error / momentum state, so it outlives the plan cache entry
         # (reference: per-partition compressor_list in BPSContext,
         # common.h:202, operations.cc:380-385)
         self._chains: Dict[int, object] = {}
+
+    def close(self) -> None:
+        """Stop the pipeline executors (idempotent). bps.shutdown() calls
+        this — without it every init/shutdown cycle would strand
+        2×pipeline_depth idle threads."""
+        for ex in (self._push_ex, self._pull_ex):
+            if ex is not None:
+                ex.shutdown(wait=False)
+        self._push_ex = self._pull_ex = None
 
     def _plan(self, tree, name: Optional[str]):
         leaves, treedef = jax.tree_util.tree_flatten(tree)
@@ -95,43 +123,105 @@ class PSGradientExchange:
         later diverges (the declaration-order contract above)."""
         self._plan(tree, name)
 
+    def _record(self, name: str, stage: str, key: int, t0: float) -> float:
+        """Timeline helper; returns a fresh t0."""
+        import time
+        now = time.time()
+        if self.timeline is not None:
+            self.timeline.record(name, stage, t0, now - t0, key)
+        return now
+
+    def _push_bucket(self, pskey, b, buf) -> None:
+        chain = self._chains.get(pskey)
+        if chain is not None:
+            # COMPRESS stage right before PUSH (reference:
+            # core_loops.cc:498-536): wire bytes are compressed; the
+            # server decompresses, dense-sums, recompresses the merge
+            self.backend.push_bytes(pskey, chain.compress(buf))
+        else:
+            self.backend.push(pskey, buf)
+
+    def _pull_bucket(self, pskey, b, buf, rnd):
+        chain = self._chains.get(pskey)
+        if chain is not None:
+            payload = self.backend.pull_bytes(pskey, round=rnd)
+            return chain.decompress(payload).astype(b.dtype)
+        self.backend.pull(pskey, buf, round=rnd)
+        return buf
+
     def exchange(self, tree, name: Optional[str] = None):
-        """Push all buckets (priority order), then pull each — one sync
-        round (per-name round counter). Returns the summed tree."""
+        """One sync round (per-name round counter): every bucket is
+        packed, pushed, and pulled, pipelined per bucket in priority
+        order (see class docstring). Returns the summed tree."""
+        import time
         decl_name, treedef, keyed = self._plan(tree, name)
         leaves, _ = jax.tree_util.tree_flatten(tree)
         for l in leaves:                 # start ALL D2H copies first so the
             if hasattr(l, "copy_to_host_async"):   # transfers overlap instead
                 l.copy_to_host_async()             # of serializing per leaf
-        flat = [np.asarray(l).reshape(-1) for l in leaves]
         rnd = self._rounds.get(decl_name, 0) + 1
         self._rounds[decl_name] = rnd
-        bufs = []
-        for pskey, b in keyed:
+
+        # lazily-materialized host leaves: bucket 0's pack waits only for
+        # ITS leaves' D2H, not the whole tree's
+        flat: List[Optional[np.ndarray]] = [None] * len(leaves)
+        flat_lock = threading.Lock()
+
+        def get_flat(i: int) -> np.ndarray:
+            v = flat[i]          # double-checked: a ready leaf never waits
+            if v is not None:    # behind another leaf's D2H copy
+                return v
+            with flat_lock:
+                if flat[i] is None:
+                    flat[i] = np.asarray(leaves[i]).reshape(-1)
+                return flat[i]
+
+        out = [np.empty(int(np.prod(l.shape)), np.dtype(l.dtype))
+               for l in leaves]
+
+        def push_one(idx: int) -> np.ndarray:
+            pskey, b = keyed[idx]
+            t0 = time.time()
             buf = np.empty(b.size, dtype=b.dtype)
             for s in b.segments:
                 buf[s.bucket_offset:s.bucket_offset + s.length] = \
-                    flat[s.leaf_index][s.leaf_offset:s.leaf_offset + s.length]
-            chain = self._chains.get(pskey)
-            if chain is not None:
-                # COMPRESS stage right before PUSH (reference:
-                # core_loops.cc:498-536): wire bytes are compressed; the
-                # server decompresses, dense-sums, recompresses the merge
-                self.backend.push_bytes(pskey, chain.compress(buf))
-            else:
-                self.backend.push(pskey, buf)
-            bufs.append(buf)
-        out = [f.copy() for f in flat]
-        for (pskey, b), buf in zip(keyed, bufs):
-            chain = self._chains.get(pskey)
-            if chain is not None:
-                payload = self.backend.pull_bytes(pskey, round=rnd)
-                buf = chain.decompress(payload).astype(b.dtype)
-            else:
-                self.backend.pull(pskey, buf, round=rnd)
-            for s in b.segments:
+                    get_flat(s.leaf_index)[
+                        s.leaf_offset:s.leaf_offset + s.length]
+            t0 = self._record(decl_name, "PS_PACK", pskey, t0)
+            self._push_bucket(pskey, b, buf)
+            self._record(decl_name, "PS_PUSH", pskey, t0)
+            return buf
+
+        def pull_one(idx: int, buf: np.ndarray) -> None:
+            pskey, b = keyed[idx]
+            t0 = time.time()
+            merged = self._pull_bucket(pskey, b, buf, rnd)
+            t0 = self._record(decl_name, "PS_PULL", pskey, t0)
+            for s in b.segments:        # disjoint segments: thread-safe
                 out[s.leaf_index][s.leaf_offset:s.leaf_offset + s.length] = \
-                    buf[s.bucket_offset:s.bucket_offset + s.length]
+                    merged[s.bucket_offset:s.bucket_offset + s.length]
+            self._record(decl_name, "PS_UNPACK", pskey, t0)
+
+        if self.pipeline_depth <= 1 or len(keyed) == 1:
+            # serial: push everything (the server sums as they land),
+            # then drain pulls in the same order
+            bufs = [push_one(i) for i in range(len(keyed))]
+            for i, buf in enumerate(bufs):
+                pull_one(i, buf)
+        else:
+            if self._push_ex is None:
+                self._push_ex = ThreadPoolExecutor(
+                    self.pipeline_depth, thread_name_prefix="bps-ps-push")
+                self._pull_ex = ThreadPoolExecutor(
+                    self.pipeline_depth, thread_name_prefix="bps-ps-pull")
+            push_futs = [self._push_ex.submit(push_one, i)
+                         for i in range(len(keyed))]
+            pull_futs = [
+                self._pull_ex.submit(
+                    lambda i=i: pull_one(i, push_futs[i].result()))
+                for i in range(len(keyed))]
+            for f in pull_futs:
+                f.result()              # propagate the first failure
         shaped = [o.reshape(l.shape) for o, l in zip(out, leaves)]
         return jax.tree_util.tree_unflatten(treedef, shaped)
 

@@ -421,6 +421,15 @@ def restore_snapshot(backend, path: str):
 
 # ------------------------------------------------------------------ client
 
+class _Channel:
+    """One pooled connection; ``sock`` is None until first use."""
+
+    __slots__ = ("sock",)
+
+    def __init__(self, sock: Optional[socket.socket]) -> None:
+        self.sock = sock
+
+
 class RemotePSBackend:
     """Worker-side client; same interface as HostPSBackend, keys sharded
     over N transport servers with the same placement hash (reference:
@@ -448,16 +457,25 @@ class RemotePSBackend:
 
     def __init__(self, addrs: Sequence[str], hash_fn: str = "djb2",
                  async_mode: bool = False,
-                 reconnect_secs: Optional[float] = None):
+                 reconnect_secs: Optional[float] = None,
+                 conns_per_shard: Optional[int] = None):
         import os as _os
+        import queue as _queue
         self._addrs = [a.rsplit(":", 1) for a in addrs]
-        self._socks: List[Optional[socket.socket]] = []
-        self._locks: List[threading.Lock] = []
         self.hash_fn = hash_fn
         self.async_mode = async_mode
         self.reconnect_secs = (
             float(_os.environ.get("BPS_RECONNECT_SECS", "30"))
             if reconnect_secs is None else reconnect_secs)
+        # connection POOL per shard: the transport server handles one
+        # request per connection at a time, so a round-blocked PULL would
+        # stall every later request on its socket — extra channels let
+        # the pipelined exchange push bucket k+1 while bucket k's pull
+        # waits on the server's merge (the reference's free-running
+        # push/pull loops, core_loops.cc:538-618)
+        self._nconns = (int(_os.environ.get("BPS_PS_CONNS", "4"))
+                        if conns_per_shard is None else conns_per_shard)
+        self._nconns = max(1, self._nconns)
         self._rounds: Dict[int, int] = {}
         # push dedup: fresh nonzero 32-bit incarnation id + per-key seq
         # (seq lives in the frame's ``round`` field, unused by pushes)
@@ -468,9 +486,13 @@ class RemotePSBackend:
         self._placed: set = set()
         # init_key replay log per shard index: key -> args
         self._inits: List[Dict[int, tuple]] = [dict() for _ in addrs]
+        self._pools: List[_queue.Queue] = []
         for i in range(len(addrs)):
-            self._socks.append(self._dial(i))
-            self._locks.append(threading.Lock())
+            pool = _queue.Queue()
+            pool.put(_Channel(self._dial(i)))   # eager: validate the addr
+            for _ in range(self._nconns - 1):
+                pool.put(_Channel(None))        # dialed on first use
+            self._pools.append(pool)
 
     def _dial(self, i: int) -> socket.socket:
         host, port = self._addrs[i]
@@ -478,24 +500,24 @@ class RemotePSBackend:
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return s
 
-    def _conn(self, key: int) -> Tuple[int, threading.Lock]:
-        i = place_key(key, len(self._socks), self.hash_fn)
-        return i, self._locks[i]
+    def _shard(self, key: int) -> int:
+        return place_key(key, len(self._pools), self.hash_fn)
 
-    def _reconnect(self, i: int, deadline: float) -> None:
-        """Redial shard ``i`` with backoff until ``deadline``, then replay
-        its init_key log (a restarted server has an empty key table; its
-        values come from the snapshot, which restore seeds BEFORE
-        accepting — so replayed inits are no-ops there). Raises
-        ConnectionError when the budget runs out."""
+    def _reconnect(self, i: int, ch: "_Channel", deadline: float) -> None:
+        """Redial ``ch`` on shard ``i`` with backoff until ``deadline``,
+        then replay the shard's init_key log (a restarted server has an
+        empty key table; its values come from the snapshot, which restore
+        seeds BEFORE accepting — so replayed inits are no-ops there;
+        several channels replaying is harmless for the same reason).
+        Raises ConnectionError when the budget runs out."""
         import time as _time
 
         from ..common.logging import get_logger
         delay = 0.1
         while True:
             try:
-                old_sock = self._socks[i]
-                self._socks[i] = self._dial(i)
+                old_sock = ch.sock
+                ch.sock = self._dial(i)
                 if old_sock is not None:    # don't leak one fd per retry
                     try:
                         old_sock.close()
@@ -512,9 +534,8 @@ class RemotePSBackend:
         get_logger().warning("reconnected to PS server %s; replaying %d "
                              "key inits", ":".join(self._addrs[i]),
                              len(self._inits[i]))
-        sock = self._socks[i]
         for args in self._inits[i].values():
-            self._send_init(sock, *args)
+            self._send_init(ch.sock, *args)
 
     def _send_init(self, sock, key, nbytes, dtype, init, compression):
         if compression:
@@ -546,10 +567,13 @@ class RemotePSBackend:
              timeout_ms: int, dtype: str, payload: Optional[memoryview],
              pull_into: Optional[np.ndarray] = None) -> bytes:
         import time as _time
-        i, lock = self._conn(key)
-        with lock:
+        i = self._shard(key)
+        ch = self._pools[i].get()        # blocks while all channels busy
+        try:
             try:
-                data = self._roundtrip(self._socks[i], op, key, rnd, nbytes,
+                if ch.sock is None:      # lazily-dialed pool channel
+                    ch.sock = self._dial(i)
+                data = self._roundtrip(ch.sock, op, key, rnd, nbytes,
                                        timeout_ms, dtype, payload)
             except (ConnectionError, OSError):
                 if self.reconnect_secs <= 0:
@@ -560,8 +584,8 @@ class RemotePSBackend:
                 deadline = _time.time() + self.reconnect_secs
                 while True:
                     try:
-                        self._reconnect(i, deadline)
-                        data = self._roundtrip(self._socks[i], op, key, rnd,
+                        self._reconnect(i, ch, deadline)
+                        data = self._roundtrip(ch.sock, op, key, rnd,
                                                nbytes, timeout_ms, dtype,
                                                payload)
                         break
@@ -576,6 +600,9 @@ class RemotePSBackend:
                 return b""          # dense pulls land in pull_into; don't
                                     # re-copy megabytes for a discarded value
             return bytes(data)
+        finally:
+            self._pools[i].put(ch)   # even with a dead sock: keep the pool
+                                     # size invariant; next user redials
 
     def init_key(self, key: int, nbytes: int, dtype: str = "float32",
                  init: Optional[np.ndarray] = None,
@@ -591,7 +618,7 @@ class RemotePSBackend:
         # empty key table) — only once ACCEPTED, or a rejected conflicting
         # re-declaration would poison the replay log; keep a copy of init
         # (the caller may mutate it)
-        i, _ = self._conn(key)
+        i = self._shard(key)
         self._inits[i][key] = (key, nbytes, dtype,
                                None if init is None else np.array(init),
                                dict(compression) if compression else None)
@@ -599,10 +626,9 @@ class RemotePSBackend:
         # no-ops server-side — don't skew the load stats)
         if key not in self._placed:
             self._placed.add(key)
-            from ..common.naming import log_key_placement, place_key
-            log_key_placement(key, nbytes,
-                              place_key(key, len(self._socks), self.hash_fn),
-                              self._shard_bytes, self.hash_fn)
+            from ..common.naming import log_key_placement
+            log_key_placement(key, nbytes, i, self._shard_bytes,
+                              self.hash_fn)
 
     def _push_token(self, key: int) -> int:
         with self._push_seq_lock:
@@ -664,11 +690,18 @@ class RemotePSBackend:
         return out
 
     def close(self) -> None:
-        for s, lock in zip(self._socks, self._locks):
-            try:
-                with lock:
-                    _send_req(s, OP_CLOSE, 0, 0, 0, 0, "", None)
-                    _recv_exact(s, _RSP.size)
-            except (ConnectionError, OSError):
-                pass
-            s.close()
+        import queue as _queue
+        for pool in self._pools:
+            while True:
+                try:
+                    ch = pool.get_nowait()
+                except _queue.Empty:
+                    break
+                if ch.sock is None:
+                    continue
+                try:
+                    _send_req(ch.sock, OP_CLOSE, 0, 0, 0, 0, "", None)
+                    _recv_exact(ch.sock, _RSP.size)
+                except (ConnectionError, OSError):
+                    pass
+                ch.sock.close()

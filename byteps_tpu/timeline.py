@@ -5,9 +5,16 @@ per-(key, stage) interval recording (scheduled_queue.cc:105-123,
 core_loops.cc:69-129), async dump to ``<dir>/<local_rank>/comm.json`` in
 Chrome Trace Format (global.cc:469-564; docs/timeline.md).
 
-Here each push_pull bucket emits one complete event per stage; we also
-bridge to ``jax.profiler`` traces for the device-side view. The output file
-name and JSON schema match the reference so existing viewers work.
+Here each push_pull bucket emits one complete event per stage, keyed by
+bucket index (pid = key, like the reference's per-key rows): DISPATCH
+(program launch), REDUCE (dispatch → device completion, i.e. queue +
+execution), CREDIT_BLOCK (credit-gate stall), and on the PS path
+REDUCE_WAIT / COPYD2H / PS_PACK / PS_PUSH / PS_PULL / PS_UNPACK per
+bucket. With ``BPS_TRACE_PROFILER=1`` the same step window also
+captures a ``jax.profiler`` device trace into
+``<trace_dir>/<local_rank>/profile`` — host spans land in comm.json
+(reference schema, existing viewers work), device-side op timing in the
+profiler trace.
 """
 
 from __future__ import annotations
@@ -29,6 +36,7 @@ class Timeline:
         self._lock = threading.Lock()
         self._t0 = time.time()
         self.step = 0
+        self._profiling = False
 
     def _active(self) -> bool:
         return (self.enabled and
@@ -36,7 +44,35 @@ class Timeline:
 
     def set_step(self, step: int) -> None:
         self.step = step
-        if self.enabled and step == self.cfg.trace_end_step + 1:
+        if not self.enabled:
+            return
+        if (self.cfg.trace_profiler and not self._profiling
+                and self.cfg.trace_start_step <= step
+                <= self.cfg.trace_end_step):
+            # device-side bridge: one jax.profiler capture over the same
+            # window the host spans cover
+            import jax
+            outdir = os.path.join(self.cfg.trace_dir,
+                                  str(self.cfg.local_rank), "profile")
+            os.makedirs(outdir, exist_ok=True)
+            try:
+                jax.profiler.start_trace(outdir)
+                self._profiling = True
+            except Exception as e:        # profiling must never kill a run
+                from .common.logging import get_logger
+                get_logger().warning("jax.profiler bridge failed: %s", e)
+        if step == self.cfg.trace_end_step + 1:
+            if self._profiling:
+                import jax
+                try:
+                    jax.profiler.stop_trace()
+                except Exception as e:   # a stop failure (disk full, dir
+                    # removed) must neither kill the run nor lose the
+                    # host-span timeline below
+                    from .common.logging import get_logger
+                    get_logger().warning("jax.profiler stop failed: %s", e)
+                finally:
+                    self._profiling = False
             self.flush()
 
     def record(self, name: str, stage: str, start_s: float, dur_s: float,

@@ -110,3 +110,139 @@ def test_pipeline_with_tensor_parallel_trains():
     losses = [float(tr.step(fixed)) for _ in range(20)]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] * 0.8
+
+
+def test_interleaved_primitive_matches_sequential():
+    """Interleaved schedule (V=2 chunks/rank over pipe=2) == sequential;
+    chunk c of rank r runs semantic layers (c*n + r)*Lc.. per the
+    interleave_permutation layout."""
+    from byteps_tpu.parallel.pipeline import (interleave_permutation,
+                                              pipeline_interleaved)
+
+    n_layers, pipe, V, n_micro, mb, dim = 8, 2, 2, 4, 2, 16
+    rng = np.random.RandomState(0)
+    ws = rng.randn(n_layers, dim, dim).astype(np.float32) * 0.1
+    x = rng.randn(n_micro, mb, dim).astype(np.float32)
+
+    def stage_fn(stage_ws, h):
+        def body(carry, w):
+            return carry + jnp.tanh(carry @ w), None
+        out, _ = jax.lax.scan(body, h, stage_ws)
+        return out
+
+    want = np.asarray(stage_fn(jnp.asarray(ws),
+                               jnp.asarray(x.reshape(-1, dim))))
+    want = want.reshape(n_micro, mb, dim)
+
+    perm = interleave_permutation(n_layers, pipe, V)
+    mesh = make_mesh({"pipe": pipe}, devices=jax.devices()[:pipe])
+
+    def run(ws_r, x):
+        Lr = ws_r.shape[0]
+        chunks = ws_r.reshape(V, Lr // V, dim, dim)
+        out = pipeline_interleaved(stage_fn, chunks, x, "pipe")
+        return last_stage_value(out, "pipe")
+
+    fn = jax.jit(jax.shard_map(run, mesh=mesh, in_specs=(P("pipe"), P()),
+                               out_specs=P(), check_vma=False))
+    got = np.asarray(fn(
+        jax.device_put(ws[perm], NamedSharding(mesh, P("pipe"))),
+        jnp.asarray(x)))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_interleaved_grads_match_gpipe():
+    """V=2 interleaved gradients == GPipe gradients == sequential
+    gradients (after undoing the layout permutation)."""
+    from byteps_tpu.parallel.pipeline import (interleave_permutation,
+                                              pipeline, pipeline_interleaved)
+
+    n_layers, pipe, V, n_micro, mb, dim = 8, 2, 2, 4, 2, 8
+    rng = np.random.RandomState(1)
+    ws = rng.randn(n_layers, dim, dim).astype(np.float32) * 0.1
+    x = rng.randn(n_micro, mb, dim).astype(np.float32)
+    tgt = rng.randn(n_micro, mb, dim).astype(np.float32)
+
+    def stage_fn(stage_ws, h):
+        def body(carry, w):
+            return carry + jnp.tanh(carry @ w), None
+        out, _ = jax.lax.scan(body, h, stage_ws)
+        return out
+
+    def seq_loss(ws):
+        out = stage_fn(ws, jnp.asarray(x.reshape(-1, dim)))
+        return ((out - tgt.reshape(-1, dim)) ** 2).mean()
+
+    g_seq = np.asarray(jax.grad(seq_loss)(jnp.asarray(ws)))
+
+    mesh = make_mesh({"pipe": pipe}, devices=jax.devices()[:pipe])
+
+    # / pipe: every rank computes the replicated loss, so the psum in
+    # last_stage_value multiplies gradients by the stage count (the
+    # trainers' uniform-rescale convention; see lm_loss's pp note)
+    def pp_loss(ws_r, x):
+        out = pipeline(stage_fn, ws_r, x, "pipe")
+        out = last_stage_value(out, "pipe")
+        return ((out - tgt) ** 2).mean() / pipe
+
+    def il_loss(ws_r, x):
+        chunks = ws_r.reshape(V, ws_r.shape[0] // V, dim, dim)
+        out = pipeline_interleaved(stage_fn, chunks, x, "pipe")
+        out = last_stage_value(out, "pipe")
+        return ((out - tgt) ** 2).mean() / pipe
+
+    def grad_of(loss_fn, ws_in):
+        fn = jax.jit(jax.shard_map(
+            jax.grad(loss_fn), mesh=mesh, in_specs=(P("pipe"), P()),
+            out_specs=P("pipe"), check_vma=False))
+        return np.asarray(fn(
+            jax.device_put(ws_in, NamedSharding(mesh, P("pipe"))),
+            jnp.asarray(x)))
+
+    g_pp = grad_of(pp_loss, ws)
+    np.testing.assert_allclose(g_pp, g_seq, rtol=1e-4, atol=1e-6)
+
+    perm = interleave_permutation(n_layers, pipe, V)
+    g_il_perm = grad_of(il_loss, ws[perm])
+    g_il = g_il_perm[np.argsort(perm)]       # back to semantic order
+    np.testing.assert_allclose(g_il, g_seq, rtol=1e-4, atol=1e-6)
+
+
+def test_bubble_fraction():
+    from byteps_tpu.parallel.pipeline import bubble_fraction
+    assert bubble_fraction(1, 8) == 0.0
+    assert bubble_fraction(4, 4) == 3 / 7
+    assert bubble_fraction(4, 4, interleave=2) == 3 / 11
+    assert bubble_fraction(4, 16, interleave=4) < bubble_fraction(4, 16)
+
+
+def test_interleaved_transformer_loss_matches_unpipelined():
+    """bert (4-layer) loss under pp=2 x V=2 interleave == plain model."""
+    import dataclasses
+    from byteps_tpu.parallel.pipeline import interleave_permutation
+
+    mesh = make_mesh({"pipe": 2}, devices=jax.devices()[:2])
+    cfg_ref = dataclasses.replace(bert.bert_tiny(), layers=4)
+    cfg_pp = dataclasses.replace(
+        bert.bert_tiny(pp_axis="pipe", pp_microbatches=2),
+        layers=4, pp_interleave=2)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg_ref)
+    batch = bert.synth_mlm_batch(np.random.RandomState(1), 4, 32,
+                                 cfg_ref.vocab_size)
+    want = float(bert.mlm_loss(params, cfg_ref,
+                               tuple(jnp.asarray(b) for b in batch)))
+
+    perm = np.array(interleave_permutation(4, 2, 2))
+    params_il = dict(params)
+    params_il["blocks"] = jax.tree_util.tree_map(lambda p: p[perm],
+                                                 params["blocks"])
+    specs = transformer.param_specs(cfg_pp)
+    fn = jax.jit(jax.shard_map(
+        lambda p, b: bert.mlm_loss(p, cfg_pp, b), mesh=mesh,
+        in_specs=(specs, P()), out_specs=P(), check_vma=False))
+    sharded = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params_il, specs,
+        is_leaf=lambda x: not isinstance(x, (dict, list)))
+    got = float(fn(sharded, tuple(jnp.asarray(b) for b in batch)))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
