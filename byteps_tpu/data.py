@@ -48,6 +48,24 @@ def shard_batch(batch, mesh: Mesh, spec: Optional[P] = None,
                                   batch)
 
 
+def shard_local_batch(batch, mesh: Mesh, spec: Optional[P] = None,
+                      sharding: Optional[NamedSharding] = None):
+    """Assemble a GLOBAL array from this process's LOCAL batch shard.
+
+    Multi-host input pipelines: each process loads only its slice of the
+    global batch (global = local × process_count along the batch dim)
+    and JAX stitches the distributed array — no host ships data it
+    doesn't own. Single-process: identical to ``shard_batch``."""
+    if sharding is None:
+        sharding = data_sharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sharding), batch)
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(sharding, x),
+        batch)
+
+
 def prefetch_to_mesh(it: Iterable, mesh: Mesh, spec: Optional[P] = None,
                      buffer_size: int = 2) -> Iterator:
     """Iterate ``it``, yielding mesh-sharded batches, transferring up to

@@ -42,13 +42,21 @@ class MirroredStrategy:
         loss = step(batch)          # batch split over replicas, grads synced
     """
 
-    def __init__(self, mesh: Optional[Mesh] = None) -> None:
+    def __init__(self, mesh: Optional[Mesh] = None,
+                 cross_device_ops=None) -> None:
         if mesh is None:
             mesh = GlobalState.get().mesh if GlobalState.initialized() \
                 else make_mesh()
         self.mesh = mesh
         self.axes = data_axes(mesh)
         self._run_cache = {}
+        # the reductions seam (reference: MirroredStrategy(devices,
+        # cross_device_ops) wiring BytepsCrossDeviceOps in,
+        # mirrored_strategy.py:365-372); default = the bucketed engine
+        if cross_device_ops is None:
+            from .cross_device_ops import BpsCrossDeviceOps
+            cross_device_ops = BpsCrossDeviceOps(mesh=mesh)
+        self.cross_device_ops = cross_device_ops
 
     @property
     def num_replicas_in_sync(self) -> int:
@@ -96,18 +104,46 @@ class MirroredStrategy:
         return jitted(*args)
 
     def reduce(self, reduce_op: str, value, axis=0):
-        """Merge a per-replica-stacked host/device value: "mean" | "sum"."""
-        if reduce_op not in ("mean", "sum"):
-            raise ValueError(f"reduce_op must be mean|sum, got {reduce_op!r}")
-        fn = jnp.mean if reduce_op == "mean" else jnp.sum
+        """Merge a per-replica-stacked host/device value: "mean" | "sum"
+        (ReduceOp-style spellings like "MEAN"/ReduceOp.SUM accepted).
+        ``axis=None`` keeps per-replica values and reduces ACROSS
+        replicas through the cross-device ops instead (the reference's
+        strategy.reduce semantics)."""
+        from .cross_device_ops import ReduceOp
+        op = ReduceOp.parse(reduce_op)
+        if axis is None:
+            return self.cross_device_ops.reduce(op, value)
+        fn = jnp.mean if op == ReduceOp.MEAN else jnp.sum
         return jax.tree_util.tree_map(lambda x: fn(x, axis=axis), value)
 
-    def experimental_distribute_dataset(self, dataset: Iterable):
-        """Yield batches placed on the mesh, split over the data axes."""
-        from .data import data_sharding, shard_batch
+    def batch_reduce(self, reduce_op: str, values):
+        """Reduce several per-replica trees in ONE bucketed exchange
+        (reference: batch_reduce_implementation +
+        _make_gradient_chunks — small tensors share launches)."""
+        return self.cross_device_ops.batch_reduce(reduce_op, values)
+
+    def broadcast(self, value, root_replica: int = 0):
+        """Every replica row := ``root_replica``'s row."""
+        return self.cross_device_ops.broadcast(value,
+                                               root_replica=root_replica)
+
+    def experimental_distribute_dataset(self, dataset: Iterable,
+                                        per_process: bool = False):
+        """Yield batches placed on the mesh, split over the data axes.
+
+        ``per_process=True``: each PROCESS's iterator yields only its
+        local shard (multi-host input pipelines — the reference's
+        per-worker dataset sharding in _experimental_distribute_dataset);
+        batches are assembled into global arrays from the local data.
+        Default: every process supplies the full global batch
+        (single-controller convenience)."""
+        from .data import data_sharding, shard_batch, shard_local_batch
         sharding = data_sharding(self.mesh)
         for batch in dataset:
-            yield shard_batch(batch, self.mesh, sharding=sharding)
+            if per_process:
+                yield shard_local_batch(batch, self.mesh, sharding=sharding)
+            else:
+                yield shard_batch(batch, self.mesh, sharding=sharding)
 
     # ---------------------------------------------------------- train step
 

@@ -72,6 +72,26 @@ def main():
     out = bps.broadcast_parameters(mine, root_rank=0)
     np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
 
+    # --- per-process dataset sharding: each process supplies only ITS
+    # rows; the strategy assembles the global batch (multi-host input
+    # pipeline — reference _experimental_distribute_dataset per-worker
+    # sharding)
+    strat = bps.MirroredStrategy()
+    local_rows = np.full((4, 3), float(pid), np.float32)
+    (dist_batch,) = list(strat.experimental_distribute_dataset(
+        [local_rows], per_process=True))
+    assert dist_batch.shape == (8, 3), dist_batch.shape
+    np.testing.assert_allclose(float(jnp.sum(dist_batch)), 12.0)
+
+    # --- cross-device ops across processes: strategy reduce(axis=None)
+    # (stacked convention: ONE row per replica slot)
+    n = strat.num_replicas_in_sync          # 4: 2 procs x 2 devices
+    x = jnp.arange(n, dtype=jnp.float32).reshape(n, 1)
+    from byteps_tpu.data import shard_batch
+    xs = shard_batch(x, strat.mesh)
+    red = strat.reduce("sum", xs, axis=None)
+    np.testing.assert_allclose(float(jnp.sum(red)) / n, 6.0)
+
     bps.shutdown()
     print(f"MP_WORKER_OK pid={pid} first={losses[0]:.5f} last={losses[-1]:.5f}")
 

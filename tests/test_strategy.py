@@ -100,3 +100,76 @@ def test_make_step_trains(strat):
     losses = [float(step((X, Y))) for _ in range(40)]
     assert losses[-1] < 0.05 * losses[0]
     assert step.trainer.step_count == 40
+
+
+def _stacked(val_per_replica):
+    """[8, ...] stacked tree placed over the data axis."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from byteps_tpu.common.global_state import GlobalState
+    mesh = GlobalState.get().mesh
+    return jax.device_put(val_per_replica, NamedSharding(mesh, P("data")))
+
+
+def test_reduce_axis_none_goes_cross_device(strat):
+    """axis=None reduces ACROSS replicas via cross_device_ops: every
+    replica row ends equal to the sum/mean of all rows."""
+    x = _stacked(np.arange(8, dtype=np.float32).reshape(8, 1))
+    out = np.asarray(strat.reduce("SUM", x, axis=None))
+    np.testing.assert_allclose(out, np.full((8, 1), 28.0))
+    out = np.asarray(strat.reduce("mean", x, axis=None))
+    np.testing.assert_allclose(out, np.full((8, 1), 3.5))
+
+
+def test_batch_reduce_multiple_trees(strat):
+    """batch_reduce ships several per-replica trees in one exchange."""
+    a = _stacked(np.ones((8, 4), np.float32))
+    b = _stacked(2 * np.ones((8, 3), np.float32))
+    got = strat.batch_reduce("sum", [{"g": a}, {"g": b}])
+    np.testing.assert_allclose(np.asarray(got[0]["g"]), 8.0)
+    np.testing.assert_allclose(np.asarray(got[1]["g"]), 16.0)
+
+
+def test_cross_device_ops_injection(strat):
+    """The AllReduce (plain psum, no bucketing) implementation drops in
+    through the ctor seam and computes identical results."""
+    from byteps_tpu.cross_device_ops import AllReduceCrossDeviceOps
+    s2 = MirroredStrategy(cross_device_ops=AllReduceCrossDeviceOps())
+    x = _stacked(np.arange(8, dtype=np.float32).reshape(8, 1))
+    np.testing.assert_allclose(
+        np.asarray(s2.reduce("sum", x, axis=None)), 28.0)
+    got = s2.batch_reduce("mean", [x, x])
+    for g in got:
+        np.testing.assert_allclose(np.asarray(g), 3.5)
+
+
+def test_reduce_to_host_destination(strat):
+    x = _stacked(np.ones((8, 2), np.float32))
+    out = strat.cross_device_ops.reduce("sum", x, destinations="host")
+    assert isinstance(out, np.ndarray)
+    np.testing.assert_allclose(out, 8.0)
+
+
+def test_strategy_broadcast(strat):
+    x = _stacked(np.arange(8, dtype=np.float32).reshape(8, 1))
+    out = np.asarray(strat.broadcast(x, root_replica=3))
+    np.testing.assert_allclose(out, np.full((8, 1), 3.0))
+
+
+def test_reduce_sparse_dense_fallback(strat):
+    """Row-sparse reduce without a PS backend: dense scatter + reduce.
+    Semantics = ONE contribution per worker process (matching the PS
+    row-sparse wire), so a single-process sum is the scatter itself."""
+    idx = np.array([0, 2, 2], np.int32)
+    rows = np.array([[1.0, 1.0], [2.0, 2.0], [3.0, 3.0]], np.float32)
+    out = np.asarray(strat.cross_device_ops.reduce_sparse(
+        "sum", idx, rows, num_rows=4))
+    np.testing.assert_allclose(out[0], 1.0)
+    np.testing.assert_allclose(out[2], 5.0)
+    np.testing.assert_allclose(out[1], 0.0)
+    np.testing.assert_allclose(out[3], 0.0)
+    # mean == sum at process_count 1; and the AllReduce implementation
+    # (base-class fallback) agrees — the seam stays interchangeable
+    from byteps_tpu.cross_device_ops import AllReduceCrossDeviceOps
+    out2 = np.asarray(AllReduceCrossDeviceOps().reduce_sparse(
+        "sum", idx, rows, num_rows=4))
+    np.testing.assert_allclose(out2, out)
