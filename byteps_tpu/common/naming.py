@@ -22,31 +22,77 @@ from typing import Dict, List, Optional
 MAX_PARTITIONS = 1 << 16  # per-tensor partition space, reference operations.cc:301
 
 
-def _hash_naive(key: int, n: int) -> int:
-    return key % n
+def _raw_naive(key: int) -> int:
+    # reference: Hash_Naive, global.cc:598-600
+    return (((key >> 16) + (key % 65536)) * 9973) & 0xFFFFFFFFFFFFFFFF
 
-def _hash_built_in(key: int, n: int) -> int:
-    return hash(key) % n
+def _raw_built_in(key: int, coef: int = 1) -> int:
+    # reference: Hash_BuiltIn = std::hash(str(key)) * coefficient
+    # (BYTEPS_BUILT_IN_HASH_COEF, global.cc:601-604) — the coefficient
+    # perturbs a hash whose low bits cluster for sequential keys.
+    # FNV-1a here, NOT Python's hash(): str hashing is salted per
+    # process (PYTHONHASHSEED), and placement must agree across every
+    # worker process or sync rounds never complete.
+    h = 0xCBF29CE484222325
+    for ch in str(key):
+        h = ((h ^ ord(ch)) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return (h * coef) & 0xFFFFFFFFFFFFFFFF
 
-def _hash_djb2(key: int, n: int) -> int:
+def _raw_djb2(key: int) -> int:
     # reference: global.cc djb2 over the decimal-string form of the key
     h = 5381
     for ch in str(key):
         h = ((h << 5) + h + ord(ch)) & 0xFFFFFFFF
-    return h % n
+    return h
 
-def _hash_sdbm(key: int, n: int) -> int:
+def _raw_sdbm(key: int) -> int:
     h = 0
     for ch in str(key):
         h = (ord(ch) + (h << 6) + (h << 16) - h) & 0xFFFFFFFF
-    return h % n
+    return h
 
 HASH_FNS = {
-    "naive": _hash_naive,
-    "built_in": _hash_built_in,
-    "djb2": _hash_djb2,
-    "sdbm": _hash_sdbm,
+    "naive": _raw_naive,
+    "built_in": _raw_built_in,
+    "djb2": _raw_djb2,
+    "sdbm": _raw_sdbm,
 }
+
+
+def mixed_mode_hash(key: int, num_servers: int, num_workers: int,
+                    bound: int = 101) -> int:
+    """Mixed-mode placement (reference: Hash_Mixed_Mode,
+    global.cc:566-597): a deployment with ``num_workers`` colocated
+    servers (one per worker host) plus ``num_servers - num_workers``
+    dedicated non-colocate servers. Keys are split so the non-colocate
+    servers absorb the analytically-optimal traffic share — the
+    ``ratio`` below is the reference's closed form — with ``bound``
+    (BPS_MIXED_MODE_BOUND, default 101, must be ≥ num_servers)
+    quantizing the split."""
+    nc = num_servers - num_workers
+    if nc <= 0:
+        raise ValueError(
+            f"mixed mode needs more servers ({num_servers}) than workers "
+            f"({num_workers}) — the extras are the non-colocate tier")
+    if bound < num_servers:
+        raise ValueError(f"BPS_MIXED_MODE_BOUND {bound} must be >= "
+                         f"num_servers {num_servers}")
+    w = num_workers
+    denom = w * (w + nc) - 2 * nc
+    if denom <= 0:      # e.g. w=1, nc=1 — no valid traffic split exists
+        raise ValueError(
+            f"mixed mode is undefined for {w} worker(s) with {nc} "
+            f"non-colocate server(s) — need more workers than the ratio "
+            f"denominator allows")
+    ratio = (2.0 * nc * (w - 1)) / denom
+    if not 0 <= ratio <= 1:
+        raise ValueError(
+            f"mixed mode needs num_noncolocate ({nc}) <= num_workers ({w})")
+    threshold = ratio * bound
+    h = _raw_djb2(key) % bound
+    if h < threshold:
+        return _raw_djb2(h) % nc
+    return nc + _raw_djb2(h) % w
 
 
 @dataclass
@@ -114,16 +160,75 @@ class NameRegistry:
             self._next_key = 0
 
 
-def place_key(key: int, num_servers: int, hash_fn: str = "djb2") -> int:
-    """Which server shard owns a PS key (reference: global.cc:628-677)."""
+def place_key(key: int, num_servers: int, hash_fn: str = "djb2",
+              num_workers: int = 0, mixed_bound: int = 101,
+              built_in_coef: int = 1,
+              reduce_roots: Optional[List[int]] = None) -> int:
+    """Which server shard owns a PS key (reference: global.cc:628-677).
+
+    ``hash_fn="mixed"`` needs ``num_workers`` (reference:
+    BYTEPS_ENABLE_MIXED_MODE + Hash_Mixed_Mode). ``reduce_roots``
+    restricts placement to the listed shards (reference:
+    BYTEPS_REDUCE_ROOTS steering which device roots own reductions,
+    global.cc:238-251) — keys hash over the root list instead of all
+    servers."""
+    if reduce_roots:
+        for r in reduce_roots:
+            if not 0 <= r < num_servers:
+                raise ValueError(f"reduce root {r} out of range "
+                                 f"0..{num_servers - 1}")
+        if len(reduce_roots) == 1:
+            return reduce_roots[0]
+        return reduce_roots[_raw_djb2(key) % len(reduce_roots)]
     if num_servers <= 1:
         return 0
+    if hash_fn == "mixed":
+        if num_workers <= 0:
+            raise ValueError("BPS_KEY_HASH_FN=mixed needs "
+                             "BPS_ENABLE_MIXED_MODE and a worker count")
+        return mixed_mode_hash(key, num_servers, num_workers,
+                               bound=mixed_bound)
     try:
         fn = HASH_FNS[hash_fn]
     except KeyError:
         raise ValueError(f"unknown BPS_KEY_HASH_FN {hash_fn!r}; "
-                         f"choose from {sorted(HASH_FNS)}") from None
-    return fn(key, num_servers)
+                         f"choose from {sorted(HASH_FNS) + ['mixed']}"
+                         ) from None
+    h = fn(key, built_in_coef) if hash_fn == "built_in" else fn(key)
+    return h % num_servers
+
+
+def placement_from_env() -> Dict:
+    """Placement knobs shared by the in-process and TCP PS backends
+    (reference env contract: BYTEPS_ENABLE_MIXED_MODE,
+    BYTEPS_MIXED_MODE_BOUND, BYTEPS_BUILT_IN_HASH_COEF,
+    BYTEPS_REDUCE_ROOTS — global.cc:137-180, 238-251)."""
+    import os
+
+    def _get(name: str, legacy: str, default: str) -> str:
+        return os.environ.get(name, os.environ.get(legacy, default))
+
+    roots_s = _get("BPS_REDUCE_ROOTS", "BYTEPS_REDUCE_ROOTS", "")
+    return dict(
+        num_workers=int(_get("BPS_NUM_WORKER", "DMLC_NUM_WORKER", "0") or 0),
+        mixed_bound=int(_get("BPS_MIXED_MODE_BOUND",
+                             "BYTEPS_MIXED_MODE_BOUND", "101")),
+        built_in_coef=int(_get("BPS_BUILT_IN_HASH_COEF",
+                               "BYTEPS_BUILT_IN_HASH_COEF", "1")),
+        reduce_roots=[int(x) for x in roots_s.split(",") if x.strip()],
+    )
+
+
+def check_mixed_mode_enabled(hash_fn: str) -> None:
+    """hash_fn="mixed" must be opted into explicitly, like the
+    reference's 'mixed mode should also set BYTEPS_ENABLE_MIXED_MODE'
+    check (global.cc:649-651)."""
+    import os
+    if hash_fn == "mixed" and not (
+            os.environ.get("BPS_ENABLE_MIXED_MODE")
+            or os.environ.get("BYTEPS_ENABLE_MIXED_MODE")):
+        raise ValueError("BPS_KEY_HASH_FN=mixed also needs "
+                         "BPS_ENABLE_MIXED_MODE=1")
 
 
 def log_key_placement(key: int, nbytes: int, shard: int,

@@ -25,6 +25,8 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <mutex>
@@ -198,12 +200,39 @@ class EngineThread {
   std::thread thread_;
 };
 
+// env helper honoring both the BPS_ and legacy BYTEPS_ spellings
+static const char* bps_getenv(const char* name, const char* legacy) {
+  const char* v = std::getenv(name);
+  if (v == nullptr && legacy != nullptr) v = std::getenv(legacy);
+  return v;
+}
+
 class Server {
  public:
   Server(int num_workers, int num_threads, bool schedule, bool async_mode)
       : num_workers_(num_workers), async_(async_mode) {
-    for (int i = 0; i < num_threads; ++i)
-      engines_.emplace_back(new EngineThread(this, i, schedule));
+    // per-stage value tracing for one key (reference:
+    // BYTEPS_SERVER_DEBUG[_KEY], server.cc:115-197 printing tensor
+    // value + address before/after COPY_FIRST / SUM_RECV)
+    const char* dbg = bps_getenv("BPS_SERVER_DEBUG", "BYTEPS_SERVER_DEBUG");
+    debug_ = dbg != nullptr && dbg[0] != '\0' && dbg[0] != '0';
+    const char* dk = bps_getenv("BPS_SERVER_DEBUG_KEY",
+                                "BYTEPS_SERVER_DEBUG_KEY");
+    debug_key_ = dk ? (uint64_t)std::strtoull(dk, nullptr, 10) : 0;
+    if (debug_)
+      std::fprintf(stderr, "[bps_server] debug mode: printing key %llu\n",
+                   (unsigned long long)debug_key_);
+    // blocking engine: apply pushes inline in the caller thread instead
+    // of queueing to engine threads (reference:
+    // BYTEPS_SERVER_ENGINE_BLOCKING, server.cc:407-414)
+    const char* blk = bps_getenv("BPS_SERVER_ENGINE_BLOCKING",
+                                 "BYTEPS_SERVER_ENGINE_BLOCKING");
+    blocking_ = blk != nullptr && blk[0] != '\0' && blk[0] != '0';
+    if (blocking_)
+      std::fprintf(stderr, "[bps_server] blocking engine mode enabled\n");
+    if (!blocking_)
+      for (int i = 0; i < num_threads; ++i)
+        engines_.emplace_back(new EngineThread(this, i, schedule));
   }
 
   // Shutdown protocol: destroying the server while another thread is
@@ -278,15 +307,18 @@ class Server {
     ks.accum.assign(nbytes, 0);
     ks.push_count = ks.pull_count = 0;
     ks.round = 0;
-    // sticky least-loaded thread assignment (reference: server.h:149-173)
+    // sticky least-loaded thread assignment (reference: server.h:149-173);
+    // blocking mode has no engine threads — everything runs inline
     int best = 0;
-    uint64_t best_load = UINT64_MAX;
-    for (size_t i = 0; i < engines_.size(); ++i) {
-      uint64_t l = engines_[i]->assigned_bytes.load();
-      if (l < best_load) { best_load = l; best = (int)i; }
+    if (!engines_.empty()) {
+      uint64_t best_load = UINT64_MAX;
+      for (size_t i = 0; i < engines_.size(); ++i) {
+        uint64_t l = engines_[i]->assigned_bytes.load();
+        if (l < best_load) { best_load = l; best = (int)i; }
+      }
+      engines_[best]->assigned_bytes += nbytes;
     }
     ks.tid = best;
-    engines_[best]->assigned_bytes += nbytes;
     if (init != nullptr) {
       std::memcpy(ks.merged.data(), init, nbytes);
       ks.ready = true;   // store initialized: async pulls may proceed
@@ -310,18 +342,57 @@ class Server {
     Task t;
     t.key = key;
     t.data.assign((const char*)data, (const char*)data + nbytes);
+    if (blocking_) {
+      // blocking engine: apply in the caller's thread (reference:
+      // BYTEPS_SERVER_ENGINE_BLOCKING) — deterministic, single-threaded
+      // summation for debugging at the cost of all engine overlap
+      Apply(t);
+      return 0;
+    }
     engines_[ks->tid]->Push(std::move(t));
     return 0;
+  }
+
+  // first element of a typed buffer, for the debug tracer (reference:
+  // DEBUG_PRINT_TENSOR_VALUE prints the leading scalar)
+  static double FirstVal(const char* p, int dtype) {
+    switch (dtype) {
+      case F32: { float f; std::memcpy(&f, p, 4); return f; }
+      case F64: { double d; std::memcpy(&d, p, 8); return d; }
+      case I32: { int32_t v; std::memcpy(&v, p, 4); return v; }
+      case I64: { int64_t v; std::memcpy(&v, p, 8); return (double)v; }
+      case F16: { uint16_t h; std::memcpy(&h, p, 2); return half_to_float(h); }
+      case BF16: { uint16_t h; std::memcpy(&h, p, 2); return bf16_to_float(h); }
+      default: return (double)(unsigned char)p[0];
+    }
+  }
+
+  void DebugStage(const char* stage, const KeyStore* ks, const char* dst,
+                  const char* src, int dtype) {
+    std::lock_guard<std::mutex> lk(debug_mu_);
+    std::fprintf(stderr,
+                 "[bps_server] stage: %s\tkey: %llu\tdst: %f\tsrc: %f\t"
+                 "dst_addr: %p\tsrc_addr: %p\n",
+                 stage, (unsigned long long)debug_key_, FirstVal(dst, dtype),
+                 FirstVal(src, dtype), (const void*)dst, (const void*)src);
+    (void)ks;
   }
 
   // engine-thread callback: apply one task
   void Apply(Task& t) {
     KeyStore* ks = Find(t.key);
     if (ks == nullptr) return;
+    bool is_debug = debug_ && t.key == debug_key_;
     std::unique_lock<std::mutex> lk(ks->mu);
     if (async_) {
+      if (is_debug)
+        DebugStage("ENGINE_SUM_RECV_BEFORE", ks, ks->merged.data(),
+                   t.data.data(), ks->dtype);
       // async: sum straight into the served store, no rounds
       reduce_sum(ks->merged.data(), t.data.data(), ks->len, ks->dtype);
+      if (is_debug)
+        DebugStage("ENGINE_SUM_RECV_AFTER", ks, ks->merged.data(),
+                   t.data.data(), ks->dtype);
       ks->ready = true;
       ks->round++;
       lk.unlock();
@@ -334,9 +405,21 @@ class Server {
     // whichever task lands first is the copy (reference: server.cc:290-342
     // decides from updates.request.size() inside the handler).
     if (ks->push_count == 0) {
+      if (is_debug)
+        DebugStage("ENGINE_COPY_MERGED_TO_STORE_BEFORE", ks,
+                   ks->accum.data(), t.data.data(), ks->dtype);
       std::memcpy(ks->accum.data(), t.data.data(), ks->len);
+      if (is_debug)
+        DebugStage("ENGINE_COPY_MERGED_TO_STORE_AFTER", ks,
+                   ks->accum.data(), t.data.data(), ks->dtype);
     } else {
+      if (is_debug)
+        DebugStage("ENGINE_SUM_RECV_BEFORE", ks, ks->accum.data(),
+                   t.data.data(), ks->dtype);
       reduce_sum(ks->accum.data(), t.data.data(), ks->len, ks->dtype);
+      if (is_debug)
+        DebugStage("ENGINE_SUM_RECV_AFTER", ks, ks->accum.data(),
+                   t.data.data(), ks->dtype);
     }
     ks->push_count++;
     if (ks->push_count == num_workers_) {
@@ -412,6 +495,10 @@ class Server {
   std::atomic<int> inflight_{0};
   int num_workers_;
   bool async_;
+  bool debug_ = false;
+  bool blocking_ = false;
+  uint64_t debug_key_ = 0;
+  std::mutex debug_mu_;
   std::mutex map_mu_;
   std::unordered_map<uint64_t, KeyStore> stores_;
   std::vector<std::unique_ptr<EngineThread>> engines_;

@@ -221,6 +221,9 @@ class HostPSBackend:
                                  async_mode)
                         for _ in range(num_servers)]
         self.hash_fn = hash_fn
+        from ..common.naming import check_mixed_mode_enabled, placement_from_env
+        check_mixed_mode_enabled(hash_fn)
+        self._placement = placement_from_env()
         self.async_mode = async_mode
         self._rounds: Dict[int, int] = {}
         self._shard_bytes: Dict[int, int] = {}
@@ -235,7 +238,8 @@ class HostPSBackend:
 
     def _shard_index(self, key: int) -> int:
         from ..common.naming import place_key
-        return place_key(key, len(self.servers), self.hash_fn)
+        return place_key(key, len(self.servers), self.hash_fn,
+                         **self._placement)
 
     def _shard(self, key: int) -> PSServer:
         return self.servers[self._shard_index(key)]
@@ -262,6 +266,14 @@ class HostPSBackend:
     def pull(self, key: int, out: np.ndarray, round: int = 0,
              timeout_ms: int = 30000) -> None:
         self._shard(key).pull(key, out, round, timeout_ms)
+
+    def round(self, key: int) -> int:
+        """Latest COMPLETED sync round for ``key`` (0 = none yet) — lets
+        a restarted worker of a live job resynchronize its round
+        counters to the server's instead of stalling on round 1
+        (the elastic-rejoin analog of the reference's is_recovery
+        skip-barrier, global.cc:283-297)."""
+        return int(self._shard(key).round(key))
 
     def push_bytes(self, key: int, payload) -> None:
         """Compressed push: decompress server-side, dense-sum in the

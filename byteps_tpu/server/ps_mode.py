@@ -57,7 +57,8 @@ class PSGradientExchange:
                                if pipeline_depth is None else pipeline_depth)
         self.timeline = None            # set by GlobalState when tracing
         self._plans: Dict = {}
-        self._rounds: Dict[str, int] = {}
+        self._key_rounds: Dict[int, int] = {}
+        self._key_rounds_lock = threading.Lock()
         self._push_ex: Optional[ThreadPoolExecutor] = None
         self._pull_ex: Optional[ThreadPoolExecutor] = None
         # per-PS-key worker compressor chain (momentum→ef→codec) — holds
@@ -131,6 +132,27 @@ class PSGradientExchange:
             self.timeline.record(name, stage, t0, now - t0, key)
         return now
 
+    def _next_round(self, pskey: int) -> int:
+        """This push's round for ``pskey``, PER KEY. First use of a key
+        seeds from the SERVER's completed round — elastic rejoin of a
+        live job (the reference's is_recovery skip-barrier analog,
+        global.cc:283-297): a predecessor may have died BETWEEN bucket
+        pushes, leaving keys at different rounds, so a single per-decl
+        seed would misalign the lagging keys forever. Fresh jobs see 0
+        everywhere (one extra RPC per key, amortized across the
+        pipeline workers). Called from the pipelined push workers —
+        at most one task per key per exchange, lock only guards the
+        dict."""
+        with self._key_rounds_lock:
+            cur = self._key_rounds.get(pskey)
+        if cur is None:
+            cur = (int(self.backend.round(pskey))
+                   if hasattr(self.backend, "round") else 0)
+        nxt = cur + 1
+        with self._key_rounds_lock:
+            self._key_rounds[pskey] = nxt
+        return nxt
+
     def _push_bucket(self, pskey, b, buf) -> None:
         chain = self._chains.get(pskey)
         if chain is not None:
@@ -150,17 +172,19 @@ class PSGradientExchange:
         return buf
 
     def exchange(self, tree, name: Optional[str] = None):
-        """One sync round (per-name round counter): every bucket is
-        packed, pushed, and pulled, pipelined per bucket in priority
-        order (see class docstring). Returns the summed tree."""
+        """One sync round (PER-KEY round counters, server-seeded on
+        first use — see _next_round): every bucket is packed, pushed,
+        and pulled, pipelined per bucket in priority order (see class
+        docstring). Returns the summed tree."""
         import time
         decl_name, treedef, keyed = self._plan(tree, name)
         leaves, _ = jax.tree_util.tree_flatten(tree)
         for l in leaves:                 # start ALL D2H copies first so the
             if hasattr(l, "copy_to_host_async"):   # transfers overlap instead
                 l.copy_to_host_async()             # of serializing per leaf
-        rnd = self._rounds.get(decl_name, 0) + 1
-        self._rounds[decl_name] = rnd
+        # per-bucket rounds, assigned (and server-seeded on first use)
+        # inside the push workers — see _next_round
+        rounds: List[Optional[int]] = [None] * len(keyed)
 
         # lazily-materialized host leaves: bucket 0's pack waits only for
         # ITS leaves' D2H, not the whole tree's
@@ -181,6 +205,7 @@ class PSGradientExchange:
 
         def push_one(idx: int) -> np.ndarray:
             pskey, b = keyed[idx]
+            rounds[idx] = self._next_round(pskey)
             t0 = time.time()
             buf = np.empty(b.size, dtype=b.dtype)
             for s in b.segments:
@@ -195,7 +220,7 @@ class PSGradientExchange:
         def pull_one(idx: int, buf: np.ndarray) -> None:
             pskey, b = keyed[idx]
             t0 = time.time()
-            merged = self._pull_bucket(pskey, b, buf, rnd)
+            merged = self._pull_bucket(pskey, b, buf, rounds[idx])
             t0 = self._record(decl_name, "PS_PULL", pskey, t0)
             for s in b.segments:        # disjoint segments: thread-safe
                 out[s.leaf_index][s.leaf_offset:s.leaf_offset + s.length] = \
@@ -311,9 +336,17 @@ class RowSparseExchange:
         elif prev != (num_rows, cols):
             raise ValueError(f"table {name!r} was {prev}, now "
                              f"{(num_rows, cols)} — shape must be stable")
-        self.backend.push_rowsparse(key, idx, rows, dense_nbytes, dtype)
-        rnd = self._rounds.get(key, 0) + 1
+        rnd = self._rounds.get(key)
+        if rnd is None:
+            # server-seeded like the dense exchange: an elastically
+            # rejoined worker resumes at the live job's round, not 1
+            # (pulling round 1 would return a stale table immediately).
+            # Read BEFORE pushing — our own push may complete the round.
+            rnd = (int(self.backend.round(key))
+                   if hasattr(self.backend, "round") else 0)
+        rnd += 1
         self._rounds[key] = rnd
+        self.backend.push_rowsparse(key, idx, rows, dense_nbytes, dtype)
         out = np.empty(num_rows * cols, rows.dtype)
         self.backend.pull(key, out, round=rnd)
         return out.reshape(num_rows, cols)

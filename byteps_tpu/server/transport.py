@@ -56,6 +56,10 @@ OP_INIT, OP_PUSH, OP_PULL, OP_CLOSE = 1, 2, 3, 4
 OP_INIT_C, OP_PUSH_C, OP_PULL_C = 5, 6, 7
 OP_PUSH_RS = 8   # row-sparse push: nbytes = DENSE table size, payload =
                  # n|idx|rows (server/rowsparse.py wire format)
+OP_ROUND = 9     # query the key's latest completed round (response
+                 # payload = u64) — a restarted worker of a LIVE job
+                 # resyncs its round counters from this instead of
+                 # stalling on round 1 (elastic rejoin)
 ST_OK, ST_ERR, ST_TIMEOUT, ST_GONE = 0, 1, 2, 3
 
 # applied seqs kept as an exact set above a contiguous floor — bounds
@@ -249,6 +253,9 @@ class PSTransportServer:
                                            int(nbytes), dtype,
                                            meta=self._rs_cols))
                 conn.sendall(_RSP.pack(ST_OK, 0))
+            elif op == OP_ROUND:
+                rv = struct.pack("!Q", int(self.backend.round(key)))
+                conn.sendall(_RSP.pack(ST_OK, len(rv)) + rv)
             elif op == OP_PULL_C:
                 from .compressed import compressed_pull
                 buf = compressed_pull(self.compressed, self.backend, key,
@@ -463,6 +470,9 @@ class RemotePSBackend:
         import queue as _queue
         self._addrs = [a.rsplit(":", 1) for a in addrs]
         self.hash_fn = hash_fn
+        from ..common.naming import check_mixed_mode_enabled, placement_from_env
+        check_mixed_mode_enabled(hash_fn)
+        self._placement = placement_from_env()
         self.async_mode = async_mode
         self.reconnect_secs = (
             float(_os.environ.get("BPS_RECONNECT_SECS", "30"))
@@ -501,7 +511,8 @@ class RemotePSBackend:
         return s
 
     def _shard(self, key: int) -> int:
-        return place_key(key, len(self._pools), self.hash_fn)
+        return place_key(key, len(self._pools), self.hash_fn,
+                         **self._placement)
 
     def _reconnect(self, i: int, ch: "_Channel", deadline: float) -> None:
         """Redial ``ch`` on shard ``i`` with backoff until ``deadline``,
@@ -653,6 +664,12 @@ class RemotePSBackend:
              timeout_ms: int = 30000) -> None:
         self._rpc(OP_PULL, key, round, out.nbytes, timeout_ms,
                   str(out.dtype), None, pull_into=out)
+
+    def round(self, key: int) -> int:
+        """The server's latest completed round for ``key`` (see
+        HostPSBackend.round — the elastic-rejoin resync point)."""
+        data = self._rpc(OP_ROUND, key, 0, 0, 0, "uint8", None)
+        return struct.unpack("!Q", data)[0]
 
     def push_bytes(self, key: int, payload) -> None:
         """Compressed push: ship the codec payload as-is; the server

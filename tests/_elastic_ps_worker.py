@@ -1,0 +1,49 @@
+"""Worker for the live-job elastic-rejoin test (launched by
+test_elastic.py). Runs sync-PS gradient exchanges for rounds
+[start, end]; with --die-after R the process exits ABRUPTLY (os._exit,
+no close/cleanup — a crash) right after completing round R.
+
+A restarted replacement passes --start R+1: its fresh exchange seeds
+round counters from the SERVER's completed round, so the live peer's
+in-flight round completes instead of stalling (the reference's
+is_recovery skip-barrier analog, global.cc:283-297)."""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from byteps_tpu.server.ps_mode import PSGradientExchange
+from byteps_tpu.server.transport import RemotePSBackend
+
+N = 4096
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--addr", required=True)
+    ap.add_argument("--start", type=int, default=1)
+    ap.add_argument("--end", type=int, required=True)
+    ap.add_argument("--die-after", type=int, default=0)
+    ap.add_argument("--tag", default="w")
+    args = ap.parse_args()
+
+    be = RemotePSBackend([args.addr])
+    ex = PSGradientExchange(be, partition_bytes=4096)   # several buckets
+    for r in range(args.start, args.end + 1):
+        tree = {"g": np.full(N, float(r), np.float32)}
+        out = ex.exchange(tree, name="g")
+        np.testing.assert_allclose(out["g"], 2.0 * r), \
+            f"round {r}: {out['g'][0]}"
+        print(f"{args.tag} round {r} ok", flush=True)
+        if args.die_after and r == args.die_after:
+            os._exit(0)      # crash: no close, sockets drop mid-job
+    be.close()
+    print(f"{args.tag} DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

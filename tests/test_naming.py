@@ -60,3 +60,79 @@ def test_place_key_single_server():
 def test_place_key_unknown_hash():
     with pytest.raises(ValueError):
         place_key(1, 4, "nope")
+
+
+def test_mixed_mode_placement_shape():
+    """Mixed mode (reference Hash_Mixed_Mode): with w colocate + nc
+    non-colocate servers, every server receives keys and the
+    non-colocate tier's share tracks the closed-form ratio."""
+    from byteps_tpu.common.naming import mixed_mode_hash, place_key
+
+    n_servers, n_workers = 6, 4            # nc = 2
+    hits = {}
+    N = 4000
+    for k in range(N):
+        s = mixed_mode_hash(k, n_servers, n_workers)
+        assert 0 <= s < n_servers
+        hits[s] = hits.get(s, 0) + 1
+    assert set(hits) == set(range(n_servers)), hits
+    nc = n_servers - n_workers
+    ratio = (2.0 * nc * (n_workers - 1)) / (
+        n_workers * (n_workers + nc) - 2 * nc)
+    nc_share = sum(hits[s] for s in range(nc)) / N
+    assert abs(nc_share - ratio) < 0.08, (nc_share, ratio)
+
+    # place_key integration + the reference's opt-in/validity checks
+    assert place_key(7, n_servers, "mixed", num_workers=n_workers) == \
+        mixed_mode_hash(7, n_servers, n_workers)
+    with pytest.raises(ValueError, match="mixed"):
+        place_key(7, n_servers, "mixed")          # no worker count
+    with pytest.raises(ValueError, match="BOUND"):
+        mixed_mode_hash(7, n_servers, n_workers, bound=3)
+    with pytest.raises(ValueError, match="non-colocate"):
+        mixed_mode_hash(7, 4, 4)                  # no non-colocate tier
+
+
+def test_reduce_roots_restricts_placement():
+    from byteps_tpu.common.naming import place_key
+
+    roots = [1, 3]
+    seen = {place_key(k, 4, "djb2", reduce_roots=roots)
+            for k in range(200)}
+    assert seen == {1, 3}
+    assert place_key(5, 4, "djb2", reduce_roots=[2]) == 2
+    with pytest.raises(ValueError, match="out of range"):
+        place_key(5, 4, "djb2", reduce_roots=[4])
+
+
+def test_built_in_hash_coefficient_changes_placement():
+    from byteps_tpu.common.naming import place_key
+
+    a = [place_key(k, 7, "built_in", built_in_coef=1) for k in range(100)]
+    b = [place_key(k, 7, "built_in", built_in_coef=9973) for k in range(100)]
+    assert a != b                      # the knob actually steers placement
+    assert all(0 <= s < 7 for s in a + b)
+
+
+def test_mixed_mode_env_opt_in_enforced(monkeypatch):
+    """hash_fn=mixed without BPS_ENABLE_MIXED_MODE must refuse, like the
+    reference's check (global.cc:649-651)."""
+    from byteps_tpu.server.engine import HostPSBackend
+
+    monkeypatch.delenv("BPS_ENABLE_MIXED_MODE", raising=False)
+    with pytest.raises(ValueError, match="MIXED_MODE"):
+        HostPSBackend(num_servers=6, num_workers=4, hash_fn="mixed")
+    monkeypatch.setenv("BPS_ENABLE_MIXED_MODE", "1")
+    # placement worker count comes from the env contract; the ctor's
+    # num_workers (push counting) stays 1 so a single pusher completes
+    monkeypatch.setenv("BPS_NUM_WORKER", "4")
+    be = HostPSBackend(num_servers=6, num_workers=1, hash_fn="mixed",
+                       engine_threads=1)
+    try:
+        import numpy as np
+        x = np.ones(8, np.float32)
+        be.init_key(3, x.nbytes)
+        out = be.push_pull(3, x)
+        np.testing.assert_allclose(out, x)
+    finally:
+        be.close()

@@ -91,9 +91,9 @@ def test_ps_trainer_grad_accumulation(_ps_env):
     xb = np.random.RandomState(4).randn(32, 8).astype(np.float32)
     tr = DistributedTrainer(_loss, {"w": np.zeros((8, 1), np.float32)},
                             optax.sgd(0.1), backward_passes_per_step=2)
-    rounds0 = dict(tr._ps_exchange._rounds)
+    rounds0 = dict(tr._ps_exchange._key_rounds)
     tr.step((xa, xa @ W))
-    assert dict(tr._ps_exchange._rounds) == rounds0, \
+    assert dict(tr._ps_exchange._key_rounds) == rounds0, \
         "intermediate pass must not hit the PS service"
     tr.step((xb, xb @ W))
     acc_w = np.asarray(tr.params["w"])

@@ -231,3 +231,49 @@ def test_close_wakes_blocked_pull():
     import pytest as _pytest
     with _pytest.raises(ServerClosed):
         be.push(1, x)
+
+
+def test_server_engine_blocking_mode(monkeypatch):
+    """BPS_SERVER_ENGINE_BLOCKING: pushes apply inline in the caller's
+    thread (reference: server.cc:407-414); sums stay exact."""
+    monkeypatch.setenv("BPS_SERVER_ENGINE_BLOCKING", "1")
+    from byteps_tpu.server.engine import PSServer
+    srv = PSServer(num_workers=2, engine_threads=4)
+    try:
+        x = np.arange(256, dtype=np.float32)
+        srv.init_key(1, x.nbytes)
+        srv.push(1, x)
+        srv.push(1, 2 * x)
+        out = np.empty_like(x)
+        srv.pull(1, out, round=1, timeout_ms=5000)
+        np.testing.assert_allclose(out, 3 * x)
+    finally:
+        srv.close()
+
+
+def test_server_debug_key_traces_stages(monkeypatch, capfd):
+    """BPS_SERVER_DEBUG + BPS_SERVER_DEBUG_KEY: per-stage value tracing
+    of the chosen key's COPY_FIRST / SUM_RECV applications (reference:
+    server.cc:115-197)."""
+    monkeypatch.setenv("BPS_SERVER_DEBUG", "1")
+    monkeypatch.setenv("BPS_SERVER_DEBUG_KEY", "7")
+    from byteps_tpu.server.engine import PSServer
+    srv = PSServer(num_workers=2, engine_threads=1)
+    try:
+        x = np.full(16, 2.5, np.float32)
+        srv.init_key(7, x.nbytes)
+        srv.init_key(8, x.nbytes)       # non-debug key: no trace lines
+        srv.push(7, x)
+        srv.push(7, x)
+        srv.push(8, x)
+        srv.push(8, x)
+        out = np.empty_like(x)
+        srv.pull(7, out, round=1, timeout_ms=5000)
+        srv.pull(8, out, round=1, timeout_ms=5000)
+    finally:
+        srv.close()
+    err = capfd.readouterr().err
+    assert "ENGINE_COPY_MERGED_TO_STORE_BEFORE" in err
+    assert "ENGINE_SUM_RECV_AFTER" in err
+    assert "key: 7" in err and "key: 8" not in err
+    assert "src: 2.5" in err
