@@ -32,6 +32,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
+# every kernel's grid is (outer..., carried): only the innermost dim
+# carries scratch state across iterations; the rest are independent
+# programs the pipeliner may reorder/overlap
+_DIM_SEMANTICS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
+
 
 def _pick_block(s: int, want: int) -> int:
     for b in (want, 512, 256, 128):
@@ -155,6 +161,7 @@ def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret, out_dtype=None):
             pltpu.VMEM((ht * bq, 128), jnp.float32),
             pltpu.VMEM((ht * bq, 128), jnp.float32),
         ],
+        compiler_params=_DIM_SEMANTICS,
         interpret=interpret,
     )(q, k, v)
     return out, lse
@@ -305,6 +312,7 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, bq, bk, interpret,
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((ht * bq, d), jnp.float32)],
+        compiler_params=_DIM_SEMANTICS,
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
@@ -322,6 +330,7 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, bq, bk, interpret,
                    jax.ShapeDtypeStruct((b, h, s, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((ht * bk, d), jnp.float32),
                         pltpu.VMEM((ht * bk, d), jnp.float32)],
+        compiler_params=_DIM_SEMANTICS,
         interpret=interpret,
     )(q, k, v, do, lse, delta)
     return dq, dk, dv

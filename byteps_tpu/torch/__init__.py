@@ -1,0 +1,46 @@
+"""PyTorch plugin: the reference's ``byteps.torch`` API over the
+TPU-native runtime.
+
+The reference's largest plugin (reference: torch/__init__.py 409 LoC +
+ops.py + cross_barrier.py) hooks every parameter's grad accumulator,
+push_pulls gradients asynchronously while backward still runs, and
+drains the handles in ``step()``. Same surface here, redesigned for
+this runtime:
+
+  - torch tensors live on the HOST, so gradients take the PS host path
+    directly (PSGradientExchange — sharded servers, compression,
+    priorities) with no device round-trip; a single-thread dispatcher
+    gives the backward/communication overlap the reference gets from
+    its pipeline (order across workers doesn't matter: the PS server
+    matches contributions per KEY, exactly like ps-lite).
+  - world size is the PS worker count (``BPS_NUM_WORKER``); at world 1
+    every op is a local no-op, like the reference built without
+    distributed support.
+  - ``BPS_ENABLE_ASYNC`` switches ``DistributedOptimizer`` to the
+    async-PS protocol: local step, push weight DELTAS, pull fresh
+    global weights (reference: torch/__init__.py:186-214).
+
+Usage is byteps-torch-compatible::
+
+    import byteps_tpu.torch as bps
+    bps.init()
+    optimizer = bps.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+    bps.broadcast_parameters(model.state_dict(), root_rank=0)
+"""
+
+from __future__ import annotations
+
+from .compression import Compression
+from .ops import (declare, init, local_rank, local_size, poll, push_pull,
+                  push_pull_async, push_pull_async_inplace, rank, shutdown,
+                  size, synchronize)
+from .optimizer import (DistributedOptimizer, broadcast_optimizer_state,
+                        broadcast_parameters)
+
+__all__ = [
+    "Compression", "DistributedOptimizer", "broadcast_optimizer_state",
+    "broadcast_parameters", "declare", "init", "local_rank", "local_size",
+    "poll", "push_pull", "push_pull_async", "push_pull_async_inplace",
+    "rank", "shutdown", "size", "synchronize",
+]

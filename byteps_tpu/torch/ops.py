@@ -1,0 +1,196 @@
+"""torch-tensor push_pull ops (reference: torch/ops.py:48-236 +
+handle_manager.{cc,h} — int handles over in-flight reductions).
+
+Handles wrap futures on a single-thread dispatcher: dispatch returns
+immediately (backward keeps running), the exchange executes on the
+side thread, ``synchronize`` blocks on the future. One thread keeps
+per-process dispatch serial; cross-worker matching is per KEY on the
+PS server, so workers may dispatch in different orders (the reference
+relies on the same ps-lite property)."""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import torch
+
+from ..common.global_state import GlobalState
+
+
+def init(config=None, **kwargs) -> None:
+    """bps.init() for torch scripts (lazy import keeps jax out of the
+    hot path)."""
+    import byteps_tpu as bps
+    bps.init(config=config, **kwargs)
+
+
+def shutdown() -> None:
+    import byteps_tpu as bps
+    _Dispatcher.reset()
+    _async_inited.clear()
+    bps.shutdown()
+
+
+def size() -> int:
+    """World size = PS worker-process count (torch processes are the
+    replicas; the jax mesh inside each is an implementation detail)."""
+    return GlobalState.get().config.num_worker
+
+
+def rank() -> int:
+    return GlobalState.get().config.worker_id
+
+
+def local_rank() -> int:
+    return GlobalState.get().config.local_rank
+
+
+def local_size() -> int:
+    return GlobalState.get().config.local_size
+
+
+def declare(name: str, **kwargs) -> None:
+    """Pre-declare a tensor (priority / compression kwargs — reference:
+    byteps_declare_tensor)."""
+    GlobalState.get().registry.declare(name, **kwargs)
+
+
+class _Dispatcher:
+    """Process-wide handle table + single-thread exchange executor."""
+
+    _lock = threading.Lock()
+    _ex: Optional[ThreadPoolExecutor] = None
+    _handles: Dict[int, Tuple[Future, torch.Tensor, bool]] = {}
+    _next = 0
+    _noname = 0
+
+    @classmethod
+    def executor(cls) -> ThreadPoolExecutor:
+        with cls._lock:
+            if cls._ex is None:
+                cls._ex = ThreadPoolExecutor(
+                    1, thread_name_prefix="bps-torch-pushpull")
+            return cls._ex
+
+    @classmethod
+    def submit(cls, fn, out: torch.Tensor, inplace: bool) -> int:
+        fut = cls.executor().submit(fn)
+        with cls._lock:
+            h = cls._next
+            cls._next += 1
+            cls._handles[h] = (fut, out, inplace)
+        return h
+
+    @classmethod
+    def take(cls, handle: int):
+        with cls._lock:
+            return cls._handles.pop(handle)
+
+    @classmethod
+    def peek(cls, handle: int):
+        with cls._lock:
+            return cls._handles[handle]
+
+    @classmethod
+    def auto_name(cls) -> str:
+        with cls._lock:
+            n = cls._noname
+            cls._noname += 1
+        return f"push_pull.noname.{n}"
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            ex, cls._ex = cls._ex, None
+            cls._handles.clear()
+        if ex is not None:
+            ex.shutdown(wait=True)
+
+
+def _exchange_np(arr: np.ndarray, average: bool, name: str) -> np.ndarray:
+    """One cross-worker sum (host path). World 1: identity."""
+    gs = GlobalState.get()
+    ex = gs.engine.ps_exchange
+    if ex is None:
+        return arr                    # single worker, nothing to reduce
+    out = ex.exchange({"t": arr}, name=name)["t"]
+    if average and gs.engine.ps_world > 1:
+        out = out / gs.engine.ps_world
+    return out
+
+
+_async_inited: set = set()
+
+
+def async_param_exchange(name: str, delta: np.ndarray,
+                         init: np.ndarray) -> np.ndarray:
+    """Async-PS protocol for one parameter: seed the store with the
+    initial weights (first-wins, idempotent — every worker broadcasts
+    the same values first), push the weight DELTA, pull the latest
+    global weights (reference: async server folds raw deltas,
+    server.cc:310-314; our AsyncPSWorker protocol in server/ps_mode.py)."""
+    gs = GlobalState.get()
+    be = gs.ps_backend
+    key = gs.registry.declare(name).key_for_partition(0)
+    if key not in _async_inited:
+        be.init_key(key, init.nbytes, str(init.dtype),
+                    init=np.ascontiguousarray(init))
+        _async_inited.add(key)
+    be.push(key, np.ascontiguousarray(delta))
+    out = np.empty(init.size, init.dtype)
+    be.pull(key, out)                 # async mode: latest, never blocks
+    return out.reshape(init.shape)
+
+
+def _dispatch(tensor: torch.Tensor, average: bool, name: Optional[str],
+              inplace: bool) -> int:
+    if name is None:
+        name = _Dispatcher.auto_name()
+    arr = tensor.detach().cpu().numpy().copy()
+
+    def run():
+        return _exchange_np(arr, average, name)
+
+    return _Dispatcher.submit(run, tensor, inplace)
+
+
+def push_pull_async(tensor: torch.Tensor, average: bool = True,
+                    name: Optional[str] = None) -> int:
+    """Dispatch a reduction of ``tensor``; returns an int handle. The
+    input is snapshotted — later in-place mutation doesn't affect the
+    exchange; ``synchronize`` returns a NEW tensor."""
+    return _dispatch(tensor, average, name, inplace=False)
+
+
+def push_pull_async_inplace(tensor: torch.Tensor, average: bool = True,
+                            name: Optional[str] = None) -> int:
+    """Like ``push_pull_async`` but ``synchronize`` writes the result
+    back INTO ``tensor`` (reference: the default grad path)."""
+    return _dispatch(tensor, average, name, inplace=True)
+
+
+def poll(handle: int) -> bool:
+    fut, _, _ = _Dispatcher.peek(handle)
+    return fut.done()
+
+
+def synchronize(handle: int) -> torch.Tensor:
+    fut, tensor, inplace = _Dispatcher.take(handle)
+    out = fut.result()
+    result = torch.from_numpy(np.ascontiguousarray(out)).reshape(
+        tensor.shape).to(tensor.dtype)
+    if inplace:
+        with torch.no_grad():
+            tensor.copy_(result)
+        return tensor
+    return result
+
+
+def push_pull(tensor: torch.Tensor, average: bool = True,
+              name: Optional[str] = None) -> torch.Tensor:
+    """Synchronous reduce; returns a new tensor (reference:
+    torch/ops.py push_pull)."""
+    return synchronize(push_pull_async(tensor, average=average, name=name))

@@ -1,0 +1,234 @@
+"""DistributedOptimizer + parameter/optimizer-state broadcast for torch
+(reference: torch/__init__.py:35-409).
+
+The wrapper subclasses the user's optimizer class dynamically (same
+trick as the reference) and:
+
+  - hooks every parameter's gradient accumulation
+    (``register_post_accumulate_grad_hook`` — the modern form of the
+    reference's ``grad_acc.register_hook`` trick) to dispatch an async
+    push_pull the moment a grad is ready, overlapping communication
+    with the rest of backward;
+  - counts ``backward_passes_per_step`` backwards before communicating
+    (local gradient accumulation, reference :83-113);
+  - ``synchronize()`` drains handles and writes averaged grads back, so
+    gradient clipping between backward and step works (reference
+    docstring pattern);
+  - async-PS mode (``BPS_ENABLE_ASYNC``): ``step()`` applies the local
+    update, pushes the weight DELTA, and pulls fresh global weights
+    (reference :186-214).
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+
+import torch
+
+from .compression import Compression
+from .ops import _Dispatcher, push_pull_async, rank, size, synchronize
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    def __init__(self, params, named_parameters, compression,
+                 backward_passes_per_step=1):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+        self._enable_async = os.getenv(
+            "BPS_ENABLE_ASYNC", os.getenv("BYTEPS_ENABLE_ASYNC", "0")) \
+            not in ("0", "", "false")
+
+        named_parameters = list(named_parameters or [])
+        if any(not isinstance(p, tuple) for p in named_parameters):
+            raise ValueError("named_parameters should be a sequence of "
+                             "(name, parameter) tuples, usually "
+                             "model.named_parameters()")
+        names = [n for n, _ in named_parameters]
+        if len(set(names)) != len(names):
+            dups = sorted({n for n in names if names.count(n) > 1})
+            raise ValueError(f"parameter names must be unique; "
+                             f"duplicates: {', '.join(dups)}")
+        if named_parameters:
+            self._parameter_names = {p: n for n, p in named_parameters}
+        else:
+            self._parameter_names = {
+                p: f"push_pull.noname.{i}"
+                for group in self.param_groups
+                for i, p in enumerate(group["params"])}
+        self.backward_passes_per_step = backward_passes_per_step
+        self._push_pull_delay = {p: backward_passes_per_step
+                                 for p in self._parameter_names}
+        self._handles = {}
+        self._hook_handles = []
+        self._requires_update = set()
+        self._should_sync = True
+        if size() > 1:
+            self._register_hooks()
+        # two sorted loops like the reference: gradient keys first, then
+        # parameter keys, so key ranges stay load-balanced
+        from ..common.global_state import GlobalState
+        reg = GlobalState.get().registry
+        for name in sorted(self._parameter_names.values()):
+            reg.declare("Gradient." + name)
+        for name in sorted(self._parameter_names.values()):
+            reg.declare("Parameter." + name)
+
+    def _register_hooks(self):
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    self._requires_update.add(p)
+                    self._hook_handles.append(
+                        p.register_post_accumulate_grad_hook(
+                            self._make_hook()))
+
+    def _make_hook(self):
+        def hook(p):
+            if p in self._handles and self._handles[p][0] is not None:
+                if self._push_pull_delay[p] <= 0:
+                    raise AssertionError(
+                        "Gradients were computed more than "
+                        "backward_passes_per_step times before step(); "
+                        "increase backward_passes_per_step to accumulate")
+            assert self._push_pull_delay[p] > 0
+            handle, ctx = None, None
+            self._push_pull_delay[p] -= 1
+            if self._push_pull_delay[p] == 0:
+                handle, ctx = self._push_pull_grad_async(p)
+            self._handles[p] = (handle, ctx)
+        return hook
+
+    def _push_pull_grad_async(self, p):
+        name = self._parameter_names[p]
+        if self._enable_async:
+            return None, None        # real handle created in step()
+        compressed, ctx = self._compression.compress(p.grad)
+        handle = push_pull_async(compressed, average=True,
+                                 name="Gradient." + name)
+        return handle, ctx
+
+    def set_backward_passes_per_step(self, passes):
+        self.backward_passes_per_step = passes
+        for p in self._push_pull_delay:
+            self._push_pull_delay[p] = passes
+
+    def synchronize(self):
+        if size() <= 1:
+            return
+        missing = self._requires_update - set(self._handles)
+        for p in missing:
+            self._handles[p] = self._push_pull_grad_async(p)
+        for p, (handle, ctx) in list(self._handles.items()):
+            if handle is None and not self._enable_async:
+                self._handles[p] = self._push_pull_grad_async(p)
+        for p, (handle, ctx) in self._handles.items():
+            if handle is None:
+                continue
+            out = synchronize(handle)
+            self._push_pull_delay[p] = self.backward_passes_per_step
+            if not self._enable_async:
+                with torch.no_grad():
+                    p.grad.copy_(self._compression.decompress(out, ctx))
+        self._handles.clear()
+
+    @contextmanager
+    def skip_synchronize(self):
+        if self._enable_async:
+            raise AssertionError(
+                "skip_synchronize cannot be used in async training")
+        self._should_sync = False
+        try:
+            yield
+        finally:
+            self._should_sync = True
+
+    def step(self, closure=None):
+        if self._enable_async and size() > 1:
+            # async-PS: local update → push delta → pull fresh weights
+            # (no inter-worker barrier; the server folds deltas into the
+            # global weights as they arrive)
+            import numpy as _np
+            from .ops import async_param_exchange
+            old = {p: p.data.clone().detach()
+                   for p in self._parameter_names}
+            loss = super(self.__class__, self).step(closure)
+            for p, name in self._parameter_names.items():
+                delta = (p.data - old[p]).cpu().numpy()
+                fresh = async_param_exchange(
+                    "AsyncParam." + name, delta,
+                    old[p].cpu().numpy().astype(_np.float32, copy=False))
+                with torch.no_grad():
+                    p.data.copy_(torch.from_numpy(
+                        _np.ascontiguousarray(fresh)).to(p.dtype))
+            self._handles.clear()
+            for p in self._push_pull_delay:
+                self._push_pull_delay[p] = self.backward_passes_per_step
+            return loss
+        if self._should_sync:
+            self.synchronize()
+        return super(self.__class__, self).step(closure)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step=1):
+    """Wrap a torch optimizer so gradients are push_pull-averaged across
+    workers before each step (reference: torch/__init__.py:218-252 —
+    dynamic subclass of the wrapped optimizer's class)."""
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    return cls(optimizer.param_groups, named_parameters, compression,
+               backward_passes_per_step)
+
+
+def broadcast_parameters(params, root_rank, prefix="Parameter."):
+    """Root's values to every worker: non-root zeros + push_pull(sum)
+    (reference: torch/__init__.py:259-291)."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    elif isinstance(params, list):
+        items = [p if isinstance(p, tuple) else (None, p) for p in params]
+    else:
+        raise ValueError(f"invalid params of type {type(params)}")
+    if size() <= 1:
+        return
+    handles = []
+    for name, p in items:
+        if not isinstance(p, torch.Tensor):
+            continue
+        with torch.no_grad():
+            if rank() != root_rank:
+                p.fill_(0)
+        handles.append((p, push_pull_async(
+            p, average=False,
+            name=(prefix + name) if name else None)))
+    for p, h in handles:
+        out = synchronize(h)
+        with torch.no_grad():
+            p.copy_(out)
+
+
+def broadcast_optimizer_state(optimizer, root_rank,
+                              prefix="OptimizerState."):
+    """Root's optimizer state to every worker; scalar state entries are
+    tensor-ized for the wire (reference: torch/__init__.py:293-409)."""
+    if size() <= 1:
+        return
+    state = optimizer.state_dict()
+    tensors = {}
+    scalars = []                       # (pid, key, original python type)
+    for pid, pstate in state.get("state", {}).items():
+        for k, v in list(pstate.items()):
+            key = f"{prefix}{pid}.{k}"
+            if isinstance(v, torch.Tensor):
+                tensors[key] = v
+            elif isinstance(v, (int, float)) and not isinstance(v, bool):
+                t = torch.tensor(float(v), dtype=torch.float64)
+                tensors[key] = t
+                pstate[k] = t
+                scalars.append((pid, k, type(v)))
+    broadcast_parameters(tensors, root_rank, prefix="")
+    for pid, k, typ in scalars:        # back to python scalars
+        state["state"][pid][k] = typ(state["state"][pid][k].item())
+    optimizer.load_state_dict(state)

@@ -1,0 +1,43 @@
+"""Async-PS torch worker (launched by test_torch_plugin.py): each
+worker trains on ITS OWN data shard with no inter-worker barrier —
+local step, push weight delta, pull fresh global weights (reference:
+torch/__init__.py:186-214)."""
+
+import os
+import sys
+
+import numpy as np
+import torch
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import byteps_tpu.torch as bps
+
+
+def main():
+    wid = int(os.environ["BPS_WORKER_ID"])
+    bps.init()
+    torch.manual_seed(0)                       # same init on every worker
+    model = torch.nn.Linear(8, 1)
+    opt = bps.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.05),
+        named_parameters=model.named_parameters())
+    rs = np.random.RandomState(100 + wid)      # per-worker data
+    w_true = np.random.RandomState(5).randn(8, 1).astype(np.float32)
+    x = torch.tensor(rs.randn(64, 8), dtype=torch.float32)
+    y = x @ torch.tensor(w_true)
+    losses = []
+    for _ in range(40):
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+    bps.shutdown()
+    print(f"TORCH_ASYNC_OK rank={wid} first={losses[0]:.4f} "
+          f"last={losses[-1]:.5f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

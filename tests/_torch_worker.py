@@ -1,0 +1,99 @@
+"""Worker for the 2-process torch-plugin test: trains a small torch MLP
+with byteps_tpu.torch.DistributedOptimizer over the TCP PS service.
+Both workers feed the SAME global batch, so their averaged gradients —
+and hence loss trajectories — must match a single-process run exactly."""
+
+import os
+import sys
+
+import numpy as np
+import torch
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import byteps_tpu.torch as bps
+
+
+def build(seed: int = 0):
+    torch.manual_seed(seed)
+    model = torch.nn.Sequential(
+        torch.nn.Linear(8, 16), torch.nn.Tanh(), torch.nn.Linear(16, 1))
+    return model
+
+
+def data():
+    rs = np.random.RandomState(1)
+    x = torch.tensor(rs.randn(64, 8), dtype=torch.float32)
+    w = torch.tensor(rs.randn(8, 1), dtype=torch.float32)
+    y = x @ w
+    return x, y
+
+
+def reference_losses(steps: int):
+    """Plain single-process torch training on the same batch."""
+    model = build()
+    opt = torch.optim.SGD(model.parameters(), lr=0.05)
+    x, y = data()
+    losses = []
+    for _ in range(steps):
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        losses.append(float(loss))
+    return losses
+
+
+def main():
+    steps = 12
+    bps.init()
+    model = build()
+    opt = torch.optim.SGD(model.parameters(), lr=0.05)
+    opt = bps.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+    bps.broadcast_parameters(model.state_dict(), root_rank=0)
+    x, y = data()
+    losses = []
+    for _ in range(steps):
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        losses.append(float(loss))
+    want = reference_losses(steps)
+    np.testing.assert_allclose(losses, want, rtol=1e-4, atol=1e-6)
+
+    # --- backward_passes_per_step=2: two half-batch backwards then one
+    # step must equal one full-batch step on the summed gradient
+    # (reference: torch/__init__.py:83-113)
+    model2 = build(seed=7)
+    opt2 = bps.DistributedOptimizer(
+        torch.optim.SGD(model2.parameters(), lr=0.05),
+        named_parameters=model2.named_parameters(),
+        backward_passes_per_step=2)
+    bps.broadcast_parameters(model2.state_dict(), root_rank=0)
+    ref2 = build(seed=7)
+    ref_opt = torch.optim.SGD(ref2.parameters(), lr=0.05)
+    ref2.load_state_dict(model2.state_dict())
+    xa, ya = x[:32], y[:32]
+    xb, yb = x[32:], y[32:]
+    # distributed: two half-batch backwards accumulate, step syncs once
+    torch.nn.functional.mse_loss(model2(xa), ya).backward()
+    torch.nn.functional.mse_loss(model2(xb), yb).backward()
+    opt2.step()
+    # reference: one backward on the summed half-batch losses
+    (torch.nn.functional.mse_loss(ref2(xa), ya)
+     + torch.nn.functional.mse_loss(ref2(xb), yb)).backward()
+    ref_opt.step()
+    for (n, p), (_, q) in zip(model2.named_parameters(),
+                              ref2.named_parameters()):
+        np.testing.assert_allclose(p.detach().numpy(), q.detach().numpy(),
+                                   rtol=1e-4, atol=1e-6, err_msg=n)
+
+    bps.shutdown()
+    print(f"TORCH_WORKER_OK rank={os.environ.get('BPS_WORKER_ID')} "
+          f"first={losses[0]:.5f} last={losses[-1]:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
