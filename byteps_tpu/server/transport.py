@@ -134,8 +134,60 @@ def _recv_req(sock: socket.socket):
 
 # ------------------------------------------------------------------ server
 
+def _ipc_path(port: int) -> str:
+    """Deterministic UDS path for a server's IPC listener — colocated
+    workers derive it from the TCP port they were given, so no extra
+    address plumbing is needed (reference: BYTEPS_ENABLE_IPC switches
+    colocated worker↔server traffic off the network stack,
+    docs/best-practice.md). Sockets live in a 0700 per-uid directory —
+    a world-writable shared path would let another local user squat the
+    name (denying startup) or bind an impostor listener that workers
+    auto-upgrade their gradients to."""
+    import os as _os
+    import stat as _stat
+    import tempfile as _tempfile
+    base = _os.environ.get("BPS_IPC_DIR")
+    if not base:
+        base = _os.path.join(_tempfile.gettempdir(),
+                             f"bps-ipc-{_os.getuid()}")
+    _os.makedirs(base, mode=0o700, exist_ok=True)
+    st = _os.stat(base)
+    if st.st_uid != _os.getuid() or (st.st_mode & 0o077):
+        raise RuntimeError(
+            f"IPC dir {base} must be owned by uid {_os.getuid()} with "
+            f"mode 0700 (found uid {st.st_uid}, mode "
+            f"{_stat.S_IMODE(st.st_mode):o}) — refusing to exchange "
+            f"gradients over a tamperable socket path")
+    return _os.path.join(base, f"bps-ipc-{port}.sock")
+
+
+def _bump_bufs(s: socket.socket, nbytes: int = 4 << 20) -> None:
+    """Grow a UDS's kernel buffers: the AF_UNIX default (~208KB) makes
+    multi-MB gradient frames ping-pong between the peers with a context
+    switch per buffer-full, which measured SLOWER than loopback TCP
+    (whose autotuned windows absorb bulk writes)."""
+    for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
+        try:
+            s.setsockopt(socket.SOL_SOCKET, opt, nbytes)
+        except OSError:
+            pass
+
+
+def _ipc_enabled() -> bool:
+    import os as _os
+    return _os.environ.get(
+        "BPS_ENABLE_IPC", _os.environ.get("BYTEPS_ENABLE_IPC", "0")) \
+        not in ("0", "", "false")
+
+
 class PSTransportServer:
-    """Threaded TCP front for a local summation backend."""
+    """Threaded TCP front for a local summation backend.
+
+    With BPS_ENABLE_IPC=1 the server ALSO listens on a Unix-domain
+    socket (path derived from the TCP port) and colocated workers
+    auto-upgrade their connections to it — loopback TCP's
+    checksum/segmentation overhead gone, same frames, same handler
+    (the reference's colocated-IPC deployment knob)."""
 
     def __init__(self, backend, host: str = "0.0.0.0", port: int = 0,
                  key_meta=None):
@@ -182,18 +234,37 @@ class PSTransportServer:
         self.port = self._sock.getsockname()[1]
         self._sock.listen(64)
         self._stop = threading.Event()
-        self._accept_thread = threading.Thread(target=self._accept_loop,
-                                               daemon=True,
-                                               name="bps-ps-accept")
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, args=(self._sock, True),
+            daemon=True, name="bps-ps-accept")
         self._accept_thread.start()
+        self._ipc_sock = None
+        self.ipc_path = None
+        if _ipc_enabled():
+            import os as _os
+            path = _ipc_path(self.port)
+            try:
+                _os.unlink(path)
+            except OSError:
+                pass
+            self._ipc_sock = socket.socket(socket.AF_UNIX,
+                                           socket.SOCK_STREAM)
+            _bump_bufs(self._ipc_sock)
+            self._ipc_sock.bind(path)
+            self._ipc_sock.listen(64)
+            self.ipc_path = path
+            threading.Thread(target=self._accept_loop,
+                             args=(self._ipc_sock, False),
+                             daemon=True, name="bps-ps-ipc-accept").start()
 
-    def _accept_loop(self) -> None:
+    def _accept_loop(self, sock: socket.socket, is_tcp: bool) -> None:
         while not self._stop.is_set():
             try:
-                conn, _ = self._sock.accept()
+                conn, _ = sock.accept()
             except OSError:
                 return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if is_tcp:
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True, name="bps-ps-conn").start()
 
@@ -377,6 +448,16 @@ class PSTransportServer:
             self._sock.close()
         except OSError:
             pass
+        if self._ipc_sock is not None:
+            import os as _os
+            try:
+                self._ipc_sock.close()
+            except OSError:
+                pass
+            try:
+                _os.unlink(self.ipc_path)
+            except OSError:
+                pass
 
 
 # ------------------------------------------------------- state snapshots
@@ -506,6 +587,25 @@ class RemotePSBackend:
 
     def _dial(self, i: int) -> socket.socket:
         host, port = self._addrs[i]
+        if host == "unix":                 # explicit "unix:/path.sock"
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            _bump_bufs(s)
+            s.connect(port)
+            return s
+        if _ipc_enabled() and host in ("127.0.0.1", "localhost"):
+            # colocated server: auto-upgrade to its Unix-domain listener
+            # (path derived from the TCP port; fall back to TCP when the
+            # server predates the knob or runs elsewhere)
+            import os as _os
+            path = _ipc_path(int(port))
+            if _os.path.exists(path):
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                _bump_bufs(s)
+                try:
+                    s.connect(path)
+                    return s
+                except OSError:
+                    s.close()
         s = socket.create_connection((host, int(port)))
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return s

@@ -52,10 +52,13 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         if named_parameters:
             self._parameter_names = {p: n for n, p in named_parameters}
         else:
+            # one running index across ALL groups — per-group enumerate
+            # would alias the first param of every group onto the same
+            # PS key (first-wins init + wrong-shape push rejections)
+            allp = [p for group in self.param_groups
+                    for p in group["params"]]
             self._parameter_names = {
-                p: f"push_pull.noname.{i}"
-                for group in self.param_groups
-                for i, p in enumerate(group["params"])}
+                p: f"push_pull.noname.{i}" for i, p in enumerate(allp)}
         self.backward_passes_per_step = backward_passes_per_step
         self._push_pull_delay = {p: backward_passes_per_step
                                  for p in self._parameter_names}
@@ -116,7 +119,12 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     def synchronize(self):
         if size() <= 1:
             return
-        missing = self._requires_update - set(self._handles)
+        # params whose hook never fired (unused in this forward) and
+        # that have no grad contribute nothing — forcing a push of
+        # p.grad=None would crash; peers must skip them identically
+        # (torch autograd leaves unused params' grads None everywhere)
+        missing = {p for p in self._requires_update - set(self._handles)
+                   if p.grad is not None}
         for p in missing:
             self._handles[p] = self._push_pull_grad_async(p)
         for p, (handle, ctx) in list(self._handles.items()):
@@ -154,7 +162,10 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                    for p in self._parameter_names}
             loss = super(self.__class__, self).step(closure)
             for p, name in self._parameter_names.items():
-                delta = (p.data - old[p]).cpu().numpy()
+                # the wire runs fp32 end to end: the store is seeded
+                # fp32, so a half/double model's delta must match
+                delta = (p.data - old[p]).cpu().numpy().astype(
+                    _np.float32, copy=False)
                 fresh = async_param_exchange(
                     "AsyncParam." + name, delta,
                     old[p].cpu().numpy().astype(_np.float32, copy=False))
@@ -215,6 +226,23 @@ def broadcast_optimizer_state(optimizer, root_rank,
     tensor-ized for the wire (reference: torch/__init__.py:293-409)."""
     if size() <= 1:
         return
+    if not optimizer.state_dict().get("state"):
+        # fresh optimizer: materialize state slots with a zero-grad step
+        # (reference/horovod trick) so every worker pushes the SAME key
+        # set — without this a checkpoint-loaded root would push keys
+        # fresh workers never push and both sides stall on the server.
+        # Params are snapshotted/restored around the step: optimizers
+        # with weight decay would otherwise drift them.
+        saved = [(p, p.detach().clone())
+                 for g in optimizer.param_groups for p in g["params"]]
+        grads = [p.grad for p, _ in saved]
+        for p, _ in saved:
+            p.grad = torch.zeros_like(p)
+        optimizer.step()
+        with torch.no_grad():
+            for (p, v), g in zip(saved, grads):
+                p.copy_(v)
+                p.grad = g
     state = optimizer.state_dict()
     tensors = {}
     scalars = []                       # (pid, key, original python type)

@@ -670,3 +670,67 @@ def test_out_of_order_tokened_pushes_both_apply(server2):
     w.pull(31, out, round=1, timeout_ms=5000)
     np.testing.assert_allclose(out, 3 * a)
     w.close()
+
+
+def test_explicit_unix_socket_address(monkeypatch):
+    """'unix:/path.sock' addresses dial the server's UDS listener."""
+    monkeypatch.setenv("BPS_ENABLE_IPC", "1")
+    be = PSServer(num_workers=1, engine_threads=1)
+    srv = PSTransportServer(be, host="127.0.0.1", port=0)
+    try:
+        assert srv.ipc_path and os.path.exists(srv.ipc_path)
+        w = RemotePSBackend([f"unix:{srv.ipc_path}"])
+        x = np.arange(512, dtype=np.float32)
+        w.init_key(3, x.nbytes)
+        out = w.push_pull(3, x)
+        np.testing.assert_allclose(out, x)
+        w.close()
+    finally:
+        srv.close()
+        be.close()
+    assert not os.path.exists(srv.ipc_path)   # cleaned up
+
+
+def test_ipc_auto_upgrade_for_loopback(monkeypatch):
+    """BPS_ENABLE_IPC: a worker given a loopback TCP address silently
+    rides the Unix-domain socket instead (the reference's colocated-IPC
+    deployment, BYTEPS_ENABLE_IPC)."""
+    import socket as _socket
+
+    monkeypatch.setenv("BPS_ENABLE_IPC", "1")
+    be = PSServer(num_workers=1, engine_threads=1)
+    srv = PSTransportServer(be, host="127.0.0.1", port=0)
+    try:
+        w = RemotePSBackend([f"127.0.0.1:{srv.port}"])
+        ch = w._pools[0].get()
+        try:
+            assert ch.sock.family == _socket.AF_UNIX   # upgraded
+        finally:
+            w._pools[0].put(ch)
+        x = np.ones(128, np.float32)
+        w.init_key(9, x.nbytes)
+        np.testing.assert_allclose(w.push_pull(9, x), x)
+        w.close()
+    finally:
+        srv.close()
+        be.close()
+
+
+def test_ipc_disabled_stays_tcp():
+    import socket as _socket
+
+    os.environ.pop("BPS_ENABLE_IPC", None)
+    be = PSServer(num_workers=1, engine_threads=1)
+    srv = PSTransportServer(be, host="127.0.0.1", port=0)
+    try:
+        assert srv.ipc_path is None
+        w = RemotePSBackend([f"127.0.0.1:{srv.port}"])
+        ch = w._pools[0].get()
+        try:
+            assert ch.sock.family == _socket.AF_INET
+        finally:
+            w._pools[0].put(ch)
+        w.close()
+    finally:
+        srv.close()
+        be.close()
