@@ -1,0 +1,145 @@
+// Concurrency stress driver for the summation server, built to run
+// under ThreadSanitizer (make tsan && ./bps_server_stress_tsan).
+//
+// The reference ships no race detection at all (SURVEY §5: "None
+// in-tree" — correctness rests on mutex discipline alone). This driver
+// exercises every cross-thread edge the server has: concurrent pushers
+// racing the COPY_FIRST/SUM_RECV decision, round-blocked pulls racing
+// publication, Round()/PushCount() probes racing the engine threads,
+// and BeginShutdown racing in-flight calls — so TSAN can prove the
+// locking, not just the tests' happy paths.
+//
+// Exit code 0 = all sums exact and no sanitizer report (TSAN aborts
+// non-zero on a race).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+// the server is header-less by design (single TU shared library); pull
+// the implementation in directly for the stress build
+#include "bps_server.cc"
+
+namespace {
+
+constexpr int kWorkers = 4;
+constexpr int kKeys = 8;
+constexpr int kRounds = 50;
+constexpr uint64_t kElems = 1024;
+
+int run_sync_stress() {
+  Server srv(kWorkers, /*threads=*/3, /*schedule=*/true, /*async=*/false);
+  for (int k = 0; k < kKeys; ++k)
+    if (srv.InitKey(k, kElems * 4, F32, nullptr) != 0) return 1;
+
+  std::atomic<int> failures{0};
+  std::vector<std::thread> ts;
+  for (int w = 0; w < kWorkers; ++w) {
+    ts.emplace_back([&srv, &failures, w]() {
+      std::vector<float> buf(kElems), out(kElems);
+      for (int r = 1; r <= kRounds; ++r) {
+        for (int k = 0; k < kKeys; ++k) {
+          for (uint64_t i = 0; i < kElems; ++i)
+            buf[i] = (float)(r + w);        // sum over w: kW*r + sum(w)
+          if (srv.Push(k, buf.data(), kElems * 4) != 0) { ++failures; return; }
+        }
+        for (int k = 0; k < kKeys; ++k) {
+          if (srv.Pull(k, out.data(), kElems * 4, (uint64_t)r, 30000) != 0) {
+            ++failures; return;
+          }
+          float want = (float)(kWorkers * r + (kWorkers * (kWorkers - 1)) / 2);
+          if (out[0] != want || out[kElems - 1] != want) { ++failures; return; }
+        }
+      }
+    });
+  }
+  // probe threads hammer the read-only entries while rounds run
+  std::atomic<bool> stop{false};
+  std::thread probe([&srv, &stop]() {
+    while (!stop.load()) {
+      for (int k = 0; k < kKeys; ++k) {
+        (void)srv.Round(k);
+        (void)srv.PushCount(k);
+        (void)srv.KeyThread(k);
+      }
+    }
+  });
+  for (auto& t : ts) t.join();
+  stop.store(true);
+  probe.join();
+  return failures.load();
+}
+
+int run_shutdown_race() {
+  // pullers blocked on a never-completing round must be woken by
+  // BeginShutdown and drain cleanly while pushes race the teardown.
+  // NOTE the delete happens only after every caller returned — the
+  // server's own contract (bps_server.cc shutdown protocol) states the
+  // C++ inflight guard alone cannot protect a caller that enters after
+  // the drain loop observes zero; the Python binding serializes destroy
+  // behind its own refcount, and this driver mirrors that: the race
+  // under test is BeginShutdown vs in-flight calls, not free vs calls.
+  for (int iter = 0; iter < 20; ++iter) {
+    auto* srv = new Server(2, 2, false, false);
+    srv->InitKey(1, kElems * 4, F32, nullptr);
+    std::vector<std::thread> ts;
+    for (int i = 0; i < 3; ++i) {
+      ts.emplace_back([srv]() {
+        std::vector<float> out(kElems);
+        (void)srv->Pull(1, out.data(), kElems * 4, 1, 30000);  // blocks
+      });
+    }
+    ts.emplace_back([srv]() {
+      std::vector<float> buf(kElems, 1.0f);
+      for (int i = 0; i < 50; ++i)
+        (void)srv->Push(1, buf.data(), kElems * 4);  // one worker only:
+    });                                              // round never fills
+    std::this_thread::sleep_for(std::chrono::milliseconds(2));
+    srv->BeginShutdown();           // wakes the blocked pulls, races the
+    for (auto& t : ts) t.join();    // pusher's in-flight calls
+    delete srv;
+  }
+  return 0;
+}
+
+int run_async_stress() {
+  Server srv(kWorkers, 2, false, /*async=*/true);
+  srv.InitKey(0, kElems * 4, F32, nullptr);
+  std::vector<std::thread> ts;
+  std::atomic<int> failures{0};
+  for (int w = 0; w < kWorkers; ++w) {
+    ts.emplace_back([&srv, &failures]() {
+      std::vector<float> one(kElems, 1.0f), out(kElems);
+      for (int r = 0; r < kRounds; ++r) {
+        if (srv.Push(0, one.data(), kElems * 4) != 0) { ++failures; return; }
+        (void)srv.Pull(0, out.data(), kElems * 4, 0, 1000);
+      }
+    });
+  }
+  for (auto& t : ts) t.join();
+  // drain engines, then the store must hold exactly kWorkers*kRounds
+  std::vector<float> out(kElems);
+  for (int spin = 0; spin < 1000; ++spin) {
+    if (srv.Pull(0, out.data(), kElems * 4, 0, 1000) != 0) return 1;
+    if (out[0] == (float)(kWorkers * kRounds)) break;
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  }
+  if (out[0] != (float)(kWorkers * kRounds)) return 1;
+  return failures.load();
+}
+
+}  // namespace
+
+int main() {
+  int rc = run_sync_stress();
+  if (rc) { std::fprintf(stderr, "sync stress failed (%d)\n", rc); return 1; }
+  rc = run_shutdown_race();
+  if (rc) { std::fprintf(stderr, "shutdown race failed\n"); return 1; }
+  rc = run_async_stress();
+  if (rc) { std::fprintf(stderr, "async stress failed\n"); return 1; }
+  std::printf("BPS_STRESS_OK\n");
+  return 0;
+}

@@ -277,3 +277,34 @@ def test_server_debug_key_traces_stages(monkeypatch, capfd):
     assert "ENGINE_SUM_RECV_AFTER" in err
     assert "key: 7" in err and "key: 8" not in err
     assert "src: 2.5" in err
+
+
+def test_native_server_tsan_stress():
+    """ThreadSanitizer proof of the C++ server's locking (exceeds the
+    reference: SURVEY §5 'Race detection: none in-tree'): concurrent
+    pushers racing COPY_FIRST/SUM_RECV, round-blocked pulls racing
+    publication, probes racing engines, shutdown racing in-flight calls.
+    TSAN exits non-zero on any race; the driver checks sums too."""
+    import os
+    import shutil
+    import subprocess
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    csrc = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "byteps_tpu", "server", "csrc")
+    build = subprocess.run(["make", "tsan"], cwd=csrc,
+                           capture_output=True, text=True)
+    if build.returncode != 0:
+        err = build.stderr.lower()
+        # only ENVIRONMENT unavailability skips (no libtsan on this
+        # toolchain); a compile error in the driver/server must FAIL,
+        # not silently disable the race coverage
+        if "tsan" in err or "sanitizer" in err or "cannot find" in err:
+            pytest.skip(f"tsan unavailable: {build.stderr[-400:]}")
+        raise AssertionError(f"tsan build broke: {build.stderr[-2000:]}")
+    run = subprocess.run([os.path.join(csrc, "bps_server_stress_tsan")],
+                         cwd=csrc, capture_output=True, text=True,
+                         timeout=280)
+    assert run.returncode == 0, (run.stdout[-2000:], run.stderr[-3000:])
+    assert "BPS_STRESS_OK" in run.stdout
