@@ -89,6 +89,20 @@ def local_size() -> int:
     return jax.local_device_count()
 
 
+def replica_ranks() -> range:
+    """ALL data-parallel replica slots this process owns, e.g. for
+    dataset sharding: ``shard = data[list(bps.replica_ranks())]``.
+
+    The reference runs one process per GPU so its ``rank()`` is unique
+    per replica; single-controller JAX drives many replicas per process,
+    making a ported ``rank()``-based shard silently process-granular.
+    This helper is the safe primitive (see also ``data.shard_batch`` /
+    ``shard_local_batch``, which handle placement directly)."""
+    per_proc = size() // max(jax.process_count(), 1)
+    start = jax.process_index() * per_proc
+    return range(start, start + per_proc)
+
+
 # -- data plane -------------------------------------------------------------
 
 def declare_tensor(name: str, priority: Optional[int] = None, **kwargs) -> int:
@@ -222,7 +236,8 @@ def __getattr__(name):
 
 __all__ = [
     "init", "shutdown", "suspend", "resume", "rank", "size", "local_rank",
-    "local_size", "declare_tensor", "push_pull", "push_pull_async",
+    "local_size", "replica_ranks", "declare_tensor", "push_pull",
+    "push_pull_async",
     "push_pull_rowsparse", "poll", "synchronize", "broadcast_parameters",
     "broadcast_optimizer_state", "get_pushpull_speed",
     "DistributedOptimizer", "DistributedTrainer", "MirroredStrategy",

@@ -60,11 +60,125 @@ OP_ROUND = 9     # query the key's latest completed round (response
                  # payload = u64) — a restarted worker of a LIVE job
                  # resyncs its round counters from this instead of
                  # stalling on round 1 (elastic rejoin)
+# Shared-memory data plane (reference: ps-lite's zero-copy ZPush/ZPull
+# on shm for colocated worker↔server, core_loops.cc:567-613 /
+# BYTEPS_ENABLE_IPC): the frame carries only the segment name and
+# length; the payload lives at offset 0 of a worker-owned POSIX shm
+# segment (one per connection channel) the server attaches to —
+# gradient bytes never cross a socket. Field semantics are unchanged
+# from the socket ops: ``round`` = dedup token (push) / sync round
+# (pull), ``timeout`` = pull timeout ms.
+OP_PUSH_SHM = 10   # payload = segment name, ``nbytes`` = data length
+OP_PULL_SHM = 11   # same; the server PULLs INTO the segment
 ST_OK, ST_ERR, ST_TIMEOUT, ST_GONE = 0, 1, 2, 3
 
 # applied seqs kept as an exact set above a contiguous floor — bounds
 # memory while letting out-of-order same-key pushes through
 _DEDUP_WINDOW = 256
+
+
+class _PosixShm:
+    """Minimal POSIX shared-memory segment (shm_open + mmap), used
+    instead of multiprocessing.shared_memory to keep the resource
+    tracker out of the picture: this Python's tracker mis-handles the
+    create-in-one-process/attach-in-another lifecycle (spurious
+    KeyErrors and exit warnings), and ownership here is explicit —
+    workers create and unlink their segments, the server only attaches.
+    A SIGKILLed worker can strand its current /dev/shm/bps-shm-*
+    files (0600, one or two per connection channel) until reboot or a
+    manual ``rm`` — the documented cost of skipping the tracker."""
+
+    __slots__ = ("name", "size", "_mmap", "buf")
+
+    def __init__(self, name: Optional[str] = None, create: bool = False,
+                 size: int = 0) -> None:
+        import mmap as _mmap
+        import os as _os
+        import secrets as _secrets
+        from multiprocessing import shared_memory as _sm
+        posixshmem = _sm._posixshmem
+        if create:
+            while True:
+                name = f"/bps-shm-{_secrets.token_hex(6)}"
+                try:
+                    fd = posixshmem.shm_open(
+                        name, _os.O_CREAT | _os.O_EXCL | _os.O_RDWR,
+                        mode=0o600)
+                    break
+                except FileExistsError:
+                    continue
+            _os.ftruncate(fd, size)
+        else:
+            fd = posixshmem.shm_open(name, _os.O_RDWR, mode=0o600)
+            size = _os.fstat(fd).st_size
+        try:
+            self._mmap = _mmap.mmap(fd, size)
+        finally:
+            _os.close(fd)
+        self.name = name
+        self.size = size
+        self.buf = memoryview(self._mmap)
+
+    def close(self) -> None:
+        try:
+            self.buf.release()
+            self._mmap.close()
+        except (BufferError, ValueError):
+            pass
+
+    def unlink(self) -> None:
+        from multiprocessing import shared_memory as _sm
+        try:
+            _sm._posixshmem.shm_unlink(self.name)
+        except OSError:
+            pass
+
+
+class _ShmCache:
+    """Server-side LRU of attached worker shm segments, bounded by
+    count AND bytes (a worker's segment growth abandons old names —
+    already unlinked, but mapped here until evicted; the byte bound
+    keeps dead generations from pinning multi-GB of shm). Slices are
+    taken under the lock so a concurrent eviction can't release a
+    buffer between lookup and use; an evicted-while-exported buffer
+    stays alive because _PosixShm.close backs off on BufferError."""
+
+    def __init__(self, cap: int = 64, cap_bytes: int = 1 << 30) -> None:
+        self._segs: Dict[str, _PosixShm] = {}   # insertion order = LRU
+        self._lock = threading.Lock()
+        self._cap = cap
+        self._cap_bytes = cap_bytes
+
+    def view(self, name: str, nbytes: int) -> memoryview:
+        with self._lock:
+            seg = self._segs.pop(name, None)
+            if seg is None:
+                seg = _PosixShm(name=name)
+            self._segs[name] = seg              # (re)insert most-recent
+            while len(self._segs) > self._cap or (
+                    len(self._segs) > 1 and
+                    sum(s.size for s in self._segs.values())
+                    > self._cap_bytes):
+                old = next(iter(self._segs))
+                if old == name:
+                    break
+                try:
+                    self._segs.pop(old).close()
+                except Exception:
+                    pass
+            if nbytes > seg.size:
+                raise ValueError(f"shm window {nbytes}B exceeds segment "
+                                 f"{name} ({seg.size}B)")
+            return seg.buf[:nbytes]
+
+    def close(self) -> None:
+        with self._lock:
+            for seg in self._segs.values():
+                try:
+                    seg.close()
+                except Exception:
+                    pass
+            self._segs.clear()
 
 
 class _DedupState:
@@ -223,6 +337,7 @@ class PSTransportServer:
         # retry window) of inactivity so elastic worker churn can't grow
         # the table without bound.
         self._push_seen: Dict[Tuple[int, int], _DedupState] = {}
+        self._shm = _ShmCache()
         self._push_lock = threading.Lock()
         self._push_cv = threading.Condition(self._push_lock)
         self._dedup_ttl = float(_os.environ.get(
@@ -327,6 +442,22 @@ class PSTransportServer:
             elif op == OP_ROUND:
                 rv = struct.pack("!Q", int(self.backend.round(key)))
                 conn.sendall(_RSP.pack(ST_OK, len(rv)) + rv)
+            elif op == OP_PUSH_SHM:
+                view = self._shm.view(bytes(payload).decode(), int(nbytes))
+                data = np.frombuffer(view, dtype=dtype)
+                self._apply_push_once(key, rnd,
+                                      lambda: self.backend.push(key, data))
+                del data, view   # release the buffer before reuse/unlink
+                conn.sendall(_RSP.pack(ST_OK, 0))
+            elif op == OP_PULL_SHM:
+                view = self._shm.view(bytes(payload).decode(), int(nbytes))
+                out = np.frombuffer(view, dtype=dtype)
+                try:
+                    self.backend.pull(key, out, round=int(rnd),
+                                      timeout_ms=int(timeout) or 30000)
+                finally:
+                    del out, view
+                conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PULL_C:
                 from .compressed import compressed_pull
                 buf = compressed_pull(self.compressed, self.backend, key,
@@ -444,6 +575,7 @@ class PSTransportServer:
 
     def close(self) -> None:
         self._stop.set()
+        self._shm.close()
         try:
             self._sock.close()
         except OSError:
@@ -510,12 +642,35 @@ def restore_snapshot(backend, path: str):
 # ------------------------------------------------------------------ client
 
 class _Channel:
-    """One pooled connection; ``sock`` is None until first use."""
+    """One pooled connection; ``sock`` is None until first use. ``shm``
+    is the channel's worker-owned segment for the shared-memory data
+    plane (created on demand, grown by replacement)."""
 
-    __slots__ = ("sock",)
+    __slots__ = ("sock", "shm")
 
     def __init__(self, sock: Optional[socket.socket]) -> None:
         self.sock = sock
+        self.shm = None
+
+    @staticmethod
+    def _unlink(seg) -> None:
+        try:
+            seg.unlink()   # name gone; the server's attachment survives
+            seg.close()
+        except Exception:
+            pass
+
+    def ensure_shm(self, nbytes: int):
+        if self.shm is None or self.shm.size < nbytes:
+            if self.shm is not None:
+                self._unlink(self.shm)
+            self.shm = _PosixShm(create=True, size=max(nbytes, 1 << 20))
+        return self.shm
+
+    def drop_shm(self) -> None:
+        if self.shm is not None:
+            self._unlink(self.shm)
+            self.shm = None
 
 
 class RemotePSBackend:
@@ -584,6 +739,14 @@ class RemotePSBackend:
             for _ in range(self._nconns - 1):
                 pool.put(_Channel(None))        # dialed on first use
             self._pools.append(pool)
+        # shared-memory data plane: colocated shards only (the reference
+        # gates its shm path the same way — BYTEPS_ENABLE_IPC colocated
+        # deployments)
+        shm_on = _os.environ.get("BPS_ENABLE_SHM", "0") not in ("0", "",
+                                                                "false")
+        self._shm_shards = [
+            shm_on and host in ("unix", "127.0.0.1", "localhost")
+            for host, _ in self._addrs]
 
     def _dial(self, i: int) -> socket.socket:
         host, port = self._addrs[i]
@@ -674,36 +837,39 @@ class RemotePSBackend:
                                f"{bytes(data).decode()!r}")
         return data
 
+    def _roundtrip_with_retry(self, i: int, ch: "_Channel", op, key, rnd,
+                              nbytes, timeout_ms, dtype, payload):
+        """One roundtrip on ``ch``, with the reconnect policy: redials
+        draw on ONE shared budget because the retry itself can land on
+        a still-dying server (GONE frames)."""
+        import time as _time
+        try:
+            if ch.sock is None:          # lazily-dialed pool channel
+                ch.sock = self._dial(i)
+            return self._roundtrip(ch.sock, op, key, rnd, nbytes,
+                                   timeout_ms, dtype, payload)
+        except (ConnectionError, OSError):
+            if self.reconnect_secs <= 0:
+                raise
+            deadline = _time.time() + self.reconnect_secs
+            while True:
+                try:
+                    self._reconnect(i, ch, deadline)
+                    return self._roundtrip(ch.sock, op, key, rnd, nbytes,
+                                           timeout_ms, dtype, payload)
+                except (ConnectionError, OSError):
+                    if _time.time() >= deadline:
+                        raise
+                    _time.sleep(0.2)
+
     def _rpc(self, op: int, key: int, rnd: int, nbytes: int,
              timeout_ms: int, dtype: str, payload: Optional[memoryview],
              pull_into: Optional[np.ndarray] = None) -> bytes:
-        import time as _time
         i = self._shard(key)
         ch = self._pools[i].get()        # blocks while all channels busy
         try:
-            try:
-                if ch.sock is None:      # lazily-dialed pool channel
-                    ch.sock = self._dial(i)
-                data = self._roundtrip(ch.sock, op, key, rnd, nbytes,
-                                       timeout_ms, dtype, payload)
-            except (ConnectionError, OSError):
-                if self.reconnect_secs <= 0:
-                    raise
-                # the retry itself can land on a still-dying server (GONE
-                # frames) — keep reconnecting until the ONE shared budget
-                # runs out (redials inside _reconnect draw on it too)
-                deadline = _time.time() + self.reconnect_secs
-                while True:
-                    try:
-                        self._reconnect(i, ch, deadline)
-                        data = self._roundtrip(ch.sock, op, key, rnd,
-                                               nbytes, timeout_ms, dtype,
-                                               payload)
-                        break
-                    except (ConnectionError, OSError):
-                        if _time.time() >= deadline:
-                            raise
-                        _time.sleep(0.2)
+            data = self._roundtrip_with_retry(i, ch, op, key, rnd, nbytes,
+                                              timeout_ms, dtype, payload)
             if pull_into is not None:
                 np.copyto(pull_into,
                           np.frombuffer(data, dtype=pull_into.dtype)
@@ -756,12 +922,68 @@ class RemotePSBackend:
             self._push_seq[key] = seq
         return (self._wid << 32) | seq
 
+    def _shm_rpc(self, op: int, key: int, rnd: int,
+                 arr: Optional[np.ndarray] = None,
+                 out: Optional[np.ndarray] = None,
+                 timeout_ms: int = 30000) -> None:
+        """Data-plane op through the channel's shared segment: only the
+        (name, length) addressing crosses the socket. Reconnect uses
+        the same single budget as ``_rpc``; the segment survives
+        redials (it is addressed by name per frame)."""
+        i = self._shard(key)
+        ch = self._pools[i].get()
+        try:
+            nbytes = arr.nbytes if arr is not None else out.nbytes
+            seg = ch.ensure_shm(nbytes)
+            if arr is not None:
+                seg.buf[:nbytes] = _as_bytes(arr)
+            dtype = str(arr.dtype if arr is not None else out.dtype)
+            self._roundtrip_with_retry(i, ch, op, key, rnd, nbytes,
+                                       timeout_ms, dtype,
+                                       memoryview(seg.name.encode()))
+            if out is not None:
+                flat = np.frombuffer(seg.buf[:nbytes], dtype=out.dtype)
+                np.copyto(out, flat.reshape(out.shape))
+        finally:
+            self._pools[i].put(ch)
+
+    def _shm_disable(self, i: int, err: Exception) -> None:
+        """No shared /dev/shm with the server (SSH-tunneled loopback,
+        separate containers): degrade this shard to the socket path
+        like the UDS auto-upgrade does, instead of hard-failing every
+        op on a mis-set env var."""
+        from ..common.logging import get_logger
+        self._shm_shards[i] = False
+        get_logger().warning(
+            "BPS_ENABLE_SHM: server %s cannot attach this worker's shm "
+            "segment (%s) — no shared /dev/shm? falling back to the "
+            "socket data plane for this shard",
+            ":".join(self._addrs[i]), err)
+
     def push(self, key: int, data: np.ndarray) -> None:
-        self._rpc(OP_PUSH, key, self._push_token(key), 0, 0,
-                  str(data.dtype), _as_bytes(data))
+        tok = self._push_token(key)
+        i = self._shard(key)
+        if self._shm_shards[i]:
+            try:
+                self._shm_rpc(OP_PUSH_SHM, key, tok, arr=data)
+                return
+            except RuntimeError as e:     # server rejected: can't attach
+                self._shm_disable(i, e)   # same token: exactly-once holds
+        self._rpc(OP_PUSH, key, tok, 0, 0, str(data.dtype),
+                  _as_bytes(data))
 
     def pull(self, key: int, out: np.ndarray, round: int = 0,
              timeout_ms: int = 30000) -> None:
+        i = self._shard(key)
+        if self._shm_shards[i]:
+            try:
+                self._shm_rpc(OP_PULL_SHM, key, round, out=out,
+                              timeout_ms=timeout_ms)
+                return
+            except TimeoutError:
+                raise
+            except RuntimeError as e:
+                self._shm_disable(i, e)
         self._rpc(OP_PULL, key, round, out.nbytes, timeout_ms,
                   str(out.dtype), None, pull_into=out)
 
@@ -814,6 +1036,7 @@ class RemotePSBackend:
                     ch = pool.get_nowait()
                 except _queue.Empty:
                     break
+                ch.drop_shm()
                 if ch.sock is None:
                     continue
                 try:

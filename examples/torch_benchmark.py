@@ -1,0 +1,101 @@
+"""Synthetic torch benchmark through byteps_tpu.torch — the analog of
+the reference's example/pytorch/benchmark_byteps.py (same flags where
+they make sense: --fp16-pushpull, --model, --batch-size, warmup/iter
+structure), running the model on the torch device (CPU in this image)
+with gradients synced through the PS runtime.
+
+Single process:
+  python examples/torch_benchmark.py --model mlp --num-iters 3
+
+Distributed (N workers + a PS server, like the reference's launcher
+recipe):
+  python -m byteps_tpu.launcher.launch --server &   # or bpslaunch-tpu
+  BPS_ENABLE_PS=1 BPS_NUM_WORKER=2 BPS_WORKER_ID=<i> \\
+  BPS_SERVER_ADDRS=host:port python examples/torch_benchmark.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import timeit
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import _bootstrap  # noqa: F401
+import byteps_tpu.torch as bps
+
+
+def make_model(name: str, num_classes: int) -> torch.nn.Module:
+    if name == "mlp":
+        return torch.nn.Sequential(
+            torch.nn.Flatten(),
+            torch.nn.Linear(3 * 32 * 32, 512), torch.nn.ReLU(),
+            torch.nn.Linear(512, 512), torch.nn.ReLU(),
+            torch.nn.Linear(512, num_classes))
+    if name == "convnet":
+        return torch.nn.Sequential(
+            torch.nn.Conv2d(3, 32, 3, padding=1), torch.nn.ReLU(),
+            torch.nn.MaxPool2d(2),
+            torch.nn.Conv2d(32, 64, 3, padding=1), torch.nn.ReLU(),
+            torch.nn.AdaptiveAvgPool2d(1), torch.nn.Flatten(),
+            torch.nn.Linear(64, num_classes))
+    # torchvision-style names when torchvision is available
+    try:
+        from torchvision import models
+        return getattr(models, name)(num_classes=num_classes)
+    except Exception as e:
+        raise SystemExit(f"model {name!r} needs torchvision: {e}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="torch synthetic benchmark")
+    ap.add_argument("--fp16-pushpull", action="store_true",
+                    help="fp16 wire compression during push_pull")
+    ap.add_argument("--model", default="mlp")
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-classes", type=int, default=100)
+    ap.add_argument("--num-warmup-batches", type=int, default=2)
+    ap.add_argument("--num-batches-per-iter", type=int, default=5)
+    ap.add_argument("--num-iters", type=int, default=5)
+    args = ap.parse_args()
+
+    bps.init()
+    model = make_model(args.model, args.num_classes)
+    compression = (bps.Compression.fp16 if args.fp16_pushpull
+                   else bps.Compression.none)
+    optimizer = bps.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.01),
+        named_parameters=model.named_parameters(),
+        compression=compression)
+    bps.broadcast_parameters(model.state_dict(), root_rank=0)
+    bps.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    data = torch.randn(args.batch_size, 3, 32, 32)
+    target = torch.randint(0, args.num_classes, (args.batch_size,))
+
+    def benchmark_step():
+        optimizer.zero_grad()
+        loss = F.cross_entropy(model(data), target)
+        loss.backward()
+        optimizer.step()
+
+    print(f"Model: {args.model}, batch size: {args.batch_size}, "
+          f"workers: {bps.size()}")
+    timeit.timeit(benchmark_step, number=args.num_warmup_batches)
+    img_secs = []
+    for _ in range(args.num_iters):
+        t = timeit.timeit(benchmark_step, number=args.num_batches_per_iter)
+        img_sec = args.batch_size * args.num_batches_per_iter / t
+        print(f"Iter: {img_sec:.1f} img/sec per worker")
+        img_secs.append(img_sec)
+    mean, conf = np.mean(img_secs), 1.96 * np.std(img_secs)
+    print(f"Img/sec per worker: {mean:.1f} +- {conf:.1f}")
+    print(f"Total img/sec on {bps.size()} worker(s): "
+          f"{bps.size() * mean:.1f} +- {bps.size() * conf:.1f}")
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()
