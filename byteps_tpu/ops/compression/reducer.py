@@ -5,14 +5,29 @@ decompresses after PULL, with the server summing decompressed payloads
 (reference: core_loops.cc:498-536, server.cc:86-113). An XLA psum over
 bit-packed payloads would be meaningless (the same reason NCCL allreduce
 couldn't compress — docs/gradient-compression.md "Motivation"), so the
-TPU-native exchange is gather-based: every replica all-gathers the
-*compressed* payloads over ICI/DCN, then locally decompress-sums. Wire
-bytes per step drop from O(n) to O(world × payload) — a win whenever
-payload ≪ n/world, exactly the regime compression targets.
+TPU-native exchange comes in two shapes, selected by the ``exchange``
+compression kwarg:
+
+- ``"gather"`` (default): every replica all-gathers the *compressed*
+  payloads over ICI/DCN, then locally decompress-sums. Wire bytes per
+  step drop from O(n) to O(world × payload); decompress latency is
+  O(world × bucket). Right at small world.
+- ``"rs"`` (reduce-scatter-shaped, the 1-bit-Adam/ps-lite scaling
+  shape): each replica splits the bucket into ``world`` shards,
+  compresses each, all_to_alls so replica r holds every replica's
+  payload for shard r, decompress-sums ITS shard only, RE-compresses
+  the merged shard once (the server-recompression role,
+  server.cc:86-113 — the merge compressor carries its own EF state,
+  matching ``create_server_chain``), and all_gathers the compressed
+  merged shards. Wire bytes AND decompress work per replica are
+  O(payload), independent of world — the scaling regime the gather
+  shape loses.
 
 ``CompressionPlan`` binds the bucket plan to per-bucket compressor
 instances and threads their state (EF memory, momentum, RNG keys) as one
-pytree, so the whole reduction jits inside the train step.
+pytree, so the whole reduction jits inside the train step. ``world``
+must be the reduction-axes size for the "rs" shape (shard sizing is
+static); the trainers thread it automatically.
 """
 
 from __future__ import annotations
@@ -31,27 +46,68 @@ class CompressionPlan:
     """Per-bucket compressors over a fixed gradient-tree structure."""
 
     def __init__(self, specs: Sequence[LeafSpec], partition_bytes: int,
-                 kwargs: Dict[str, str], min_compress_bytes: int = 65536):
+                 kwargs: Dict[str, str], min_compress_bytes: int = 65536,
+                 world: int = 1):
+        kwargs = dict(kwargs)
+        self.exchange = kwargs.pop("exchange", "gather")
+        if self.exchange not in ("gather", "rs"):
+            raise ValueError(f"compression exchange must be gather|rs, "
+                             f"got {self.exchange!r}")
+        if self.exchange == "rs" and world < 1:
+            raise ValueError("exchange='rs' needs the reduction world "
+                             "size (trainers pass it automatically)")
+        self.world = world
         self.buckets: List[Bucket] = plan_buckets(specs, partition_bytes,
                                                   reverse_order=True)
         self.compressors: List[Optional[base.Compressor]] = []
+        self.merge_compressors: List[Optional[base.Compressor]] = []
+        self.shard_sizes: List[int] = []
+        # the merge recompression plays the SERVER's role, whose chain
+        # skips only momentum (compressor_registry.cc:40-56 /
+        # host.create_server_chain) — reusing the worker chain would
+        # apply momentum a second time to the already-momentum'd merge
+        merge_kwargs = {k: v for k, v in kwargs.items()
+                        if k != "momentum_type"}
         for b in self.buckets:
             nbytes = b.size * np.dtype(b.dtype).itemsize
             if nbytes < min_compress_bytes:
                 # small buckets skip compression (reference:
                 # operations.cc:362-364, BYTEPS_MIN_COMPRESS_BYTES)
                 self.compressors.append(None)
+                self.merge_compressors.append(None)
+                self.shard_sizes.append(0)
+            elif self.exchange == "rs":
+                shard = -(-b.size // world)          # ceil: zero-padded
+                self.compressors.append(base.create(kwargs, shard, b.dtype))
+                self.merge_compressors.append(
+                    base.create(merge_kwargs, shard, b.dtype))
+                self.shard_sizes.append(shard)
             else:
                 self.compressors.append(base.create(kwargs, b.size, b.dtype))
+                self.merge_compressors.append(None)
+                self.shard_sizes.append(0)
 
     @classmethod
     def for_tree(cls, tree, partition_bytes: int, kwargs: Dict[str, str],
-                 min_compress_bytes: int = 65536) -> "CompressionPlan":
+                 min_compress_bytes: int = 65536,
+                 world: int = 1) -> "CompressionPlan":
         from ...parallel.collectives import leaf_specs_of_tree
         return cls(leaf_specs_of_tree(tree), partition_bytes, kwargs,
-                   min_compress_bytes)
+                   min_compress_bytes, world=world)
 
     def init_state(self):
+        if self.exchange == "rs":
+            out = []
+            for c, mc in zip(self.compressors, self.merge_compressors):
+                if c is None:
+                    out.append(())
+                    continue
+                shard_state = jax.tree_util.tree_map(
+                    lambda z: jnp.broadcast_to(z, (self.world,)
+                                               + jnp.shape(z)),
+                    c.init_state())
+                out.append((shard_state, mc.init_state()))
+            return tuple(out)
         return tuple(c.init_state() if c is not None else ()
                      for c in self.compressors)
 
@@ -67,10 +123,23 @@ class CompressionPlan:
         for ax in axes:
             n *= jax.lax.axis_size(ax)
         new_states = []
-        for b, comp, st in zip(self.buckets, self.compressors, states):
+        for b, comp, mcomp, shard, st in zip(self.buckets, self.compressors,
+                                             self.merge_compressors,
+                                             self.shard_sizes, states):
             buf = _pack_bucket(flat, b)
             if comp is None or not axes:
                 red = jax.lax.psum(buf, axes) if axes else buf
+                if average:
+                    red = red / n
+                new_states.append(st)
+            elif self.exchange == "rs":
+                if n != self.world:
+                    raise ValueError(
+                        f"exchange='rs' plan was built for world "
+                        f"{self.world} but the mesh reduces over {n} "
+                        f"replicas — rebuild the plan (trainers do)")
+                red, st = self._reduce_rs(buf, comp, mcomp, shard, st,
+                                          axes, b, n, average)
                 new_states.append(st)
             else:
                 payload, st2 = comp.compress(buf, st)
@@ -86,9 +155,61 @@ class CompressionPlan:
                 red = jax.lax.fori_loop(
                     0, world, dec_one,
                     jnp.zeros((b.size,), dtype=b.dtype))
+                if average:
+                    red = red / n
                 new_states.append(st2)
-            if average:
-                red = red / n
             _unpack_bucket(red, b, flat)
         out = [f.reshape(s) for f, s in zip(flat, shapes)]
         return jax.tree_util.tree_unflatten(treedef, out), tuple(new_states)
+
+    def _reduce_rs(self, buf, comp, mcomp, shard: int, st, axes, b,
+                   n: int, average: bool):
+        """Reduce-scatter-shaped exchange for one bucket (see module
+        docstring): compress per shard → all_to_all → decompress-sum MY
+        shard → recompress the merge (momentum-free, EF-compensated
+        merge compressor — the server-chain role) → all_gather →
+        decompress every shard."""
+        world = self.world
+        shard_states, merge_state = st
+        padded = jnp.zeros((shard * world,), buf.dtype).at[:b.size].set(buf)
+        shards = padded.reshape(world, shard)
+        payloads, new_shard_states = jax.vmap(comp.compress)(shards,
+                                                             shard_states)
+        # leading dim = destination shard: all_to_all leaves replica r
+        # holding every replica's payload for shard r
+        recv = jax.tree_util.tree_map(
+            lambda p: jax.lax.all_to_all(p, axes, split_axis=0,
+                                         concat_axis=0),
+            payloads)
+
+        def dec_one(i, acc):
+            pl = jax.tree_util.tree_map(lambda g: g[i], recv)
+            return acc + comp.decompress(pl)
+
+        merged = jax.lax.fori_loop(0, world, dec_one,
+                                   jnp.zeros((shard,), dtype=b.dtype))
+        # mask the zero-pad tail: dense codecs decompress pad positions
+        # to ±scale garbage that would inflate the merge compressor's
+        # scale and poison its EF state (only the LAST shards can carry
+        # padding). Linearized shard index = rank order over ``axes``,
+        # the same row-major order all_to_all/all_gather use.
+        my = jnp.zeros((), jnp.int32)
+        for ax in axes:
+            my = my * jax.lax.axis_size(ax) + jax.lax.axis_index(ax)
+        pos = my * shard + jnp.arange(shard)
+        merged = jnp.where(pos < b.size, merged, 0)
+        if average:
+            merged = merged / n       # averaged BEFORE the wire recompress
+        mpay, new_merge_state = mcomp.compress(merged, merge_state)
+        gathered = jax.tree_util.tree_map(
+            lambda p: jax.lax.all_gather(p, axes, axis=0, tiled=False),
+            mpay)
+
+        def dec_shard(i, acc):
+            pl = jax.tree_util.tree_map(lambda g: g[i], gathered)
+            return acc.at[i].set(mcomp.decompress(pl))
+
+        full = jax.lax.fori_loop(0, world, dec_shard,
+                                 jnp.zeros((world, shard), dtype=b.dtype))
+        red = full.reshape(-1)[:b.size]
+        return red, (new_shard_states, new_merge_state)

@@ -40,7 +40,8 @@ def _make(inner: optax.GradientTransformation, axes: Tuple[str, ...],
 def _make_compressed(inner: optax.GradientTransformation, axes: Tuple[str, ...],
                      average: bool, partition_bytes: int,
                      compression: dict, min_compress_bytes: int,
-                     leaf_specs=None, state_world: int = 1):
+                     leaf_specs=None, state_world: int = 1,
+                     reduce_world: int = 1):
     """Compressed-allreduce wrapper.
 
     ``leaf_specs``: LOCAL per-shard leaf shapes (from
@@ -65,9 +66,10 @@ def _make_compressed(inner: optax.GradientTransformation, axes: Tuple[str, ...],
         kw = {k: str(v) for k, v in compression.items()}
         if leaf_specs is not None:
             return CompressionPlan(leaf_specs, partition_bytes, kw,
-                                   min_compress_bytes)
+                                   min_compress_bytes, world=reduce_world)
         return CompressionPlan.for_tree(params, partition_bytes, kw,
-                                        min_compress_bytes)
+                                        min_compress_bytes,
+                                        world=reduce_world)
 
     def init_fn(params):
         # rebuild per init: re-initing with a different tree must not
@@ -100,7 +102,8 @@ def distributed_optimizer(inner: optax.GradientTransformation,
                           compression: dict | None = None,
                           min_compress_bytes: int = 65536,
                           compression_leaf_specs=None,
-                          compression_state_world: int = 1):
+                          compression_state_world: int = 1,
+                          compression_reduce_world: int = 1):
     """Wrap an optax transformation with cross-replica gradient sync.
 
     ``backward_passes_per_step > 1`` accumulates locally and only
@@ -119,7 +122,8 @@ def distributed_optimizer(inner: optax.GradientTransformation,
         gt = _make_compressed(inner, tuple(axes), average, partition_bytes,
                               compression, min_compress_bytes,
                               leaf_specs=compression_leaf_specs,
-                              state_world=compression_state_world)
+                              state_world=compression_state_world,
+                              reduce_world=compression_reduce_world)
     else:
         gt = _make(inner, tuple(axes), average, partition_bytes, reducer)
     if backward_passes_per_step > 1:

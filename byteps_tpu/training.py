@@ -212,13 +212,17 @@ class DistributedTrainer:
         lossless = compression is None and reducer is psum_reducer
         comm_axes = (tuple(a for a in self.axes if mesh.shape[a] > 1)
                      if lossless else self.axes)
+        reduce_world = 1
+        for a in comm_axes:
+            reduce_world *= mesh.shape[a]
         self.tx = distributed_optimizer(tx, axes=comm_axes,
                                         partition_bytes=partition_bytes,
                                         backward_passes_per_step=backward_passes_per_step,
                                         reducer=reducer,
                                         compression=compression,
                                         min_compress_bytes=min_compress_bytes,
-                                        compression_state_world=mesh.size)
+                                        compression_state_world=mesh.size,
+                                        compression_reduce_world=reduce_world)
         replicated = NamedSharding(mesh, P())
         # Copy (not alias) into the trainer: the step donates its param
         # buffers, and device_put aliases when the sharding already matches —
@@ -440,12 +444,16 @@ class ShardedTrainer:
                       if compression else None)
         comm_axes = (self.dp_axes if compression else
                      tuple(a for a in self.dp_axes if mesh.shape[a] > 1))
+        reduce_world = 1
+        for a in comm_axes:
+            reduce_world *= mesh.shape[a]
         self.tx = distributed_optimizer(
             tx, axes=comm_axes, partition_bytes=partition_bytes,
             backward_passes_per_step=backward_passes_per_step,
             compression=compression, min_compress_bytes=min_compress_bytes,
             compression_leaf_specs=comp_specs,
-            compression_state_world=mesh.size)
+            compression_state_world=mesh.size,
+            compression_reduce_world=reduce_world)
         self.pspec = param_spec_tree
         self.ospec = opt_state_specs(
             self.tx, params, param_spec_tree,

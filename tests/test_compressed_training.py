@@ -135,3 +135,131 @@ def test_compression_composes_with_tensor_parallel():
     losses = [float(tr.step(fixed)) for _ in range(30)]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] * 0.85, losses[::6]
+
+
+def test_rs_exchange_lossless_matches_psum():
+    """exchange='rs' with topk at 100% density (lossless both phases)
+    must equal a plain psum exactly — the schedule moves bytes, not
+    math."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from byteps_tpu.ops.compression.reducer import CompressionPlan
+    from byteps_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"data": 8})
+    world = 8
+    tree = {"w": np.linspace(-2, 2, 4000).astype(np.float32),
+            "b": np.arange(1, 131, dtype=np.float32)}
+    # one bucket of 4130 elems → shard ceil(4130/8) = 517; absolute
+    # k = shard makes topk keep EVERYTHING (lossless both phases)
+    kw = {"compressor_type": "topk", "compressor_k": "517",
+          "exchange": "rs"}
+    plan = CompressionPlan.for_tree(tree, 1 << 20, kw,
+                                    min_compress_bytes=0, world=world)
+    assert plan.shard_sizes == [517]
+    state = plan.init_state()
+
+    def run(tree, state):
+        # per-replica distinct grads: row index scales the tree
+        import jax
+        r = jax.lax.axis_index("data").astype(np.float32)
+        scaled = jax.tree_util.tree_map(lambda x: x * (r + 1), tree)
+        out, st = plan.reduce_tree(scaled, state, ("data",), average=False)
+        return out, st
+
+    fn = jax.jit(jax.shard_map(run, mesh=mesh, in_specs=(P(), P()),
+                               out_specs=(P(), P()), check_vma=False))
+    out, _ = fn(tree, state)
+    want_factor = sum(range(1, world + 1))      # 36
+    for k in tree:
+        np.testing.assert_allclose(np.asarray(out[k]),
+                                   want_factor * tree[k], rtol=1e-5)
+
+
+def test_rs_exchange_trainer_converges_and_replicas_agree():
+    """DistributedTrainer with onebit + exchange='rs': training
+    converges and every replica holds identical params (the all_gather
+    of recompressed shards is byte-identical everywhere)."""
+    import optax
+    from byteps_tpu.parallel.mesh import make_mesh
+    from byteps_tpu.training import DistributedTrainer
+
+    mesh = make_mesh({"data": 8})
+    rs = np.random.RandomState(0)
+    X = rs.randn(64, 12).astype(np.float32)
+    y = X @ rs.randn(12, 1).astype(np.float32)
+
+    def loss_fn(p, b):
+        xx, yy = b
+        return ((xx @ p["w"] - yy) ** 2).mean()
+
+    tr = DistributedTrainer(
+        loss_fn, {"w": np.zeros((12, 1), np.float32)}, optax.sgd(0.05),
+        mesh=mesh,
+        compression={"compressor_type": "onebit",
+                     "compressor_onebit_scaling": "true",
+                     "ef_type": "vanilla", "exchange": "rs"},
+        min_compress_bytes=0)
+    losses = [float(tr.step((X, y))) for _ in range(60)]
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+
+def test_rs_merge_chain_skips_momentum():
+    """The rs merge recompression is the SERVER role: momentum must not
+    apply twice (host.create_server_chain parity — only ef carries
+    over)."""
+    from byteps_tpu.ops.compression.reducer import CompressionPlan
+
+    tree = {"w": np.zeros(4096, np.float32)}
+    kw = {"compressor_type": "onebit", "momentum_type": "nesterov",
+          "ef_type": "vanilla", "exchange": "rs"}
+    plan = CompressionPlan.for_tree(tree, 1 << 20, kw,
+                                    min_compress_bytes=0, world=8)
+    worker_chain = type(plan.compressors[0]).__name__
+    merge_chain = type(plan.merge_compressors[0]).__name__
+    assert "Momentum" in worker_chain, worker_chain
+    assert "Momentum" not in merge_chain, merge_chain
+    assert "ErrorFeedback" in merge_chain or "EF" in merge_chain or \
+        hasattr(plan.merge_compressors[0], "inner"), merge_chain
+
+
+def test_rs_padding_masked_out_of_merge_scale():
+    """Non-divisible bucket: pad positions must NOT leak into the merge
+    compressor's scale. Golden-checked against a numpy emulation of the
+    exact schedule (onebit scaled, world 4, bucket 10 -> shard 3, 2
+    pads)."""
+    from jax.sharding import PartitionSpec as P
+    from byteps_tpu.ops.compression.reducer import CompressionPlan
+    from byteps_tpu.parallel.mesh import make_mesh
+
+    world = 4
+    vals = np.array([1.0, -2.0, 3.0, -1.0, 2.0, -3.0, 1.5, -1.5, 2.5,
+                     -0.5], np.float32)          # size 10 -> shard 3
+    mesh = make_mesh({"data": world}, devices=jax.devices()[:world])
+    kw = {"compressor_type": "onebit", "compressor_onebit_scaling": "true",
+          "exchange": "rs"}
+    plan = CompressionPlan.for_tree({"w": vals}, 1 << 20, kw,
+                                    min_compress_bytes=0, world=world)
+    state = plan.init_state()
+
+    def run(tree, state):
+        return plan.reduce_tree(tree, state, ("data",), average=False)
+
+    fn = jax.jit(jax.shard_map(run, mesh=mesh, in_specs=(P(), P()),
+                               out_specs=(P(), P()), check_vma=False))
+    out = np.asarray(fn({"w": vals}, state)[0]["w"])
+
+    # numpy emulation: every replica contributes identical vals
+    shard = 3
+    padded = np.zeros(world * shard, np.float32)
+    padded[:10] = vals
+    want = np.zeros(world * shard, np.float32)
+    for s in range(world):
+        blk = padded[s * shard:(s + 1) * shard]
+        scale = np.abs(blk).mean()
+        dec = np.where(blk < 0, -scale, scale)   # onebit scaled
+        merged = world * dec
+        merged[np.arange(s * shard, (s + 1) * shard) >= 10] = 0  # mask
+        mscale = np.abs(merged).mean()
+        want[s * shard:(s + 1) * shard] = np.where(
+            merged < 0, -mscale, mscale)
+    np.testing.assert_allclose(out, want[:10], rtol=1e-5)
