@@ -37,9 +37,11 @@ from .ops import (declare, init, local_rank, local_size, poll, push_pull,
                   size, synchronize)
 from .optimizer import (DistributedOptimizer, broadcast_optimizer_state,
                         broadcast_parameters)
+from .parallel import DistributedDataParallel
 
 __all__ = [
-    "Compression", "DistributedOptimizer", "broadcast_optimizer_state",
+    "Compression", "DistributedDataParallel", "DistributedOptimizer",
+    "broadcast_optimizer_state",
     "broadcast_parameters", "declare", "init", "local_rank", "local_size",
     "poll", "push_pull", "push_pull_async", "push_pull_async_inplace",
     "rank", "shutdown", "size", "synchronize",

@@ -58,6 +58,19 @@ def declare(name: str, **kwargs) -> None:
     GlobalState.get().registry.declare(name, **kwargs)
 
 
+def declare_model_keys(names) -> None:
+    """Declare Gradient.* then Parameter.* keys for a model's parameter
+    names — two sorted loops for key-range load balancing, the
+    reference's exact pattern (torch/__init__.py:95-100); shared by
+    DistributedOptimizer and DistributedDataParallel so both map params
+    onto identical PS key ranges."""
+    reg = GlobalState.get().registry
+    for name in sorted(names):
+        reg.declare("Gradient." + name)
+    for name in sorted(names):
+        reg.declare("Parameter." + name)
+
+
 class _Dispatcher:
     """Process-wide handle table + single-thread exchange executor."""
 

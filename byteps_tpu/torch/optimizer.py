@@ -68,14 +68,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self._should_sync = True
         if size() > 1:
             self._register_hooks()
-        # two sorted loops like the reference: gradient keys first, then
-        # parameter keys, so key ranges stay load-balanced
-        from ..common.global_state import GlobalState
-        reg = GlobalState.get().registry
-        for name in sorted(self._parameter_names.values()):
-            reg.declare("Gradient." + name)
-        for name in sorted(self._parameter_names.values()):
-            reg.declare("Parameter." + name)
+        from .ops import declare_model_keys
+        declare_model_keys(self._parameter_names.values())
 
     def _register_hooks(self):
         for group in self.param_groups:

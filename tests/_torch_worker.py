@@ -90,9 +90,57 @@ def main():
         np.testing.assert_allclose(p.detach().numpy(), q.detach().numpy(),
                                    rtol=1e-4, atol=1e-6, err_msg=n)
 
+    ddp_phase()
+
     bps.shutdown()
     print(f"TORCH_WORKER_OK rank={os.environ.get('BPS_WORKER_ID')} "
           f"first={losses[0]:.5f} last={losses[-1]:.6f}", flush=True)
+
+
+
+
+def ddp_phase():
+    """DistributedDataParallel: grads are averaged by the time
+    backward() returns; a PLAIN torch optimizer steps. Trajectory must
+    match single-process training on the shared global batch, and
+    no_sync() must accumulate like summed-batch backward."""
+    import byteps_tpu.torch as bps
+    import torch
+    import numpy as np
+
+    model = bps.DistributedDataParallel(build(seed=11))
+    opt = torch.optim.SGD(model.module.parameters(), lr=0.05)
+    ref = build(seed=11)
+    ref.load_state_dict(model.module.state_dict())
+    ref_opt = torch.optim.SGD(ref.parameters(), lr=0.05)
+    x, y = data()
+    for _ in range(6):
+        opt.zero_grad()
+        torch.nn.functional.mse_loss(model(x), y).backward()
+        opt.step()
+        ref_opt.zero_grad()
+        torch.nn.functional.mse_loss(ref(x), y).backward()
+        ref_opt.step()
+    for (n, p), (_, q) in zip(model.module.named_parameters(),
+                              ref.named_parameters()):
+        np.testing.assert_allclose(p.detach().numpy(), q.detach().numpy(),
+                                   rtol=1e-4, atol=1e-6, err_msg=n)
+
+    # no_sync accumulation: two local backwards + one synced backward
+    opt.zero_grad()
+    ref_opt.zero_grad()
+    xa, ya = x[:32], y[:32]
+    xb, yb = x[32:], y[32:]
+    with model.no_sync():
+        torch.nn.functional.mse_loss(model(xa), ya).backward()
+    torch.nn.functional.mse_loss(model(xb), yb).backward()  # syncs both
+    (torch.nn.functional.mse_loss(ref(xa), ya)
+     + torch.nn.functional.mse_loss(ref(xb), yb)).backward()
+    for (n, p), (_, q) in zip(model.module.named_parameters(),
+                              ref.named_parameters()):
+        np.testing.assert_allclose(p.grad.numpy(), q.grad.numpy(),
+                                   rtol=1e-4, atol=1e-6, err_msg=n)
+    print("DDP_PHASE_OK", flush=True)
 
 
 if __name__ == "__main__":

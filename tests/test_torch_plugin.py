@@ -222,3 +222,14 @@ def test_noname_params_unique_across_groups(bt):
         lr=0.1))
     names = list(opt._parameter_names.values())
     assert len(names) == len(set(names)), names
+
+
+def test_ddp_world1_passthrough(bt):
+    """At world 1 DDP wraps transparently: same outputs, no hooks."""
+    torch.manual_seed(2)
+    m = torch.nn.Linear(4, 2)
+    ddp = bt.DistributedDataParallel(m)
+    x = torch.randn(8, 4)
+    assert torch.equal(ddp(x), m(x))
+    torch.nn.functional.mse_loss(ddp(x), torch.randn(8, 2)).backward()
+    assert all(p.grad is not None for p in m.parameters())
