@@ -72,6 +72,12 @@ OP_PUSH_SHM = 10   # payload = segment name, ``nbytes`` = data length
 OP_PULL_SHM = 11   # same; the server PULLs INTO the segment
 ST_OK, ST_ERR, ST_TIMEOUT, ST_GONE = 0, 1, 2, 3
 
+
+class _ServerTimeout(TimeoutError):
+    """An ST_TIMEOUT reply — an APPLICATION answer on a healthy
+    connection. Distinct from the OS's TimeoutError (ETIMEDOUT, which
+    also subclasses OSError and SHOULD take the reconnect path)."""
+
 # applied seqs kept as an exact set above a contiguous floor — bounds
 # memory while letting out-of-order same-key pushes through
 _DEDUP_WINDOW = 256
@@ -826,8 +832,8 @@ class RemotePSBackend:
         status, rbytes = _RSP.unpack(_recv_exact(sock, _RSP.size))
         data = _recv_exact(sock, rbytes) if rbytes else memoryview(b"")
         if status == ST_TIMEOUT:
-            raise TimeoutError(bytes(data).decode() or
-                               f"pull({key}) timed out")
+            raise _ServerTimeout(bytes(data).decode() or
+                                 f"pull({key}) timed out")
         if status == ST_GONE:
             # server announced shutdown mid-request — treat like a dropped
             # connection so _rpc's reconnect path takes over
@@ -848,6 +854,14 @@ class RemotePSBackend:
                 ch.sock = self._dial(i)
             return self._roundtrip(ch.sock, op, key, rnd, nbytes,
                                    timeout_ms, dtype, payload)
+        except _ServerTimeout:
+            # an APPLICATION reply on a healthy connection — and
+            # TimeoutError subclasses OSError, so without this explicit
+            # re-raise the reconnect path below would swallow every
+            # server-side pull timeout into a redial-and-resend loop for
+            # the whole reconnect budget. The OS's ETIMEDOUT (a real
+            # link failure) deliberately still takes the reconnect path.
+            raise
         except (ConnectionError, OSError):
             if self.reconnect_secs <= 0:
                 raise
@@ -857,6 +871,8 @@ class RemotePSBackend:
                     self._reconnect(i, ch, deadline)
                     return self._roundtrip(ch.sock, op, key, rnd, nbytes,
                                            timeout_ms, dtype, payload)
+                except _ServerTimeout:
+                    raise
                 except (ConnectionError, OSError):
                     if _time.time() >= deadline:
                         raise
@@ -972,20 +988,45 @@ class RemotePSBackend:
         self._rpc(OP_PUSH, key, tok, 0, 0, str(data.dtype),
                   _as_bytes(data))
 
+    # Round-blocked pulls wait on the server in SHORT slices and the
+    # client loops to its own deadline: a severed connection then costs
+    # at most one slice instead of silently re-arming the full wait on
+    # every reconnect — without this, steady connection churn could
+    # extend a "30 s" pull indefinitely (observed as livelock under
+    # fault injection, tests/test_fault_injection.py).
+    _PULL_SLICE_MS = 2000
+
+    def _sliced_pull(self, attempt, timeout_ms: int, descr: str):
+        """Run ``attempt(slice_ms)`` until it succeeds or ONE global
+        deadline expires; server-side waits are per-slice."""
+        import time as _time
+        deadline = _time.time() + timeout_ms / 1e3
+        while True:
+            left_ms = max(1, int((deadline - _time.time()) * 1e3))
+            try:
+                return attempt(min(self._PULL_SLICE_MS, left_ms))
+            except TimeoutError:
+                if _time.time() >= deadline:
+                    raise TimeoutError(
+                        f"{descr} timed out after {timeout_ms}ms "
+                        f"(sliced waits)") from None
+
     def pull(self, key: int, out: np.ndarray, round: int = 0,
              timeout_ms: int = 30000) -> None:
-        i = self._shard(key)
-        if self._shm_shards[i]:
-            try:
-                self._shm_rpc(OP_PULL_SHM, key, round, out=out,
-                              timeout_ms=timeout_ms)
-                return
-            except TimeoutError:
-                raise
-            except RuntimeError as e:
-                self._shm_disable(i, e)
-        self._rpc(OP_PULL, key, round, out.nbytes, timeout_ms,
-                  str(out.dtype), None, pull_into=out)
+        def attempt(slice_ms: int) -> None:
+            i = self._shard(key)
+            if self._shm_shards[i]:
+                try:
+                    self._shm_rpc(OP_PULL_SHM, key, round, out=out,
+                                  timeout_ms=slice_ms)
+                    return
+                except RuntimeError as e:   # server cannot attach our shm
+                    self._shm_disable(i, e)
+            self._rpc(OP_PULL, key, round, out.nbytes, slice_ms,
+                      str(out.dtype), None, pull_into=out)
+
+        self._sliced_pull(attempt, timeout_ms,
+                          f"pull({key}) round={round}")
 
     def round(self, key: int) -> int:
         """The server's latest completed round for ``key`` (see
@@ -1013,8 +1054,10 @@ class RemotePSBackend:
 
     def pull_bytes(self, key: int, round: int = 0,
                    timeout_ms: int = 30000) -> bytes:
-        return self._rpc(OP_PULL_C, key, round, 0, timeout_ms, "uint8",
-                         None)
+        return self._sliced_pull(
+            lambda slice_ms: self._rpc(OP_PULL_C, key, round, 0,
+                                       slice_ms, "uint8", None),
+            timeout_ms, f"pull_bytes({key}) round={round}")
 
     def push_pull(self, key: int, data: np.ndarray,
                   timeout_ms: int = 30000) -> np.ndarray:

@@ -788,3 +788,24 @@ def test_shm_roundtrip_and_dedup_single_process(monkeypatch):
     finally:
         srv.close()
         be.close()
+
+
+def test_pull_timeout_is_global_across_slices(server2):
+    """Round-blocked pulls wait in short server-side slices with ONE
+    client-side deadline: a never-completing round times out at
+    ~timeout_ms total (pre-slice behavior re-armed the FULL wait per
+    reconnect, extending '30s' unboundedly under connection churn)."""
+    import time as _time
+
+    addr = f"127.0.0.1:{server2.port}"
+    w = RemotePSBackend([addr])
+    x = np.ones(64, np.float32)
+    w.init_key(41, x.nbytes)
+    w.push(41, x)                      # 1 of 2 workers: round never fills
+    out = np.empty_like(x)
+    t0 = _time.time()
+    with pytest.raises(TimeoutError):
+        w.pull(41, out, round=1, timeout_ms=3000)
+    dt = _time.time() - t0
+    assert 2.5 < dt < 8.0, dt
+    w.close()
