@@ -76,8 +76,10 @@ def time_plain_steps(params, data, loss_fn, batch: int, iters: int,
 def verify_kernels() -> bool:
     """TPU-mode numerical check of the Pallas kernels vs naive XLA
     attention ON THE REAL CHIP (VERDICT r1: interpret-mode CI alone left
-    real-TPU numerics unproven). Asserts loudly; returns True so the
-    bench line records that the check ran."""
+    real-TPU numerics unproven). Raises on any mismatch — the caller
+    retries once (tunnel transients) and reports a persistent failure
+    as ``kernels_verified: false`` in the bench JSON line; returns True
+    so the line records that the check ran."""
     import jax.numpy as jnp
     from byteps_tpu.ops.flash_attention import flash_attention
     from byteps_tpu.parallel.ring import local_attention, ring_attention
@@ -148,7 +150,6 @@ def main() -> None:
                 break
             except Exception as e:      # noqa: BLE001 — recorded below
                 kernels_ok, kernel_err = False, f"{type(e).__name__}: {e}"
-    if on_tpu:
         cfg = bert.bert_large(max_seq=512)
         batch, seq = 64, 512      # reference headline config: batch 64/chip
         iters = 10                # longer window washes out the first-launch
