@@ -92,6 +92,18 @@ def main():
     red = strat.reduce("sum", xs, axis=None)
     np.testing.assert_allclose(float(jnp.sum(red)) / n, 6.0)
 
+    # --- reduce-scatter compressed exchange ACROSS PROCESS BOUNDARIES:
+    # the rs schedule's all_to_all/all_gather span both hosts' devices;
+    # training must converge and stay replica-consistent
+    rs_tr = bps.DistributedTrainer(
+        loss_fn, {"w": jnp.zeros((4, 1))}, optax.sgd(0.05),
+        compression={"compressor_type": "onebit",
+                     "compressor_onebit_scaling": "true",
+                     "ef_type": "vanilla", "exchange": "rs"},
+        min_compress_bytes=0, name="rs_grads")
+    rs_losses = [float(rs_tr.step(local_batch)) for _ in range(30)]
+    assert rs_losses[-1] < rs_losses[0] * 0.5, (rs_losses[0], rs_losses[-1])
+
     bps.shutdown()
     print(f"MP_WORKER_OK pid={pid} first={losses[0]:.5f} last={losses[-1]:.5f}")
 
