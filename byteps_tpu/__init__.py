@@ -28,6 +28,7 @@ from .common import naming
 from .version import __version__
 
 _suspended_decls = None
+_warned_rank_granularity = False
 
 
 # -- lifecycle (reference: operations.cc:34-129) ----------------------------
@@ -70,7 +71,19 @@ def rank() -> int:
     owns ``size() // jax.process_count()`` consecutive replica slots; for
     dataset sharding use ``rank()`` with ``local_size()`` replicas, or just
     ``DistributedTrainer.shard_batch`` which handles placement."""
-    return jax.process_index() * (size() // max(jax.process_count(), 1))
+    slots = size() // max(jax.process_count(), 1)
+    global _warned_rank_granularity
+    if slots > 1 and not _warned_rank_granularity:
+        _warned_rank_granularity = True
+        import warnings
+        warnings.warn(
+            "bps.rank() is process-granular: this process owns "
+            f"{slots} data-parallel replica slots, so sharding a dataset "
+            "by rank()/size() Horovod-style covers only 1/"
+            f"{slots} of this process's replicas. Shard by "
+            "replica_ranks() (all owned slots) or use "
+            "DistributedTrainer.shard_batch.", stacklevel=2)
+    return jax.process_index() * slots
 
 
 def size() -> int:

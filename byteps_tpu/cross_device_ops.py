@@ -86,6 +86,18 @@ class CrossDeviceOps:
         dp = 1
         for ax in data_axes(mesh):
             dp *= mesh.shape[ax]
+        # the stacked-MEAN identity below assumes a HOMOGENEOUS pod:
+        # every process owns dp/process_count replica slots, so each
+        # process's broadcast copies carry equal weight. JAX multi-host
+        # meshes require a uniform local device count anyway; guard the
+        # arithmetic so a future heterogeneous layout fails loudly
+        # instead of returning silently mis-weighted sums.
+        n_proc = jax.process_count()
+        if dp % n_proc != 0:
+            raise ValueError(
+                f"reduce_sparse needs homogeneous replica slots per "
+                f"process (dp={dp} not divisible by process_count="
+                f"{n_proc}); use the dense reduce path instead")
         vals = jnp.asarray(values)
         dense = jnp.zeros((num_rows, vals.shape[-1]),
                           vals.dtype).at[jnp.asarray(indices)].add(vals)
@@ -95,7 +107,7 @@ class CrossDeviceOps:
         # theirs — so mean = cross-process mean, sum = mean × n_proc
         stacked = jnp.broadcast_to(dense, (dp,) + dense.shape)
         mean = self.reduce(ReduceOp.MEAN, stacked)[0]
-        return mean * jax.process_count() if op == ReduceOp.SUM else mean
+        return mean * n_proc if op == ReduceOp.SUM else mean
 
     @staticmethod
     def _deliver(result, destinations: Optional[str]):

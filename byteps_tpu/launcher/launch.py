@@ -129,6 +129,19 @@ def run_local(args, cmd: List[str]) -> int:
         srv.close()
         return 0
     full = numa_prefix(args.numa) + cmd
+    gdb_flag = env.get("BPS_ENABLE_GDB", env.get("BYTEPS_ENABLE_GDB", "0"))
+    if gdb_flag.strip().lower() in ("1", "true", "yes", "on"):
+        # crash-triage wrap (reference: launcher/launch.py:144-148): run the
+        # worker under gdb and print a backtrace on abnormal exit; degrade
+        # like numa_prefix does when the tool is missing.
+        # --return-child-result: the launcher's exit code must stay the
+        # WORKER's (supervisors restart on it), not gdb's own
+        if shutil.which("gdb"):
+            full = ["gdb", "--return-child-result", "-ex", "run", "-ex",
+                    "bt", "-batch", "--args"] + full
+        else:
+            print("[bpslaunch-tpu] BPS_ENABLE_GDB set but gdb not found; "
+                  "running unwrapped", file=sys.stderr)
     return subprocess.call(full, env=env)
 
 

@@ -59,14 +59,29 @@ def _head_tile(h: int, nq: int, nk: int, bq: int, bk: int, d: int,
     temporaries live, and the scoped-vmem limit is 16M). BPS_FLASH_HT
     overrides (0 = auto)."""
     import os as _os
+
+    def _vmem(cand: int) -> int:
+        return cand * (mats * bq * bk * 4 + 8 * max(bq, bk) * d)
+
     env = int(_os.environ.get("BPS_FLASH_HT", "0"))
     if env:
-        return env if h % env == 0 else 1
+        if h % env != 0:
+            return 1
+        if _vmem(env) >= 10 << 20:
+            # an oversized override would blow the 16M scoped-vmem limit
+            # and fail Mosaic compilation at runtime — clamp to the same
+            # budget the auto path enforces
+            from ..common.logging import get_logger
+            get_logger().warning(
+                "BPS_FLASH_HT=%d exceeds the VMEM budget for this shape "
+                "(bq=%d bk=%d d=%d mats=%d); falling back to auto tiling",
+                env, bq, bk, d, mats)
+        else:
+            return env
     if interpret or nq != 1 or nk != 1:
         return 1
     for cand in (8, 4, 2):
-        vmem = cand * (mats * bq * bk * 4 + 8 * max(bq, bk) * d)
-        if h % cand == 0 and vmem < 10 << 20:
+        if h % cand == 0 and _vmem(cand) < 10 << 20:
             return cand
     return 1
 

@@ -213,7 +213,16 @@ class PSGradientExchange:
                     get_flat(s.leaf_index)[
                         s.leaf_offset:s.leaf_offset + s.length]
             t0 = self._record(decl_name, "PS_PACK", pskey, t0)
-            self._push_bucket(pskey, b, buf)
+            try:
+                self._push_bucket(pskey, b, buf)
+            except Exception:
+                # the round counter advanced but the push never landed: drop
+                # the entry so a retried exchange() re-seeds from the
+                # server's round instead of pulling a round that will never
+                # complete (permanent sliced-pull timeout)
+                with self._key_rounds_lock:
+                    self._key_rounds.pop(pskey, None)
+                raise
             self._record(decl_name, "PS_PUSH", pskey, t0)
             return buf
 

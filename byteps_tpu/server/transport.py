@@ -950,9 +950,15 @@ class RemotePSBackend:
         ch = self._pools[i].get()
         try:
             nbytes = arr.nbytes if arr is not None else out.nbytes
-            seg = ch.ensure_shm(nbytes)
-            if arr is not None:
-                seg.buf[:nbytes] = _as_bytes(arr)
+            try:
+                seg = ch.ensure_shm(nbytes)
+                if arr is not None:
+                    seg.buf[:nbytes] = _as_bytes(arr)
+            except OSError as e:
+                # client-side shm_open/ftruncate failure (small or full
+                # /dev/shm): same degradation as a server-side attach
+                # rejection, not a hard op failure
+                raise RuntimeError(f"client-side shm unavailable: {e}") from e
             dtype = str(arr.dtype if arr is not None else out.dtype)
             self._roundtrip_with_retry(i, ch, op, key, rnd, nbytes,
                                        timeout_ms, dtype,

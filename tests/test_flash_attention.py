@@ -105,3 +105,18 @@ def test_naive_fallback_warns_once_per_shape(monkeypatch):
     finally:
         logger.setLevel(prev_level)
         logger.removeHandler(handler)
+
+
+def test_flash_ht_override_clamped_by_vmem(monkeypatch):
+    """BPS_FLASH_HT beyond the scoped-VMEM budget must fall back to auto
+    tiling instead of failing Mosaic compilation at runtime (ADVICE r2)."""
+    from byteps_tpu.ops.flash_attention import _head_tile
+    # a shape where ht=64 would need ~64*(3*512*512*4) bytes >> 10M
+    monkeypatch.setenv("BPS_FLASH_HT", "64")
+    ht = _head_tile(h=64, nq=1, nk=1, bq=512, bk=512, d=64,
+                    interpret=False, mats=3)
+    assert ht in (8, 4, 2, 1) and ht != 64
+    # a modest override inside budget is honored
+    monkeypatch.setenv("BPS_FLASH_HT", "2")
+    assert _head_tile(h=64, nq=1, nk=1, bq=128, bk=128, d=64,
+                      interpret=False) == 2

@@ -102,3 +102,34 @@ def test_pipelined_exchange_matches_serial():
     for (a1, a2), (b1, b2) in [(outs[0], outs[1])]:
         jax.tree_util.tree_map(np.testing.assert_array_equal, a1, b1)
         jax.tree_util.tree_map(np.testing.assert_array_equal, a2, b2)
+
+
+def test_push_failure_rolls_back_round_counter():
+    """A push that dies after _next_round advanced must drop the key's
+    round entry, so a retried exchange() re-seeds from the server and
+    pulls a round that actually completes (ADVICE r2: without the
+    rollback the worker waits forever on a round the server never saw)."""
+    be = HostPSBackend(num_servers=1, num_workers=1, engine_threads=1)
+    try:
+        ex = PSGradientExchange(be, partition_bytes=1024)
+        tree = {"w": np.ones(16, np.float32)}
+        ex.exchange(tree)                      # round 1 lands normally
+
+        real_push = be.push
+        calls = {"n": 0}
+
+        def failing_push(key, data):
+            calls["n"] += 1
+            raise ConnectionError("wire died mid-push")
+
+        be.push = failing_push
+        with pytest.raises(ConnectionError):
+            ex.exchange(tree)
+        assert calls["n"] == 1
+        assert ex._key_rounds == {}, "failed push must clear its round"
+
+        be.push = real_push                    # wire restored: retry works
+        out = ex.exchange(tree)
+        np.testing.assert_allclose(np.asarray(out["w"]), tree["w"])
+    finally:
+        be.close()
