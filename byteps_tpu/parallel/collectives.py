@@ -44,11 +44,36 @@ Reducer = Callable[[jnp.ndarray, Tuple[str, ...]], jnp.ndarray]
 
 
 def psum_reducer(x: jnp.ndarray, axes: Tuple[str, ...]) -> jnp.ndarray:
-    """Default reducer: plain hierarchical psum. Reducing over the ICI axis
-    first and the DCN axis second is how XLA lowers a multi-axis psum over a
-    hybrid mesh — the hierarchical NCCL-then-ps-lite split of the reference
-    (core_loops.cc:232-268 + 538-618) for free."""
-    return jax.lax.psum(x, axes) if axes else x
+    """Default reducer.
+
+    ICI-only meshes get a plain psum (XLA's ring allreduce is already
+    bandwidth-optimal at 2(n-1)/n bytes/chip). Hybrid dcn+ici meshes get
+    the explicit hierarchy the reference builds out of NCCL-then-PS
+    (core_loops.cc:232-268 + 538-618), in its bandwidth-optimal TPU
+    form: reduce_scatter inside the slice → cross-slice all_reduce on
+    the 1/ici-sized shard → all_gather inside the slice. Only bytes/ici
+    ever cross the slow DCN tier — a flat psum over both axes leaves
+    that decomposition to the whims of the partitioner, and the scaling
+    model (parallel/scaling_model.py) pins this schedule in lowered HLO.
+    """
+    if not axes:
+        return x
+    dcn = tuple(a for a in axes if a == "dcn")
+    ici = tuple(a for a in axes if a != "dcn")
+    if not dcn or not ici or x.ndim != 1:
+        return jax.lax.psum(x, axes)
+    n = x.shape[0]
+    ici_n = 1
+    for a in ici:
+        ici_n *= jax.lax.axis_size(a)
+    if ici_n == 1 or n < ici_n:
+        return jax.lax.psum(x, axes)
+    pad = (-n) % ici_n
+    xp = jnp.pad(x, (0, pad)) if pad else x
+    s = jax.lax.psum_scatter(xp, ici, scatter_dimension=0, tiled=True)
+    s = jax.lax.psum(s, dcn)
+    y = jax.lax.all_gather(s, ici, axis=0, tiled=True)
+    return y[:n] if pad else y
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,109 @@
+"""HLO collective-schedule invariants at 8/64/256 logical devices.
+
+The multi-chip north star (BASELINE.md: ≥90% scaling efficiency
+8 → 256, per reference README.md:37-44) cannot be measured on this box;
+these tests pin what the curve depends on that IS checkable without
+hardware: the compiled data-parallel step's communication structure,
+AOT-lowered over an AbstractMesh (see parallel/scaling_model.py
+docstring). A regression that de-buckets, serializes an extra hop, or
+ships full-size buckets across the dcn tier fails here.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from byteps_tpu.models import bert
+from byteps_tpu.parallel.scaling_model import (
+    CommModel, collective_schedule, format_table, lower_flagship_step,
+    model_step_time, scaling_table, verify_dp_schedule)
+
+# small model + small buckets: same program shape as the flagship
+# (multi-bucket, multi-layer), seconds to trace instead of minutes
+CFG = bert.bert_tiny()
+PB = 64 << 10
+
+
+def _lower(n, dcn=1, **kw):
+    return lower_flagship_step(n, dcn=dcn, cfg=CFG, seq=32,
+                               partition_bytes=PB, **kw)
+
+
+def test_ici_only_one_allreduce_per_bucket():
+    lowered, info = _lower(8)
+    sched = collective_schedule(lowered, 8)
+    counts = verify_dp_schedule(sched, info)
+    assert info["n_buckets"] > 1, "config must exercise multi-bucket"
+    assert counts["bulk"] == info["n_buckets"]
+    # byte volume: collectives carry exactly the gradient bytes
+    assert counts["reduced_bytes"] == info["grad_bytes"]
+
+
+def test_hybrid_mesh_hierarchical_schedule():
+    """dcn×ici lowers one reduce_scatter/all_reduce/all_gather triplet
+    per bucket; only the 1/ici shard crosses the dcn tier."""
+    lowered, info = _lower(64, dcn=8)
+    sched = collective_schedule(lowered, 64, dcn=8)
+    verify_dp_schedule(sched, info)
+    bulk = [c for c in sched if c.operand_bytes > 4096]
+    dcn_bytes = sum(c.wire_bytes() for c in bulk if c.crosses_dcn)
+    ici_stage = sum(c.wire_bytes() for c in bulk if not c.crosses_dcn)
+    # hierarchical win: dcn wire traffic ≈ 2(dcn-1)/dcn × grads/ici —
+    # 8× less than a flat all_reduce of the full gradients would ship
+    flat_dcn = 2 * 63 / 64 * info["grad_bytes"]
+    assert dcn_bytes < flat_dcn / 4, (dcn_bytes, flat_dcn)
+    assert ici_stage > 0
+
+
+def test_256_devices_lowers_and_verifies():
+    """The 256-logical-device program is checkable on a 1-chip box —
+    the whole point of AOT lowering over AbstractMesh."""
+    lowered, info = _lower(256, dcn=32)
+    sched = collective_schedule(lowered, 256, dcn=32)
+    verify_dp_schedule(sched, info)
+    ar = [c for c in sched if c.kind == "all_reduce"
+          and c.operand_bytes > 4096]
+    assert all(c.group_size == 32 and c.crosses_dcn for c in ar)
+
+
+def test_flat_psum_regression_fails_hybrid_invariants():
+    """A reducer that ships full buckets across dcn (flat psum over both
+    axes — the pre-round-3 lowering) must FAIL verification: this is the
+    regression the pins exist to catch."""
+    flat = lambda x, axes: jax.lax.psum(x, axes)  # noqa: E731
+    lowered, info = _lower(64, dcn=8, reducer=flat)
+    sched = collective_schedule(lowered, 64, dcn=8)
+    with pytest.raises(AssertionError):
+        verify_dp_schedule(sched, info)
+
+
+def test_wire_bytes_formulas():
+    from byteps_tpu.parallel.scaling_model import Collective
+    ar = Collective("all_reduce", 1000, 1000, "f32", 4, 8, 1, False)
+    assert ar.wire_bytes() == int(2 * 7 / 8 * 4000)
+    rs = Collective("reduce_scatter", 1000, 125, "f32", 4, 8, 1, False)
+    assert rs.wire_bytes() == int(7 / 8 * 4000)
+    ag = Collective("all_gather", 125, 1000, "f32", 4, 8, 1, False)
+    assert ag.wire_bytes() == int(7 / 8 * 4000)
+
+
+def test_model_step_time_and_table():
+    """Analytic model sanity: comm grows with dcn, overlap bound never
+    exceeds the no-overlap bound, efficiencies in (0, 1]."""
+    rows = scaling_table(0.848, configs=((8, 1), (64, 8)), cfg=CFG,
+                         seq=32, partition_bytes=PB)
+    assert rows[1]["dcn_ms"] > rows[0]["dcn_ms"] == 0
+    for r in rows:
+        assert 0 < r["eff_no_overlap"] <= r["eff_overlap"] <= 1
+    txt = format_table(rows)
+    assert "devices" in txt and "64" in txt
+
+
+def test_slow_fabric_breaks_overlap_bound():
+    """On a 100× slower fabric the model must show comm-bound steps —
+    guards against the model silently reporting 1.0 for any input."""
+    lowered, info = _lower(64, dcn=8)
+    sched = collective_schedule(lowered, 64, dcn=8)
+    slow = CommModel(ici_bw=9e8, dcn_bw=2.5e7)
+    t = model_step_time(sched, compute_s=1e-4, comm=slow)
+    assert t["overlap_s"] > 1e-4, t
