@@ -1,0 +1,322 @@
+"""PS vs ring-allreduce under an emulated bandwidth constraint.
+
+The reference's raison d'être is that the PS pattern uses bottleneck
+bandwidth better than allreduce — "up to 2×" on slow networks
+(reference: README.md:9,46; docs/rationale.md). The arithmetic behind
+the claim, for G gradient bytes, n workers, s parameter servers, every
+machine behind a B bytes/sec full-duplex NIC:
+
+- **ring allreduce**: every worker sends AND receives
+  ``2(n-1)/n × G`` → time ``2(n-1)/n × G/B``.
+- **PS, s EXTRA server machines**: each worker pushes G up and pulls
+  G down (overlapped on a full-duplex NIC) → ``G/B``; each server
+  moves ``n×G/s`` each way → ``nG/(sB)``. At ``s = n`` the worker NIC
+  is the bottleneck and PS wins by ``2(n-1)/n`` — →2× at large n.
+- **PS colocated** (servers share worker NICs): each machine moves
+  ``2G`` each way → ``2G/B``, WORSE than ring — which is why the
+  reference's win condition is spare CPU machines
+  (docs/rationale.md), and why this repo's in-jit path uses XLA
+  collectives, not PS, inside a slice.
+
+This module measures all three over the SAME stack: the real
+`PSTransportServer`/`RemotePSBackend` data plane (framing, dedup,
+connection pools, pipelined exchange) and a ring allreduce written on
+the same throttled sockets, with every endpoint's bytes charged to a
+`throttle.Nic`. Run ``examples/ps_vs_allreduce_bench.py`` for the
+sweep table in docs/performance.md; `tests/test_ps_vs_allreduce.py`
+asserts the crossover in CI.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .throttle import Nic, ThrottledSocket
+
+__all__ = ["ring_allreduce", "ps_exchange", "predicted_times"]
+
+
+def predicted_times(n_workers: int, n_servers: int, nbytes: int,
+                    rate: float, colocated: bool = False,
+                    parts: int = 32) -> Dict[str, float]:
+    """The analytic times the emulation should reproduce.
+
+    The PS term includes the last-bucket tail: after the final gradient
+    byte lands, the server holding the last bucket must fan the merged
+    ``G/parts`` bytes out to all n workers through its one NIC —
+    ``n×G/(parts×B)``. Smaller buckets shrink the tail, more RPCs raise
+    the constant; parts=32 measured best on this stack (the emulation
+    matches this model within a few % once placement is balanced)."""
+    g, b, n = float(nbytes), float(rate), n_workers
+    ring = 2 * (n - 1) / n * g / b
+    tail = n * (g / parts) / b
+    if colocated:
+        ps = 2 * g / b + tail
+    else:
+        ps = max(g / b, n * g / (max(n_servers, 1) * b)) + tail
+    return {"ring_s": ring, "ps_s": ps}
+
+
+# --------------------------------------------------------------------------
+# ring allreduce over throttled loopback TCP
+# --------------------------------------------------------------------------
+
+from .transport import _recv_exact
+
+
+def ring_allreduce(n_workers: int, nbytes: int, rate: float,
+                   latency: float = 0.0, iters: int = 1,
+                   verify: bool = True) -> float:
+    """Bandwidth-optimal ring allreduce (reduce-scatter + all-gather,
+    2(n-1) steps) between n worker threads over loopback TCP, each
+    endpoint charged to its own ``Nic(rate, latency)``. Returns
+    measured seconds per iteration."""
+    n = n_workers
+    elems = nbytes // 4
+    chunk = -(-elems // n)                  # ceil
+    padded = chunk * n
+    nics = [Nic(rate, latency) for _ in range(n)]
+
+    # ring wiring: worker i accepts from i-1, connects to i+1
+    listeners = []
+    for _ in range(n):
+        ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ls.bind(("127.0.0.1", 0))
+        ls.listen(1)
+        listeners.append(ls)
+    out_socks: List[Optional[socket.socket]] = [None] * n
+    in_socks: List[Optional[socket.socket]] = [None] * n
+
+    def connect(i):
+        s = socket.create_connection(
+            ("127.0.0.1", listeners[(i + 1) % n].getsockname()[1]))
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        out_socks[i] = s
+
+    cts = [threading.Thread(target=connect, args=(i,)) for i in range(n)]
+    [t.start() for t in cts]
+    for i in range(n):
+        conn, _ = listeners[i].accept()
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        in_socks[i] = conn
+    [t.join() for t in cts]
+    for ls in listeners:
+        ls.close()
+
+    datas = [np.random.RandomState(i).randn(padded).astype(np.float32)
+             for i in range(n)]
+    want = np.sum(datas, axis=0) if verify else None
+    results: List[Optional[np.ndarray]] = [None] * n
+    errors: List[BaseException] = []
+    barrier = threading.Barrier(n + 1)
+
+    def worker(i: int) -> None:
+        tx = ThrottledSocket(out_socks[i], nics[i])
+        rx = ThrottledSocket(in_socks[i], nics[i])
+        try:
+            for _ in range(iters):
+                barrier.wait()
+                x = datas[i].copy()
+                view = x.reshape(n, chunk)
+                # reduce-scatter: after n-1 steps worker i owns the
+                # full sum of chunk (i+1) % n
+                for step in range(n - 1):
+                    s_idx = (i - step) % n
+                    r_idx = (i - step - 1) % n
+                    snd = threading.Thread(
+                        target=tx.sendall,
+                        args=(view[s_idx].tobytes(),))
+                    snd.start()
+                    got = np.frombuffer(_recv_exact(rx, chunk * 4),
+                                        np.float32)
+                    snd.join()
+                    view[r_idx] += got
+                # all-gather: forward the completed chunks around
+                for step in range(n - 1):
+                    s_idx = (i + 1 - step) % n
+                    r_idx = (i - step) % n
+                    snd = threading.Thread(
+                        target=tx.sendall,
+                        args=(view[s_idx].tobytes(),))
+                    snd.start()
+                    got = np.frombuffer(_recv_exact(rx, chunk * 4),
+                                        np.float32)
+                    snd.join()
+                    view[r_idx] = got
+                results[i] = x
+                barrier.wait()
+        except BaseException as e:   # noqa: BLE001 — surfaced below
+            errors.append(e)
+            try:
+                barrier.abort()
+            except Exception:
+                pass
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    [t.start() for t in ts]
+    total = 0.0
+    try:
+        for _ in range(iters):
+            barrier.wait()
+            t0 = time.perf_counter()
+            barrier.wait()
+            total += time.perf_counter() - t0
+    except threading.BrokenBarrierError:
+        pass                      # a worker aborted; its error re-raised below
+    finally:
+        [t.join() for t in ts]
+        for s in out_socks + in_socks:
+            try:
+                s.close()
+            except Exception:
+                pass
+    if errors:
+        raise errors[0]
+    if verify:
+        for r in results:
+            np.testing.assert_allclose(r, want, rtol=1e-4, atol=1e-4)
+    return total / iters
+
+
+# --------------------------------------------------------------------------
+# PS exchange over the real transport, throttled
+# --------------------------------------------------------------------------
+
+def ps_exchange(n_workers: int, n_servers: int, nbytes: int, rate: float,
+                latency: float = 0.0, iters: int = 1,
+                partition_bytes: Optional[int] = None,
+                colocated: bool = False, verify: bool = True) -> float:
+    """One PS sync round (push G, pull merged G) per iteration through
+    the REAL transport stack, every endpoint throttled.
+
+    ``colocated=True`` models servers running ON the worker machines:
+    server j shares worker j's Nic (j mod n_workers), so its traffic
+    competes for the same emulated port — the deployment where the
+    reference itself says PS stops winning."""
+    import os
+    from ..common.naming import NameRegistry
+    from .engine import PSServer
+    from .ps_mode import PSGradientExchange
+    from .transport import PSTransportServer, RemotePSBackend
+
+    # the shm/IPC data planes carry payloads OUTSIDE the throttled
+    # sockets (only a segment name crosses the wire) — with either
+    # enabled the comparison is meaningless, so pin both off here
+    saved = {k: os.environ.pop(k, None)
+             for k in ("BPS_ENABLE_SHM", "BPS_ENABLE_IPC",
+                       "BYTEPS_ENABLE_IPC")}
+
+    def _restore_env() -> None:
+        for k, v in saved.items():
+            if v is not None:
+                os.environ[k] = v
+
+    if partition_bytes is None:
+        # 32 buckets: in the bandwidth-bound regime PS time ≈
+        # G/B × (1 + n/parts) — early buckets' rounds complete while
+        # later buckets still push, and the last bucket's merged
+        # result fans out to n workers through one server NIC (the
+        # tail predicted_times models). 4 coarse buckets measurably
+        # serialize into push-all-then-pull-all (193 ms vs 108 ms at
+        # 50 MB/s); past ~64 buckets per-RPC overhead wins instead
+        partition_bytes = max(32 << 10, nbytes // 32)
+    worker_nics = [Nic(rate, latency) for _ in range(n_workers)]
+    if colocated:
+        server_nics = [worker_nics[j % n_workers] for j in range(n_servers)]
+    else:
+        server_nics = [Nic(rate, latency) for _ in range(n_servers)]
+
+    try:
+        backends = [PSServer(num_workers=n_workers, engine_threads=1)
+                    for _ in range(n_servers)]
+        servers = [PSTransportServer(be, host="127.0.0.1", nic=nic)
+                   for be, nic in zip(backends, server_nics)]
+    except BaseException:
+        _restore_env()
+        raise
+    addrs = [f"127.0.0.1:{s.port}" for s in servers]
+
+    elems = nbytes // 4
+    datas = [np.random.RandomState(100 + i).randn(elems).astype(np.float32)
+             for i in range(n_workers)]
+    want = np.sum(datas, axis=0) if verify else None
+
+    reg = NameRegistry()
+    # naive hash == key % n_servers, and bucket keys are decl<<16 | i:
+    # EXACT round-robin placement. djb2 put 5/16 buckets on one server
+    # and built_in 20/64 — every round then gates on the hottest
+    # server's NIC (+25% measured). Placement balance is precisely what
+    # BYTEPS_KEY_HASH_FN exists to tune in the reference
+    try:
+        remotes = [RemotePSBackend(addrs, nic=worker_nics[i],
+                                   hash_fn="naive")
+                   for i in range(n_workers)]
+        exs = [PSGradientExchange(remotes[i],
+                                  partition_bytes=partition_bytes,
+                                  registry=reg)
+               for i in range(n_workers)]
+        # one worker pre-plans so concurrent init_key never races the plan
+        exs[0]._plan({"g": datas[0]}, None)
+        for ex in exs[1:]:
+            ex._plans = exs[0]._plans
+    except BaseException:
+        for s in servers:
+            s.close()
+        for be in backends:
+            be.close()
+        _restore_env()
+        raise
+
+    results: List[Optional[np.ndarray]] = [None] * n_workers
+    errors: List[BaseException] = []
+    barrier = threading.Barrier(n_workers + 1)
+
+    def worker(i: int) -> None:
+        try:
+            for _ in range(iters):
+                barrier.wait()
+                results[i] = exs[i].exchange({"g": datas[i]})["g"]
+                barrier.wait()
+        except BaseException as e:   # noqa: BLE001
+            errors.append(e)
+            try:
+                barrier.abort()
+            except Exception:
+                pass
+
+    ts = [threading.Thread(target=worker, args=(i,))
+          for i in range(n_workers)]
+    [t.start() for t in ts]
+    total = 0.0
+    try:
+        for _ in range(iters):
+            barrier.wait()
+            t0 = time.perf_counter()
+            barrier.wait()
+            total += time.perf_counter() - t0
+    except threading.BrokenBarrierError:
+        pass                      # a worker aborted; its error re-raised below
+    finally:
+        [t.join() for t in ts]
+        for r in remotes:
+            try:
+                r.close()
+            except Exception:
+                pass
+        for s in servers:
+            s.close()
+        for be in backends:
+            be.close()
+        _restore_env()                # restore the caller's data-plane env
+    if errors:
+        raise errors[0]
+    if verify:
+        for r in results:
+            np.testing.assert_allclose(np.asarray(r), want,
+                                       rtol=1e-4, atol=1e-4)
+    return total / iters

@@ -310,8 +310,12 @@ class PSTransportServer:
     (the reference's colocated-IPC deployment knob)."""
 
     def __init__(self, backend, host: str = "0.0.0.0", port: int = 0,
-                 key_meta=None):
+                 key_meta=None, nic=None):
         self.backend = backend
+        # optional emulated-NIC throttle (throttle.Nic): every accepted
+        # connection's bytes are charged to this server endpoint's
+        # bandwidth — see throttle.py / the PS-vs-allreduce bench
+        self._nic = nic
         from .compressed import CompressedKeyStore
         self.compressed = CompressedKeyStore()
         # per-key traffic log (reference: PS_KEY_LOG on the server,
@@ -386,6 +390,9 @@ class PSTransportServer:
                 return
             if is_tcp:
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self._nic is not None:
+                from .throttle import ThrottledSocket
+                conn = ThrottledSocket(conn, self._nic)
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True, name="bps-ps-conn").start()
 
@@ -707,10 +714,14 @@ class RemotePSBackend:
     def __init__(self, addrs: Sequence[str], hash_fn: str = "djb2",
                  async_mode: bool = False,
                  reconnect_secs: Optional[float] = None,
-                 conns_per_shard: Optional[int] = None):
+                 conns_per_shard: Optional[int] = None,
+                 nic=None):
         import os as _os
         import queue as _queue
         self._addrs = [a.rsplit(":", 1) for a in addrs]
+        # optional emulated-NIC throttle (throttle.Nic) charged for this
+        # worker endpoint's traffic across ALL its channels
+        self._nic = nic
         self.hash_fn = hash_fn
         from ..common.naming import check_mixed_mode_enabled, placement_from_env
         check_mixed_mode_enabled(hash_fn)
@@ -755,6 +766,13 @@ class RemotePSBackend:
             for host, _ in self._addrs]
 
     def _dial(self, i: int) -> socket.socket:
+        s = self._dial_raw(i)
+        if self._nic is not None:
+            from .throttle import ThrottledSocket
+            s = ThrottledSocket(s, self._nic)
+        return s
+
+    def _dial_raw(self, i: int) -> socket.socket:
         host, port = self._addrs[i]
         if host == "unix":                 # explicit "unix:/path.sock"
             s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)

@@ -1,0 +1,79 @@
+"""Bandwidth-constrained PS vs ring-allreduce sweep.
+
+Measures the reference's core claim — "PS uses bottleneck bandwidth up
+to 2× better than allreduce" (reference: README.md:9,46;
+docs/rationale.md) — through THIS repo's real transport stack under an
+emulated NIC (see byteps_tpu/server/allreduce_emu.py for the setup and
+the arithmetic). Produces the sweep table in docs/performance.md
+("Proving the PS win").
+
+Usage:
+    python examples/ps_vs_allreduce_bench.py \
+        --workers 4 --mbytes 4 --rates 25,50,100 --latencies 0,1
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from byteps_tpu.server.allreduce_emu import (ps_exchange, predicted_times,
+                                             ring_allreduce)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--servers", type=int, default=0,
+                    help="PS server machines (0 = same count as workers)")
+    ap.add_argument("--mbytes", type=float, default=4.0,
+                    help="gradient payload per worker, MB")
+    ap.add_argument("--rates", default="25,50,100",
+                    help="per-NIC bandwidths to sweep, MB/s")
+    ap.add_argument("--latencies", default="0,1",
+                    help="per-frame latencies to sweep, ms")
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--colocated", action="store_true",
+                    help="ALSO measure servers sharing worker NICs (the "
+                         "regime where PS is expected to LOSE)")
+    args = ap.parse_args()
+
+    n = args.workers
+    s = args.servers or n
+    G = int(args.mbytes * 1e6)
+    print(f"# n={n} workers, s={s} servers, G={args.mbytes} MB/worker, "
+          f"{args.iters} iters/point")
+    # the 1-core box's protocol+CPU floor (unthrottled run): all 2n
+    # emulated machines share one core here, so measured times carry
+    # this additive overhead that real per-machine CPUs would not —
+    # sweep at bandwidths where the floor is small vs the link time
+    floor_ring = ring_allreduce(n, G, 100e9, iters=args.iters)
+    floor_ps = ps_exchange(n, s, G, 100e9, iters=args.iters)
+    print(f"# 1-core floors: ring {floor_ring:.3f} s, "
+          f"PS {floor_ps:.3f} s")
+    hdr = ("| BW MB/s | lat ms | ring s | PS s | PS/ring speedup "
+           "| predicted | ")
+    if args.colocated:
+        hdr += "PS-colocated s | "
+    print(hdr)
+    print("|" + "---|" * (7 if args.colocated else 6))
+    for rate_mb in (float(r) for r in args.rates.split(",")):
+        for lat_ms in (float(x) for x in args.latencies.split(",")):
+            rate, lat = rate_mb * 1e6, lat_ms * 1e-3
+            t_ring = ring_allreduce(n, G, rate, lat, iters=args.iters)
+            t_ps = ps_exchange(n, s, G, rate, lat, iters=args.iters)
+            pred = predicted_times(n, s, G, rate)
+            row = (f"| {rate_mb:g} | {lat_ms:g} | {t_ring:.3f} "
+                   f"| {t_ps:.3f} | {t_ring / t_ps:.2f}× "
+                   f"| {pred['ring_s'] / pred['ps_s']:.2f}× |")
+            if args.colocated:
+                t_colo = ps_exchange(n, s, G, rate, lat,
+                                     iters=args.iters, colocated=True)
+                row += f" {t_colo:.3f} |"
+            print(row, flush=True)
+    print(json.dumps({"metric": "ps_vs_allreduce_sweep_done", "n": n,
+                      "s": s, "G_mb": args.mbytes}))
+
+
+if __name__ == "__main__":
+    main()

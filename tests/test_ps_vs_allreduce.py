@@ -1,0 +1,82 @@
+"""The PS win, asserted in CI (VERDICT r2 #1).
+
+The reference's core claim — the PS pattern beats allreduce on
+bottleneck bandwidth (reference: README.md:9,46; docs/rationale.md) —
+measured through THIS repo's real transport stack under an emulated
+NIC (byteps_tpu/server/allreduce_emu.py). One throttled regime runs in
+CI; the full sweep lives in examples/ps_vs_allreduce_bench.py and
+docs/performance.md.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.server.allreduce_emu import (ps_exchange, predicted_times,
+                                             ring_allreduce)
+from byteps_tpu.server.throttle import Nic, TokenBucket
+
+
+def test_token_bucket_paces_to_rate():
+    tb = TokenBucket(rate=10e6, burst=64 << 10)
+    tb.consume(tb.burst)                  # drain the free burst
+    t0 = time.perf_counter()
+    tb.consume(2 << 20)                   # 2 MB at 10 MB/s → 200 ms
+    dt = time.perf_counter() - t0
+    assert 0.15 < dt < 0.4, dt
+
+
+def test_nic_control_frames_ride_free():
+    nic = Nic(rate=1e3)                   # 1 KB/s: bulk would take ages
+    nic.tx.consume(nic.tx.burst)
+    t0 = time.perf_counter()
+    for _ in range(50):
+        nic.on_send(40)                   # header/ack sized
+        nic.on_recv(16)
+    assert time.perf_counter() - t0 < 0.5
+
+
+def test_predicted_crossover_math():
+    """2(n-1)/n vs 1 + n/parts: the arithmetic the emulation checks."""
+    p = predicted_times(8, 8, 100 << 20, 1e9, parts=32)
+    assert p["ring_s"] / p["ps_s"] == pytest.approx(
+        (2 * 7 / 8) / (1 + 8 / 32), rel=1e-6)
+    colo = predicted_times(8, 8, 100 << 20, 1e9, colocated=True)
+    assert colo["ps_s"] > p["ring_s"], "colocated PS must lose"
+
+
+def test_ring_allreduce_matches_bandwidth_model():
+    """The ring emulation is the measuring stick — it must track
+    2(n-1)/n × G/B closely or every comparison is meaningless."""
+    n, G, B = 4, 2 << 20, 25e6
+    t = ring_allreduce(n, G, B, iters=2)
+    pred = predicted_times(n, n, G, B)["ring_s"]
+    assert t == pytest.approx(pred, rel=0.25), (t, pred)
+
+
+def test_ps_beats_ring_in_bandwidth_bound_regime():
+    """THE claim: with s=n extra server machines behind equal NICs, the
+    PS data plane completes a sync round faster than ring allreduce —
+    measured through the real transport (framing, dedup, pipelining),
+    both sides throttled identically."""
+    n, G, B = 4, 2 << 20, 10e6
+    t_ring = ring_allreduce(n, G, B, iters=2)
+    t_ps = ps_exchange(n, n, G, B, iters=2)
+    assert t_ps < t_ring, (
+        f"PS {t_ps:.3f}s must beat ring {t_ring:.3f}s at "
+        f"{B / 1e6:.0f} MB/s — the framework's flagship claim")
+    # and not by an accounting fluke: within the analytic band
+    pred = predicted_times(n, n, G, B)
+    assert t_ps > 0.5 * pred["ps_s"], "PS faster than physics — "\
+        "the throttle stopped charging real bytes"
+
+
+def test_ps_colocated_loses_to_ring():
+    """Servers sharing worker NICs move 2G each way — the regime where
+    the reference itself says to prefer allreduce. The emulation must
+    reproduce the LOSS too, or the win above is unfalsifiable."""
+    n, G, B = 4, 2 << 20, 10e6
+    t_ring = ring_allreduce(n, G, B, iters=2)
+    t_colo = ps_exchange(n, n, G, B, iters=2, colocated=True)
+    assert t_colo > t_ring, (t_colo, t_ring)
