@@ -32,6 +32,19 @@ from ..common.partition import LeafSpec, plan_buckets
 from .engine import HostPSBackend
 
 
+class _PendingExchange:
+    """Handle returned by ``PSGradientExchange.exchange_async``: the
+    pushes are already in flight; ``result()`` drains the pulls."""
+
+    __slots__ = ("_drain",)
+
+    def __init__(self, drain) -> None:
+        self._drain = drain
+
+    def result(self):
+        return self._drain()
+
+
 class PSGradientExchange:
     """Sync-mode bucketed gradient exchange through the host PS service.
 
@@ -176,6 +189,24 @@ class PSGradientExchange:
         first use — see _next_round): every bucket is packed, pushed,
         and pulled, pipelined per bucket in priority order (see class
         docstring). Returns the summed tree."""
+        return self._exchange_impl(tree, name, detach=False)
+
+    def exchange_async(self, tree, name: Optional[str] = None):
+        """Like ``exchange`` but returns as soon as every bucket's PUSH
+        is submitted to the pipeline executors; call ``.result()`` on
+        the returned handle to drain the pulls and get the summed tree.
+
+        The contract callers rely on (torch _Dispatcher): this worker's
+        pushes reach the wire without waiting for any pull, so a peer's
+        round can always complete — a caller holding a scheduling slot
+        through a blocking pull cannot deadlock the exchange the way a
+        monolithic push+pull call can (two workers' slot pools wedged
+        on disjoint key sets; the reference avoids the same geometry
+        with free-running separate push/pull loops,
+        core_loops.cc:538-618)."""
+        return self._exchange_impl(tree, name, detach=True)
+
+    def _exchange_impl(self, tree, name: Optional[str], detach: bool):
         import time
         decl_name, treedef, keyed = self._plan(tree, name)
         leaves, _ = jax.tree_util.tree_flatten(tree)
@@ -236,28 +267,40 @@ class PSGradientExchange:
                     merged[s.bucket_offset:s.bucket_offset + s.length]
             self._record(decl_name, "PS_UNPACK", pskey, t0)
 
-        if self.pipeline_depth <= 1 or len(keyed) == 1:
+        def assemble():
+            shaped = [o.reshape(l.shape) for o, l in zip(out, leaves)]
+            return jax.tree_util.tree_unflatten(treedef, shaped)
+
+        if not detach and (self.pipeline_depth <= 1 or len(keyed) == 1):
             # serial: push everything (the server sums as they land),
             # then drain pulls in the same order
             bufs = [push_one(i) for i in range(len(keyed))]
             for i, buf in enumerate(bufs):
                 pull_one(i, buf)
-        else:
-            if self._push_ex is None:
-                self._push_ex = ThreadPoolExecutor(
-                    self.pipeline_depth, thread_name_prefix="bps-ps-push")
-                self._pull_ex = ThreadPoolExecutor(
-                    self.pipeline_depth, thread_name_prefix="bps-ps-pull")
-            push_futs = [self._push_ex.submit(push_one, i)
-                         for i in range(len(keyed))]
-            pull_futs = [
-                self._pull_ex.submit(
-                    lambda i=i: pull_one(i, push_futs[i].result()))
-                for i in range(len(keyed))]
+            return assemble()
+        # pipelined (always, for the detached form: its no-deadlock
+        # contract needs pushes on executor threads, not the caller's)
+        if self._push_ex is None:
+            width = max(2, self.pipeline_depth)
+            self._push_ex = ThreadPoolExecutor(
+                width, thread_name_prefix="bps-ps-push")
+            self._pull_ex = ThreadPoolExecutor(
+                width, thread_name_prefix="bps-ps-pull")
+        push_futs = [self._push_ex.submit(push_one, i)
+                     for i in range(len(keyed))]
+        pull_futs = [
+            self._pull_ex.submit(
+                lambda i=i: pull_one(i, push_futs[i].result()))
+            for i in range(len(keyed))]
+
+        def drain():
             for f in pull_futs:
-                f.result()              # propagate the first failure
-        shaped = [o.reshape(l.shape) for o, l in zip(out, leaves)]
-        return jax.tree_util.tree_unflatten(treedef, shaped)
+                f.result()          # propagate the first failure
+            return assemble()
+
+        if not detach:
+            return drain()
+        return _PendingExchange(drain)
 
 
 class AsyncPSWorker:

@@ -32,6 +32,7 @@ Usage is byteps-torch-compatible::
 from __future__ import annotations
 
 from .compression import Compression
+from .cross_barrier import CrossBarrier
 from .ops import (declare, init, local_rank, local_size, poll, push_pull,
                   push_pull_async, push_pull_async_inplace, rank, shutdown,
                   size, synchronize)
@@ -40,8 +41,8 @@ from .optimizer import (DistributedOptimizer, broadcast_optimizer_state,
 from .parallel import DistributedDataParallel
 
 __all__ = [
-    "Compression", "DistributedDataParallel", "DistributedOptimizer",
-    "broadcast_optimizer_state",
+    "Compression", "CrossBarrier", "DistributedDataParallel",
+    "DistributedOptimizer", "broadcast_optimizer_state",
     "broadcast_parameters", "declare", "init", "local_rank", "local_size",
     "poll", "push_pull", "push_pull_async", "push_pull_async_inplace",
     "rank", "shutdown", "size", "synchronize",

@@ -11,7 +11,7 @@ relies on the same ps-lite property)."""
 from __future__ import annotations
 
 import threading
-from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import Future
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -72,29 +72,114 @@ def declare_model_keys(names) -> None:
 
 
 class _Dispatcher:
-    """Process-wide handle table + single-thread exchange executor."""
+    """Process-wide handle table + PRIORITY-scheduled channel pool.
+
+    Multi-channel (``BPS_TORCH_CHANNELS``, default 4): a slow tensor
+    must not head-of-line-block every later exchange — the reference
+    runs free multi-channel push/pull loops. Pending exchanges drain in
+    PRIORITY order (lower value first; ties FIFO): backward produces
+    the LAST layer's gradient first, but the next forward needs the
+    FIRST layer's parameters first, so the optimizer submits each
+    parameter with its forward position as priority and queued
+    exchanges jump ahead of later layers' (the reference's
+    BYTEPS_SCHEDULING priority / the ByteScheduler result its
+    cross_barrier.py cites). Safe: PS keys/rounds are independent per
+    tensor name, so cross-worker dispatch order may differ."""
 
     _lock = threading.Lock()
-    _ex: Optional[ThreadPoolExecutor] = None
     _handles: Dict[int, Tuple[Future, torch.Tensor, bool]] = {}
     _next = 0
     _noname = 0
+    _pq: Optional[list] = None      # heap of (priority, seq, start, fut)
+    _cv: Optional[threading.Condition] = None
+    _pullq = None                   # queue of (resolver, fut)
+    _threads: list = []
+    _stop_evt: Optional[threading.Event] = None   # per pool GENERATION
 
     @classmethod
-    def executor(cls) -> ThreadPoolExecutor:
+    def _ensure_pool(cls) -> None:
         with cls._lock:
-            if cls._ex is None:
-                cls._ex = ThreadPoolExecutor(
-                    1, thread_name_prefix="bps-torch-pushpull")
-            return cls._ex
+            if cls._pq is not None:
+                return
+            import os
+            import queue as _queue
+            cls._pq = []
+            cls._cv = threading.Condition()
+            cls._pullq = _queue.Queue()
+            cls._stop_evt = threading.Event()
+            width = max(1, int(os.environ.get("BPS_TORCH_CHANNELS", "4")))
+            cls._threads = [
+                threading.Thread(target=cls._push_worker, daemon=True,
+                                 args=(cls._pq, cls._cv, cls._pullq,
+                                       cls._stop_evt),
+                                 name=f"bps-torch-push-{i}")
+                for i in range(width)]
+            cls._threads += [
+                threading.Thread(target=cls._pull_worker, daemon=True,
+                                 args=(cls._pullq,),
+                                 name=f"bps-torch-pull-{i}")
+                for i in range(width)]
+            for t in cls._threads:
+                t.start()
 
     @classmethod
-    def submit(cls, fn, out: torch.Tensor, inplace: bool) -> int:
-        fut = cls.executor().submit(fn)
+    def _push_worker(cls, pq: list, cv: threading.Condition,
+                     pullq, stop: threading.Event) -> None:
+        # pq/cv/stop captured at spawn: reset() swaps the class attrs
+        # for a fresh pool while old workers drain against their OWN
+        # generation's objects (a shared class-level stop flag could
+        # kill a freshly created pool racing the reset).
+        # A push worker only STARTS an exchange (its pushes are in
+        # flight when start() returns); the blocking pull drain happens
+        # on the pull workers — pushes never queue behind pulls, so two
+        # workers' channel pools cannot wedge on disjoint key sets
+        # (reference: free-running separate push/pull loops,
+        # core_loops.cc:538-618)
+        import heapq
+        while True:
+            with cv:
+                while not pq and not stop.is_set():
+                    cv.wait()
+                if stop.is_set():
+                    return
+                _, _, start, fut = heapq.heappop(pq)
+            try:
+                resolver = start()
+            except BaseException as e:   # noqa: BLE001 — via future
+                fut.set_exception(e)
+                continue
+            pullq.put((resolver, fut))
+
+    @classmethod
+    def _pull_worker(cls, pullq) -> None:
+        while True:
+            item = pullq.get()
+            if item is None:
+                return
+            resolver, fut = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(resolver())
+            except BaseException as e:   # noqa: BLE001 — via future
+                fut.set_exception(e)
+
+    @classmethod
+    def submit(cls, start, out: torch.Tensor, inplace: bool,
+               priority: int = 0) -> int:
+        """``start`` runs on a push worker and must return a resolver
+        whose call (on a pull worker) yields the reduced array."""
+        import heapq
+        cls._ensure_pool()
+        fut: Future = Future()
         with cls._lock:
             h = cls._next
             cls._next += 1
             cls._handles[h] = (fut, out, inplace)
+            seq = h
+        with cls._cv:
+            heapq.heappush(cls._pq, (priority, seq, start, fut))
+            cls._cv.notify()
         return h
 
     @classmethod
@@ -117,10 +202,20 @@ class _Dispatcher:
     @classmethod
     def reset(cls) -> None:
         with cls._lock:
-            ex, cls._ex = cls._ex, None
+            threads, cls._threads = cls._threads, []
+            cv, cls._cv = cls._cv, None
+            pullq, cls._pullq = cls._pullq, None
+            stop, cls._stop_evt = cls._stop_evt, None
+            cls._pq = None
             cls._handles.clear()
-        if ex is not None:
-            ex.shutdown(wait=True)
+        if cv is not None:
+            with cv:
+                stop.set()            # this generation's flag only
+                cv.notify_all()
+            for _ in threads:
+                pullq.put(None)       # wake & stop pull workers
+            for t in threads:
+                t.join(timeout=5)
 
 
 def _exchange_np(arr: np.ndarray, average: bool, name: str) -> np.ndarray:
@@ -133,6 +228,28 @@ def _exchange_np(arr: np.ndarray, average: bool, name: str) -> np.ndarray:
     if average and gs.engine.ps_world > 1:
         out = out / gs.engine.ps_world
     return out
+
+
+def _exchange_start(arr: np.ndarray, average: bool, name: str):
+    """Split form for the dispatcher: pushes are IN FLIGHT when this
+    returns; the returned resolver (run on a pull worker) blocks for
+    the merged result. See _Dispatcher._push_worker for why."""
+    gs = GlobalState.get()
+    ex = gs.engine.ps_exchange
+    if ex is None:
+        # no wire: defer to _exchange_np on the pull side (also the
+        # tests' monkeypatch point)
+        return lambda: _exchange_np(arr, average, name)
+    pend = ex.exchange_async({"t": arr}, name=name)
+    world = gs.engine.ps_world
+
+    def resolve():
+        out = pend.result()["t"]
+        if average and world > 1:
+            out = out / world
+        return out
+
+    return resolve
 
 
 _async_inited: set = set()
@@ -159,30 +276,41 @@ def async_param_exchange(name: str, delta: np.ndarray,
 
 
 def _dispatch(tensor: torch.Tensor, average: bool, name: Optional[str],
-              inplace: bool) -> int:
+              inplace: bool, priority: int = 0) -> int:
     if name is None:
         name = _Dispatcher.auto_name()
+    # declare on the DISPATCHING thread: name→key assignment is
+    # declaration-order (naming.py), and every worker dispatches in the
+    # same order (same model, same hooks) — on the racing push workers
+    # the order would be nondeterministic and the same name could get
+    # different PS keys on different workers (silent mis-summation)
+    GlobalState.get().registry.declare(name)
     arr = tensor.detach().cpu().numpy().copy()
 
-    def run():
-        return _exchange_np(arr, average, name)
+    def start():
+        return _exchange_start(arr, average, name)
 
-    return _Dispatcher.submit(run, tensor, inplace)
+    return _Dispatcher.submit(start, tensor, inplace, priority=priority)
 
 
 def push_pull_async(tensor: torch.Tensor, average: bool = True,
-                    name: Optional[str] = None) -> int:
+                    name: Optional[str] = None, priority: int = 0) -> int:
     """Dispatch a reduction of ``tensor``; returns an int handle. The
     input is snapshotted — later in-place mutation doesn't affect the
-    exchange; ``synchronize`` returns a NEW tensor."""
-    return _dispatch(tensor, average, name, inplace=False)
+    exchange; ``synchronize`` returns a NEW tensor. Lower ``priority``
+    drains first when channels are busy (the reference's
+    BYTEPS_SCHEDULING priority knob)."""
+    return _dispatch(tensor, average, name, inplace=False,
+                     priority=priority)
 
 
 def push_pull_async_inplace(tensor: torch.Tensor, average: bool = True,
-                            name: Optional[str] = None) -> int:
+                            name: Optional[str] = None,
+                            priority: int = 0) -> int:
     """Like ``push_pull_async`` but ``synchronize`` writes the result
     back INTO ``tensor`` (reference: the default grad path)."""
-    return _dispatch(tensor, average, name, inplace=True)
+    return _dispatch(tensor, average, name, inplace=True,
+                     priority=priority)
 
 
 def poll(handle: int) -> bool:

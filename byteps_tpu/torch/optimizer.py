@@ -60,6 +60,10 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             self._parameter_names = {
                 p: f"push_pull.noname.{i}" for i, p in enumerate(allp)}
         self.backward_passes_per_step = backward_passes_per_step
+        # forward position of each param (named_parameters yields in
+        # module order) — used as exchange priority
+        self._param_index = {p: i for i, p in
+                             enumerate(self._parameter_names)}
         self._push_pull_delay = {p: backward_passes_per_step
                                  for p in self._parameter_names}
         self._handles = {}
@@ -101,8 +105,13 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         if self._enable_async:
             return None, None        # real handle created in step()
         compressed, ctx = self._compression.compress(p.grad)
+        # priority = forward position: when channels are busy, earlier
+        # layers' exchanges jump the queue, so the NEXT forward (which
+        # consumes layer 0 first) unblocks soonest — the reference's
+        # priority scheduling, which is what makes CrossBarrier pay off
         handle = push_pull_async(compressed, average=True,
-                                 name="Gradient." + name)
+                                 name="Gradient." + name,
+                                 priority=self._param_index.get(p, 0))
         return handle, ctx
 
     def set_backward_passes_per_step(self, passes):

@@ -1,0 +1,50 @@
+"""Worker for the 2-process CrossBarrier test: same setup as
+_torch_worker.py (both workers feed the same global batch, so the loss
+trajectory must match serial training exactly), but stepping through
+bps.CrossBarrier — per-parameter updates applied by the poller, next
+forward gated per-module by the parameter locks (reference:
+byteps/torch/cross_barrier.py)."""
+
+import os
+import sys
+
+import numpy as np
+import torch
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import byteps_tpu.torch as bps
+from tests._torch_worker import build, data, reference_losses
+
+
+def main():
+    steps = 12
+    bps.init()
+    model = build()
+    opt = torch.optim.SGD(model.parameters(), lr=0.05)
+    opt = bps.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+    opt = bps.CrossBarrier(model, opt, num_steps=steps + 1)
+    bps.broadcast_parameters(model.state_dict(), root_rank=0)
+    x, y = data()
+    losses = []
+    opt.step()                       # step 0: init step (reference flow)
+    for _ in range(steps):
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        losses.append(float(loss))
+    opt.flush()
+    # cross-barrier forward blocks per-module until that module's params
+    # are updated, so the trajectory equals the serial run exactly
+    want = reference_losses(steps)
+    np.testing.assert_allclose(losses, want, rtol=1e-4, atol=1e-6)
+    opt.close()
+    bps.shutdown()
+    print(f"TORCH_CB_WORKER_OK rank={os.environ.get('BPS_WORKER_ID')} "
+          f"last={losses[-1]:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

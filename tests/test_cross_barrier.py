@@ -1,0 +1,207 @@
+"""CrossBarrier unit tests (single process; ``size()`` patched to 2 so
+the scheduling machinery engages while the world-1 exchange is an async
+identity — the 2-process TCP test drives the real wire).
+
+Reference behavior being matched: byteps/torch/cross_barrier.py:28-120
+— per-parameter locks + poller apply updates as exchanges land; forward
+blocks per-module, not globally.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import byteps_tpu as bps_core  # noqa: E402
+import byteps_tpu.torch as bps  # noqa: E402
+import byteps_tpu.torch.cross_barrier as cb_mod  # noqa: E402
+import byteps_tpu.torch.optimizer as opt_mod  # noqa: E402
+import byteps_tpu.torch.ops as ops_mod  # noqa: E402
+
+
+@pytest.fixture
+def fake_world2(monkeypatch):
+    bps.init()
+    for m in (cb_mod, opt_mod, ops_mod):
+        monkeypatch.setattr(m, "size", lambda: 2, raising=False)
+    yield
+    bps.shutdown()
+
+
+def _mlp(seed=0):
+    torch.manual_seed(seed)
+    return torch.nn.Sequential(
+        torch.nn.Linear(6, 12), torch.nn.Tanh(), torch.nn.Linear(12, 1))
+
+
+def _data():
+    rs = np.random.RandomState(3)
+    x = torch.tensor(rs.randn(32, 6), dtype=torch.float32)
+    y = torch.tensor(rs.randn(32, 1), dtype=torch.float32)
+    return x, y
+
+
+def _train(model, opt, steps, lr_schedule=None, cross_barrier=False):
+    x, y = _data()
+    losses = []
+    if cross_barrier:
+        opt.step()                       # step 0 (init)
+    for t in range(steps):
+        if lr_schedule is not None:
+            for g in opt.param_groups:
+                g["lr"] = lr_schedule(t)
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        losses.append(float(loss))
+    if cross_barrier:
+        opt.flush()
+    return losses
+
+
+@pytest.mark.parametrize("make_opt", [
+    lambda ps: torch.optim.SGD(ps, lr=0.05, momentum=0.9),
+    lambda ps: torch.optim.AdamW(ps, lr=0.01),
+    lambda ps: torch.optim.RMSprop(ps, lr=0.01),
+], ids=["sgd-momentum", "adamw", "rmsprop"])
+def test_trajectory_matches_serial(fake_world2, make_opt):
+    """Per-parameter poller updates + forward gating must reproduce the
+    serial trajectory exactly — for ANY optimizer class (the reference
+    hard-codes 3; AdamW here would crash its poller)."""
+    steps = 8
+    serial_model = _mlp()
+    serial = _train(serial_model, make_opt(serial_model.parameters()),
+                    steps)
+    model = _mlp()
+    opt = bps.DistributedOptimizer(
+        make_opt(model.parameters()),
+        named_parameters=model.named_parameters())
+    opt = bps.CrossBarrier(model, opt, num_steps=steps + 1)
+    got = _train(model, opt, steps, cross_barrier=True)
+    np.testing.assert_allclose(got, serial, rtol=1e-5, atol=1e-7)
+    opt.close()
+
+
+def test_lr_schedule_mirrored_to_children(fake_world2):
+    """Live param_group mutations (lr schedulers) must reach the
+    per-parameter child optimizers."""
+    sched = lambda t: 0.1 / (1 + t)  # noqa: E731
+    steps = 6
+    sm = _mlp(1)
+    serial = _train(sm, torch.optim.SGD(sm.parameters(), lr=1.0), steps,
+                    lr_schedule=sched)
+    model = _mlp(1)
+    opt = bps.CrossBarrier(model, bps.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=1.0),
+        named_parameters=model.named_parameters()), num_steps=steps + 1)
+    got = _train(model, opt, steps, lr_schedule=sched, cross_barrier=True)
+    np.testing.assert_allclose(got, serial, rtol=1e-5, atol=1e-7)
+    opt.close()
+
+
+def test_forward_starts_while_late_param_in_flight(fake_world2,
+                                                   monkeypatch):
+    """THE cross-barrier property: with the LAST layer's exchange held
+    on the wire, the next forward's FIRST layer proceeds; a
+    synchronize-everything barrier would block the whole forward
+    (reference cross_barrier.py's reason to exist)."""
+    model = _mlp(2)
+    opt = bps.CrossBarrier(model, bps.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.01),
+        named_parameters=model.named_parameters()), num_steps=10 ** 6)
+
+    gate = threading.Event()
+    slow_names = {n for n, _ in model.named_parameters()
+                  if n.startswith("2.")}          # last Linear
+    real_ex = ops_mod._exchange_np
+
+    def slow_exchange(arr, average, name):
+        if any(name == "Gradient." + n for n in slow_names):
+            gate.wait(10)                          # held on the wire
+        return real_ex(arr, average, name)
+
+    monkeypatch.setattr(ops_mod, "_exchange_np", slow_exchange)
+
+    first_forward_entered = threading.Event()
+    model[0].register_forward_pre_hook(
+        lambda m, i: first_forward_entered.set())
+
+    x, y = _data()
+    opt.step()                                     # step 0
+    torch.nn.functional.mse_loss(model(x), y).backward()
+    first_forward_entered.clear()
+    opt.step()                                     # returns immediately
+
+    done = threading.Event()
+
+    def next_iter():
+        torch.nn.functional.mse_loss(model(x), y).backward()
+        done.set()
+
+    t = threading.Thread(target=next_iter, daemon=True)
+    t.start()
+    # layer 0 must start its forward while layer 2's exchange is stuck
+    assert first_forward_entered.wait(5), \
+        "first layer's forward blocked on the last layer's exchange"
+    assert not done.is_set(), "forward finished while the last layer's "\
+        "exchange was still in flight — the lock gating is broken"
+    gate.set()                                     # wire delivers
+    assert done.wait(10)
+    t.join(10)
+    opt.flush()
+    opt.close()
+
+
+def test_poller_error_surfaces_on_step(fake_world2, monkeypatch):
+    model = _mlp(4)
+    opt = bps.CrossBarrier(model, bps.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.01),
+        named_parameters=model.named_parameters()), num_steps=10 ** 6)
+
+    def boom(arr, average, name):
+        raise ConnectionError("wire died")
+
+    x, y = _data()
+    opt.step()
+    monkeypatch.setattr(ops_mod, "_exchange_np", boom)
+    torch.nn.functional.mse_loss(model(x), y).backward()
+    with pytest.raises(ConnectionError):
+        opt.step()                    # surfaces here or on a later flush
+        for _ in range(200):
+            time.sleep(0.01)
+            opt.flush()
+    # every failed param re-arms _error: drain them all, then close
+    for _ in range(200):
+        try:
+            opt.flush()
+            break
+        except ConnectionError:
+            time.sleep(0.01)
+    opt.close()
+
+def test_documented_usage_without_init_step(fake_world2):
+    """The docs show plain `backward(); step()` with NO bare init step —
+    in-flight exchanges at step 0 must take the scheduled path, not a
+    racing local update (r3 review finding)."""
+    steps = 6
+    sm = _mlp(5)
+    serial = _train(sm, torch.optim.SGD(sm.parameters(), lr=0.05), steps)
+    model = _mlp(5)
+    opt = bps.CrossBarrier(model, bps.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.05),
+        named_parameters=model.named_parameters()), num_steps=10 ** 6)
+    x, y = _data()
+    losses = []
+    for _ in range(steps):
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        losses.append(float(loss))
+    opt.flush()
+    np.testing.assert_allclose(losses, serial, rtol=1e-5, atol=1e-7)
+    opt.close()

@@ -233,3 +233,50 @@ def test_ddp_world1_passthrough(bt):
     assert torch.equal(ddp(x), m(x))
     torch.nn.functional.mse_loss(ddp(x), torch.randn(8, 2)).backward()
     assert all(p.grad is not None for p in m.parameters())
+
+
+def test_two_process_cross_barrier_over_tcp():
+    """CrossBarrier over the real wire: per-parameter poller updates +
+    per-module forward gating must reproduce serial training exactly,
+    with two torch workers and a TCP PS server (reference:
+    byteps/torch/cross_barrier.py)."""
+    from byteps_tpu.server.engine import PSServer
+    from byteps_tpu.server.transport import PSTransportServer
+
+    be = PSServer(num_workers=2, engine_threads=2)
+    srv = PSTransportServer(be, host="127.0.0.1", port=0)
+    procs = []
+    try:
+        for wid in (0, 1):
+            env = dict(
+                os.environ,
+                BPS_ENABLE_PS="1",
+                BPS_NUM_WORKER="2",
+                BPS_WORKER_ID=str(wid),
+                BPS_SERVER_ADDRS=f"127.0.0.1:{srv.port}",
+                JAX_PLATFORMS="cpu",
+            )
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.join(ROOT, "tests",
+                                              "_torch_cb_worker.py")],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                out, _ = p.communicate()
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        srv.close()
+        be.close()
+    for wid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, \
+            f"cross-barrier worker {wid} failed:\n{out[-3000:]}"
+        assert "TORCH_CB_WORKER_OK" in out, out[-2000:]
