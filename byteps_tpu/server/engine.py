@@ -100,6 +100,7 @@ class PSServer:
         self._cv = threading.Condition()
         self._inflight = 0
         self._closed = False
+        self._key_dtypes: dict = {}   # key -> store dtype str (transcode)
 
     def _enter(self):
         with self._cv:
@@ -148,8 +149,15 @@ class PSServer:
             raise ServerClosed(f"init_key({key}): server shutting down")
         if rc != 0:
             raise RuntimeError(f"init_key({key}) failed rc={rc}")
+        self._key_dtypes[key] = dtype
 
     def push(self, key: int, data: np.ndarray) -> None:
+        # in-process transcode mirror of the transport server's wire
+        # transcode (narrow async-delta pushes land in a full-precision
+        # store); no bandwidth at stake here, just uniform semantics
+        store = self._key_dtypes.get(key)
+        if store is not None and str(data.dtype) != store:
+            data = data.astype(store)
         data = np.ascontiguousarray(data)
         self._enter()
         try:
@@ -168,6 +176,12 @@ class PSServer:
              timeout_ms: int = 30000) -> None:
         """Pull round ``round`` (1-based; 0 = latest published). Sync-mode
         callers should pass the round their push contributed to."""
+        store = self._key_dtypes.get(key)
+        if store is not None and str(out.dtype) != store:
+            tmp = np.empty(out.size, dtype=store)
+            self.pull(key, tmp, round=round, timeout_ms=timeout_ms)
+            np.copyto(out, tmp.astype(out.dtype).reshape(out.shape))
+            return
         self._enter()
         try:
             rc = self._lib.bps_server_pull(

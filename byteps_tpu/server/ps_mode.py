@@ -74,6 +74,7 @@ class PSGradientExchange:
         self._key_rounds_lock = threading.Lock()
         self._push_ex: Optional[ThreadPoolExecutor] = None
         self._pull_ex: Optional[ThreadPoolExecutor] = None
+        self._ex_lock = threading.Lock()
         # per-PS-key worker compressor chain (momentum→ef→codec) — holds
         # EF error / momentum state, so it outlives the plan cache entry
         # (reference: per-partition compressor_list in BPSContext,
@@ -279,13 +280,17 @@ class PSGradientExchange:
                 pull_one(i, buf)
             return assemble()
         # pipelined (always, for the detached form: its no-deadlock
-        # contract needs pushes on executor threads, not the caller's)
-        if self._push_ex is None:
-            width = max(2, self.pipeline_depth)
-            self._push_ex = ThreadPoolExecutor(
-                width, thread_name_prefix="bps-ps-push")
-            self._pull_ex = ThreadPoolExecutor(
-                width, thread_name_prefix="bps-ps-pull")
+        # contract needs pushes on executor threads, not the caller's).
+        # Creation is locked: the multi-channel torch dispatcher reaches
+        # here concurrently, and a double-created pair would orphan
+        # threads close() never shuts down
+        with self._ex_lock:
+            if self._push_ex is None:
+                width = max(2, self.pipeline_depth)
+                self._push_ex = ThreadPoolExecutor(
+                    width, thread_name_prefix="bps-ps-push")
+                self._pull_ex = ThreadPoolExecutor(
+                    width, thread_name_prefix="bps-ps-pull")
         push_futs = [self._push_ex.submit(push_one, i)
                      for i in range(len(keyed))]
         pull_futs = [
@@ -305,12 +310,25 @@ class PSGradientExchange:
 
 class AsyncPSWorker:
     """Async-PS training worker: local step + weight-delta push + fresh
-    weight pull, no inter-worker barrier."""
+    weight pull, no inter-worker barrier.
+
+    ``BPS_ASYNC_WIRE_DTYPE`` (e.g. ``bfloat16``) narrows the DELTA wire
+    format: pushes cross the wire at half the bytes and the transport
+    (or HostPSBackend) upcasts into the full-precision store. Deltas
+    tolerate the rounding (one step's worth of error, folded into a
+    fp32 accumulator); the weight PULL stays at store precision by
+    default — set ``BPS_ASYNC_PULL_DTYPE`` too only if the model
+    tolerates lossy weights."""
 
     def __init__(self, backend: HostPSBackend, params, name: str = "model",
                  init_store: bool = True,
                  registry: Optional[NameRegistry] = None) -> None:
+        import os as _os
         self.backend = backend
+        self.wire_dtype = _os.environ.get("BPS_ASYNC_WIRE_DTYPE") or None
+        self.pull_dtype = _os.environ.get("BPS_ASYNC_PULL_DTYPE") or None
+        if self.wire_dtype is not None:
+            np.dtype(self.wire_dtype)     # fail fast on a typo
         leaves, self.treedef = jax.tree_util.tree_flatten(params)
         self.shapes = [l.shape for l in leaves]
         self.dtypes = [str(np.dtype(l.dtype)) for l in leaves]
@@ -332,10 +350,16 @@ class AsyncPSWorker:
     def pull_weights(self):
         outs = []
         for k, n, dt, shp in zip(self.keys, self.sizes, self.dtypes, self.shapes):
-            buf = np.empty(n, dtype=dt)
+            buf = np.empty(n, dtype=self.pull_dtype or dt)
             self.backend.pull(k, buf)
-            outs.append(buf.reshape(shp))
+            outs.append(buf.astype(dt).reshape(shp)
+                        if self.pull_dtype else buf.reshape(shp))
         return jax.tree_util.tree_unflatten(self.treedef, outs)
+
+    def _wire(self, arr: np.ndarray) -> np.ndarray:
+        if self.wire_dtype and str(arr.dtype) != self.wire_dtype:
+            arr = arr.astype(self.wire_dtype)
+        return np.ascontiguousarray(arr)
 
     def push_delta(self, new_params, old_params):
         """Push w_new - w_old; the server accumulates deltas into the
@@ -344,18 +368,19 @@ class AsyncPSWorker:
         old_l = jax.tree_util.tree_leaves(old_params)
         for k, nw, od in zip(self.keys, new_l, old_l):
             delta = np.asarray(nw).reshape(-1) - np.asarray(od).reshape(-1)
-            self.backend.push(k, np.ascontiguousarray(delta))
+            self.backend.push(k, self._wire(delta))
 
     def push_delta_tree(self, delta):
         """Push pre-computed deltas (e.g. produced on-device inside the
-        jitted step, so the subtraction fuses and only ONE tree crosses
-        D2H instead of two)."""
+        jitted step, so the subtraction — and the wire-dtype cast, see
+        DistributedTrainer._delta_fn — fuses and only ONE narrow tree
+        crosses D2H instead of two wide ones)."""
         for k, d in zip(self.keys, jax.tree_util.tree_leaves(delta)):
             if hasattr(d, "copy_to_host_async"):
                 d.copy_to_host_async()
         for k, d in zip(self.keys, jax.tree_util.tree_leaves(delta)):
             self.backend.push(
-                k, np.ascontiguousarray(np.asarray(d).reshape(-1)))
+                k, self._wire(np.asarray(d).reshape(-1)))
 
 
 class RowSparseExchange:

@@ -47,6 +47,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+try:                       # registers "bfloat16" with numpy for the
+    import ml_dtypes       # noqa: F401 — bf16 wire transcode path
+except ImportError:        # pragma: no cover — jax ships ml_dtypes
+    pass
+
 from ..common.naming import place_key
 
 _HDR = struct.Struct("!BQQQQQ8s")   # op, key, round, nbytes, timeout, plen, dtype
@@ -416,16 +421,29 @@ class PSTransportServer:
                 self._key_meta[key] = (int(nbytes), dtype)
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PUSH:
+                # wire transcode: a frame dtype narrower than the store
+                # (bf16 async deltas, BPS_ASYNC_WIRE_DTYPE) halves wire
+                # bytes; the store keeps full precision (the reference's
+                # inter-node fp16 compression, applied the TPU way)
+                arr = np.frombuffer(payload, dtype=dtype)
+                meta = self._key_meta.get(key)
+                if meta is not None and meta[1] != dtype:
+                    arr = arr.astype(meta[1])
                 self._apply_push_once(
-                    key, rnd,
-                    lambda: self.backend.push(
-                        key, np.frombuffer(payload, dtype=dtype)))
+                    key, rnd, lambda: self.backend.push(key, arr))
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PULL:
-                out = np.empty(nbytes // np.dtype(dtype).itemsize,
-                               dtype=dtype)
-                self.backend.pull(key, out, round=int(rnd),
-                                  timeout_ms=int(timeout) or 30000)
+                elems = nbytes // np.dtype(dtype).itemsize
+                meta = self._key_meta.get(key)
+                if meta is not None and meta[1] != dtype:
+                    store = np.empty(elems, dtype=meta[1])
+                    self.backend.pull(key, store, round=int(rnd),
+                                      timeout_ms=int(timeout) or 30000)
+                    out = store.astype(dtype)   # downcast on the wire
+                else:
+                    out = np.empty(elems, dtype=dtype)
+                    self.backend.pull(key, out, round=int(rnd),
+                                      timeout_ms=int(timeout) or 30000)
                 conn.sendall(_RSP.pack(ST_OK, out.nbytes))
                 conn.sendall(_as_bytes(out))    # zero-copy: contiguous
             elif op == OP_INIT_C:

@@ -103,10 +103,16 @@ class CrossBarrier:
         values in force when its gradient was produced — serial
         semantics, exactly."""
         self._locks[p].acquire()
-        g = self._child_group[p]
-        hyper = {k: v for k, v in g.items() if k != "params"}
-        handle, ctx = self._orig_dispatch(p)
-        self._queue.put((p, handle, ctx, hyper))
+        try:
+            g = self._child_group[p]
+            hyper = {k: v for k, v in g.items() if k != "params"}
+            handle, ctx = self._orig_dispatch(p)
+            self._queue.put((p, handle, ctx, hyper))
+        except BaseException:
+            # a leaked lock would hang the next forward forever; release
+            # and let the exception surface retryably from backward
+            self._locks[p].release()
+            raise
         return handle, ctx
 
     def _child_opt(self, p, hyper):
@@ -116,12 +122,15 @@ class CrossBarrier:
             # groups may carry keys that aren't __init__ args (e.g.
             # AdamW's decoupled_weight_decay)
             child = self._user_cls([{"params": [p], **hyper}])
-            # ONE state table: momentum/exp_avg buffers live in the
-            # parent, so broadcast_optimizer_state / state_dict see them
-            child.state = self._opt.state
             self._child[p] = child
         else:
             child.param_groups[0].update(hyper)
+        # ONE state table: momentum/exp_avg buffers live in the parent,
+        # so broadcast_optimizer_state / state_dict see them. Re-bound
+        # on EVERY update because torch's load_state_dict REPLACES the
+        # parent's state dict — a cached reference would silently keep
+        # updating the pre-checkpoint buffers
+        child.state = self._opt.state
         return child
 
     def _poll_loop(self):
@@ -146,8 +155,12 @@ class CrossBarrier:
                 self._opt._push_pull_delay[p] = \
                     self._opt.backward_passes_per_step
                 self._child_opt(p, hyper).step()
-                with torch.no_grad():
-                    p.grad.zero_()
+                # None, not zero_(): serial training's default
+                # zero_grad(set_to_none=True) leaves unused params'
+                # grads None so torch SKIPS their update — a zeroed
+                # (non-None) grad would be re-dispatched every step and
+                # momentum/weight-decay would keep moving the param
+                p.grad = None
             except BaseException as e:   # noqa: BLE001 — re-raised on the
                 self._error = e          # training thread via step/flush
             finally:

@@ -1,12 +1,16 @@
 """torch-tensor push_pull ops (reference: torch/ops.py:48-236 +
 handle_manager.{cc,h} — int handles over in-flight reductions).
 
-Handles wrap futures on a single-thread dispatcher: dispatch returns
-immediately (backward keeps running), the exchange executes on the
-side thread, ``synchronize`` blocks on the future. One thread keeps
-per-process dispatch serial; cross-worker matching is per KEY on the
-PS server, so workers may dispatch in different orders (the reference
-relies on the same ps-lite property)."""
+Handles wrap futures on a priority-scheduled multi-channel pool
+(``_Dispatcher``): dispatch returns immediately (backward keeps
+running), exchanges drain lowest-priority-first across
+``BPS_TORCH_CHANNELS`` push workers, and pulls resolve on separate
+pull workers so a blocked pull never keeps pushes off the wire.
+Exchange START order is therefore NOT per-process FIFO — anything
+order-sensitive (name→key declaration) happens on the dispatching
+thread in ``_dispatch``. Cross-worker matching is per KEY on the PS
+server, so workers may run exchanges in different orders (the
+reference relies on the same ps-lite property)."""
 
 from __future__ import annotations
 

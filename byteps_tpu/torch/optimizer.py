@@ -164,11 +164,17 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             old = {p: p.data.clone().detach()
                    for p in self._parameter_names}
             loss = super(self.__class__, self).step(closure)
+            # the STORE runs fp32 (seeded below, so half/double models
+            # work); BPS_ASYNC_WIRE_DTYPE narrows just the delta wire —
+            # bf16 deltas cross at half the bytes, the server upcasts
+            wire = os.environ.get("BPS_ASYNC_WIRE_DTYPE") or None
+            if wire:
+                import ml_dtypes  # noqa: F401 — registers bf16 w/ numpy
             for p, name in self._parameter_names.items():
-                # the wire runs fp32 end to end: the store is seeded
-                # fp32, so a half/double model's delta must match
                 delta = (p.data - old[p]).cpu().numpy().astype(
                     _np.float32, copy=False)
+                if wire:
+                    delta = delta.astype(wire)
                 fresh = async_param_exchange(
                     "AsyncParam." + name, delta,
                     old[p].cpu().numpy().astype(_np.float32, copy=False))

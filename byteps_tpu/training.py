@@ -14,6 +14,7 @@ whole point of the reference's pipeline.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Callable, Optional
 
@@ -161,8 +162,16 @@ class DistributedTrainer:
                                                name=self._name,
                                                init_store=True,
                                                registry=gs.registry)
-            self._delta_fn = jax.jit(lambda new, old: jax.tree_util.tree_map(
-                jnp.subtract, new, old))
+            # the wire-dtype cast fuses into the jitted subtract, so a
+            # bf16 wire (BPS_ASYNC_WIRE_DTYPE) halves D2H bytes too
+            wire = os.environ.get("BPS_ASYNC_WIRE_DTYPE") or None
+
+            def _delta(a, b):
+                d = jnp.subtract(a, b)
+                return d.astype(wire) if wire else d
+
+            self._delta_fn = jax.jit(
+                lambda new, old: jax.tree_util.tree_map(_delta, new, old))
             self._accum = None
             self.step_count = 0
             return

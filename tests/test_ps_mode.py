@@ -133,3 +133,21 @@ def test_push_failure_rolls_back_round_counter():
         np.testing.assert_allclose(np.asarray(out["w"]), tree["w"])
     finally:
         be.close()
+
+
+def test_async_bf16_delta_wire(monkeypatch):
+    """BPS_ASYNC_WIRE_DTYPE=bfloat16: deltas cross the backend boundary
+    at half width, the fp32 store upcasts, training still converges
+    (VERDICT r2 #7)."""
+    monkeypatch.setenv("BPS_ASYNC_WIRE_DTYPE", "bfloat16")
+    from _async_sgd import make_workers, run_async_convergence
+
+    be = HostPSBackend(num_servers=1, num_workers=2, engine_threads=1,
+                       async_mode=True)
+    try:
+        _, _, workers = make_workers(lambda: be, n=2)
+        assert all(w.wire_dtype == "bfloat16" for w in workers)
+        run_async_convergence(workers,
+                              applied_rounds=lambda: be.servers[0].round(0))
+    finally:
+        be.close()

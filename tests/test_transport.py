@@ -809,3 +809,32 @@ def test_pull_timeout_is_global_across_slices(server2):
     dt = _time.time() - t0
     assert 2.5 < dt < 8.0, dt
     w.close()
+
+
+def test_wire_dtype_transcode_over_tcp():
+    """A bf16 push frame lands in a fp32 store (upcast server-side) and
+    a bf16 pull request gets a downcast payload — half the wire bytes
+    for async deltas (BPS_ASYNC_WIRE_DTYPE), full-precision store."""
+    import ml_dtypes
+    be = HostPSBackend(num_servers=1, num_workers=1, engine_threads=1)
+    srv = PSTransportServer(be, host="127.0.0.1", port=0)
+    try:
+        w = RemotePSBackend([f"127.0.0.1:{srv.port}"])
+        base = np.linspace(-4, 4, 256).astype(np.float32)
+        w.init_key(11, base.nbytes, "float32")
+        w.push(11, base.astype(ml_dtypes.bfloat16))   # narrow wire frame
+        out = np.empty(256, np.float32)
+        w.pull(11, out, round=1)
+        # store is fp32 but the VALUES carry bf16 rounding (8-bit mantissa)
+        np.testing.assert_allclose(out, base, rtol=1e-2)
+        assert not np.allclose(out, base, rtol=1e-7), \
+            "bf16 wire should round — did the frame go out in fp32?"
+        # narrow PULL: request bf16 of the fp32 store
+        out16 = np.empty(256, ml_dtypes.bfloat16)
+        w.pull(11, out16, round=1)
+        np.testing.assert_allclose(out16.astype(np.float32), base,
+                                   rtol=1e-2)
+        w.close()
+    finally:
+        srv.close()
+        be.close()
