@@ -103,16 +103,47 @@ class CompressedKeyStore:
                     rounds.pop(next(iter(rounds)))
             return buf
 
+    def put_cached(self, key: int, rnd: int, buf: bytes) -> None:
+        """Insert an externally-produced (native) recompression for a
+        completed round; same eviction as recompress()."""
+        if rnd == 0:
+            return
+        with self._lock:
+            rounds = self._cache[key]
+            rounds.setdefault(rnd, buf)
+            while len(rounds) > _CACHE_ROUNDS:
+                rounds.pop(next(iter(rounds)))
+
     def reset(self) -> None:
         with self._lock:
             self._codecs.clear()
             self._cache.clear()
 
 
+def _native_onebit(store: CompressedKeyStore, backend, key: int):
+    """The bare-onebit fp32 chain on a native engine shard runs fully
+    in C++ (fused decompress→enqueue / pull→recompress; reference:
+    server.cc:86-113 does codec work inside the engine, not in
+    per-connection interpreter threads). EF/momentum chains and other
+    codecs keep the Python path."""
+    import os
+    if os.environ.get("BPS_NATIVE_CODEC", "1") in ("0", "false"):
+        return None            # A/B knob: force the Python codec path
+    from ..ops.compression.host import HostOnebit
+    codec = store._codecs.get(key)
+    if (isinstance(codec, HostOnebit) and codec.dtype == np.float32
+            and hasattr(backend, "push_onebit")):
+        return codec
+    return None
+
+
 def compressed_push(store: CompressedKeyStore, backend, key: int,
                     payload) -> None:
     """Decompress → dense push into the summation engine (reference:
     BytePSServerEngineThread decompress before SUM_RECV, server.cc:86-113)."""
+    if _native_onebit(store, backend, key) is not None:
+        backend.push_onebit(key, payload)
+        return
     backend.push(key, store.decompress(key, payload))
 
 
@@ -123,6 +154,18 @@ def compressed_pull(store: CompressedKeyStore, backend, key: int,
     later pullers skip the dense copy out of the engine entirely."""
     buf = store.cached(key, rnd)
     if buf is not None:
+        return buf
+    codec = _native_onebit(store, backend, key)
+    if codec is not None:
+        buf = backend.pull_onebit(key, codec.payload_nbytes(),
+                                  round=rnd, timeout_ms=timeout_ms,
+                                  use_scale=codec.use_scale)
+        # deterministic codec, so caching is for THROUGHPUT, not
+        # byte-identity: later pullers of the round skip the dense
+        # copy out of the engine and the recompress entirely (without
+        # this, native measured SLOWER than Python at 4 workers —
+        # every puller paid the full pull+compress the cache elides)
+        store.put_cached(key, rnd, buf)
         return buf
     codec = store.codec(key)
     dense = np.empty(codec.size, codec.dtype)

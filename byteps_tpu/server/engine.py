@@ -61,6 +61,13 @@ def _lib() -> ctypes.CDLL:
     lib.bps_server_key_thread.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     lib.bps_reduce_sum.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int]
+    lib.bps_server_push_onebit.restype = ctypes.c_int
+    lib.bps_server_push_onebit.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64]
+    lib.bps_server_pull_onebit.restype = ctypes.c_int
+    lib.bps_server_pull_onebit.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.c_uint64, ctypes.c_int, ctypes.c_int]
     _LIB = lib
     return lib
 
@@ -197,6 +204,46 @@ class PSServer:
         if rc != 0:
             raise RuntimeError(f"pull({key}) failed rc={rc}")
 
+    def push_onebit(self, key: int, payload) -> None:
+        """Fused native decompress→enqueue of a onebit payload (fp32
+        stores; reference: server.cc:86-113 decompress-before-SUM_RECV
+        inside the C++ engine). The ctypes call releases the GIL, so
+        concurrent workers' payloads decode in parallel."""
+        buf = np.frombuffer(bytes(payload), np.uint8)
+        self._enter()
+        try:
+            rc = self._lib.bps_server_push_onebit(
+                self._h, key, buf.ctypes.data_as(ctypes.c_void_p),
+                buf.nbytes)
+        finally:
+            self._exit()
+        if rc == -5:
+            raise ServerClosed(f"push_onebit({key}): server shutting down")
+        if rc != 0:
+            raise RuntimeError(f"push_onebit({key}) failed rc={rc} "
+                               f"(bad payload length or non-fp32 key)")
+
+    def pull_onebit(self, key: int, payload_nbytes: int, round: int = 0,
+                    timeout_ms: int = 30000,
+                    use_scale: bool = False) -> bytes:
+        """Native merged-round pull + onebit recompress in one call."""
+        out = np.empty(payload_nbytes, np.uint8)
+        self._enter()
+        try:
+            rc = self._lib.bps_server_pull_onebit(
+                self._h, key, out.ctypes.data_as(ctypes.c_void_p),
+                out.nbytes, round, timeout_ms, int(use_scale))
+        finally:
+            self._exit()
+        if rc == -2:
+            raise TimeoutError(f"pull_onebit({key}) round={round} timed "
+                               f"out after {timeout_ms}ms")
+        if rc == -5:
+            raise ServerClosed(f"pull_onebit({key}): server shutting down")
+        if rc != 0:
+            raise RuntimeError(f"pull_onebit({key}) failed rc={rc}")
+        return out.tobytes()
+
     def round(self, key: int) -> int:
         self._enter()
         try:
@@ -288,6 +335,16 @@ class HostPSBackend:
         (the elastic-rejoin analog of the reference's is_recovery
         skip-barrier, global.cc:283-297)."""
         return int(self._shard(key).round(key))
+
+    def push_onebit(self, key: int, payload) -> None:
+        """Native onebit push on the key's shard (see PSServer)."""
+        self._shard(key).push_onebit(key, payload)
+
+    def pull_onebit(self, key: int, payload_nbytes: int, round: int = 0,
+                    timeout_ms: int = 30000,
+                    use_scale: bool = False) -> bytes:
+        return self._shard(key).pull_onebit(key, payload_nbytes, round,
+                                            timeout_ms, use_scale)
 
     def push_bytes(self, key: int, payload) -> None:
         """Compressed push: decompress server-side, dense-sum in the

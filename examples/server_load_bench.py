@@ -1,0 +1,135 @@
+"""Transport-server load test: W worker processes × C channels of
+compressed keys against ONE server.
+
+What it measures (VERDICT r2 #5/#6): the server component whose whole
+point is multi-worker aggregation throughput. Each worker process
+blasts ``--rounds`` sync rounds of ``--keys`` onebit-compressed keys
+(push_bytes + round-blocked pull_bytes) through the real TCP
+transport. Two knobs isolate the server's codec cost:
+
+- ``BPS_NATIVE_CODEC=1`` (default): fused C++ decompress→sum and
+  pull→recompress (bps_server.cc, GIL released across the call);
+- ``BPS_NATIVE_CODEC=0``: the Python/numpy codec chain runs inside the
+  server's per-connection threads — GIL-serialized under load.
+
+Prints one line per mode plus a JSON summary.
+
+Usage: python examples/server_load_bench.py --workers 4 --keys 8 \
+           --elems 262144 --rounds 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+WORKER = textwrap.dedent("""
+    import os, sys, time
+    sys.path.insert(0, %(root)r)
+    import numpy as np
+    from byteps_tpu.ops.compression.host import HostOnebit
+    from byteps_tpu.server.transport import RemotePSBackend
+
+    addr = os.environ["LB_ADDR"]
+    wid = int(os.environ["LB_WID"])
+    keys = int(os.environ["LB_KEYS"])
+    elems = int(os.environ["LB_ELEMS"])
+    rounds = int(os.environ["LB_ROUNDS"])
+    kw = {"compressor_type": "onebit", "compressor_onebit_scaling": "true"}
+
+    be = RemotePSBackend([addr])
+    codec = HostOnebit(elems, use_scale=True)
+    rs = np.random.RandomState(wid)
+    payloads = []
+    for k in range(keys):
+        be.init_key(k, elems * 4, "float32", compression=kw)
+        payloads.append(codec.compress(
+            rs.randn(elems).astype(np.float32)))
+    t0 = time.perf_counter()
+    for r in range(1, rounds + 1):
+        for k in range(keys):
+            be.push_bytes(k, payloads[k])
+        for k in range(keys):
+            be.pull_bytes(k, round=r, timeout_ms=120000)
+    dt = time.perf_counter() - t0
+    be.close()
+    print(f"LB_RESULT {dt:.3f}", flush=True)
+""")
+
+
+def run_mode(native: bool, args) -> dict:
+    from byteps_tpu.server.engine import PSServer
+    from byteps_tpu.server.transport import PSTransportServer
+
+    env_flag = "1" if native else "0"
+    be = PSServer(num_workers=args.workers, engine_threads=args.threads)
+    os.environ["BPS_NATIVE_CODEC"] = env_flag
+    srv = PSTransportServer(be, host="127.0.0.1", port=0)
+    procs, outs = [], []
+    try:
+        for wid in range(args.workers):
+            env = dict(os.environ,
+                       LB_ADDR=f"127.0.0.1:{srv.port}", LB_WID=str(wid),
+                       LB_KEYS=str(args.keys), LB_ELEMS=str(args.elems),
+                       LB_ROUNDS=str(args.rounds),
+                       BPS_NATIVE_CODEC=env_flag)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", WORKER % {
+                    "root": os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__)))}],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+        for p in procs:
+            out, _ = p.communicate(timeout=900)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        srv.close()
+        be.close()
+    secs = []
+    for wid, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            raise RuntimeError(f"worker {wid}:\n{out[-2000:]}")
+        secs.append(float(out.strip().rsplit("LB_RESULT ", 1)[1]))
+    wall = max(secs)
+    n_rpc = args.workers * args.keys * args.rounds * 2
+    dense_mb = (args.workers * args.keys * args.rounds * args.elems * 4
+                / 1e6)
+    return {"mode": "native" if native else "python",
+            "wall_s": round(wall, 3),
+            "rpc_per_s": round(n_rpc / wall, 1),
+            "dense_mb_per_s": round(dense_mb / wall, 1)}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--keys", type=int, default=8)
+    ap.add_argument("--elems", type=int, default=262144,
+                    help="fp32 elements per key (262144 = 1 MB dense)")
+    ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--threads", type=int, default=4,
+                    help="server engine threads")
+    args = ap.parse_args()
+    rows = [run_mode(False, args), run_mode(True, args)]
+    for r in rows:
+        print(r)
+    speedup = rows[0]["wall_s"] / rows[1]["wall_s"]
+    print(json.dumps({"metric": "native_codec_speedup",
+                      "value": round(speedup, 2), "unit": "x",
+                      "workers": args.workers, "keys": args.keys,
+                      "elems": args.elems,
+                      "python": rows[0], "native": rows[1]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,138 @@
+"""Native (C++) server-side onebit codec (VERDICT r2 #5; reference:
+server.cc:86-113 — decompress/sum/recompress inside the engine, not in
+per-connection interpreter threads)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from byteps_tpu.ops.compression.host import HostOnebit
+from byteps_tpu.server.engine import HostPSBackend, PSServer
+from byteps_tpu.server.transport import PSTransportServer, RemotePSBackend
+
+KW = {"compressor_type": "onebit", "compressor_onebit_scaling": "true"}
+
+
+@pytest.mark.parametrize("size", [1000, 1024, 31, 7])
+@pytest.mark.parametrize("use_scale", [True, False])
+def test_native_onebit_bit_exact(size, use_scale):
+    """Sign words byte-identical to the Python codec; scale within one
+    float ulp-ish (C++ accumulates the L1 mean in float64 — more
+    accurate than numpy's float32 pairwise mean, not less)."""
+    srv = PSServer(num_workers=2, engine_threads=1)
+    try:
+        codec = HostOnebit(size, use_scale=use_scale)
+        srv.init_key(7, size * 4, "float32")
+        xa = np.random.RandomState(6).randn(size).astype(np.float32)
+        xb = np.random.RandomState(7).randn(size).astype(np.float32)
+        srv.push_onebit(7, codec.compress(xa))
+        srv.push_onebit(7, codec.compress(xb))
+        buf = srv.pull_onebit(7, codec.payload_nbytes(), round=1,
+                              use_scale=use_scale)
+        merged = codec.decompress(codec.compress(xa)) + \
+            codec.decompress(codec.compress(xb))
+        want = codec.compress(merged)
+        assert buf[:-4] == want[:-4], "sign words differ"
+        (sn,), (sp,) = struct.unpack("<f", buf[-4:]), \
+            struct.unpack("<f", want[-4:])
+        assert sn == pytest.approx(sp, rel=1e-6)
+    finally:
+        srv.close()
+
+
+def test_native_and_python_paths_agree_over_transport(monkeypatch):
+    """The BPS_NATIVE_CODEC A/B knob: both paths must serve the same
+    merged values through the real wire (signs exact, scale to fp
+    accumulation tolerance)."""
+    results = {}
+    size = 4096
+    codec = HostOnebit(size, use_scale=True)
+    xs = [np.random.RandomState(i).randn(size).astype(np.float32)
+          for i in range(2)]
+    from byteps_tpu.server.compressed import _native_onebit
+    for mode in ("0", "1"):
+        monkeypatch.setenv("BPS_NATIVE_CODEC", mode)
+        be = HostPSBackend(num_servers=1, num_workers=2, engine_threads=1)
+        srv = PSTransportServer(be, host="127.0.0.1", port=0)
+        try:
+            ws = [RemotePSBackend([f"127.0.0.1:{srv.port}"])
+                  for _ in range(2)]
+            for w in ws:
+                w.init_key(3, size * 4, "float32", compression=KW)
+            # the A/B must actually be native-vs-python, not py-vs-py:
+            # the server-side store must route key 3 natively in mode 1
+            engaged = _native_onebit(srv.compressed, be, 3) is not None
+            assert engaged == (mode == "1"), (mode, engaged)
+            for w, x in zip(ws, xs):
+                w.push_bytes(3, codec.compress(x))
+            results[mode] = codec.decompress(ws[0].pull_bytes(3, round=1))
+            for w in ws:
+                w.close()
+        finally:
+            srv.close()
+            be.close()
+    np.testing.assert_allclose(results["0"], results["1"], rtol=1e-5)
+
+
+def test_python_path_keeps_ef_chains(monkeypatch):
+    """Server-side EF chains must NOT take the native fast path (the
+    C++ codec has no EF state) — registration with ef_type falls back
+    to Python and still works."""
+    from byteps_tpu.ops.compression.host import HostErrorFeedback
+    from byteps_tpu.server.compressed import (CompressedKeyStore,
+                                              _native_onebit)
+    store = CompressedKeyStore()
+    srv = PSServer(num_workers=1, engine_threads=1)
+    try:
+        kw = dict(KW, ef_type="vanilla")
+        chain = store.register(5, kw, 256, "float32")
+        assert isinstance(chain, HostErrorFeedback)
+        assert _native_onebit(store, srv, 5) is None
+        srv.init_key(5, 256 * 4, "float32")
+        x = np.random.RandomState(0).randn(256).astype(np.float32)
+        from byteps_tpu.server.compressed import (compressed_pull,
+                                                  compressed_push)
+        codec = HostOnebit(256, use_scale=True)
+        compressed_push(store, srv, 5, codec.compress(x))
+        out = codec.decompress(compressed_pull(store, srv, 5, 1))
+        assert out.shape == (256,)
+    finally:
+        srv.close()
+
+
+def test_native_codec_multiworker_load():
+    """Smoke version of examples/server_load_bench.py: 2 workers × 4
+    compressed keys × 3 rounds through the native path complete and
+    every pull round is byte-identical across workers."""
+    size = 8192
+    codec = HostOnebit(size, use_scale=True)
+    be = HostPSBackend(num_servers=1, num_workers=2, engine_threads=2)
+    srv = PSTransportServer(be, host="127.0.0.1", port=0)
+    try:
+        ws = [RemotePSBackend([f"127.0.0.1:{srv.port}"]) for _ in range(2)]
+        for w in ws:
+            for k in range(4):
+                w.init_key(k, size * 4, "float32", compression=KW)
+        import threading
+        pulls = {0: {}, 1: {}}
+
+        def worker(i):
+            rs = np.random.RandomState(10 + i)
+            for r in range(1, 4):
+                for k in range(4):
+                    ws[i].push_bytes(k, codec.compress(
+                        rs.randn(size).astype(np.float32)))
+                for k in range(4):
+                    pulls[i][(k, r)] = ws[i].pull_bytes(k, round=r)
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        for kr, buf in pulls[0].items():
+            assert buf == pulls[1][kr], f"round payloads differ at {kr}"
+        for w in ws:
+            w.close()
+    finally:
+        srv.close()
+        be.close()
