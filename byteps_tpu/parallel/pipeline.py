@@ -94,7 +94,8 @@ def pipeline(stage_fn: Callable, stage_params, inputs: jnp.ndarray,
 
 
 def pipeline_interleaved(stage_fn: Callable, chunk_params,
-                         inputs: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+                         inputs: jnp.ndarray, axis_name: str,
+                         remat_chunk: bool = True) -> jnp.ndarray:
     """Interleaved (circular) pipeline over ``axis_name``.
 
     Call inside shard_map. Each rank holds ``V`` layer CHUNKS
@@ -115,8 +116,18 @@ def pipeline_interleaved(stage_fn: Callable, chunk_params,
     Args:
       stage_fn: ``stage_fn(one_chunk_params, x) -> y`` (shape-preserving).
       chunk_params: pytree with leading dim V on every leaf.
-      inputs: ``[n_micro, mb, ...]``; n_micro must be a multiple of n.
+      inputs: ``[n_micro, mb, ...]``; any count — ragged tails are
+        padded with ghost microbatches internally and sliced off.
       axis_name: pipeline mesh axis.
+      remat_chunk: checkpoint each tick's chunk (gather + stage): the
+        backward sweep re-gathers and recomputes the chunk forward.
+        Without this the scan stores the dynamically gathered chunk
+        params as residuals EVERY tick — measured 5.5× GPipe's
+        activation temp at V=2; with it, 10× less, below plain GPipe
+        (docs/performance.md "Pipeline memory"). This is the standard
+        PP-regime activation-recompute tradeoff (~1/3 extra compute);
+        it supersedes any remat policy inside ``stage_fn``. Pass False
+        to keep per-tick residuals (fastest backward, highest memory).
 
     Returns:
       ``[n_micro, mb, ...]``, valid on the LAST stage only.
@@ -130,16 +141,38 @@ def pipeline_interleaved(stage_fn: Callable, chunk_params,
             return x
         return _scan_micro(whole, chunk_params, inputs)
     stage = jax.lax.axis_index(axis_name)
+    m_real = inputs.shape[0]
+    pad = (-m_real) % n
+    if pad:
+        # schedule arithmetic needs whole groups of n; run ghost
+        # microbatches (copies of the last one) and slice them off —
+        # they never reach the returned outputs, so their cotangent is
+        # zero and gradients stay exact
+        inputs = jnp.concatenate(
+            [inputs, jnp.broadcast_to(inputs[-1:],
+                                      (pad,) + inputs.shape[1:])])
     m = inputs.shape[0]
-    if m % n:
-        raise ValueError(f"interleaved pipeline needs n_micro % n_stages "
-                         f"== 0; got {m} % {n}")
     cycle = V * n
     total_busy = (m // n) * cycle
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     state = jnp.zeros_like(inputs[0])
     outputs = jnp.zeros_like(inputs)
+
+    def run_chunk(params, v, x):
+        params_v = jax.tree_util.tree_map(
+            lambda p: jax.lax.dynamic_index_in_dim(p, v, 0, keepdims=False),
+            params)
+        return stage_fn(params_v, x)
+
+    if remat_chunk:
+        # full chunk checkpoint, not a named-save policy: the gathered
+        # weights double as the stage's matmul residuals, so the only
+        # way not to store a per-tick copy of them is to recompute the
+        # chunk forward in the backward sweep (measured: a
+        # save-anything-except-the-gather policy saved the weights
+        # right back as dot_general residuals — zero memory won)
+        run_chunk = jax.checkpoint(run_chunk)
 
     def tick(carry, t):
         state, outputs = carry
@@ -148,12 +181,9 @@ def pipeline_interleaved(stage_fn: Callable, chunk_params,
         rem = local % cycle
         v = rem // n
         micro = g * n + rem % n
-        params_v = jax.tree_util.tree_map(
-            lambda p: jax.lax.dynamic_index_in_dim(p, v, 0, keepdims=False),
-            chunk_params)
         inp = jax.lax.dynamic_index_in_dim(inputs, micro, 0, keepdims=False)
         x = jnp.where(jnp.logical_and(stage == 0, v == 0), inp, state)
-        y = stage_fn(params_v, x)
+        y = run_chunk(chunk_params, v, x)
         valid = jnp.logical_and(t >= stage, t - stage < total_busy)
         commit = jnp.logical_and(
             valid, jnp.logical_and(stage == n - 1, v == V - 1))
@@ -165,7 +195,7 @@ def pipeline_interleaved(stage_fn: Callable, chunk_params,
 
     (state, outputs), _ = jax.lax.scan(tick, (state, outputs),
                                        jnp.arange(total_busy + n - 1))
-    return outputs
+    return outputs[:m_real] if pad else outputs
 
 
 def interleave_permutation(n_layers: int, n_stages: int,
@@ -197,6 +227,36 @@ def bubble_fraction(n_stages: int, n_micro: int, interleave: int = 1) -> float:
     if n_stages <= 1:
         return 0.0
     return (n_stages - 1) / (interleave * n_micro + n_stages - 1)
+
+
+def activation_memory_model(n_stages: int, n_micro: int,
+                            interleave: int = 1,
+                            boundary_bytes: int = 1,
+                            stage_residual_bytes: int = 0) -> dict:
+    """Per-rank activation-memory model of the SPMD-scan schedules.
+
+    In this formulation reverse-mode saves each scan tick's residuals
+    for ONE backward sweep at the end, so the peak is
+
+        ``ticks × (boundary + stage_residuals/interleave)``
+
+    with ``ticks = V·m + n - 1`` (GPipe is V=1). ``jax.checkpoint`` on
+    ``stage_fn`` shrinks ``stage_residual_bytes`` to ~0 (recompute in
+    the sweep), leaving the per-tick BOUNDARY activation — that is the
+    memory lever here, not the schedule. 1F1B's classic win (≤ n
+    microbatches in flight instead of m) assumes per-microbatch
+    backwards interleaved with forwards; a single jitted scan cannot
+    retire a microbatch's residuals early, so a faithful 1F1B would
+    trade the one-compile scan structure (and XLA's tick-level
+    compute/ppermute overlap) for a hand-scheduled program —
+    docs/performance.md "Pipeline memory" records the measured numbers
+    behind that decision.
+    """
+    m = n_micro + ((-n_micro) % n_stages if interleave > 1 else 0)
+    ticks = interleave * m + n_stages - 1
+    per_tick = boundary_bytes + stage_residual_bytes / max(interleave, 1)
+    return {"ticks": ticks, "peak_bytes": ticks * per_tick,
+            "bubble": bubble_fraction(n_stages, m, interleave)}
 
 
 def _scan_micro(stage_fn, stage_params, inputs):
