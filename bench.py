@@ -208,6 +208,28 @@ def main() -> None:
         line["kernels_verified"] = kernels_ok
     if kernel_err:
         line["kernel_verify_error"] = kernel_err[:300]
+
+    if on_tpu:
+        # higher-arithmetic-intensity flagship variant: same hidden/
+        # layers/FLOPs, 8 heads × d_head 128 instead of 16 × 64. The
+        # MXU's 128-lane contraction is exactly filled, confirming the
+        # plateau analysis: the d-64 gap is head-geometry, not kernel
+        # quality (docs/performance.md "Where the other 61% goes")
+        import dataclasses
+        del trainer, params, data
+        gc.collect()
+        try:   # a transient here must not cost the headline line above
+            cfg128 = dataclasses.replace(cfg, heads=8)
+            p128, d128, lf128 = mlm_setup(cfg128, batch, seq)
+            sps128 = time_plain_steps(p128, d128, lf128, batch, iters,
+                                      warm)
+            fps128 = transformer_train_flops_per_sample(
+                cfg128, seq, lm_positions=max(1, int(0.2 * seq)))
+            line["dh128_sps"] = round(sps128, 2)
+            if peak:
+                line["dh128_mfu"] = round(sps128 * fps128 / peak, 4)
+        except Exception as e:   # noqa: BLE001 — recorded, not fatal
+            line["dh128_error"] = f"{type(e).__name__}: {e}"[:300]
     print(json.dumps(line))
 
 

@@ -65,6 +65,10 @@ class TransformerConfig:
     # interleaved/circular schedule: bubble shrinks interleave-fold; the
     # stacked layer params must be laid out with
     # parallel.pipeline.interleave_permutation)
+    pp_remat_chunk: bool = True   # interleaved PP: checkpoint each tick's
+    # chunk (10× less scan-residual memory, ~1/3 extra compute; overrides
+    # remat_policy inside the chunk). False keeps per-tick residuals and
+    # honors remat_policy (e.g. "mlp_only") at full memory cost.
     scan_unroll: int = 1          # lax.scan unroll factor over layers
     lm_head_chunk: int = 0        # >0: chunked cross-entropy — the LM
     # head + softmax run per sequence chunk under jax.checkpoint, so the
@@ -336,7 +340,8 @@ def apply(params, cfg: TransformerConfig, tokens: jnp.ndarray,
             chunked = jax.tree_util.tree_map(
                 lambda p: p.reshape(V, p.shape[0] // V, *p.shape[1:]),
                 params["blocks"])
-            xm = pipeline_interleaved(stack_fn, chunked, xm, cfg.pp_axis)
+            xm = pipeline_interleaved(stack_fn, chunked, xm, cfg.pp_axis,
+                                      remat_chunk=cfg.pp_remat_chunk)
         else:
             xm = pipeline(stack_fn, params["blocks"], xm, cfg.pp_axis)
         x = xm.reshape(b, *x.shape[1:])   # valid on the last stage only
