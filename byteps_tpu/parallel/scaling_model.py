@@ -52,8 +52,9 @@ from jax.sharding import AbstractMesh, PartitionSpec as P
 
 __all__ = [
     "Collective", "CommModel", "V5E_COMM", "lower_flagship_step",
-    "collective_schedule", "verify_dp_schedule", "model_step_time",
-    "scaling_table", "format_table",
+    "lower_hybrid_step", "collective_schedule", "verify_dp_schedule",
+    "verify_hybrid_schedule", "model_step_time", "scaling_table",
+    "format_table",
 ]
 
 
@@ -79,6 +80,10 @@ class Collective:
     group_size: int           # participants per replica group
     n_groups: int
     crosses_dcn: bool         # any group spans >1 dcn slice
+    spans: frozenset = frozenset()   # mesh axes the replica groups vary
+    # over (populated when collective_schedule gets axis_sizes) —
+    # classification by membership, NOT by group size: sizes collide
+    # (tp×sp == dcn is common) and would mask layout regressions
 
     @property
     def operand_bytes(self) -> int:
@@ -121,15 +126,26 @@ def _parse_tensor_type(t) -> Tuple[int, str, int]:
     return elems, dtype, _DTYPE_BYTES.get(dtype, 4)
 
 
-def collective_schedule(lowered, n_devices: int,
-                        dcn: int = 1) -> List[Collective]:
+def collective_schedule(lowered, n_devices: int, dcn: int = 1,
+                        axis_sizes: Optional[Sequence[Tuple[str, int]]]
+                        = None) -> List[Collective]:
     """Walk a ``jax.stages.Lowered`` MLIR module and return every
     collective with its replica-group structure classified against the
-    row-major dcn-slice layout of ``AbstractMesh((dcn, ...))``."""
+    row-major dcn-slice layout of ``AbstractMesh((dcn, ...))``.
+    ``axis_sizes`` (the mesh's ``(name, size)`` pairs in declaration
+    order) additionally derives each collective's ``spans`` — the set
+    of mesh axes its replica groups vary over."""
     per_slice = n_devices // max(dcn, 1)
     out: List[Collective] = []
 
-    def classify(groups: np.ndarray) -> Tuple[int, int, bool]:
+    strides: List[Tuple[str, int, int]] = []
+    if axis_sizes is not None:
+        stride = 1
+        for name, size in reversed(list(axis_sizes)):
+            strides.append((name, size, stride))
+            stride *= size
+
+    def classify(groups: np.ndarray) -> Tuple[int, int, bool, frozenset]:
         g = groups.shape[-1]
         crosses = False
         if dcn > 1:
@@ -138,7 +154,14 @@ def collective_schedule(lowered, n_devices: int,
                 if len(slices) > 1:
                     crosses = True
                     break
-        return g, int(np.prod(groups.shape[:-1])), crosses
+        spans: set = set()
+        if strides:
+            for row in groups.reshape(-1, g):
+                for name, size, stride in strides:
+                    if len({(int(d) // stride) % size for d in row}) > 1:
+                        spans.add(name)
+        return g, int(np.prod(groups.shape[:-1])), crosses, \
+            frozenset(spans)
 
     def walk(op):
         for region in op.regions:
@@ -152,7 +175,7 @@ def collective_schedule(lowered, n_devices: int,
                         except KeyError:   # collective_permute
                             groups = np.array(
                                 o.attributes["source_target_pairs"])
-                        gsz, ngroups, crosses = classify(groups)
+                        gsz, ngroups, crosses, spans = classify(groups)
                         oelems, dt, db = _parse_tensor_type(
                             o.operands[0].type)
                         relems, _, _ = _parse_tensor_type(
@@ -161,7 +184,8 @@ def collective_schedule(lowered, n_devices: int,
                             kind=name.split(".", 1)[1],
                             operand_elems=oelems, result_elems=relems,
                             dtype=dt, dtype_bytes=db, group_size=gsz,
-                            n_groups=ngroups, crosses_dcn=crosses))
+                            n_groups=ngroups, crosses_dcn=crosses,
+                            spans=spans))
                     walk(o)
 
     walk(lowered.compiler_ir().operation)
@@ -239,6 +263,105 @@ def lower_flagship_step(n_devices: int, dcn: int = 1, cfg=None,
     info = {"n_buckets": len(buckets), "grad_bytes": grad_bytes,
             "axes": axes, "ici": n_devices // max(dcn, 1), "dcn": dcn}
     return lowered, info
+
+
+def lower_hybrid_step(n_devices: int, dcn: int = 1, tp: int = 2,
+                      sp: int = 2, cfg=None, seq: int = 64,
+                      batch_per_replica: int = 2,
+                      partition_bytes: int = 4 << 20):
+    """AOT-lower the HYBRID step — data × tensor × sequence parallel
+    over ``AbstractMesh((dcn, data, seq, model))`` — mirroring
+    ``ShardedTrainer``'s program (training.py): per-leaf grad psum over
+    the non-dp axes the leaf is not sharded on, then the bucketed DP
+    exchange. Used to pin that model/seq collectives NEVER cross the
+    dcn tier at any logical scale (the mesh layout guarantee the
+    8→256 north star rides on)."""
+    import optax
+    from ..models import bert, transformer
+    from ..optim import distributed_optimizer
+    from .sharding import opt_state_specs, spec_axes
+
+    ici_dp = n_devices // (dcn * tp * sp)
+    if ici_dp < 1 or n_devices % (dcn * tp * sp):
+        raise ValueError(f"{n_devices} devices can't mesh as "
+                         f"dcn={dcn}×dp×seq={sp}×model={tp}")
+    mesh = AbstractMesh((dcn, ici_dp, sp, tp),
+                        ("dcn", "data", "seq", "model"))
+    dp_axes = ("dcn", "data") if dcn > 1 else ("data",)
+    other_axes = ("seq", "model")
+
+    if cfg is None:
+        cfg = bert.bert_tiny(tp_axis="model", sp_axis="seq")
+    params = jax.eval_shape(
+        lambda: transformer.init_params(jax.random.PRNGKey(0), cfg))
+    pspec = transformer.param_specs(cfg)
+    tx = distributed_optimizer(optax.adamw(1e-4), axes=dp_axes,
+                               partition_bytes=partition_bytes)
+    opt_state = jax.eval_shape(tx.init, params)
+    ospec = opt_state_specs(tx, params, pspec)
+    max_pred = max(1, int(0.2 * seq))
+    flat_specs = jax.tree_util.tree_leaves(
+        pspec, is_leaf=lambda x: isinstance(x, P))
+    other_prod = sp * tp
+
+    def loss_fn(p, batch):
+        return bert.mlm_loss(p, cfg, batch, max_predictions=max_pred)
+
+    def step(p, s, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(p, batch)
+        g_leaves, g_def = jax.tree_util.tree_flatten(grads)
+        synced = []
+        for g, sp_ in zip(g_leaves, flat_specs):
+            axes = tuple(a for a in other_axes if a not in spec_axes(sp_))
+            g = jax.lax.psum(g, axes) if axes else g
+            synced.append(g / other_prod)
+        grads = jax.tree_util.tree_unflatten(g_def, synced)
+        updates, s = tx.update(grads, s, p)
+        p = optax.apply_updates(p, updates)
+        return p, s, jax.lax.pmean(loss, dp_axes + ("seq",))
+
+    batch_spec = P(dp_axes, "seq")
+    shard_fn = jax.shard_map(
+        step, mesh=mesh, in_specs=(pspec, ospec, batch_spec),
+        out_specs=(pspec, ospec, P()), check_vma=False)
+    global_batch = batch_per_replica * dcn * ici_dp
+    batch = (jax.ShapeDtypeStruct((global_batch, seq), jnp.int32),
+             jax.ShapeDtypeStruct((global_batch, seq), jnp.int32))
+    lowered = jax.jit(shard_fn).lower(params, opt_state, batch)
+    info = {"ici": ici_dp * sp * tp, "dcn": dcn, "tp": tp, "sp": sp,
+            "dp": dcn * ici_dp,
+            "axis_sizes": (("dcn", dcn), ("data", ici_dp),
+                           ("seq", sp), ("model", tp))}
+    return lowered, info
+
+
+def verify_hybrid_schedule(schedule: Sequence[Collective], info: Dict,
+                           small_bytes: int = 4096) -> Dict[str, int]:
+    """The hybrid-mesh invariant the north star rides on: model/seq
+    (TP/SP) collectives — activation syncs and per-leaf grad psums —
+    stay INSIDE the slice at every logical scale; only the bucketed DP
+    gradient exchange touches dcn. Classified by the mesh AXES each
+    replica group actually spans (``Collective.spans``), never by
+    group size — sizes collide (tp×sp == dcn at common configs) and a
+    size-based check was shown to pass on a broken layout."""
+    dcn = info["dcn"]
+    bulk = [c for c in schedule if c.operand_bytes > small_bytes]
+    assert all(c.spans for c in bulk), \
+        "schedule lacks axis spans — pass axis_sizes to " \
+        "collective_schedule"
+    tp_like = [c for c in bulk if {"model", "seq"} & c.spans]
+    for c in tp_like:
+        assert "dcn" not in c.spans and not c.crosses_dcn, (
+            "a TP/SP collective crosses the dcn tier — the mesh "
+            "layout broke", c)
+    crossers = [c for c in bulk if "dcn" in c.spans]
+    if dcn > 1:
+        assert crossers, "no dcn collectives at dcn>1 — grads not synced?"
+        for c in crossers:
+            assert c.spans == {"dcn"}, (
+                "only the pure cross-slice DP stage may span slices", c)
+    return {"bulk": len(bulk), "tp_like": len(tp_like),
+            "dcn_crossers": len(crossers)}
 
 
 # --------------------------------------------------------------------------

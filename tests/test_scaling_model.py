@@ -107,3 +107,28 @@ def test_slow_fabric_breaks_overlap_bound():
     slow = CommModel(ici_bw=9e8, dcn_bw=2.5e7)
     t = model_step_time(sched, compute_s=1e-4, comm=slow)
     assert t["overlap_s"] > 1e-4, t
+
+
+def test_hybrid_mesh_tp_sp_never_cross_dcn():
+    """The hybrid (dcn × data × seq × model) step's TP/SP collectives —
+    activation syncs and per-leaf grad psums — must stay inside the
+    slice at every logical scale; only the DP gradient stages may span
+    slices. This is the mesh-layout guarantee the 8→256 curve rides
+    on (ICI carries the chatty parallelism, DCN only the 1/ici
+    gradient shard)."""
+    from byteps_tpu.parallel.scaling_model import (lower_hybrid_step,
+                                                   verify_hybrid_schedule)
+    for n, dcn in ((16, 2), (64, 4), (256, 8)):
+        lowered, info = lower_hybrid_step(n, dcn=dcn,
+                                          partition_bytes=64 << 10)
+        sched = collective_schedule(lowered, n, dcn=dcn,
+                                    axis_sizes=info["axis_sizes"])
+        out = verify_hybrid_schedule(sched, info)
+        # the dcn-crossing count must not grow with device count: it is
+        # one per DP bucket stage, not per chip
+        assert out["dcn_crossers"] == 4, out
+        assert out["bulk"] > out["dcn_crossers"], out
+        # axis-membership classification (NOT group size — sizes
+        # collide at e.g. tp*sp == dcn): every bulk collective's spans
+        # are known, TP/SP ones present and slice-local
+        assert out["tp_like"] > 0, out
