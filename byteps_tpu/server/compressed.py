@@ -120,29 +120,43 @@ class CompressedKeyStore:
             self._cache.clear()
 
 
-def _native_onebit(store: CompressedKeyStore, backend, key: int):
-    """The bare-onebit fp32 chain on a native engine shard runs fully
-    in C++ (fused decompress→enqueue / pull→recompress; reference:
-    server.cc:86-113 does codec work inside the engine, not in
-    per-connection interpreter threads). EF/momentum chains and other
-    codecs keep the Python path."""
+def _native_codec(store: CompressedKeyStore, backend, key: int):
+    """(kind, codec) when the key's chain runs fully in C++ (fused
+    decompress→enqueue / pull→recompress; reference: server.cc:86-113
+    does codec work inside the engine, not in per-connection
+    interpreter threads): bare onebit or topk on fp32. EF/momentum
+    chains, randomk (stateful RNG lives in the Python chain), and
+    other codecs keep the Python path."""
     import os
     if os.environ.get("BPS_NATIVE_CODEC", "1") in ("0", "false"):
-        return None            # A/B knob: force the Python codec path
-    from ..ops.compression.host import HostOnebit
+        return None, None      # A/B knob: force the Python codec path
+    from ..ops.compression.host import HostOnebit, HostTopk
     codec = store._codecs.get(key)
-    if (isinstance(codec, HostOnebit) and codec.dtype == np.float32
-            and hasattr(backend, "push_onebit")):
-        return codec
-    return None
+    if codec is None or codec.dtype != np.float32:
+        return None, None
+    if isinstance(codec, HostOnebit) and hasattr(backend, "push_onebit"):
+        return "onebit", codec
+    if type(codec) is HostTopk and hasattr(backend, "push_topk"):
+        return "topk", codec
+    return None, None
+
+
+def _native_onebit(store: CompressedKeyStore, backend, key: int):
+    """Back-compat shim for the onebit-only check (tests use it)."""
+    kind, codec = _native_codec(store, backend, key)
+    return codec if kind == "onebit" else None
 
 
 def compressed_push(store: CompressedKeyStore, backend, key: int,
                     payload) -> None:
     """Decompress → dense push into the summation engine (reference:
     BytePSServerEngineThread decompress before SUM_RECV, server.cc:86-113)."""
-    if _native_onebit(store, backend, key) is not None:
+    kind, _ = _native_codec(store, backend, key)
+    if kind == "onebit":
         backend.push_onebit(key, payload)
+        return
+    if kind == "topk":
+        backend.push_topk(key, payload)
         return
     backend.push(key, store.decompress(key, payload))
 
@@ -155,12 +169,16 @@ def compressed_pull(store: CompressedKeyStore, backend, key: int,
     buf = store.cached(key, rnd)
     if buf is not None:
         return buf
-    codec = _native_onebit(store, backend, key)
-    if codec is not None:
-        buf = backend.pull_onebit(key, codec.payload_nbytes(),
-                                  round=rnd, timeout_ms=timeout_ms,
-                                  use_scale=codec.use_scale)
-        # deterministic codec, so caching is for THROUGHPUT, not
+    kind, codec = _native_codec(store, backend, key)
+    if kind is not None:
+        if kind == "onebit":
+            buf = backend.pull_onebit(key, codec.payload_nbytes(),
+                                      round=rnd, timeout_ms=timeout_ms,
+                                      use_scale=codec.use_scale)
+        else:
+            buf = backend.pull_topk(key, codec.payload_nbytes(),
+                                    round=rnd, timeout_ms=timeout_ms)
+        # deterministic codecs, so caching is for THROUGHPUT, not
         # byte-identity: later pullers of the round skip the dense
         # copy out of the engine and the recompress entirely (without
         # this, native measured SLOWER than Python at 4 workers —

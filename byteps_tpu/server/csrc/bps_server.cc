@@ -21,6 +21,7 @@
 //   - async mode: pushes sum immediately into the store, pulls never wait
 //     (server.cc:310-314, BYTEPS_ENABLE_ASYNC).
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <cmath>
@@ -404,6 +405,80 @@ class Server {
     return 0;
   }
 
+  // ---- native topk codec. Wire: k int32 indices then k fp32 values
+  // (matches _SparseCodec._pack). Selection: k largest |x|, ties to
+  // the LOWER index — the Python codec's stable argsort of -|x|
+  // (host.py HostTopk). Deterministic, so recompressed rounds are
+  // byte-identical across pullers with no cache. fp32 stores only. ----
+
+  int PushTopk(uint64_t key, const void* payload, uint64_t plen) {
+    CallGuard g(inflight_, dying_);
+    if (g.refused) return -5;
+    KeyStore* ks = Find(key);
+    if (ks == nullptr || ks->dtype != F32) return -1;
+    if (plen % 8 != 0) return -1;
+    const size_t kk = plen / 8;
+    const size_t n = ks->len / 4;
+    if (kk > n) return -1;
+    const int32_t* idx = (const int32_t*)payload;
+    const float* vals = (const float*)((const char*)payload + kk * 4);
+    Task t;
+    t.key = key;
+    t.data.assign(ks->len, 0);           // scatter into zeros
+    float* out = (float*)t.data.data();
+    for (size_t i = 0; i < kk; ++i) {
+      const int32_t j = idx[i];
+      if (j < 0 || (size_t)j >= n) return -1;
+      out[j] = vals[i];   // duplicate indices: LAST WINS, matching the
+    }                     // Python path's scatter (out[idx] = vals) so
+                          // the BPS_NATIVE_CODEC A/B stays meaningful
+                          // even on malformed payloads
+    if (blocking_) {
+      Apply(t);
+      return 0;
+    }
+    engines_[ks->tid]->Push(std::move(t));
+    return 0;
+  }
+
+  int PullTopk(uint64_t key, void* dst, uint64_t dst_len,
+               uint64_t want_round, int timeout_ms) {
+    CallGuard g(inflight_, dying_);
+    if (g.refused) return -5;
+    KeyStore* ks = Find(key);
+    if (ks == nullptr || ks->dtype != F32) return -1;
+    if (dst_len % 8 != 0) return -1;
+    const size_t kk = dst_len / 8;
+    const size_t n = ks->len / 4;
+    if (kk > n) return -1;
+    std::vector<char> dense(ks->len);
+    int rc = Pull(key, dense.data(), ks->len, want_round, timeout_ms);
+    if (rc != 0) return rc;
+    const float* x = (const float*)dense.data();
+    std::vector<int32_t> order(n);
+    for (size_t i = 0; i < n; ++i) order[i] = (int32_t)i;
+    auto cmp = [x](int32_t a, int32_t b) {
+      // NaN maps to -inf: deterministic, keeps the comparator a strict
+      // weak ordering (fabs(NaN) comparisons would make NaN "equal" to
+      // everything while finite values still order — UB in introsort),
+      // and matches numpy's NaN-last argsort so the all-NaN store
+      // selects indices 0..k-1 exactly like the Python codec
+      float fa = std::fabs(x[a]), fb = std::fabs(x[b]);
+      if (std::isnan(fa)) fa = -INFINITY;
+      if (std::isnan(fb)) fb = -INFINITY;
+      return fa != fb ? fa > fb : a < b;   // ties → lower index first
+    };
+    std::nth_element(order.begin(), order.begin() + kk, order.end(), cmp);
+    std::sort(order.begin(), order.begin() + kk, cmp);
+    int32_t* oidx = (int32_t*)dst;
+    float* ovals = (float*)((char*)dst + kk * 4);
+    for (size_t i = 0; i < kk; ++i) {
+      oidx[i] = order[i];
+      ovals[i] = x[order[i]];
+    }
+    return 0;
+  }
+
   // pull the merged round and recompress to onebit in one native call;
   // deterministic, so every worker pulling a round gets identical bytes
   // without a cache. use_scale: L1-mean scale like the worker codec.
@@ -691,6 +766,18 @@ int bps_server_pull_onebit(void* h, uint64_t key, void* dst,
                            int timeout_ms, int use_scale) {
   return ((Server*)h)->PullOnebit(key, dst, dst_len, want_round,
                                   timeout_ms, use_scale);
+}
+
+int bps_server_push_topk(void* h, uint64_t key, const void* payload,
+                         uint64_t plen) {
+  return ((Server*)h)->PushTopk(key, payload, plen);
+}
+
+int bps_server_pull_topk(void* h, uint64_t key, void* dst,
+                         uint64_t dst_len, uint64_t want_round,
+                         int timeout_ms) {
+  return ((Server*)h)->PullTopk(key, dst, dst_len, want_round,
+                                timeout_ms);
 }
 
 }  // extern "C"

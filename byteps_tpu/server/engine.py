@@ -68,6 +68,13 @@ def _lib() -> ctypes.CDLL:
     lib.bps_server_pull_onebit.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
         ctypes.c_uint64, ctypes.c_int, ctypes.c_int]
+    lib.bps_server_push_topk.restype = ctypes.c_int
+    lib.bps_server_push_topk.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64]
+    lib.bps_server_pull_topk.restype = ctypes.c_int
+    lib.bps_server_pull_topk.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.c_uint64, ctypes.c_int]
     _LIB = lib
     return lib
 
@@ -244,6 +251,44 @@ class PSServer:
             raise RuntimeError(f"pull_onebit({key}) failed rc={rc}")
         return out.tobytes()
 
+    def push_topk(self, key: int, payload) -> None:
+        """Fused native scatter→enqueue of a topk payload (k int32
+        indices + k fp32 values; duplicate indices accumulate)."""
+        buf = np.frombuffer(bytes(payload), np.uint8)
+        self._enter()
+        try:
+            rc = self._lib.bps_server_push_topk(
+                self._h, key, buf.ctypes.data_as(ctypes.c_void_p),
+                buf.nbytes)
+        finally:
+            self._exit()
+        if rc == -5:
+            raise ServerClosed(f"push_topk({key}): server shutting down")
+        if rc != 0:
+            raise RuntimeError(f"push_topk({key}) failed rc={rc} "
+                               f"(bad payload or non-fp32 key)")
+
+    def pull_topk(self, key: int, payload_nbytes: int, round: int = 0,
+                  timeout_ms: int = 30000) -> bytes:
+        """Native merged-round pull + top-k reselection (largest |x|,
+        ties to the lower index — matches HostTopk)."""
+        out = np.empty(payload_nbytes, np.uint8)
+        self._enter()
+        try:
+            rc = self._lib.bps_server_pull_topk(
+                self._h, key, out.ctypes.data_as(ctypes.c_void_p),
+                out.nbytes, round, timeout_ms)
+        finally:
+            self._exit()
+        if rc == -2:
+            raise TimeoutError(f"pull_topk({key}) round={round} timed "
+                               f"out after {timeout_ms}ms")
+        if rc == -5:
+            raise ServerClosed(f"pull_topk({key}): server shutting down")
+        if rc != 0:
+            raise RuntimeError(f"pull_topk({key}) failed rc={rc}")
+        return out.tobytes()
+
     def round(self, key: int) -> int:
         self._enter()
         try:
@@ -345,6 +390,15 @@ class HostPSBackend:
                     use_scale: bool = False) -> bytes:
         return self._shard(key).pull_onebit(key, payload_nbytes, round,
                                             timeout_ms, use_scale)
+
+    def push_topk(self, key: int, payload) -> None:
+        """Native topk push on the key's shard (see PSServer)."""
+        self._shard(key).push_topk(key, payload)
+
+    def pull_topk(self, key: int, payload_nbytes: int, round: int = 0,
+                  timeout_ms: int = 30000) -> bytes:
+        return self._shard(key).pull_topk(key, payload_nbytes, round,
+                                          timeout_ms)
 
     def push_bytes(self, key: int, payload) -> None:
         """Compressed push: decompress server-side, dense-sum in the

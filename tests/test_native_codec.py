@@ -136,3 +136,75 @@ def test_native_codec_multiworker_load():
     finally:
         srv.close()
         be.close()
+
+
+@pytest.mark.parametrize("size,k", [(1000, 50), (256, 256), (64, 1)])
+def test_native_topk_byte_identical(size, k):
+    """Native topk (scatter-sum push + top-k reselection pull) is
+    byte-identical to the Python codec — same largest-|x| selection
+    with ties to the lower index."""
+    from byteps_tpu.ops.compression.host import HostTopk
+    srv = PSServer(num_workers=2, engine_threads=1)
+    try:
+        codec = HostTopk(size, "float32", k)
+        srv.init_key(9, size * 4, "float32")
+        xa = np.random.RandomState(1).randn(size).astype(np.float32)
+        xb = np.random.RandomState(2).randn(size).astype(np.float32)
+        srv.push_topk(9, codec.compress(xa))
+        srv.push_topk(9, codec.compress(xb))
+        got = srv.pull_topk(9, codec.payload_nbytes(), round=1)
+        merged = codec.decompress(codec.compress(xa)) + \
+            codec.decompress(codec.compress(xb))
+        assert got == codec.compress(merged)
+    finally:
+        srv.close()
+
+
+def test_native_topk_routes_over_transport(monkeypatch):
+    """Bare fp32 topk chains engage the native path through the real
+    wire; results agree with the forced-Python path."""
+    from byteps_tpu.ops.compression.host import HostTopk
+    from byteps_tpu.server.compressed import _native_codec
+    kw = {"compressor_type": "topk", "compressor_k": "32"}
+    size = 2048
+    codec = HostTopk(size, "float32", 32)
+    xs = [np.random.RandomState(i + 5).randn(size).astype(np.float32)
+          for i in range(2)]
+    results = {}
+    for mode in ("0", "1"):
+        monkeypatch.setenv("BPS_NATIVE_CODEC", mode)
+        be = HostPSBackend(num_servers=1, num_workers=2, engine_threads=1)
+        srv = PSTransportServer(be, host="127.0.0.1", port=0)
+        try:
+            ws = [RemotePSBackend([f"127.0.0.1:{srv.port}"])
+                  for _ in range(2)]
+            for w in ws:
+                w.init_key(4, size * 4, "float32", compression=kw)
+            kind, _ = _native_codec(srv.compressed, be, 4)
+            assert (kind == "topk") == (mode == "1"), (mode, kind)
+            for w, x in zip(ws, xs):
+                w.push_bytes(4, codec.compress(x))
+            results[mode] = codec.decompress(ws[0].pull_bytes(4, round=1))
+            for w in ws:
+                w.close()
+        finally:
+            srv.close()
+            be.close()
+    np.testing.assert_allclose(results["0"], results["1"], rtol=1e-6)
+
+
+def test_randomk_stays_on_python_path():
+    """RandomK's worker-synchronized RNG lives in the Python chain —
+    the native router must not claim it."""
+    from byteps_tpu.server.compressed import (CompressedKeyStore,
+                                              _native_codec)
+    store = CompressedKeyStore()
+    srv = PSServer(num_workers=1, engine_threads=1)
+    try:
+        store.register(6, {"compressor_type": "randomk",
+                           "compressor_k": "16", "seed": "7"},
+                       256, "float32")
+        kind, _ = _native_codec(store, srv, 6)
+        assert kind is None
+    finally:
+        srv.close()
