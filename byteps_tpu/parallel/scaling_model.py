@@ -52,8 +52,9 @@ from jax.sharding import AbstractMesh, PartitionSpec as P
 
 __all__ = [
     "Collective", "CommModel", "V5E_COMM", "lower_flagship_step",
-    "lower_hybrid_step", "collective_schedule", "verify_dp_schedule",
-    "verify_hybrid_schedule", "model_step_time", "scaling_table",
+    "lower_hybrid_step", "lower_moe_step", "collective_schedule",
+    "verify_dp_schedule", "verify_hybrid_schedule",
+    "verify_moe_schedule", "model_step_time", "scaling_table",
     "format_table",
 ]
 
@@ -361,6 +362,89 @@ def verify_hybrid_schedule(schedule: Sequence[Collective], info: Dict,
             assert c.spans == {"dcn"}, (
                 "only the pure cross-slice DP stage may span slices", c)
     return {"bulk": len(bulk), "tp_like": len(tp_like),
+            "dcn_crossers": len(crossers)}
+
+
+def lower_moe_step(n_devices: int, dcn: int = 1, ep: int = 2,
+                   seq: int = 32, batch_per_replica: int = 2,
+                   partition_bytes: int = 64 << 10):
+    """AOT-lower the expert-parallel MoE training step over
+    ``AbstractMesh((dcn, data, expert))``. Pins that the token-routing
+    ``all_to_all`` pair (dispatch + return) rides the expert axis
+    INSIDE the slice — all_to_all over DCN would be the worst possible
+    placement for the chattiest collective in the program."""
+    import optax
+    from ..models import moe
+    from ..optim import distributed_optimizer
+
+    ici_dp = n_devices // (dcn * ep)
+    if ici_dp < 1 or n_devices % (dcn * ep):
+        raise ValueError(f"{n_devices} devices can't mesh as "
+                         f"dcn={dcn}×dp×expert={ep}")
+    mesh = AbstractMesh((dcn, ici_dp, ep), ("dcn", "data", "expert"))
+    dp_axes = ("dcn", "data") if dcn > 1 else ("data",)
+    cfg = moe.moe_tiny(ep_axis="expert")
+    params = jax.eval_shape(
+        lambda: moe.init_moe_params(jax.random.PRNGKey(0), cfg))
+    pspec = moe.moe_param_specs(cfg)
+    tx = distributed_optimizer(optax.adamw(1e-4), axes=dp_axes,
+                               partition_bytes=partition_bytes)
+    opt_state = jax.eval_shape(tx.init, params)
+    from .sharding import opt_state_specs, spec_axes
+    ospec = opt_state_specs(tx, params, pspec)
+    flat_specs = jax.tree_util.tree_leaves(
+        pspec, is_leaf=lambda x: isinstance(x, P))
+
+    def step(p, s, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p, b: moe.moe_lm_loss(p, cfg, b))(p, batch)
+        g_leaves, g_def = jax.tree_util.tree_flatten(grads)
+        synced = []
+        for g, sp_ in zip(g_leaves, flat_specs):
+            if "expert" not in spec_axes(sp_):
+                g = jax.lax.psum(g, ("expert",)) / ep
+            else:
+                g = g / ep
+            synced.append(g)
+        grads = jax.tree_util.tree_unflatten(g_def, synced)
+        updates, s = tx.update(grads, s, p)
+        p = optax.apply_updates(p, updates)
+        return p, s, jax.lax.pmean(loss, dp_axes)
+
+    batch_spec = P(dp_axes)
+    shard_fn = jax.shard_map(
+        step, mesh=mesh, in_specs=(pspec, ospec, batch_spec),
+        out_specs=(pspec, ospec, P()), check_vma=False)
+    global_batch = batch_per_replica * dcn * ici_dp
+    batch = (jax.ShapeDtypeStruct((global_batch, seq), jnp.int32),
+             jax.ShapeDtypeStruct((global_batch, seq), jnp.int32))
+    lowered = jax.jit(shard_fn).lower(params, opt_state, batch)
+    info = {"dcn": dcn, "ep": ep, "dp": dcn * ici_dp,
+            "ici": ici_dp * ep,
+            "axis_sizes": (("dcn", dcn), ("data", ici_dp),
+                           ("expert", ep))}
+    return lowered, info
+
+
+def verify_moe_schedule(schedule: Sequence[Collective], info: Dict,
+                        small_bytes: int = 1024) -> Dict[str, int]:
+    """EP invariant: every all_to_all spans EXACTLY the expert axis (so
+    it never leaves the slice); dcn crossers span only dcn — and at
+    dcn>1 they must EXIST (a schedule with no cross-slice stage means
+    gradients are never synchronized across slices)."""
+    bulk = [c for c in schedule if c.operand_bytes > small_bytes]
+    a2a = [c for c in schedule if c.kind == "all_to_all"]
+    assert a2a, "MoE step lowered no all_to_all — routing vanished?"
+    for c in a2a:
+        assert c.spans == {"expert"}, (
+            "token routing must ride the expert axis only", c)
+    crossers = [c for c in bulk if "dcn" in c.spans]
+    for c in crossers:
+        assert c.spans == {"dcn"}, (
+            "only the cross-slice DP stage may span slices", c)
+    if info["dcn"] > 1:
+        assert crossers, "no dcn collectives at dcn>1 — grads not synced?"
+    return {"bulk": len(bulk), "all_to_all": len(a2a),
             "dcn_crossers": len(crossers)}
 
 

@@ -253,7 +253,8 @@ class PSServer:
 
     def push_topk(self, key: int, payload) -> None:
         """Fused native scatter→enqueue of a topk payload (k int32
-        indices + k fp32 values; duplicate indices accumulate)."""
+        indices + k fp32 values; duplicate indices are LAST-WINS,
+        matching the Python scatter ``out[idx] = vals``)."""
         buf = np.frombuffer(bytes(payload), np.uint8)
         self._enter()
         try:

@@ -132,3 +132,17 @@ def test_hybrid_mesh_tp_sp_never_cross_dcn():
         # collide at e.g. tp*sp == dcn): every bulk collective's spans
         # are known, TP/SP ones present and slice-local
         assert out["tp_like"] > 0, out
+
+
+def test_moe_all_to_all_rides_expert_axis_only():
+    """EP invariant: the token-routing all_to_all pair (dispatch +
+    return, fwd + bwd) spans exactly the expert axis — never the dcn
+    tier — at any logical scale; dcn sees only the pure DP stage."""
+    from byteps_tpu.parallel.scaling_model import (lower_moe_step,
+                                                   verify_moe_schedule)
+    for n, dcn in ((16, 2), (64, 4)):
+        lowered, info = lower_moe_step(n, dcn=dcn)
+        sched = collective_schedule(lowered, n, dcn=dcn,
+                                    axis_sizes=info["axis_sizes"])
+        out = verify_moe_schedule(sched, info)
+        assert out["all_to_all"] == 4, out   # fwd+bwd x dispatch+return
