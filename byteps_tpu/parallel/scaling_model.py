@@ -433,6 +433,9 @@ def verify_moe_schedule(schedule: Sequence[Collective], info: Dict,
     dcn>1 they must EXIST (a schedule with no cross-slice stage means
     gradients are never synchronized across slices)."""
     bulk = [c for c in schedule if c.operand_bytes > small_bytes]
+    assert all(c.spans for c in bulk), \
+        "schedule lacks axis spans — pass axis_sizes to " \
+        "collective_schedule"
     a2a = [c for c in schedule if c.kind == "all_to_all"]
     assert a2a, "MoE step lowered no all_to_all — routing vanished?"
     for c in a2a:

@@ -190,14 +190,20 @@ def ring_allreduce(n_workers: int, nbytes: int, rate: float,
 def ps_exchange(n_workers: int, n_servers: int, nbytes: int, rate: float,
                 latency: float = 0.0, iters: int = 1,
                 partition_bytes: Optional[int] = None,
-                colocated: bool = False, verify: bool = True) -> float:
+                colocated: bool = False, verify: bool = True,
+                compression: Optional[Dict[str, str]] = None) -> float:
     """One PS sync round (push G, pull merged G) per iteration through
     the REAL transport stack, every endpoint throttled.
 
     ``colocated=True`` models servers running ON the worker machines:
     server j shares worker j's Nic (j mod n_workers), so its traffic
     competes for the same emulated port — the deployment where the
-    reference itself says PS stops winning."""
+    reference itself says PS stops winning.
+
+    ``compression`` (reference-format kwargs, e.g. onebit) rides the
+    real compressed wire: workers push codec payloads, the (native)
+    server codec decompresses/sums/recompresses — LOSSY, so verify is
+    skipped; the point is wire time where bandwidth is the bottleneck."""
     import os
     from ..common.naming import NameRegistry
     from .engine import PSServer
@@ -244,6 +250,7 @@ def ps_exchange(n_workers: int, n_servers: int, nbytes: int, rate: float,
     elems = nbytes // 4
     datas = [np.random.RandomState(100 + i).randn(elems).astype(np.float32)
              for i in range(n_workers)]
+    verify = verify and not compression   # lossy codec: timing only
     want = np.sum(datas, axis=0) if verify else None
 
     reg = NameRegistry()
@@ -253,17 +260,21 @@ def ps_exchange(n_workers: int, n_servers: int, nbytes: int, rate: float,
     # server's NIC (+25% measured). Placement balance is precisely what
     # BYTEPS_KEY_HASH_FN exists to tune in the reference
     try:
+        if compression:
+            reg.declare("lb", **compression)
         remotes = [RemotePSBackend(addrs, nic=worker_nics[i],
                                    hash_fn="naive")
                    for i in range(n_workers)]
         exs = [PSGradientExchange(remotes[i],
                                   partition_bytes=partition_bytes,
-                                  registry=reg)
+                                  registry=reg, min_compress_bytes=0)
                for i in range(n_workers)]
-        # one worker pre-plans so concurrent init_key never races the plan
-        exs[0]._plan({"g": datas[0]}, None)
-        for ex in exs[1:]:
-            ex._plans = exs[0]._plans
+        # SEQUENTIAL pre-planning: every worker builds its own plan (and
+        # its own compressor chains — per-worker state) before the
+        # threads start, so concurrent first-use init_key never races;
+        # server-side init is idempotent
+        for ex in exs:
+            ex._plan({"g": datas[0]}, "lb" if compression else None)
     except BaseException:
         for s in servers:
             s.close()
@@ -280,7 +291,9 @@ def ps_exchange(n_workers: int, n_servers: int, nbytes: int, rate: float,
         try:
             for _ in range(iters):
                 barrier.wait()
-                results[i] = exs[i].exchange({"g": datas[i]})["g"]
+                results[i] = exs[i].exchange(
+                    {"g": datas[i]},
+                    name="lb" if compression else None)["g"]
                 barrier.wait()
         except BaseException as e:   # noqa: BLE001
             errors.append(e)

@@ -152,12 +152,12 @@ def compressed_push(store: CompressedKeyStore, backend, key: int,
     """Decompress → dense push into the summation engine (reference:
     BytePSServerEngineThread decompress before SUM_RECV, server.cc:86-113)."""
     kind, codec = _native_codec(store, backend, key)
-    if kind is not None and len(bytes(payload)) != codec.payload_nbytes():
+    if kind is not None and len(payload) != codec.payload_nbytes():
         # same strictness as the Python decompress (which raises on a
         # mis-sized buffer): a truncated frame must not be silently
         # mis-split into garbage indices/values by the native scatter
         raise ValueError(
-            f"key {key}: compressed payload is {len(bytes(payload))} "
+            f"key {key}: compressed payload is {len(payload)} "
             f"bytes, codec expects {codec.payload_nbytes()}")
     if kind == "onebit":
         backend.push_onebit(key, payload)

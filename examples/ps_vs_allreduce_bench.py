@@ -36,6 +36,10 @@ def main() -> None:
     ap.add_argument("--colocated", action="store_true",
                     help="ALSO measure servers sharing worker NICs (the "
                          "regime where PS is expected to LOSE)")
+    ap.add_argument("--compressed", action="store_true",
+                    help="ALSO measure onebit-compressed PS (lossy; "
+                         "G/32 wire bytes through the native server "
+                         "codec)")
     args = ap.parse_args()
 
     n = args.workers
@@ -53,10 +57,15 @@ def main() -> None:
           f"PS {floor_ps:.3f} s")
     hdr = ("| BW MB/s | lat ms | ring s | PS s | PS/ring speedup "
            "| predicted | ")
+    ncols = 6
     if args.colocated:
         hdr += "PS-colocated s | "
+        ncols += 1
+    if args.compressed:
+        hdr += "PS-onebit s | "
+        ncols += 1
     print(hdr)
-    print("|" + "---|" * (7 if args.colocated else 6))
+    print("|" + "---|" * ncols)
     for rate_mb in (float(r) for r in args.rates.split(",")):
         for lat_ms in (float(x) for x in args.latencies.split(",")):
             rate, lat = rate_mb * 1e6, lat_ms * 1e-3
@@ -70,6 +79,13 @@ def main() -> None:
                 t_colo = ps_exchange(n, s, G, rate, lat,
                                      iters=args.iters, colocated=True)
                 row += f" {t_colo:.3f} |"
+            if args.compressed:
+                t_c = ps_exchange(n, s, G, rate, lat, iters=args.iters,
+                                  compression={
+                                      "compressor_type": "onebit",
+                                      "compressor_onebit_scaling":
+                                          "true"})
+                row += f" {t_c:.3f} |"
             print(row, flush=True)
     print(json.dumps({"metric": "ps_vs_allreduce_sweep_done", "n": n,
                       "s": s, "G_mb": args.mbytes}))

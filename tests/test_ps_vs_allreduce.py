@@ -80,3 +80,18 @@ def test_ps_colocated_loses_to_ring():
     t_ring = ring_allreduce(n, G, B, iters=2)
     t_colo = ps_exchange(n, n, G, B, iters=2, colocated=True)
     assert t_colo > t_ring, (t_colo, t_ring)
+
+
+def test_compressed_ps_crushes_bandwidth_bound_regime():
+    """onebit-compressed PS (G/32 wire bytes through the native server
+    codec) must beat BOTH dense PS and ring by a wide margin when
+    bandwidth is the bottleneck — this is what gradient compression is
+    FOR (reference: docs/gradient-compression.md)."""
+    n, G, B = 4, 2 << 20, 10e6
+    t_ring = ring_allreduce(n, G, B, iters=2)
+    t_ps = ps_exchange(n, n, G, B, iters=2)
+    t_psc = ps_exchange(n, n, G, B, iters=2,
+                        compression={"compressor_type": "onebit",
+                                     "compressor_onebit_scaling": "true"})
+    assert t_psc < t_ring / 3, (t_psc, t_ring)
+    assert t_psc < t_ps, (t_psc, t_ps)
