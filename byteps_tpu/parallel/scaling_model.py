@@ -634,6 +634,20 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     rows = scaling_table(args.compute_ms / 1e3, configs=configs,
                          seq=args.seq)
     print(format_table(rows))
+    # one-stop evidence: also verify the hybrid (TP/SP) and MoE (EP)
+    # schedules at a multi-slice size
+    lowered, info = lower_hybrid_step(64, dcn=4,
+                                      partition_bytes=64 << 10)
+    sched = collective_schedule(lowered, 64, dcn=4,
+                                axis_sizes=info["axis_sizes"])
+    verify_hybrid_schedule(sched, info)
+    lowered, info = lower_moe_step(64, dcn=4)
+    sched = collective_schedule(lowered, 64, dcn=4,
+                                axis_sizes=info["axis_sizes"])
+    verify_moe_schedule(sched, info)
+    print("hybrid (dcn×data×seq×model) and MoE (dcn×data×expert) "
+          "schedules verified at 64 devices: TP/SP/EP collectives "
+          "never cross the dcn tier")
 
 
 if __name__ == "__main__":
