@@ -1,0 +1,111 @@
+"""Encoder-decoder (T5-style) model family: shapes, learning, TP
+exactness, and trainer integration (additive beyond the reference's
+zoo — no seq2seq exists in its example/ tree)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from byteps_tpu.models import t5
+from byteps_tpu.parallel.mesh import make_mesh
+
+
+def test_shapes_and_finite_loss():
+    cfg = t5.t5_tiny()
+    params = t5.init_t5_params(jax.random.PRNGKey(0), cfg)
+    src, tgt = t5.synth_seq2seq_batch(np.random.RandomState(0), 4, 16,
+                                      12, cfg.vocab_size)
+    mem = t5.encode(params, cfg, jnp.asarray(src))
+    assert mem.shape == (4, 16, cfg.hidden)
+    hid = t5.decode(params, cfg, jnp.asarray(tgt[:, :-1]), mem)
+    assert hid.shape == (4, 11, cfg.hidden)
+    loss = t5.seq2seq_loss(params, cfg, (jnp.asarray(src),
+                                         jnp.asarray(tgt)))
+    assert np.isfinite(float(loss))
+
+
+def test_copy_task_learns():
+    """The decoder must learn to copy the source through the
+    cross-attention path — loss drops well below the uniform floor."""
+    cfg = t5.t5_tiny(remat=False)
+    params = t5.init_t5_params(jax.random.PRNGKey(1), cfg)
+    src, tgt = t5.synth_seq2seq_batch(np.random.RandomState(1), 16, 12,
+                                      10, cfg.vocab_size)
+    batch = (jnp.asarray(src), jnp.asarray(tgt))
+    tx = optax.adam(3e-3)
+    state = tx.init(params)
+
+    @jax.jit
+    def step(p, s):
+        loss, g = jax.value_and_grad(
+            lambda p: t5.seq2seq_loss(p, cfg, batch))(p)
+        u, s = tx.update(g, s, p)
+        return optax.apply_updates(p, u), s, loss
+
+    l0 = None
+    for i in range(60):
+        params, state, loss = step(params, state)
+        if l0 is None:
+            l0 = float(loss)
+    assert float(loss) < l0 * 0.5, (l0, float(loss))
+
+
+def test_tp2_matches_tp1():
+    """Tensor-parallel training over 2 model shards must match the
+    single-device model: one ShardedTrainer SGD step (its per-leaf grad
+    sync owns the psum/rescale conventions) vs a plain optax step."""
+    import byteps_tpu as bps
+    from byteps_tpu.training import ShardedTrainer
+    cfg1 = t5.t5_tiny(remat=False)
+    cfg2 = t5.t5_tiny(remat=False, tp_axis="model")
+    params = t5.init_t5_params(jax.random.PRNGKey(2), cfg1)
+    src, tgt = t5.synth_seq2seq_batch(np.random.RandomState(2), 4, 12,
+                                      10, cfg1.vocab_size)
+    batch = (jnp.asarray(src), jnp.asarray(tgt))
+
+    tx = optax.sgd(0.1)
+    g = jax.grad(lambda p: t5.seq2seq_loss(p, cfg1, batch))(params)
+    u, _ = tx.update(g, tx.init(params), params)
+    want = optax.apply_updates(params, u)
+
+    mesh = make_mesh({"model": 2}, devices=jax.devices()[:2])
+    bps.init(mesh=mesh)
+    try:
+        tr = ShardedTrainer(lambda p, b: t5.seq2seq_loss(p, cfg2, b),
+                            params, t5.t5_param_specs(cfg2),
+                            optax.sgd(0.1), mesh=mesh, batch_spec=P())
+        tr.step(batch)
+        got = jax.tree_util.tree_map(np.asarray, tr.params)
+    finally:
+        bps.shutdown()
+    flat_w, _ = jax.tree_util.tree_flatten(want)
+    flat_g, _ = jax.tree_util.tree_flatten(got)
+    for a, b_ in zip(flat_g, flat_w):
+        # bf16 compute: the biggest per-leaf drift observed is ~5e-4 on
+        # post-psum bias grads; anything structural is orders larger
+        np.testing.assert_allclose(a, np.asarray(b_), rtol=2e-2,
+                                   atol=2e-3)
+
+
+def test_trainer_integration():
+    """DistributedTrainer drives the seq2seq family like any other."""
+    import byteps_tpu as bps
+    from byteps_tpu.training import DistributedTrainer
+    bps.init()
+    try:
+        cfg = t5.t5_tiny()
+        params = t5.init_t5_params(jax.random.PRNGKey(3), cfg)
+        tr = DistributedTrainer(
+            lambda p, b: t5.seq2seq_loss(p, cfg, b), params,
+            optax.adamw(1e-3))
+        src, tgt = t5.synth_seq2seq_batch(np.random.RandomState(3), 8,
+                                          16, 12, cfg.vocab_size)
+        l0 = float(tr.step((src, tgt)))
+        for _ in range(5):
+            l = float(tr.step((src, tgt)))
+        assert np.isfinite(l) and l < l0
+    finally:
+        bps.shutdown()
