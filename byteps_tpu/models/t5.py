@@ -148,7 +148,10 @@ def t5_param_specs(cfg: T5Config):
 
 # ------------------------------------------------------------------ layers
 
-def _self_attention(x, blk, cfg: T5Config, causal: bool, tp_size: int):
+def _self_attention(x, blk, cfg: T5Config, causal: bool):
+    # local sibling of transformer._attention rather than a reuse: the
+    # encoder/decoder pair varies ``causal`` per stack (the shared fn
+    # reads it from its config) and T5 has no sp_axis/ring branch
     b, s, _ = x.shape
     qkv = jnp.einsum("bsh,hcnd->bscnd", x, blk["qkv"].astype(x.dtype))
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
@@ -179,33 +182,24 @@ def _cross_attention(x, memory, blk, cfg: T5Config):
     return out
 
 
-def _enc_block(x, blk, cfg: T5Config, tp_size: int):
+def _enc_block(x, blk, cfg: T5Config):
     x = x + _self_attention(
         _layernorm(x, blk["ln1"]["scale"], blk["ln1"]["bias"]),
-        blk, cfg, False, tp_size)
-    mcfg = _MLPShim(cfg.tp_axis)
+        blk, cfg, False)
+    # transformer._mlp reads only cfg.tp_axis, which T5Config has
     return x + _mlp(_layernorm(x, blk["ln2"]["scale"], blk["ln2"]["bias"]),
-                    blk, mcfg)
+                    blk, cfg)
 
 
-def _dec_block(x, memory, blk, cfg: T5Config, tp_size: int):
+def _dec_block(x, memory, blk, cfg: T5Config):
     x = x + _self_attention(
         _layernorm(x, blk["ln1"]["scale"], blk["ln1"]["bias"]),
-        blk, cfg, True, tp_size)
+        blk, cfg, True)
     x = x + _cross_attention(
         _layernorm(x, blk["lnx"]["scale"], blk["lnx"]["bias"]),
         memory, blk, cfg)
-    mcfg = _MLPShim(cfg.tp_axis)
     return x + _mlp(_layernorm(x, blk["ln2"]["scale"], blk["ln2"]["bias"]),
-                    blk, mcfg)
-
-
-class _MLPShim:
-    """transformer._mlp only reads cfg.tp_axis — hand it exactly that."""
-    __slots__ = ("tp_axis",)
-
-    def __init__(self, tp_axis):
-        self.tp_axis = tp_axis
+                    blk, cfg)
 
 
 # ------------------------------------------------------------------ model
@@ -219,9 +213,8 @@ def _embed(params, cfg: T5Config, tokens):
 
 def encode(params, cfg: T5Config, src_tokens: jnp.ndarray) -> jnp.ndarray:
     """Encoder memory [b, s_src, hidden]."""
-    tp_size = jax.lax.axis_size(cfg.tp_axis) if cfg.tp_axis else 1
     x = _embed(params, cfg, src_tokens)
-    fn = partial(_enc_block, cfg=cfg, tp_size=tp_size)
+    fn = partial(_enc_block, cfg=cfg)
     if cfg.remat:
         fn = jax.checkpoint(fn)
 
@@ -236,9 +229,8 @@ def encode(params, cfg: T5Config, src_tokens: jnp.ndarray) -> jnp.ndarray:
 def decode(params, cfg: T5Config, tgt_tokens: jnp.ndarray,
            memory: jnp.ndarray) -> jnp.ndarray:
     """Decoder hidden states [b, s_tgt, hidden] (teacher forcing)."""
-    tp_size = jax.lax.axis_size(cfg.tp_axis) if cfg.tp_axis else 1
     x = _embed(params, cfg, tgt_tokens)
-    fn = partial(_dec_block, cfg=cfg, tp_size=tp_size)
+    fn = partial(_dec_block, cfg=cfg)
     if cfg.remat:
         fn = jax.checkpoint(fn)
     x, _ = jax.lax.scan(lambda c, b: (fn(c, memory, b), None), x,
