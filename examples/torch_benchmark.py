@@ -59,6 +59,9 @@ def main() -> None:
     ap.add_argument("--num-warmup-batches", type=int, default=2)
     ap.add_argument("--num-batches-per-iter", type=int, default=5)
     ap.add_argument("--num-iters", type=int, default=5)
+    ap.add_argument("--cross-barrier", action="store_true",
+                    help="per-parameter scheduled optimizer "
+                         "(bps.CrossBarrier; docs/cross-barrier.md)")
     args = ap.parse_args()
 
     bps.init()
@@ -69,8 +72,15 @@ def main() -> None:
         torch.optim.SGD(model.parameters(), lr=0.01),
         named_parameters=model.named_parameters(),
         compression=compression)
+    if args.cross_barrier:
+        total = args.num_warmup_batches + \
+            args.num_iters * args.num_batches_per_iter
+        optimizer = bps.CrossBarrier(model, optimizer,
+                                     num_steps=total + 2)
     bps.broadcast_parameters(model.state_dict(), root_rank=0)
     bps.broadcast_optimizer_state(optimizer, root_rank=0)
+    if args.cross_barrier:
+        optimizer.step()               # step 0: init (reference flow)
 
     data = torch.randn(args.batch_size, 3, 32, 32)
     target = torch.randint(0, args.num_classes, (args.batch_size,))
