@@ -121,16 +121,18 @@ class CompressedKeyStore:
 
 
 def _native_codec(store: CompressedKeyStore, backend, key: int):
-    """(kind, codec) when the key's chain runs fully in C++ (fused
+    """(kind, codec) when the key's chain can run in C++ (fused
     decompress→enqueue / pull→recompress; reference: server.cc:86-113
     does codec work inside the engine, not in per-connection
-    interpreter threads): bare onebit or topk on fp32. EF/momentum
-    chains, randomk (stateful RNG lives in the Python chain), and
-    other codecs keep the Python path."""
+    interpreter threads): bare onebit or topk on fp32 natively both
+    ways; bare randomk pushes natively (same wire/scatter as topk)
+    while its RECOMPRESS keeps the Python chain (the stateful
+    XorShift lives there). EF/momentum chains and other codecs keep
+    the Python path end to end."""
     import os
     if os.environ.get("BPS_NATIVE_CODEC", "1") in ("0", "false"):
         return None, None      # A/B knob: force the Python codec path
-    from ..ops.compression.host import HostOnebit, HostTopk
+    from ..ops.compression.host import HostOnebit, HostRandomk, HostTopk
     codec = store._codecs.get(key)
     if codec is None or codec.dtype != np.float32:
         return None, None
@@ -138,6 +140,13 @@ def _native_codec(store: CompressedKeyStore, backend, key: int):
         return "onebit", codec
     if type(codec) is HostTopk and hasattr(backend, "push_topk"):
         return "topk", codec
+    if type(codec) is HostRandomk and hasattr(backend, "push_topk"):
+        # randomk's (idx|vals) wire layout and last-wins scatter are
+        # identical to topk, so the PUSH side decompress+sum runs
+        # native; the RECOMPRESS keeps the Python chain (its
+        # worker-synchronized XorShift state lives there) — half the
+        # codec work still leaves the GIL
+        return "randomk_push", codec
     return None, None
 
 
@@ -162,7 +171,7 @@ def compressed_push(store: CompressedKeyStore, backend, key: int,
     if kind == "onebit":
         backend.push_onebit(key, payload)
         return
-    if kind == "topk":
+    if kind in ("topk", "randomk_push"):
         backend.push_topk(key, payload)
         return
     backend.push(key, store.decompress(key, payload))
@@ -177,6 +186,8 @@ def compressed_pull(store: CompressedKeyStore, backend, key: int,
     if buf is not None:
         return buf
     kind, codec = _native_codec(store, backend, key)
+    if kind == "randomk_push":
+        kind = None                   # pull side: Python chain + cache
     if kind is not None:
         if kind == "onebit":
             buf = backend.pull_onebit(key, codec.payload_nbytes(),

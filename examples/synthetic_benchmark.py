@@ -71,6 +71,14 @@ def build(model: str, batch: int):
             [tokens[:, 1:], np.full((batch, 1), -1, np.int32)], axis=1)
         data = (tokens, targets)
         loss_fn = lambda p, b: moe.moe_lm_loss(p, cfg, b)
+    elif model.startswith("t5"):
+        from byteps_tpu.models import t5
+        cfg = {"t5-small": t5.t5_small, "t5-tiny": t5.t5_tiny}[model]()
+        params = t5.init_t5_params(jax.random.PRNGKey(0), cfg)
+        src_len = min(cfg.max_seq, 256)
+        data = t5.synth_seq2seq_batch(rng, batch, src_len,
+                                      src_len // 2, cfg.vocab_size)
+        loss_fn = lambda p, b: t5.seq2seq_loss(p, cfg, b)
     else:
         raise SystemExit(f"unknown model {model}")
     return params, data, loss_fn

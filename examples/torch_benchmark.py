@@ -104,6 +104,10 @@ def main() -> None:
     print(f"Img/sec per worker: {mean:.1f} +- {conf:.1f}")
     print(f"Total img/sec on {bps.size()} worker(s): "
           f"{bps.size() * mean:.1f} +- {bps.size() * conf:.1f}")
+    if args.cross_barrier:
+        # explicit flush+stop: exact step arithmetic must never decide
+        # whether in-flight per-parameter updates survive shutdown
+        optimizer.close()
     bps.shutdown()
 
 

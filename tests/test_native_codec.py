@@ -193,18 +193,50 @@ def test_native_topk_routes_over_transport(monkeypatch):
     np.testing.assert_allclose(results["0"], results["1"], rtol=1e-6)
 
 
-def test_randomk_stays_on_python_path():
-    """RandomK's worker-synchronized RNG lives in the Python chain —
-    the native router must not claim it."""
+def test_randomk_native_push_python_pull():
+    """RandomK: the (idx|vals) push decompress+sum runs native (same
+    wire/scatter as topk), but the RECOMPRESS must stay on the Python
+    chain — its worker-synchronized XorShift state lives there."""
+    from byteps_tpu.ops.compression.host import HostRandomk
     from byteps_tpu.server.compressed import (CompressedKeyStore,
-                                              _native_codec)
+                                              _native_codec,
+                                              compressed_pull,
+                                              compressed_push)
     store = CompressedKeyStore()
     srv = PSServer(num_workers=1, engine_threads=1)
     try:
-        store.register(6, {"compressor_type": "randomk",
-                           "compressor_k": "16", "seed": "7"},
-                       256, "float32")
+        kw = {"compressor_type": "randomk", "compressor_k": "16",
+              "seed": "7"}
+        store.register(6, kw, 256, "float32")
         kind, _ = _native_codec(store, srv, 6)
-        assert kind is None
+        assert kind == "randomk_push"
+        srv.init_key(6, 256 * 4, "float32")
+        worker = HostRandomk(256, "float32", 16, seed=7)
+        x = np.random.RandomState(3).randn(256).astype(np.float32)
+        payload = worker.compress(x)
+        compressed_push(store, srv, 6, payload)       # native scatter
+        got = compressed_pull(store, srv, 6, 1)
+        out = worker.decompress(got)
+        assert out.shape == (256,) and np.isfinite(out).all()
     finally:
         srv.close()
+    # A/B parity: the seeded server chain recompresses deterministically,
+    # so a FRESH server on the forced-Python path must produce the
+    # byte-identical pulled payload — catches any native scatter/split
+    # regression that still yields finite floats
+    import os
+    os.environ["BPS_NATIVE_CODEC"] = "0"
+    try:
+        store2 = CompressedKeyStore()
+        srv2 = PSServer(num_workers=1, engine_threads=1)
+        try:
+            store2.register(6, kw, 256, "float32")
+            assert _native_codec(store2, srv2, 6)[0] is None
+            srv2.init_key(6, 256 * 4, "float32")
+            compressed_push(store2, srv2, 6, payload)
+            want = compressed_pull(store2, srv2, 6, 1)
+            assert got == want, "native push diverged from Python path"
+        finally:
+            srv2.close()
+    finally:
+        os.environ.pop("BPS_NATIVE_CODEC", None)
