@@ -42,9 +42,14 @@ def shutdown() -> None:
     GlobalState.shutdown()
 
 
+_suspended_config = None
+
+
 def suspend() -> None:
     """Tear down, remembering tensor declarations (reference: byteps_suspend)."""
-    global _suspended_decls
+    global _suspended_decls, _suspended_config
+    if GlobalState.initialized():
+        _suspended_config = GlobalState.get().config
     _suspended_decls = GlobalState.suspend()
 
 
@@ -54,9 +59,17 @@ def resume(num_worker: Optional[int] = None, config: Optional[Config] = None,
     stays stable (reference: byteps_resume, operations.cc:96-112)."""
     global _suspended_decls
     if config is None:
+        import os
         overrides = {}
         if num_worker is not None:
             overrides["num_worker"] = num_worker
+        # host_only is sticky across suspend/resume: torch init sets it
+        # PROGRAMMATICALLY (default-on, no env var), so a from-env
+        # rebuild would silently drop it and resume() would hang in
+        # device discovery on a dead tunnel. An explicit env var wins.
+        if _suspended_config is not None \
+                and "BPS_HOST_ONLY" not in os.environ:
+            overrides["host_only"] = _suspended_config.host_only
         config = Config.from_env(**overrides)
     GlobalState.resume(_suspended_decls, config, mesh=mesh)
     _suspended_decls = None
@@ -71,6 +84,8 @@ def rank() -> int:
     owns ``size() // jax.process_count()`` consecutive replica slots; for
     dataset sharding use ``rank()`` with ``local_size()`` replicas, or just
     ``DistributedTrainer.shard_batch`` which handles placement."""
+    if _host_only():
+        return GlobalState.get().config.worker_id
     slots = size() // max(jax.process_count(), 1)
     global _warned_rank_granularity
     if slots > 1 and not _warned_rank_granularity:
@@ -93,12 +108,18 @@ def size() -> int:
     return jax.device_count()
 
 
+def _host_only() -> bool:
+    return GlobalState.initialized() and GlobalState.get().config.host_only
+
+
 def local_rank() -> int:
     cfg = GlobalState.get().config if GlobalState.initialized() else Config.from_env()
     return cfg.local_rank
 
 
 def local_size() -> int:
+    if _host_only():
+        return GlobalState.get().config.local_size
     return jax.local_device_count()
 
 

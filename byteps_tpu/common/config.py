@@ -81,6 +81,12 @@ class Config:
     # --- PS / server mode (reference: server.cc:407-439) ---
     enable_async: bool = False           # BYTEPS_ENABLE_ASYNC
     enable_ps: bool = False              # route push_pull through host PS service
+    host_only: bool = False              # BPS_HOST_ONLY: no device mesh / no
+                                         # JAX backend discovery — the runtime
+                                         # is the host PS plane only (the torch
+                                         # plugin's numpy-over-TCP path; keeps
+                                         # init alive when the accelerator
+                                         # tunnel is unreachable)
     server_addrs: str = ""               # BPS_SERVER_ADDRS: host:port,... of
                                          # standalone servers (empty → in-process)
     server_engine_threads: int = 4       # BYTEPS_SERVER_ENGINE_THREAD
@@ -124,6 +130,7 @@ class Config:
             scheduling_credit=_env_int("BPS_SCHEDULING_CREDIT", "BYTEPS_SCHEDULING_CREDIT", 0),
             enable_async=_env_bool("BPS_ENABLE_ASYNC", "BYTEPS_ENABLE_ASYNC"),
             enable_ps=_env_bool("BPS_ENABLE_PS", "BYTEPS_ENABLE_PS"),
+            host_only=_env_bool("BPS_HOST_ONLY", None),
             server_addrs=_env("BPS_SERVER_ADDRS", None, ""),
             server_engine_threads=_env_int("BPS_SERVER_ENGINE_THREAD", "BYTEPS_SERVER_ENGINE_THREAD", 4),
             server_enable_schedule=_env_bool("BPS_SERVER_ENABLE_SCHEDULE", "BYTEPS_SERVER_ENABLE_SCHEDULE"),

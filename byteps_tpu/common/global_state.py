@@ -22,25 +22,75 @@ from .naming import NameRegistry
 log = get_logger()
 
 
+class _HostOnlyEngine:
+    """Engine stand-in for host-only mode (``BPS_HOST_ONLY`` / the torch
+    plugin): carries the PS host-exchange plane with NO device mesh and
+    NO JAX backend discovery. The torch path is numpy-over-TCP end to
+    end (torch/ops.py), so forcing accelerator discovery at init —
+    which hangs when the TPU tunnel is down — bought nothing. Collective
+    entry points raise with a pointer at the full engine."""
+
+    def __init__(self) -> None:
+        self.ps_exchange = None
+        self.ps_world = 1
+        self.timeline = None
+        self.debug_sample = ""
+        self._handles: dict = {}
+
+    def _no_mesh(self, api: str):
+        raise RuntimeError(
+            f"{api} needs a device mesh, but the runtime was initialised "
+            "host-only (BPS_HOST_ONLY / byteps_tpu.torch). Re-init via "
+            "byteps_tpu.init() (or BPS_HOST_ONLY=0) for the collective "
+            "engine.")
+
+    def push_pull(self, *a, **k):
+        self._no_mesh("push_pull")
+
+    def push_pull_async(self, *a, **k):
+        self._no_mesh("push_pull_async")
+
+    def poll(self, *a, **k):
+        self._no_mesh("poll")
+
+    def synchronize(self, *a, **k):
+        self._no_mesh("synchronize")
+
+    def broadcast(self, *a, **k):
+        self._no_mesh("broadcast")
+
+
 class GlobalState:
     _instance: Optional["GlobalState"] = None
     _lock = threading.Lock()
 
     def __init__(self, config: Config, mesh=None) -> None:
-        from ..parallel.mesh import make_mesh, dp_size
-        from ..parallel.collectives import PushPullEngine
         from ..telemetry import PushPullSpeed
         from ..timeline import Timeline
 
         self.config = config
         self.registry = NameRegistry()
-        self.mesh = mesh if mesh is not None else make_mesh()
         self.telemetry = PushPullSpeed() if config.telemetry_on else None
         self.timeline = Timeline(config) if config.trace_on else None
-        self.engine = PushPullEngine(
-            self.mesh, partition_bytes=config.partition_bytes,
-            registry=self.registry, telemetry=self.telemetry,
-            scheduling_credit=config.scheduling_credit)
+        if config.host_only:
+            if mesh is not None:
+                raise ValueError(
+                    "host_only config with an explicit mesh is "
+                    "contradictory — drop BPS_HOST_ONLY (or the mesh) ")
+            # host-only: PS plane without any accelerator backend —
+            # jax.devices() (and the axon tunnel behind it) is never
+            # touched, so torch PS workers init even with the TPU
+            # tunnel dead (the numpy path never needed a device)
+            self.mesh = None
+            self.engine = _HostOnlyEngine()
+        else:
+            from ..parallel.mesh import make_mesh
+            from ..parallel.collectives import PushPullEngine
+            self.mesh = mesh if mesh is not None else make_mesh()
+            self.engine = PushPullEngine(
+                self.mesh, partition_bytes=config.partition_bytes,
+                registry=self.registry, telemetry=self.telemetry,
+                scheduling_credit=config.scheduling_credit)
         self.engine.timeline = self.timeline
         self.engine.debug_sample = config.debug_sample_tensor
         self.ps_backend = None
@@ -81,10 +131,16 @@ class GlobalState:
                     min_compress_bytes=config.min_compress_bytes)
                 self.engine.ps_exchange.timeline = self.timeline
                 self.engine.ps_world = config.num_worker
-        self.dp = dp_size(self.mesh)
+        if self.mesh is None:
+            self.dp = config.num_worker
+        else:
+            from ..parallel.mesh import dp_size
+            self.dp = dp_size(self.mesh)
         self.step = 0
         log.info("BPS init: role=%s mesh=%s dp=%d partition_bytes=%d",
-                 config.role, dict(self.mesh.shape), self.dp, config.partition_bytes)
+                 config.role,
+                 "host-only" if self.mesh is None else dict(self.mesh.shape),
+                 self.dp, config.partition_bytes)
 
     # -- lifecycle ----------------------------------------------------------
     @classmethod
@@ -93,7 +149,8 @@ class GlobalState:
             if cls._instance is not None:
                 return cls._instance
             cfg = config or Config.from_env()
-            if cfg.coordinator_address and cfg.num_processes and cfg.num_processes > 1:
+            if (not cfg.host_only and cfg.coordinator_address
+                    and cfg.num_processes and cfg.num_processes > 1):
                 jax.distributed.initialize(
                     coordinator_address=cfg.coordinator_address,
                     num_processes=cfg.num_processes, process_id=cfg.process_id)

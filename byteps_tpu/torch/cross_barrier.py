@@ -42,7 +42,7 @@ import time
 
 import torch
 
-from .ops import poll, size, synchronize
+from .ops import size, synchronize
 
 __all__ = ["CrossBarrier"]
 
@@ -76,6 +76,7 @@ class CrossBarrier:
         self._stop = threading.Event()
         self._error = None
         self._poller = None
+        self._ungated: set = set()
         if size() > 1:
             # intercept the parent's dispatch: every push_pull now also
             # takes the param's lock and lands on the poller's queue
@@ -101,13 +102,26 @@ class CrossBarrier:
         update after the user already mutated lr for the next step (lr
         schedulers run at iteration top), and the update must use the
         values in force when its gradient was produced — serial
-        semantics, exactly."""
+        semantics, exactly.
+
+        EVENT-DRIVEN: the item lands on the applier queue from the
+        exchange future's done-callback, so the applier thread only
+        ever sees LANDED exchanges — no poll/re-queue spinning, and no
+        wakeups charged against compute while results are still on the
+        wire."""
         self._locks[p].acquire()
         try:
             g = self._child_group[p]
             hyper = {k: v for k, v in g.items() if k != "params"}
             handle, ctx = self._orig_dispatch(p)
-            self._queue.put((p, handle, ctx, hyper))
+            item = (p, handle, ctx, hyper)
+            if handle is None:
+                self._queue.put(item)
+            else:
+                from .ops import _Dispatcher
+                fut, _, _ = _Dispatcher.peek(handle)
+                fut.add_done_callback(
+                    lambda _f, _item=item: self._queue.put(_item))
         except BaseException:
             # a leaked lock would hang the next forward forever; release
             # and let the exception surface retryably from backward
@@ -134,6 +148,9 @@ class CrossBarrier:
         return child
 
     def _poll_loop(self):
+        """Applier loop: every queued item's exchange has ALREADY landed
+        (done-callback enqueue, see _dispatch), so each pass is
+        synchronize → decompress → child step, with no busy polling."""
         while not self._stop.is_set():
             try:
                 item = self._queue.get(timeout=0.1)
@@ -142,10 +159,6 @@ class CrossBarrier:
             if item is None:
                 break
             p, handle, ctx, hyper = item
-            if handle is not None and not poll(handle):
-                self._queue.put(item)      # not landed yet; recheck soon
-                time.sleep(0.0005)
-                continue
             try:
                 if handle is not None:
                     out = synchronize(handle)
@@ -161,7 +174,20 @@ class CrossBarrier:
                 # (non-None) grad would be re-dispatched every step and
                 # momentum/weight-decay would keep moving the param
                 p.grad = None
+                # drop the parent's stale handle entry so the next
+                # backward's hook doesn't trip on an already-applied
+                # exchange (safe: the hook can only write a NEW entry
+                # from _dispatch, which blocks on the lock we hold)
+                if self._opt._handles.get(p, (None,))[0] is handle:
+                    self._opt._handles.pop(p, None)
             except BaseException as e:   # noqa: BLE001 — re-raised on the
+                # restore dispatchability first: a delay stuck at 0 (or
+                # a live grad) would raise the misleading "more than
+                # backward_passes_per_step" assertion on the NEXT
+                # backward before step() could surface the real error
+                self._opt._push_pull_delay[p] = \
+                    self._opt.backward_passes_per_step
+                p.grad = None
                 self._error = e          # training thread via step/flush
             finally:
                 self._locks[p].release()
@@ -176,9 +202,41 @@ class CrossBarrier:
                 if lock is not None:
                     with lock:       # wait until the poller released it
                         pass
+        covered = set()
         for mod in self._model.modules():
-            if next(mod.parameters(recurse=False), None) is not None:
+            direct = list(mod.parameters(recurse=False))
+            if direct:
                 mod.register_forward_pre_hook(pre_hook)
+                covered.update(direct)
+        # Params NOT read through their owning module's forward
+        # (functional application, tied weights) bypass the gate above:
+        # their backward hook can fire while last step's update is
+        # still in flight. Those get a fallback wait in a WRAPPED
+        # backward hook instead — correct, at the cost of blocking
+        # backward on that one param's in-flight update.
+        self._ungated = set(self._locks) - covered
+        if self._ungated:
+            opt = self._opt
+            for h in opt._hook_handles:
+                h.remove()
+            opt._hook_handles = []
+            inner = opt._make_hook()
+
+            def gated_hook(p):
+                if p in self._ungated:
+                    lock = self._locks.get(p)
+                    if lock is not None:
+                        with lock:   # in-flight update applied
+                            pass
+                    opt._handles.pop(p, None)
+                inner(p)
+
+            for g in opt.param_groups:
+                for p in g["params"]:
+                    if p.requires_grad:
+                        opt._hook_handles.append(
+                            p.register_post_accumulate_grad_hook(
+                                gated_hook))
 
     # -- optimizer surface -------------------------------------------------
 
@@ -202,6 +260,15 @@ class CrossBarrier:
             for p, (handle, ctx) in list(opt._handles.items()):
                 if handle is None:
                     opt._handles[p] = opt._push_pull_grad_async(p)
+            # ungated params (no owning-module forward to gate): the
+            # next forward reads them with NO lock, so their in-flight
+            # updates must land before step() returns — overlap is kept
+            # for every module-gated param
+            for p in self._ungated:
+                lock = self._locks.get(p)
+                if lock is not None:
+                    with lock:
+                        pass
             loss = closure() if closure is not None else None
             self._step_count += 1
             if self._step_count >= self._final_step:
@@ -212,12 +279,12 @@ class CrossBarrier:
         self._step_count += 1
         return loss
 
-    def zero_grad(self, set_to_none: bool = False):
+    def zero_grad(self, set_to_none: bool = True):
         """No-op after step 1: the poller zeroes each grad right after
         its per-parameter update (zeroing here would race in-flight
         exchanges)."""
         if size() <= 1 or self._step_count == 0:
-            self._opt.zero_grad()
+            self._opt.zero_grad(set_to_none=set_to_none)
 
     def flush(self, timeout: float = 60.0):
         """Block until every in-flight exchange has been applied — use

@@ -25,9 +25,19 @@ from ..common.global_state import GlobalState
 
 
 def init(config=None, **kwargs) -> None:
-    """bps.init() for torch scripts (lazy import keeps jax out of the
-    hot path)."""
+    """bps.init() for torch scripts.
+
+    Defaults to HOST-ONLY mode (no device mesh, no JAX backend
+    discovery): the torch plugin's wire is numpy-over-TCP end to end,
+    so touching accelerator discovery at init only added a hang risk
+    when the TPU tunnel is unreachable. Set ``BPS_HOST_ONLY=0`` to get
+    the full collective engine in the same process (mixed torch+JAX
+    scripts)."""
     import byteps_tpu as bps
+    if config is None and not GlobalState.initialized():
+        from ..common.config import Config, _env_bool
+        config = Config.from_env(
+            host_only=_env_bool("BPS_HOST_ONLY", None, default=True))
     bps.init(config=config, **kwargs)
 
 
@@ -174,27 +184,46 @@ class _Dispatcher:
         """``start`` runs on a push worker and must return a resolver
         whose call (on a pull worker) yields the reduced array."""
         import heapq
-        cls._ensure_pool()
         fut: Future = Future()
-        with cls._lock:
-            h = cls._next
-            cls._next += 1
-            cls._handles[h] = (fut, out, inplace)
-            seq = h
-        with cls._cv:
-            heapq.heappush(cls._pq, (priority, seq, start, fut))
-            cls._cv.notify()
-        return h
+        while True:
+            cls._ensure_pool()
+            with cls._lock:
+                if cls._pq is None:
+                    continue   # reset() raced _ensure_pool; rebuild
+                # enqueue while STILL holding cls._lock: reset() swaps
+                # the generation under the same lock, so capture-then-
+                # push-outside would let it retire this generation (and
+                # clear _handles) between the two — the exchange would
+                # land on a dead queue and its future never resolve
+                h = cls._next
+                cls._next += 1
+                cls._handles[h] = (fut, out, inplace)
+                with cls._cv:
+                    heapq.heappush(cls._pq, (priority, h, start, fut))
+                    cls._cv.notify()
+                return h
 
     @classmethod
     def take(cls, handle: int):
         with cls._lock:
-            return cls._handles.pop(handle)
+            try:
+                return cls._handles.pop(handle)
+            except KeyError:
+                raise RuntimeError(
+                    f"unknown push_pull handle {handle} — already "
+                    "synchronized, or the dispatcher was reset/"
+                    "shut down") from None
 
     @classmethod
     def peek(cls, handle: int):
         with cls._lock:
-            return cls._handles[handle]
+            try:
+                return cls._handles[handle]
+            except KeyError:
+                raise RuntimeError(
+                    f"unknown push_pull handle {handle} — already "
+                    "synchronized, or the dispatcher was reset/"
+                    "shut down") from None
 
     @classmethod
     def auto_name(cls) -> str:
@@ -210,7 +239,8 @@ class _Dispatcher:
             cv, cls._cv = cls._cv, None
             pullq, cls._pullq = cls._pullq, None
             stop, cls._stop_evt = cls._stop_evt, None
-            cls._pq = None
+            pq, cls._pq = cls._pq, None
+            handles = dict(cls._handles)
             cls._handles.clear()
         if cv is not None:
             with cv:
@@ -220,6 +250,23 @@ class _Dispatcher:
                 pullq.put(None)       # wake & stop pull workers
             for t in threads:
                 t.join(timeout=5)
+            # push workers exit on stop without draining: fail any
+            # leftover queued exchanges so their waiters get an error,
+            # not a silent hang (shutdown with undrained handles is
+            # already warned about upstream)
+            with cv:
+                leftovers, pq[:] = list(pq), []
+            for _, h, _, f in leftovers:
+                if not f.done():
+                    f.set_exception(RuntimeError(
+                        "push_pull dispatcher was shut down before this "
+                        "exchange started"))
+                    # re-expose the handle so the waiter's synchronize()
+                    # surfaces THIS error rather than an unknown-handle
+                    # one (the wholesale clear above removed it)
+                    if h in handles:
+                        with cls._lock:
+                            cls._handles.setdefault(h, handles[h])
 
 
 def _exchange_np(arr: np.ndarray, average: bool, name: str) -> np.ndarray:

@@ -183,6 +183,110 @@ def test_poller_error_surfaces_on_step(fake_world2, monkeypatch):
             time.sleep(0.01)
     opt.close()
 
+def test_functional_param_outside_model_gated_in_backward(fake_world2):
+    """A parameter the optimizer owns but NO module's forward reads
+    (functional application) bypasses the per-module forward gate — it
+    must fall back to a wait in its backward hook instead of tripping
+    the backward_passes_per_step assertion while its update is still in
+    flight (r3 advisor finding)."""
+    steps = 6
+    sm = _mlp(6)
+    s_free = torch.nn.Parameter(torch.tensor(0.5))
+    s_opt = torch.optim.SGD(list(sm.parameters()) + [s_free], lr=0.05)
+    x, y = _data()
+    serial = []
+    for _ in range(steps):
+        s_opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(sm(x) * s_free, y)
+        loss.backward()
+        s_opt.step()
+        serial.append(float(loss))
+
+    model = _mlp(6)
+    free = torch.nn.Parameter(torch.tensor(0.5))
+    opt = bps.DistributedOptimizer(
+        torch.optim.SGD(list(model.parameters()) + [free], lr=0.05),
+        named_parameters=list(model.named_parameters()) + [("free", free)])
+    opt = bps.CrossBarrier(model, opt, num_steps=10 ** 6)
+    assert opt._ungated == {free}
+    losses = []
+    for _ in range(steps):
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(x) * free, y)
+        loss.backward()
+        opt.step()
+        losses.append(float(loss))
+    opt.flush()
+    np.testing.assert_allclose(losses, serial, rtol=1e-5, atol=1e-7)
+    opt.close()
+
+
+def test_zero_grad_forwards_set_to_none():
+    """world-1 delegation must honor set_to_none=False (torch optimizer
+    contract: grads become zero tensors, not None)."""
+    bps.init()
+    try:
+        model = _mlp(7)
+        opt = bps.CrossBarrier(model, bps.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.05),
+            named_parameters=model.named_parameters()))
+        x, y = _data()
+        torch.nn.functional.mse_loss(model(x), y).backward()
+        opt.zero_grad(set_to_none=False)
+        for p in model.parameters():
+            assert p.grad is not None and torch.count_nonzero(p.grad) == 0
+        torch.nn.functional.mse_loss(model(x), y).backward()
+        opt.zero_grad()                    # default: torch's set_to_none
+        assert all(p.grad is None for p in model.parameters())
+        opt.close()
+    finally:
+        bps.shutdown()
+
+
+def test_poller_error_keeps_next_backward_dispatchable(fake_world2,
+                                                       monkeypatch):
+    """After a poller-side failure the param's delay must be re-armed:
+    the NEXT backward should dispatch normally and the REAL error (not
+    a misleading accumulate-count assertion) surface from step()
+    (r3 advisor finding)."""
+    model = _mlp(8)
+    opt = bps.CrossBarrier(model, bps.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.01),
+        named_parameters=model.named_parameters()), num_steps=10 ** 6)
+    x, y = _data()
+    opt.step()                             # step 0
+    fail_once = {"armed": True}
+    real_ex = ops_mod._exchange_np
+
+    def flaky(arr, average, name):
+        if fail_once["armed"]:
+            fail_once["armed"] = False
+            raise ConnectionError("transient wire error")
+        return real_ex(arr, average, name)
+
+    monkeypatch.setattr(ops_mod, "_exchange_np", flaky)
+    torch.nn.functional.mse_loss(model(x), y).backward()
+    try:
+        opt.step()       # poller may have surfaced the error already
+    except ConnectionError:
+        pass
+    # drain the in-flight applies so the error has landed
+    for _ in range(200):
+        try:
+            opt.flush()
+            break
+        except ConnectionError:
+            time.sleep(0.01)
+    # next iteration must not raise the accumulate-count AssertionError
+    torch.nn.functional.mse_loss(model(x), y).backward()
+    try:
+        opt.step()
+    except ConnectionError:
+        pass                               # stored error surfacing: fine
+    opt.flush()
+    opt.close()
+
+
 def test_documented_usage_without_init_step(fake_world2):
     """The docs show plain `backward(); step()` with NO bare init step —
     in-flight exchanges at step 0 must take the scheduled path, not a
