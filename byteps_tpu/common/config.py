@@ -95,6 +95,13 @@ class Config:
     # --- key placement (reference: global.cc:158-180) ---
     key_hash_fn: str = "djb2"            # naive|built_in|djb2|sdbm
 
+    # --- emulated-NIC throttle for this worker endpoint (perf lab:
+    # charges all RemotePSBackend traffic to a throttle.Nic so
+    # multi-process training A/Bs run under a bandwidth constraint;
+    # 0 = off) ---
+    emu_nic_rate: float = 0.0            # BPS_EMU_NIC_RATE bytes/sec
+    emu_nic_latency: float = 0.0         # BPS_EMU_NIC_LATENCY seconds/frame
+
     # --- compression (reference: global.cc:137-139) ---
     min_compress_bytes: int = 65536      # BYTEPS_MIN_COMPRESS_BYTES default 64KiB
 
@@ -135,6 +142,8 @@ class Config:
             server_engine_threads=_env_int("BPS_SERVER_ENGINE_THREAD", "BYTEPS_SERVER_ENGINE_THREAD", 4),
             server_enable_schedule=_env_bool("BPS_SERVER_ENABLE_SCHEDULE", "BYTEPS_SERVER_ENABLE_SCHEDULE"),
             key_hash_fn=_env("BPS_KEY_HASH_FN", "BYTEPS_KEY_HASH_FN", "djb2"),
+            emu_nic_rate=float(_env("BPS_EMU_NIC_RATE", None, "0") or 0),
+            emu_nic_latency=float(_env("BPS_EMU_NIC_LATENCY", None, "0") or 0),
             min_compress_bytes=_env_int("BPS_MIN_COMPRESS_BYTES", "BYTEPS_MIN_COMPRESS_BYTES", 65536),
             trace_on=_env_bool("BPS_TRACE_ON", "BYTEPS_TRACE_ON"),
             trace_start_step=_env_int("BPS_TRACE_START_STEP", "BYTEPS_TRACE_START_STEP", 10),

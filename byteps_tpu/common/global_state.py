@@ -104,9 +104,14 @@ class GlobalState:
                 from ..server.transport import RemotePSBackend
                 addrs = [a.strip() for a in config.server_addrs.split(",")
                          if a.strip()]
+                nic = None
+                if config.emu_nic_rate > 0:
+                    from ..server.throttle import Nic
+                    nic = Nic(config.emu_nic_rate,
+                              latency=config.emu_nic_latency)
                 self.ps_backend = RemotePSBackend(
                     addrs, hash_fn=config.key_hash_fn,
-                    async_mode=config.enable_async)
+                    async_mode=config.enable_async, nic=nic)
             else:
                 if config.num_worker > 1:
                     raise ValueError(

@@ -69,6 +69,34 @@ def predicted_times(n_workers: int, n_servers: int, nbytes: int,
 from .transport import _recv_exact
 
 
+def ring_rounds(tx, rx, view: np.ndarray, n: int, i: int) -> None:
+    """The bandwidth-optimal ring schedule on an open (tx, rx) pair:
+    n-1 reduce-scatter rounds then n-1 all-gather rounds over
+    ``view`` ([n, chunk] fp32, modified in place). Each round sends on
+    a helper thread while receiving — full-duplex, like NCCL's ring.
+    Shared by the one-shot bench (``ring_allreduce``) and the
+    persistent training peer (``train_emu.RingPeer``)."""
+    chunk = view.shape[1]
+    for step in range(n - 1):              # reduce-scatter
+        s_idx = (i - step) % n
+        r_idx = (i - step - 1) % n
+        snd = threading.Thread(target=tx.sendall,
+                               args=(view[s_idx].tobytes(),))
+        snd.start()
+        got = np.frombuffer(_recv_exact(rx, chunk * 4), np.float32)
+        snd.join()
+        view[r_idx] += got
+    for step in range(n - 1):              # all-gather
+        s_idx = (i + 1 - step) % n
+        r_idx = (i - step) % n
+        snd = threading.Thread(target=tx.sendall,
+                               args=(view[s_idx].tobytes(),))
+        snd.start()
+        got = np.frombuffer(_recv_exact(rx, chunk * 4), np.float32)
+        snd.join()
+        view[r_idx] = got
+
+
 def ring_allreduce(n_workers: int, nbytes: int, rate: float,
                    latency: float = 0.0, iters: int = 1,
                    verify: bool = True) -> float:
@@ -122,32 +150,7 @@ def ring_allreduce(n_workers: int, nbytes: int, rate: float,
             for _ in range(iters):
                 barrier.wait()
                 x = datas[i].copy()
-                view = x.reshape(n, chunk)
-                # reduce-scatter: after n-1 steps worker i owns the
-                # full sum of chunk (i+1) % n
-                for step in range(n - 1):
-                    s_idx = (i - step) % n
-                    r_idx = (i - step - 1) % n
-                    snd = threading.Thread(
-                        target=tx.sendall,
-                        args=(view[s_idx].tobytes(),))
-                    snd.start()
-                    got = np.frombuffer(_recv_exact(rx, chunk * 4),
-                                        np.float32)
-                    snd.join()
-                    view[r_idx] += got
-                # all-gather: forward the completed chunks around
-                for step in range(n - 1):
-                    s_idx = (i + 1 - step) % n
-                    r_idx = (i - step) % n
-                    snd = threading.Thread(
-                        target=tx.sendall,
-                        args=(view[s_idx].tobytes(),))
-                    snd.start()
-                    got = np.frombuffer(_recv_exact(rx, chunk * 4),
-                                        np.float32)
-                    snd.join()
-                    view[r_idx] = got
+                ring_rounds(tx, rx, x.reshape(n, chunk), n, i)
                 results[i] = x
                 barrier.wait()
         except BaseException as e:   # noqa: BLE001 — surfaced below

@@ -1,0 +1,473 @@
+"""END-TO-END training A/B under emulated NICs: ring vs PS vs
+PS+compression vs PS+CrossBarrier.
+
+Round 3 proved the PS pattern's bandwidth win at the EXCHANGE level
+(allreduce_emu.py: one G-byte round through throttled sockets). The
+reference's claim is stronger — "double the *training speed*"
+(reference: README.md:9,46; docs/performance.md whole-model img/s
+tables) — so this module trains a real torch model end to end with
+N REAL worker processes, all gradient traffic charged to per-endpoint
+``throttle.Nic`` token buckets, and compares:
+
+  - ``ring``   — bucketed ring allreduce between the worker processes
+    (reduce-scatter + all-gather over throttled TCP), with backward
+    OVERLAP: grads enter a comm thread's queue the moment autograd
+    produces them (hook order is identical across workers, so the
+    collectives match). This is the Horovod-style baseline, given the
+    same courtesy overlap the PS arm gets from its dispatcher.
+  - ``ps``     — the torch plugin path: ``DistributedOptimizer`` over
+    ``s = n`` standalone throttled PS servers (the reference's win
+    condition: spare server NICs).
+  - ``ps_onebit`` — same, with the onebit codec registered on every
+    Gradient.* key ≥ BPS_MIN_COMPRESS_BYTES: 32× fewer wire bytes,
+    decompress→sum→recompress on the (native) server engine.
+  - ``cb``     — ``ps`` + ``CrossBarrier`` per-parameter scheduling.
+
+Every worker feeds the SAME global batch, so ring / ps / cb loss
+trajectories must equal serial single-process training bit-for-bit
+(CI-asserted in tests/test_train_emu.py); onebit is lossy and is
+asserted on convergence instead. samples/sec is measured per mode.
+
+Run ``examples/ps_training_ab.py`` for the sweep table in
+docs/performance.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .throttle import Nic, ThrottledSocket
+
+__all__ = ["RingPeer", "run_training", "serial_reference"]
+
+
+# --------------------------------------------------------------------------
+# process-based ring
+# --------------------------------------------------------------------------
+
+class RingPeer:
+    """One worker process's membership in a ring over throttled TCP.
+
+    Worker i accepts from worker i-1 and dials worker i+1 (mod n); both
+    directions are charged to THIS endpoint's ``Nic``. ``allreduce``
+    runs the bandwidth-optimal reduce-scatter + all-gather (2(n-1)
+    steps, each moving ceil(len/n) elements), the same schedule as
+    ``allreduce_emu.ring_allreduce`` but persistent across calls so a
+    training loop can reuse the wiring every step."""
+
+    def __init__(self, index: int, n: int, ports: List[int],
+                 rate: float, latency: float = 0.0,
+                 connect_timeout: float = 60.0) -> None:
+        self.i, self.n = index, n
+        nic = Nic(rate, latency) if rate > 0 else None
+
+        # bind with retry: the parent probed these ports as free, but
+        # each worker spends seconds importing torch before binding —
+        # a stranger can grab the port in that window (TOCTOU). Retry
+        # absorbs TIME_WAIT and transient squatters; a persistent owner
+        # still surfaces as EADDRINUSE at the deadline.
+        deadline0 = time.time() + connect_timeout / 2
+        while True:
+            ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                ls.bind(("127.0.0.1", ports[index]))
+                break
+            except OSError:
+                ls.close()
+                if time.time() >= deadline0:
+                    raise
+                time.sleep(0.1)
+        ls.listen(1)
+        self._listener = ls
+
+        # dial the next peer with retry (it may not be listening yet),
+        # accepting from the previous peer concurrently — a sequential
+        # connect-then-accept deadlocks the ring at n=2
+        nxt = ("127.0.0.1", ports[(index + 1) % n])
+        out_sock: List[Optional[socket.socket]] = [None]
+        err: List[BaseException] = []
+
+        def dial() -> None:
+            deadline = time.time() + connect_timeout
+            while True:
+                try:
+                    s = socket.create_connection(nxt, timeout=2.0)
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    s.settimeout(None)
+                    out_sock[0] = s
+                    return
+                except OSError as e:
+                    if time.time() >= deadline:
+                        err.append(e)
+                        return
+                    time.sleep(0.05)
+
+        t = threading.Thread(target=dial, daemon=True)
+        t.start()
+        ls.settimeout(connect_timeout)
+        conn, _ = ls.accept()
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        t.join()
+        if err:
+            raise err[0]
+        self._tx_raw, self._rx_raw = out_sock[0], conn
+        if nic is not None:
+            self._tx = ThrottledSocket(out_sock[0], nic)
+            self._rx = ThrottledSocket(conn, nic)
+        else:
+            self._tx, self._rx = out_sock[0], conn
+
+    def allreduce(self, x: np.ndarray) -> np.ndarray:
+        """In-place-ish sum-allreduce of a flat fp32 array; returns the
+        summed array (padded schedule, result trimmed)."""
+        from .allreduce_emu import ring_rounds
+        n = self.n
+        if n == 1:
+            return x
+        elems = x.size
+        chunk = -(-elems // n)
+        buf = np.zeros(chunk * n, np.float32)
+        buf[:elems] = x
+        ring_rounds(self._tx, self._rx, buf.reshape(n, chunk), n, self.i)
+        return buf[:elems]
+
+    def close(self) -> None:
+        for s in (self._tx_raw, self._rx_raw, self._listener):
+            try:
+                s.close()
+            except Exception:
+                pass
+
+
+# --------------------------------------------------------------------------
+# worker process body (one per mode; dispatched by __main__ below)
+# --------------------------------------------------------------------------
+
+def _build_model(width: int, depth: int):
+    import torch
+    torch.manual_seed(0)
+    layers = []
+    for _ in range(depth):
+        layers += [torch.nn.Linear(width, width), torch.nn.Tanh()]
+    return torch.nn.Sequential(*layers)
+
+
+def _global_batch(width: int, batch: int):
+    import torch
+    rs = np.random.RandomState(1)
+    x = torch.tensor(rs.randn(batch, width), dtype=torch.float32)
+    y = torch.tensor(rs.randn(batch, width), dtype=torch.float32)
+    return x, y
+
+
+def serial_reference(steps: int, width: int = 256, depth: int = 8,
+                     batch: int = 64, lr: float = 0.05) -> List[float]:
+    """Single-process torch training on the same global batch — the
+    trajectory every lossless distributed mode must reproduce."""
+    import torch
+    model = _build_model(width, depth)
+    opt = torch.optim.SGD(model.parameters(), lr=lr)
+    x, y = _global_batch(width, batch)
+    losses = []
+    for _ in range(steps):
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        losses.append(float(loss))
+    return losses
+
+
+def _worker_ring() -> Dict:
+    """Ring-allreduce worker with backward OVERLAP: a post-accumulate
+    hook enqueues each param's grad; a comm thread ring-allreduces them
+    in registration order (identical on every worker, so the n
+    collectives pair correctly) while later grads are still being
+    computed; step() drains."""
+    import queue as _q
+
+    import torch
+
+    i = int(os.environ["TRAIN_EMU_RANK"])
+    n = int(os.environ["TRAIN_EMU_WORLD"])
+    ports = json.loads(os.environ["TRAIN_EMU_RING_PORTS"])
+    rate = float(os.environ["TRAIN_EMU_RATE"])
+    latency = float(os.environ.get("TRAIN_EMU_LATENCY", "0"))
+    steps = int(os.environ["TRAIN_EMU_STEPS"])
+    width = int(os.environ["TRAIN_EMU_WIDTH"])
+    depth = int(os.environ["TRAIN_EMU_DEPTH"])
+    batch = int(os.environ["TRAIN_EMU_BATCH"])
+    lr = float(os.environ["TRAIN_EMU_LR"])
+
+    ring = RingPeer(i, n, ports, rate, latency)
+    model = _build_model(width, depth)
+    opt = torch.optim.SGD(model.parameters(), lr=lr)
+    x, y = _global_batch(width, batch)
+
+    # comm thread: ring collectives must run in the SAME order on every
+    # worker; autograd hook order (reverse layer) is deterministic for
+    # this model, so hook-order draining is safe — the same contract the
+    # PS arm's declaration-order keys rely on
+    q: "_q.Queue" = _q.Queue()
+    pending: List = []
+    errs: List[BaseException] = []
+
+    def comm() -> None:
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            p, done = item
+            try:
+                flat = p.grad.detach().numpy().ravel().astype(
+                    np.float32, copy=True)
+                summed = ring.allreduce(flat) / n
+                with torch.no_grad():
+                    p.grad.copy_(torch.from_numpy(
+                        summed.reshape(p.grad.shape)))
+            except BaseException as e:   # noqa: BLE001 — joined in step
+                errs.append(e)
+            finally:
+                done.set()
+
+    ct = threading.Thread(target=comm, daemon=True)
+    ct.start()
+
+    def make_hook():
+        def hook(p):
+            done = threading.Event()
+            pending.append(done)
+            q.put((p, done))
+        return hook
+
+    for p in model.parameters():
+        p.register_post_accumulate_grad_hook(make_hook())
+
+    losses = []
+    t0 = None
+    warm = 1
+    for step in range(steps + warm):
+        if step == warm:
+            t0 = time.perf_counter()
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        for done in pending:              # drain this step's collectives
+            if not done.wait(120):
+                raise TimeoutError(
+                    "ring allreduce did not complete within 120s — "
+                    "hung peer or a NIC rate too slow for this model")
+        pending.clear()
+        if errs:
+            raise errs[0]
+        opt.step()
+        losses.append(float(loss))
+    dt = time.perf_counter() - t0
+    q.put(None)
+    ct.join(10)
+    ring.close()
+    return {"sps": batch * steps / dt, "losses": losses}
+
+
+def _worker_ps() -> Dict:
+    """PS-mode worker: the real torch plugin over throttled transport.
+    mode ps_onebit registers the onebit codec on every Gradient.* key
+    before the optimizer declares them (first-declare-wins kwargs);
+    mode cb wraps with CrossBarrier."""
+    import torch
+
+    import byteps_tpu.torch as bps
+
+    mode = os.environ["TRAIN_EMU_MODE"]
+    steps = int(os.environ["TRAIN_EMU_STEPS"])
+    width = int(os.environ["TRAIN_EMU_WIDTH"])
+    depth = int(os.environ["TRAIN_EMU_DEPTH"])
+    batch = int(os.environ["TRAIN_EMU_BATCH"])
+    lr = float(os.environ["TRAIN_EMU_LR"])
+
+    model = _build_model(width, depth)
+    bps.init()
+    if mode == "ps_onebit":
+        for name, _ in model.named_parameters():
+            bps.declare("Gradient." + name, compressor_type="onebit",
+                        compressor_onebit_scaling="true")
+    opt = torch.optim.SGD(model.parameters(), lr=lr)
+    opt = bps.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+    if mode == "cb":
+        opt = bps.CrossBarrier(model, opt, num_steps=10 ** 6)
+    bps.broadcast_parameters(model.state_dict(), root_rank=0)
+    x, y = _global_batch(width, batch)
+
+    losses = []
+    t0 = None
+    warm = 1
+    if mode == "cb":
+        opt.step()                        # step 0 (init)
+    for step in range(steps + warm):
+        if step == warm:
+            if mode == "cb":
+                opt.flush()               # timing starts clean
+            t0 = time.perf_counter()
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        losses.append(float(loss))
+    if mode == "cb":
+        opt.flush()
+    dt = time.perf_counter() - t0
+    if mode == "cb":
+        opt.close()
+    bps.shutdown()
+    return {"sps": batch * steps / dt, "losses": losses}
+
+
+def _worker_main() -> None:
+    mode = os.environ["TRAIN_EMU_MODE"]
+    out = _worker_ring() if mode == "ring" else _worker_ps()
+    print("TRAIN_EMU_RESULT " + json.dumps(out), flush=True)
+
+
+# --------------------------------------------------------------------------
+# parent-side orchestration
+# --------------------------------------------------------------------------
+
+def _free_ports(k: int) -> List[int]:
+    socks, ports = [], []
+    for _ in range(k):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def run_training(mode: str, n_workers: int, rate: float,
+                 latency: float = 0.0, steps: int = 8, width: int = 256,
+                 depth: int = 8, batch: int = 64, lr: float = 0.05,
+                 timeout: float = 600.0,
+                 partition_bytes: Optional[int] = None) -> Dict:
+    """Launch ``n_workers`` worker processes in ``mode`` and return
+    {"sps": min-over-workers samples/sec, "losses": worker-0 trajectory}.
+    ``losses`` covers EVERY step including the 1 untimed warmup, so it
+    compares 1:1 against ``serial_reference(steps + 1)``; ``sps`` times
+    only the post-warmup window (the first step pays connection dials
+    and key-init RPCs).
+
+    PS modes start ``n_workers`` standalone throttled servers in THIS
+    process (each with its own Nic — the reference's extra-server-NICs
+    win condition); the ring needs no servers."""
+    assert mode in ("ring", "ps", "ps_onebit", "cb"), mode
+    env = dict(
+        os.environ,
+        TRAIN_EMU_MODE=mode, TRAIN_EMU_WORLD=str(n_workers),
+        TRAIN_EMU_RATE=str(rate), TRAIN_EMU_LATENCY=str(latency),
+        TRAIN_EMU_STEPS=str(steps), TRAIN_EMU_WIDTH=str(width),
+        TRAIN_EMU_DEPTH=str(depth), TRAIN_EMU_BATCH=str(batch),
+        TRAIN_EMU_LR=str(lr),
+    )
+    # the shm/IPC data planes bypass the throttled sockets — pin off
+    for k in ("BPS_ENABLE_SHM", "BPS_ENABLE_IPC", "BYTEPS_ENABLE_IPC"):
+        env.pop(k, None)
+    # ~32 KB buckets: the torch path's per-PARAM exchanges otherwise
+    # ride 256 KB buckets whose coarse frames pace poorly under
+    # contended token buckets AND delay each round's completion —
+    # measured 1516 -> 590 ms/step at 5 MB/s x 4 workers (the exchange
+    # rig independently landed on ~the same bucket size). NOT for the
+    # compressed mode: 33 KB buckets sit under the 64 KB compression
+    # floor, silently disabling the codec — and its wire frames are
+    # 32x smaller anyway, so coarse per-param buckets pace fine.
+    # Forced (not setdefault): an inherited BPS_PARTITION_BYTES from
+    # the calling process (e.g. conftest.py) must not leak in —
+    # callers choose via the partition_bytes parameter.
+    if partition_bytes is not None:
+        env["BPS_PARTITION_BYTES"] = str(partition_bytes)
+    elif mode != "ps_onebit":
+        env["BPS_PARTITION_BYTES"] = "33000"
+    else:
+        env.pop("BPS_PARTITION_BYTES", None)
+
+    servers, backends = [], []
+    procs: List[subprocess.Popen] = []
+    try:
+        if mode == "ring":
+            env["TRAIN_EMU_RING_PORTS"] = json.dumps(_free_ports(n_workers))
+        else:
+            from .engine import PSServer
+            from .transport import PSTransportServer
+            for _ in range(n_workers):        # s = n (non-colocated)
+                be = PSServer(num_workers=n_workers, engine_threads=1)
+                srv = PSTransportServer(
+                    be, host="127.0.0.1", port=0,
+                    nic=Nic(rate, latency) if rate > 0 else None)
+                backends.append(be)
+                servers.append(srv)
+            env.update(
+                BPS_ENABLE_PS="1",
+                BPS_NUM_WORKER=str(n_workers),
+                BPS_SERVER_ADDRS=",".join(
+                    f"127.0.0.1:{s.port}" for s in servers),
+                # round-robin bucket placement across the server shards
+                # (allreduce_emu.py measured djb2 hotspotting +25%)
+                BPS_KEY_HASH_FN="naive",
+                BPS_EMU_NIC_RATE=str(rate),
+                BPS_EMU_NIC_LATENCY=str(latency),
+            )
+        for wid in range(n_workers):
+            wenv = dict(env, TRAIN_EMU_RANK=str(wid),
+                        BPS_WORKER_ID=str(wid))
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "byteps_tpu.server.train_emu"],
+                env=wenv, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True,
+                cwd=os.path.dirname(os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__))))))
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                out, _ = p.communicate()
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for s in servers:
+            s.close()
+        for be in backends:
+            be.close()
+    results = []
+    for wid, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"{mode} worker {wid} failed:\n{out[-3000:]}")
+        line = [ln for ln in out.splitlines()
+                if ln.startswith("TRAIN_EMU_RESULT ")]
+        if not line:
+            raise RuntimeError(f"{mode} worker {wid}: no result\n"
+                               f"{out[-2000:]}")
+        results.append(json.loads(line[-1].split(" ", 1)[1]))
+    # the straggler sets training speed; trajectories must agree anyway
+    return {"sps": min(r["sps"] for r in results),
+            "losses": results[0]["losses"],
+            "all_losses": [r["losses"] for r in results]}
+
+
+if __name__ == "__main__":
+    _worker_main()

