@@ -121,14 +121,16 @@ class CompressedKeyStore:
 
 
 def _native_codec(store: CompressedKeyStore, backend, key: int):
-    """(kind, codec) when the key's chain can run in C++ (fused
-    decompress→enqueue / pull→recompress; reference: server.cc:86-113
-    does codec work inside the engine, not in per-connection
-    interpreter threads): bare onebit or topk on fp32 natively both
-    ways; bare randomk pushes natively (same wire/scatter as topk)
-    while its RECOMPRESS keeps the Python chain (the stateful
-    XorShift lives there). EF/momentum chains and other codecs keep
-    the Python path end to end."""
+    """(kind, codec) when the key's chain can run FULLY FUSED in C++
+    (zero-Python decompress→enqueue / pull→recompress; reference:
+    server.cc:86-113 does codec work inside the engine, not in
+    per-connection interpreter threads): bare onebit or topk on fp32
+    both ways; bare randomk pushes fused (same wire/scatter as topk).
+    Everything else — EF chains, dithering, randomk's recompress,
+    non-fp32 keys — routes through the Python chain whose heavy legs
+    are themselves native primitives (host.py ``_native``: C++ loops,
+    GIL released, chain state stays in Python), so "not fused" no
+    longer means "interpreted"."""
     import os
     if os.environ.get("BPS_NATIVE_CODEC", "1") in ("0", "false"):
         return None, None      # A/B knob: force the Python codec path

@@ -76,14 +76,35 @@ inline float half_to_float(uint16_t h) {
 }
 
 inline uint16_t float_to_half(float f) {
+  // round-to-nearest-even, subnormal-preserving — matches numpy's
+  // float32→float16 cast so native f16 sums agree with the numpy
+  // reference path elementwise (the previous truncate-and-flush form
+  // biased sums low by up to 1 ulp per add)
   uint32_t bits;
   std::memcpy(&bits, &f, 4);
   uint32_t sign = (bits >> 16) & 0x8000;
-  int32_t exp = (int32_t)((bits >> 23) & 0xFF) - 127 + 15;
-  uint32_t man = bits & 0x7FFFFF;
-  if (exp <= 0) return (uint16_t)sign;               // flush to zero
-  if (exp >= 31) return (uint16_t)(sign | 0x7C00);   // inf
-  return (uint16_t)(sign | (exp << 10) | (man >> 13));
+  uint32_t absf = bits & 0x7FFFFFFF;
+  if (absf >= 0x7F800000)                            // inf / nan
+    return (uint16_t)(sign | 0x7C00 | ((absf > 0x7F800000) ? 0x200 : 0));
+  if (absf >= 0x477FF000)                            // overflow → inf
+    return (uint16_t)(sign | 0x7C00);
+  if (absf < 0x38800000) {                           // subnormal / zero
+    if (absf < 0x33000000) return (uint16_t)sign;    // underflow → 0
+    // h = round(1.man × 2^(e-103)): the 24-bit significand shifted
+    // right by 126-e (e ∈ [102,112] here, so the shift is 14..24 —
+    // well-defined), RNE on the dropped bits
+    uint32_t shift = 126 - (absf >> 23);
+    uint32_t man = (absf & 0x7FFFFF) | 0x800000;
+    uint32_t h = man >> shift;
+    uint32_t rem = man & ((1u << shift) - 1);
+    uint32_t half = 1u << (shift - 1);
+    if (rem > half || (rem == half && (h & 1))) h++;
+    return (uint16_t)(sign | h);
+  }
+  uint32_t h = (((absf >> 23) - 112) << 10) | ((absf >> 13) & 0x3FF);
+  uint32_t rem = absf & 0x1FFF;
+  if (rem > 0x1000 || (rem == 0x1000 && (h & 1))) h++;  // RNE
+  return (uint16_t)(sign | h);
 }
 
 inline float bf16_to_float(uint16_t h) {
@@ -778,6 +799,148 @@ int bps_server_pull_topk(void* h, uint64_t key, void* dst,
                          int timeout_ms) {
   return ((Server*)h)->PullTopk(key, dst, dst_len, want_round,
                                 timeout_ms);
+}
+
+// ---------------------------------------------------------------------
+// Standalone codec primitives (round 4). The per-key CHAIN state —
+// error-feedback accumulators, momentum buffers, XorShift128+ RNG
+// state — stays owned by the Python chain objects (host.py), which
+// pass raw buffers / state words in and out of these calls; the
+// O(n) loops run here with the GIL released. This is how every
+// registered compressor chain (dithering, randomk recompress, the
+// EF server chain, non-fp32 keys) leaves the Python interpreter,
+// complementing the zero-Python fused fp32 paths above (reference:
+// the server's engine does all codec work in C++,
+// server.cc:86-113; compressor_registry.cc:40-56).
+// ---------------------------------------------------------------------
+
+// XorShift128+, bit-exact with ops/compression/rng.py (reference:
+// compressor/utils.h:72-158): state {a, b}; the caller owns the words.
+static inline uint64_t xorshift128p_next(uint64_t* st) {
+  uint64_t t = st[0];
+  const uint64_t s = st[1];
+  st[0] = s;
+  t ^= t << 23;
+  t ^= t >> 17;
+  t ^= s ^ (s >> 26);
+  st[1] = t;
+  return t + s;
+}
+
+// (No onebit-compress primitive: numpy's SIMD packbits measured
+// FASTER than a scalar bit loop — compress stays numpy; the fused
+// server paths above own the zero-Python onebit lane.)
+
+// out[i] = ±scale from the packed bits (fp32). Matches
+// HostOnebit.decompress (the dtype cast stays in Python).
+void bps_codec_onebit_decompress(const unsigned char* p, uint64_t n,
+                                 float* out) {
+  const size_t chunks = ((size_t)n + 31) / 32;
+  float scale;
+  std::memcpy(&scale, p + chunks * 4, 4);
+  const float vals[2] = {scale, -scale};
+#pragma omp parallel for
+  for (size_t w = 0; w < chunks; ++w) {
+    uint32_t word;
+    std::memcpy(&word, p + w * 4, 4);
+    float* o = out + w * 32;
+    const size_t lim = (w * 32 + 32 <= n) ? 32 : ((size_t)n - w * 32);
+    for (size_t j = 0; j < lim; ++j)
+      o[j] = vals[(word >> (31 - j)) & 1u];
+  }
+}
+
+// k largest |x|, ties to the LOWER index, NaN ordered last — the
+// Python codec's stable argsort of -|x| (HostTopk.compress). idx_out
+// [k] int32, val_out [k] fp32 (dtype narrowing stays in Python).
+int bps_codec_topk_select(const float* x, uint64_t n, uint64_t k,
+                          int32_t* idx_out, float* val_out) {
+  if (k > n) return -1;
+  std::vector<int32_t> order((size_t)n);
+  for (size_t i = 0; i < n; ++i) order[i] = (int32_t)i;
+  auto cmp = [x](int32_t a, int32_t b) {
+    float fa = std::fabs(x[a]), fb = std::fabs(x[b]);
+    if (std::isnan(fa)) fa = -INFINITY;
+    if (std::isnan(fb)) fb = -INFINITY;
+    return fa != fb ? fa > fb : a < b;
+  };
+  std::nth_element(order.begin(), order.begin() + (size_t)k, order.end(),
+                   cmp);
+  std::sort(order.begin(), order.begin() + (size_t)k, cmp);
+  for (size_t i = 0; i < k; ++i) {
+    idx_out[i] = order[i];
+    val_out[i] = x[order[i]];
+  }
+  return 0;
+}
+
+// Scatter k (idx, val) pairs into a zeroed dense fp32 buffer;
+// duplicate indices LAST-WINS (the Python out[idx] = vals scatter).
+int bps_codec_scatter_f32(const int32_t* idx, const float* vals,
+                          uint64_t k, uint64_t n, float* out) {
+  std::memset(out, 0, (size_t)n * 4);
+  for (size_t i = 0; i < k; ++i) {
+    const int32_t j = idx[i];
+    if (j < 0 || (uint64_t)j >= n) return -1;
+    out[j] = vals[i];
+  }
+  return 0;
+}
+
+// k sequential draws of Randint(0, n_range) from the caller's
+// XorShift128+ state (updated in place) — HostRandomk's index stream,
+// so the server's randomk RECOMPRESS runs native, seeded from the
+// worker-synced state the Python chain maintains.
+void bps_codec_xorshift_indices(uint64_t n_range, uint64_t k,
+                                uint64_t* state, int32_t* idx_out) {
+  for (size_t i = 0; i < k; ++i)
+    idx_out[i] = (int32_t)(xorshift128p_next(state) % n_range);
+}
+
+// Seeded stochastic quantization, bit-exact with
+// HostDithering.compress (LINEAR {i/s} / NATURAL {2^(i-s)} levels;
+// reference: impl/dithering.{cc,h}). The RNG is SEQUENTIAL — the
+// Python seeded path loops per element in the interpreter, which is
+// exactly the loop that belongs here. ``scale`` is computed by the
+// caller (max or L2 — numpy's pairwise L2 sum is kept on both paths
+// by construction). qbits 8 → int8 out, else int16.
+void bps_codec_dithering_compress(const float* x, uint64_t n, float scale,
+                                  int s, int ptype, int qbits,
+                                  uint64_t* state, void* out_q) {
+  const float safe = scale > 0.0f ? scale : 1.0f;
+  int8_t* o8 = (int8_t*)out_q;
+  int16_t* o16 = (int16_t*)out_q;
+  const int LINEAR = 0;
+  for (size_t i = 0; i < n; ++i) {
+    // u BEFORE the branch, one draw per element, like _uniform(n)
+    const double u =
+        (double)xorshift128p_next(state) / 18446744073709551616.0;
+    const float ax = std::fabs(x[i]);
+    double q;
+    if (ptype == LINEAR) {
+      const float norm = ax / safe * (float)s;
+      const float fl = std::floor(norm);
+      q = (double)fl + (u < (double)(norm - fl) ? 1.0 : 0.0);
+    } else {
+      const uint32_t level = 1u << (s - 1);
+      const float norm = ax / safe * (float)level;
+      uint32_t c = (uint32_t)std::ceil(norm);
+      uint32_t v = (c > 1 ? c : 1) - 1;          // RoundNextPow2 >> 1
+      v |= v >> 1; v |= v >> 2; v |= v >> 4; v |= v >> 8; v |= v >> 16;
+      const float fl = (float)(((uint64_t)v + 1) >> 1);
+      // p in FLOAT, not double: numpy 2.x's np.where keeps float32
+      // (NEP 50 weak python scalars), so the reference path computes
+      // the f32-rounded quotient — a double quotient here can flip
+      // the u < p comparison on boundary draws (~2^-26/element)
+      const float length = fl != 0.0f ? fl : 1.0f;
+      const float p = (norm - fl) / length;
+      q = (double)fl + (double)length * (u < (double)p ? 1.0 : 0.0);
+    }
+    const float sg = x[i] > 0.0f ? 1.0f : (x[i] < 0.0f ? -1.0f : 0.0f);
+    const double sq = (double)sg * q;
+    if (qbits <= 8) o8[i] = (int8_t)sq;
+    else o16[i] = (int16_t)sq;
+  }
 }
 
 }  // extern "C"

@@ -34,9 +34,17 @@ def _lib() -> ctypes.CDLL:
         return _LIB
     here = os.path.join(os.path.dirname(__file__), "csrc")
     so = os.path.join(here, "libbps_server.so")
-    if not os.path.exists(so):
+    # run make unconditionally (not just when the .so is missing): the
+    # Makefile's source dependency decides whether to rebuild, so a
+    # stale .so from before a source change can never be dlopened with
+    # missing symbols (every binding below would AttributeError)
+    try:
         subprocess.run(["make", "-C", here], check=True,
                        capture_output=True)
+    except (subprocess.CalledProcessError, OSError):
+        if not os.path.exists(so):
+            raise                      # no library at all: surface it
+        # toolchain unavailable but a prebuilt .so exists — use it
     lib = ctypes.CDLL(so)
     lib.bps_server_create.restype = ctypes.c_void_p
     lib.bps_server_create.argtypes = [ctypes.c_int] * 4
@@ -75,6 +83,23 @@ def _lib() -> ctypes.CDLL:
     lib.bps_server_pull_topk.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
         ctypes.c_uint64, ctypes.c_int]
+    # standalone codec primitives (round 4): chain state stays in
+    # Python, O(n) loops run here — see host.py's _native routing
+    lib.bps_codec_onebit_decompress.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p]
+    lib.bps_codec_topk_select.restype = ctypes.c_int
+    lib.bps_codec_topk_select.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_void_p, ctypes.c_void_p]
+    lib.bps_codec_scatter_f32.restype = ctypes.c_int
+    lib.bps_codec_scatter_f32.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.c_uint64, ctypes.c_void_p]
+    lib.bps_codec_xorshift_indices.argtypes = [
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p]
+    lib.bps_codec_dithering_compress.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_float, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p]
     _LIB = lib
     return lib
 

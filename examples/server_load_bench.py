@@ -34,7 +34,8 @@ WORKER = textwrap.dedent("""
     import os, sys, time
     sys.path.insert(0, %(root)r)
     import numpy as np
-    from byteps_tpu.ops.compression.host import HostOnebit
+    from byteps_tpu.ops.compression.host import (HostDithering, HostOnebit,
+                                                 HostRandomk, HostTopk)
     from byteps_tpu.server.transport import RemotePSBackend
 
     addr = os.environ["LB_ADDR"]
@@ -42,10 +43,24 @@ WORKER = textwrap.dedent("""
     keys = int(os.environ["LB_KEYS"])
     elems = int(os.environ["LB_ELEMS"])
     rounds = int(os.environ["LB_ROUNDS"])
-    kw = {"compressor_type": "onebit", "compressor_onebit_scaling": "true"}
+    name = os.environ.get("LB_CODEC", "onebit")
+    if name == "onebit":
+        kw = {"compressor_type": "onebit",
+              "compressor_onebit_scaling": "true"}
+        codec = HostOnebit(elems, use_scale=True)
+    elif name == "topk":
+        kw = {"compressor_type": "topk", "compressor_k": str(elems // 100)}
+        codec = HostTopk(elems, "float32", elems // 100)
+    elif name == "randomk":
+        kw = {"compressor_type": "randomk",
+              "compressor_k": str(elems // 100), "seed": "13"}
+        codec = HostRandomk(elems, "float32", elems // 100, seed=13)
+    else:                                       # dithering (seeded)
+        kw = {"compressor_type": "dithering", "compressor_k": "4",
+              "seed": "13"}
+        codec = HostDithering(elems, s=4, seed=13)
 
     be = RemotePSBackend([addr])
-    codec = HostOnebit(elems, use_scale=True)
     rs = np.random.RandomState(wid)
     payloads = []
     for k in range(keys):
@@ -79,6 +94,7 @@ def run_mode(native: bool, args) -> dict:
                        LB_ADDR=f"127.0.0.1:{srv.port}", LB_WID=str(wid),
                        LB_KEYS=str(args.keys), LB_ELEMS=str(args.elems),
                        LB_ROUNDS=str(args.rounds),
+                       LB_CODEC=args.codec,
                        BPS_NATIVE_CODEC=env_flag)
             procs.append(subprocess.Popen(
                 [sys.executable, "-c", WORKER % {
@@ -119,12 +135,18 @@ def main() -> None:
     ap.add_argument("--rounds", type=int, default=10)
     ap.add_argument("--threads", type=int, default=4,
                     help="server engine threads")
+    ap.add_argument("--codec", default="onebit",
+                    choices=["onebit", "topk", "randomk", "dithering"],
+                    help="server-side chain under load (round 4: every "
+                         "codec has a native path — fused for "
+                         "onebit/topk, primitive-backed for "
+                         "randomk recompress and seeded dithering)")
     args = ap.parse_args()
     rows = [run_mode(False, args), run_mode(True, args)]
     for r in rows:
         print(r)
     speedup = rows[0]["wall_s"] / rows[1]["wall_s"]
-    print(json.dumps({"metric": "native_codec_speedup",
+    print(json.dumps({"metric": f"native_codec_speedup_{args.codec}",
                       "value": round(speedup, 2), "unit": "x",
                       "workers": args.workers, "keys": args.keys,
                       "elems": args.elems,
