@@ -9,11 +9,23 @@ kernels for self-attention, and the same Megatron-style tensor-parallel
 sharding (column-parallel QKV over heads, row-parallel projections with
 one psum per sublayer).
 
-Cross-attention runs the XLA einsum path: its memory is [b, sq, sk]
-with sq·sk = dec_len·enc_len — at seq2seq's typical lengths that block
-is small (it is NOT the O(s²) self-attention problem flash exists for),
-and its k/v lengths differ from q's, which the flash kernel's
-block-tiling contract doesn't cover.
+Round 4 fidelity upgrades (the two signature T5 mechanisms):
+
+- **Relative position bias** (`pos_encoding="relative"`, the default):
+  no absolute position embedding; each stack owns ONE learned
+  [num_buckets, heads] table (shared across its layers, exactly T5's
+  weight sharing), turned into a [heads, sq, sk] additive score bias
+  through the log-spaced bucket function — bidirectional buckets in
+  the encoder, causal buckets in the decoder. The bias rides the
+  flash kernels' differentiable ``bias`` input (dbias accumulated in
+  the dq kernel). T5's no-1/√d-scaling convention applies in this
+  mode. ``pos_encoding="absolute"`` keeps the learned-positions
+  variant.
+- **Flash cross-attention**: the kernels' tiling contract is per-axis
+  (q and kv lengths independent), so decoder-over-encoder attention
+  runs the same Pallas path as self-attention — the O(sq·sk) score
+  matrix never leaves VMEM, which is what makes LONG-encoder seq2seq
+  (e.g. summarization at 8k+ source tokens) feasible.
 """
 
 from __future__ import annotations
@@ -31,7 +43,8 @@ from .transformer import _layernorm, _mlp, embed_lookup
 
 __all__ = ["T5Config", "t5_tiny", "t5_small", "init_t5_params",
            "t5_param_specs", "encode", "decode", "seq2seq_loss",
-           "synth_seq2seq_batch"]
+           "synth_seq2seq_batch", "relative_position_bucket",
+           "relative_bias"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,10 +60,19 @@ class T5Config:
     remat: bool = True
     attn_impl: str = "auto"
     tp_axis: Optional[str] = None
+    # T5's signature position scheme (see module docstring); "absolute"
+    # restores the learned position table
+    pos_encoding: str = "relative"
+    rel_buckets: int = 32
+    rel_max_distance: int = 128
 
     @property
     def head_dim(self) -> int:
         return self.hidden // self.heads
+
+    @property
+    def relative(self) -> bool:
+        return self.pos_encoding == "relative"
 
 
 def t5_tiny(**kw) -> T5Config:
@@ -102,13 +124,29 @@ def init_t5_params(rng, cfg: T5Config):
     stack = lambda blocks: jax.tree_util.tree_map(
         lambda *xs: jnp.stack(xs), *blocks)
     sd = 0.02
-    return {
-        "embed": {
+    if cfg.relative:
+        # one bucket table PER STACK, shared by its layers (T5's
+        # weight sharing; reference T5 holds it in layer 0)
+        k1, k2 = jax.random.split(keys[1])
+        embed = {"tok": jax.random.normal(keys[0], (cfg.vocab_size, h),
+                                          jnp.float32) * sd}
+        rel = {
+            "enc_rel_bias": jax.random.normal(
+                k1, (cfg.rel_buckets, cfg.heads), jnp.float32) * sd,
+            "dec_rel_bias": jax.random.normal(
+                k2, (cfg.rel_buckets, cfg.heads), jnp.float32) * sd,
+        }
+    else:
+        embed = {
             "tok": jax.random.normal(keys[0], (cfg.vocab_size, h),
                                      jnp.float32) * sd,
             "pos": jax.random.normal(keys[1], (cfg.max_seq, h),
                                      jnp.float32) * sd,
-        },
+        }
+        rel = {}
+    return {
+        "embed": embed,
+        **rel,
         "enc_blocks": stack(enc),
         "dec_blocks": stack(dec),
         "enc_final_ln": {"scale": jnp.ones((h,)), "bias": jnp.zeros((h,))},
@@ -137,18 +175,65 @@ def t5_param_specs(cfg: T5Config):
         "xkv": P(None, None, None, tp, None),
         "x_out": P(None, tp, None),
     })
-    return {
-        "embed": {"tok": rep, "pos": rep},
+    specs = {
+        "embed": ({"tok": rep} if cfg.relative
+                  else {"tok": rep, "pos": rep}),
         "enc_blocks": enc,
         "dec_blocks": dec,
         "enc_final_ln": {"scale": rep, "bias": rep},
         "dec_final_ln": {"scale": rep, "bias": rep},
     }
+    if cfg.relative:
+        # bucket tables shard over HEADS like qkv's head axis, so each
+        # TP rank computes the bias for exactly its local heads
+        specs["enc_rel_bias"] = P(None, tp)
+        specs["dec_rel_bias"] = P(None, tp)
+    return specs
+
+
+# ------------------------------------------------------ relative positions
+
+def relative_position_bucket(rel, bidirectional: bool,
+                             num_buckets: int = 32,
+                             max_distance: int = 128):
+    """T5's log-spaced relative-position bucketing. ``rel`` is
+    (memory_pos - query_pos), any int array. Bidirectional (encoder):
+    half the buckets for each sign; causal (decoder): future positions
+    collapse to bucket 0. Near offsets get exact buckets, far ones
+    log-spaced up to ``max_distance``."""
+    ret = jnp.zeros_like(rel)
+    n = -rel
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (n < 0).astype(rel.dtype) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_large = max_exact + (
+        jnp.log(jnp.maximum(n, 1).astype(jnp.float32) / max_exact)
+        / np.log(max_distance / max_exact)
+        * (num_buckets - max_exact)).astype(rel.dtype)
+    val_large = jnp.minimum(val_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_large)
+
+
+def relative_bias(table, sq: int, sk: int, bidirectional: bool,
+                  num_buckets: int = 32, max_distance: int = 128):
+    """[num_buckets, heads] table → [heads, sq, sk] additive score
+    bias (fp32), computed once per stack and shared by its layers."""
+    ctx = jnp.arange(sq, dtype=jnp.int32)[:, None]
+    mem = jnp.arange(sk, dtype=jnp.int32)[None, :]
+    bucket = relative_position_bucket(mem - ctx, bidirectional,
+                                      num_buckets, max_distance)
+    bias = jnp.take(table.astype(jnp.float32), bucket, axis=0)
+    return jnp.transpose(bias, (2, 0, 1))            # [heads, sq, sk]
 
 
 # ------------------------------------------------------------------ layers
 
-def _self_attention(x, blk, cfg: T5Config, causal: bool):
+def _self_attention(x, blk, cfg: T5Config, causal: bool, bias=None):
     # local sibling of transformer._attention rather than a reuse: the
     # encoder/decoder pair varies ``causal`` per stack (the shared fn
     # reads it from its config) and T5 has no sp_axis/ring branch
@@ -156,7 +241,10 @@ def _self_attention(x, blk, cfg: T5Config, causal: bool):
     qkv = jnp.einsum("bsh,hcnd->bscnd", x, blk["qkv"].astype(x.dtype))
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     from ..ops.flash_attention import attention
-    out = attention(q, k, v, causal=causal, impl=cfg.attn_impl)
+    # T5's convention: no 1/sqrt(d) score scaling in relative mode
+    scale = 1.0 if cfg.relative else None
+    out = attention(q, k, v, causal=causal, impl=cfg.attn_impl,
+                    scale=scale, bias=bias)
     out = out.reshape(b, s, -1)
     out = out @ blk["attn_out"].astype(x.dtype)
     if cfg.tp_axis is not None:
@@ -166,35 +254,37 @@ def _self_attention(x, blk, cfg: T5Config, causal: bool):
 
 def _cross_attention(x, memory, blk, cfg: T5Config):
     """q from the decoder stream [b, sq, h]; k/v from the encoder
-    memory [b, sk, h]. XLA einsum path (see module docstring)."""
+    memory [b, sk, h] — MISMATCHED lengths on the flash path (the
+    kernels' tiling contract is per-axis), so a long encoder never
+    materializes the O(sq·sk) score matrix in HBM. T5 applies no
+    position bias to cross-attention."""
     dt = x.dtype
     q = jnp.einsum("bsh,hnd->bsnd", x, blk["xq"].astype(dt))
     kv = jnp.einsum("bth,hcnd->btcnd", memory.astype(dt),
                     blk["xkv"].astype(dt))
     k, v = kv[:, :, 0], kv[:, :, 1]
-    scale = cfg.head_dim ** -0.5
-    s = jnp.einsum("bsnd,btnd->bnst", q, k) * scale
-    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(dt)
-    out = jnp.einsum("bnst,btnd->bsnd", p, v)
+    from ..ops.flash_attention import attention
+    out = attention(q, k, v, causal=False, impl=cfg.attn_impl,
+                    scale=(1.0 if cfg.relative else None))
     out = out.reshape(*x.shape[:2], -1) @ blk["x_out"].astype(dt)
     if cfg.tp_axis is not None:
         out = jax.lax.psum(out, cfg.tp_axis)
     return out
 
 
-def _enc_block(x, blk, cfg: T5Config):
+def _enc_block(x, blk, cfg: T5Config, bias=None):
     x = x + _self_attention(
         _layernorm(x, blk["ln1"]["scale"], blk["ln1"]["bias"]),
-        blk, cfg, False)
+        blk, cfg, False, bias=bias)
     # transformer._mlp reads only cfg.tp_axis, which T5Config has
     return x + _mlp(_layernorm(x, blk["ln2"]["scale"], blk["ln2"]["bias"]),
                     blk, cfg)
 
 
-def _dec_block(x, memory, blk, cfg: T5Config):
+def _dec_block(x, memory, blk, cfg: T5Config, bias=None):
     x = x + _self_attention(
         _layernorm(x, blk["ln1"]["scale"], blk["ln1"]["bias"]),
-        blk, cfg, True)
+        blk, cfg, True, bias=bias)
     x = x + _cross_attention(
         _layernorm(x, blk["lnx"]["scale"], blk["lnx"]["bias"]),
         memory, blk, cfg)
@@ -208,13 +298,22 @@ def _embed(params, cfg: T5Config, tokens):
     dt = jnp.dtype(cfg.dtype)
     s = tokens.shape[1]
     x = embed_lookup(params["embed"]["tok"], tokens).astype(dt)
-    return x + params["embed"]["pos"][:s].astype(dt)
+    if not cfg.relative:
+        x = x + params["embed"]["pos"][:s].astype(dt)
+    return x
 
 
 def encode(params, cfg: T5Config, src_tokens: jnp.ndarray) -> jnp.ndarray:
     """Encoder memory [b, s_src, hidden]."""
     x = _embed(params, cfg, src_tokens)
-    fn = partial(_enc_block, cfg=cfg)
+    bias = None
+    if cfg.relative:
+        s = src_tokens.shape[1]
+        # computed ONCE, closed over by every scan step — T5's
+        # shared-across-layers bias
+        bias = relative_bias(params["enc_rel_bias"], s, s, True,
+                             cfg.rel_buckets, cfg.rel_max_distance)
+    fn = partial(_enc_block, cfg=cfg, bias=bias)
     if cfg.remat:
         fn = jax.checkpoint(fn)
 
@@ -230,7 +329,12 @@ def decode(params, cfg: T5Config, tgt_tokens: jnp.ndarray,
            memory: jnp.ndarray) -> jnp.ndarray:
     """Decoder hidden states [b, s_tgt, hidden] (teacher forcing)."""
     x = _embed(params, cfg, tgt_tokens)
-    fn = partial(_dec_block, cfg=cfg)
+    bias = None
+    if cfg.relative:
+        s = tgt_tokens.shape[1]
+        bias = relative_bias(params["dec_rel_bias"], s, s, False,
+                             cfg.rel_buckets, cfg.rel_max_distance)
+    fn = partial(_dec_block, cfg=cfg, bias=bias)
     if cfg.remat:
         fn = jax.checkpoint(fn)
     x, _ = jax.lax.scan(lambda c, b: (fn(c, memory, b), None), x,

@@ -88,8 +88,13 @@ def _head_tile(h: int, nq: int, nk: int, bq: int, bk: int, d: int,
 
 # --------------------------------------------------------------- forward
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc, m_scr, l_scr, *, scale, causal, bq, bk, nk, ht):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, bq, bk, nk,
+                ht, has_bias=False):
+    if has_bias:
+        bias_ref, o_ref, lse_ref, acc, m_scr, l_scr = rest
+    else:
+        bias_ref = None
+        o_ref, lse_ref, acc, m_scr, l_scr = rest
     kb = pl.program_id(3)
     qb = pl.program_id(2)
 
@@ -115,6 +120,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale   # [bq, bk]
+            if has_bias:
+                # additive score bias (T5 relative position): S =
+                # qkᵀ·scale + B — folded in BEFORE the online softmax
+                s = s + bias_ref[t].astype(jnp.float32)
             if causal:
                 rows = qb * bq + jax.lax.broadcasted_iota(
                     jnp.int32, (bq, bk), 0)
@@ -143,33 +152,46 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             lse_ref[0, t] = m_scr[r, :1] + jnp.log(l)
 
 
-def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret, out_dtype=None):
-    """q,k,v: [b, h, s, d] → (out [b,h,s,d], lse [b,h,s,1] fp32).
+def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret, out_dtype=None,
+               bias=None):
+    """q: [b, h, sq, d]; k,v: [b, h, sk, d] → (out [b,h,sq,d],
+    lse [b,h,sq,1] fp32). sq and sk may DIFFER (cross-attention: the
+    decoder's queries over the encoder's keys) — the kernels only ever
+    see (bq, bk) blocks, so the tiling contract is per-axis.
 
     out_dtype overrides the output dtype (default q.dtype) — ring
     attention requests fp32 partials so the per-step LSE combine does
     not accumulate one bf16 rounding per ring step."""
-    b, h, s, d = q.shape
-    nq, nk = s // bq, s // bk
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    nq, nk = sq // bq, sk // bk
     ht = _head_tile(h, nq, nk, bq, bk, d, interpret)
     grid = (b, h // ht, nq, nk)
+    has_bias = bias is not None
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk, nk=nk, ht=ht)
+                               bq=bq, bk=bk, nk=nk, ht=ht,
+                               has_bias=has_bias)
+    in_specs = [
+        pl.BlockSpec((1, ht, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+        pl.BlockSpec((1, ht, bk, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
+        pl.BlockSpec((1, ht, bk, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
+    ]
+    inputs = [q, k, v]
+    if has_bias:
+        in_specs.append(pl.BlockSpec(
+            (ht, bq, bk), lambda ib, ih, iq, ik: (ih, iq, ik)))
+        inputs.append(bias)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, ht, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
-            pl.BlockSpec((1, ht, bk, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
-            pl.BlockSpec((1, ht, bk, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, ht, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
             pl.BlockSpec((1, ht, bq, 1), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, s, d), out_dtype or q.dtype),
-            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq, d), out_dtype or q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((ht * bq, d), jnp.float32),
@@ -178,11 +200,11 @@ def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret, out_dtype=None):
         ],
         compiler_params=_DIM_SEMANTICS,
         interpret=interpret,
-    )(q, k, v)
+    )(*inputs)
     return out, lse
 
 
-def _xla_fwd(qt, kt, vt, causal, scale, out_dtype=None):
+def _xla_fwd(qt, kt, vt, causal, scale, out_dtype=None, bias=None):
     """[b,h,s,d] → (out, lse [b,h,s,1] fp32) with plain XLA ops.
 
     At moderate sequence lengths the XLA-fused softmax-attention forward
@@ -193,6 +215,8 @@ def _xla_fwd(qt, kt, vt, causal, scale, out_dtype=None):
     the same (out, lse) residual contract the Pallas backward needs."""
     s = jax.lax.dot_general(qt, kt, (((3,), (3,)), ((0, 1), (0, 1))),
                             preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias[None].astype(jnp.float32)
     if causal:
         rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
         cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
@@ -208,8 +232,13 @@ def _xla_fwd(qt, kt, vt, causal, scale, out_dtype=None):
 
 # -------------------------------------------------------------- backward
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc, *, scale, causal, bq, bk, nk, ht):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+               scale, causal, bq, bk, nk, ht, has_bias=False):
+    if has_bias:
+        bias_ref, dq_ref, dbias_ref, dq_acc = rest
+    else:
+        bias_ref = dbias_ref = None
+        dq_ref, dq_acc = rest
     kb = pl.program_id(3)
     qb = pl.program_id(2)
 
@@ -218,6 +247,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
     run = True if not causal else (kb * bk <= qb * bq + bq - 1)
+
+    if has_bias:
+        # every (iq, ik) grid point owns its own dbias block, INCLUDING
+        # causally-skipped ones — an unwritten output block is garbage
+        @pl.when(jnp.logical_not(run))
+        def _zero_dbias():
+            dbias_ref[...] = jnp.zeros_like(dbias_ref)
 
     @pl.when(run)
     def _block():
@@ -231,6 +267,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
+            if has_bias:
+                s = s + bias_ref[t].astype(jnp.float32)
             p = jnp.exp(s - lse)                            # [bq, bk]
             if causal:
                 rows = qb * bq + jax.lax.broadcasted_iota(
@@ -241,7 +279,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             dp = jax.lax.dot_general(
                 do, v, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)         # [bq, bk]
-            ds = (p * (dp - delta)).astype(k.dtype)
+            ds32 = p * (dp - delta)           # dL/dS, S = qkᵀ·scale + B
+            if has_bias:
+                dbias_ref[0, t] = ds32        # dB = dS (summed over batch
+            ds = ds32.astype(k.dtype)         # by the caller)
             r = slice(t * bq, (t + 1) * bq)
             dq_acc[r] += jax.lax.dot_general(
                 ds, k, (((1,), (0,)), ((), ())),
@@ -253,9 +294,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             dq_ref[0, t] = dq_acc[t * bq:(t + 1) * bq].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc,
-                *, scale, causal, bq, bk, nq, ht):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                scale, causal, bq, bk, nq, ht, has_bias=False):
+    if has_bias:
+        bias_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        bias_ref = None
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
     qb = pl.program_id(3)
     kb = pl.program_id(2)
 
@@ -278,6 +323,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale  # [bq, bk]
+            if has_bias:
+                s = s + bias_ref[t].astype(jnp.float32)
             p = jnp.exp(s - lse)
             if causal:
                 rows = qb * bq + jax.lax.broadcasted_iota(
@@ -307,48 +354,77 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, out, lse, do, causal, scale, bq, bk, interpret,
-               delta=None):
-    b, h, s, d = q.shape
-    nq, nk = s // bq, s // bk
+               delta=None, bias=None):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    nq, nk = sq // bq, sk // bk
     if delta is None:      # ring callers hoist this loop-invariant reduction
         delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                         axis=-1, keepdims=True)             # [b,h,s,1]
 
-    ht = _head_tile(h, nq, nk, bq, bk, d, interpret, mats=3)
+    has_bias = bias is not None
+    ht = _head_tile(h, nq, nk, bq, bk, d, interpret,
+                    mats=4 if has_bias else 3)
     qspec = pl.BlockSpec((1, ht, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0))
     kspec = pl.BlockSpec((1, ht, bk, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0))
     r1spec = pl.BlockSpec((1, ht, bq, 1), lambda ib, ih, iq, ik: (ib, ih, iq, 0))
 
-    dq = pl.pallas_call(
+    in_specs = [qspec, kspec, kspec, qspec, r1spec, r1spec]
+    inputs = [q, k, v, do, lse, delta]
+    out_specs = qspec
+    out_shape = jax.ShapeDtypeStruct((b, h, sq, d), q.dtype)
+    if has_bias:
+        bspec = pl.BlockSpec((ht, bq, bk), lambda ib, ih, iq, ik: (ih, iq, ik))
+        in_specs.append(bspec)
+        inputs.append(bias)
+        # per-batch dbias blocks (dB = dS); summed over batch below.
+        # O(b·h·sq·sk) fp32 — the biased path is for MODERATE lengths
+        # (T5 self-attention); long-context stays unbiased.
+        out_specs = [qspec, pl.BlockSpec(
+            (1, ht, bq, bk), lambda ib, ih, iq, ik: (ib, ih, iq, ik))]
+        out_shape = [out_shape,
+                     jax.ShapeDtypeStruct((b, h, sq, sk), jnp.float32)]
+    res = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk, ht=ht),
+                          bq=bq, bk=bk, nk=nk, ht=ht, has_bias=has_bias),
         grid=(b, h // ht, nq, nk),
-        in_specs=[qspec, kspec, kspec, qspec, r1spec, r1spec],
-        out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((ht * bq, d), jnp.float32)],
         compiler_params=_DIM_SEMANTICS,
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*inputs)
+    if has_bias:
+        dq, dbias_b = res
+        dbias = jnp.sum(dbias_b, axis=0)                   # [h, sq, sk]
+    else:
+        dq, dbias = res, None
 
     # dk/dv: kv block is the outer (carried) grid dim, q block inner
     qspec2 = pl.BlockSpec((1, ht, bq, d), lambda ib, ih, ik, iq: (ib, ih, iq, 0))
     kspec2 = pl.BlockSpec((1, ht, bk, d), lambda ib, ih, ik, iq: (ib, ih, ik, 0))
     r1spec2 = pl.BlockSpec((1, ht, bq, 1), lambda ib, ih, ik, iq: (ib, ih, iq, 0))
+    in_specs2 = [qspec2, kspec2, kspec2, qspec2, r1spec2, r1spec2]
+    inputs2 = [q, k, v, do, lse, delta]
+    if has_bias:
+        in_specs2.append(pl.BlockSpec(
+            (ht, bq, bk), lambda ib, ih, ik, iq: (ih, iq, ik)))
+        inputs2.append(bias)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq, ht=ht),
+                          bq=bq, bk=bk, nq=nq, ht=ht, has_bias=has_bias),
         grid=(b, h // ht, nk, nq),
-        in_specs=[qspec2, kspec2, kspec2, qspec2, r1spec2, r1spec2],
+        in_specs=in_specs2,
         out_specs=[kspec2, kspec2],
-        out_shape=[jax.ShapeDtypeStruct((b, h, s, d), k.dtype),
-                   jax.ShapeDtypeStruct((b, h, s, d), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, h, sk, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((ht * bk, d), jnp.float32),
                         pltpu.VMEM((ht * bk, d), jnp.float32)],
         compiler_params=_DIM_SEMANTICS,
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
-    return dq, dk, dv
+    )(*inputs2)
+    return dq, dk, dv, dbias
 
 
 # ------------------------------------------------------------ public API
@@ -356,40 +432,53 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, bq, bk, interpret,
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(q, k, v, causal=False, scale=None,
                     block_q=512, block_k=512, interpret=False,
-                    fwd_xla=False):
-    """Pallas flash attention. q,k,v: [b, s, heads, d] → [b, s, heads, d].
+                    fwd_xla=False, bias=None):
+    """Pallas flash attention. q: [b, sq, heads, d]; k,v: [b, sk, heads,
+    d] → [b, sq, heads, d]. sq and sk may differ (cross-attention).
 
-    seq must be divisible by the (auto-shrunk) block sizes. Differentiable
-    via the flash backward kernels. 512 blocks measured ~29% faster than
-    256 on BERT-large seq-512 (fewer grid steps, full-width MXU tiles);
-    VMEM stays comfortable through d=256 (p-block 1MB + acc 512KB).
-    ``fwd_xla`` swaps the forward for the XLA-fused one (see ``_xla_fwd``)
-    while keeping the flash backward — the "hybrid" impl.
+    Each seq must be divisible by the (auto-shrunk) block sizes.
+    Differentiable via the flash backward kernels. 512 blocks measured
+    ~29% faster than 256 on BERT-large seq-512 (fewer grid steps,
+    full-width MXU tiles); VMEM stays comfortable through d=256
+    (p-block 1MB + acc 512KB). ``fwd_xla`` swaps the forward for the
+    XLA-fused one (see ``_xla_fwd``) while keeping the flash backward —
+    the "hybrid" impl. ``bias`` [heads, sq, sk] is an additive score
+    bias (T5 relative position), differentiable; its BACKWARD
+    materializes per-batch dbias blocks — O(batch·heads·sq·sk) fp32 —
+    before the batch sum, so the biased path is for MODERATE-length
+    self-attention; long-context runs unbiased.
     """
     out, _ = _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret,
-                       fwd_xla)
+                       fwd_xla, bias)
     return out
 
 
-def _resolve(q, scale, block_q, block_k):
-    b, s, h, d = q.shape
+def _resolve(q, k, scale, block_q, block_k):
+    _, sq, _, d = q.shape
+    sk = k.shape[1]
     if scale is None:
         scale = d ** -0.5
-    bq = _pick_block(s, min(block_q, s))
-    bk = _pick_block(s, min(block_k, s))
+    bq = _pick_block(sq, min(block_q, sq))
+    bk = _pick_block(sk, min(block_k, sk))
     return scale, bq, bk
 
 
 def _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret,
-              fwd_xla=False):
-    scale, bq, bk = _resolve(q, scale, block_q, block_k)
+              fwd_xla=False, bias=None):
+    if causal and q.shape[1] != k.shape[1]:
+        raise ValueError(
+            "causal masking requires equal q/kv lengths (got "
+            f"{q.shape[1]} vs {k.shape[1]}); cross-attention is "
+            "bidirectional")
+    scale, bq, bk = _resolve(q, k, scale, block_q, block_k)
     qt = jnp.swapaxes(q, 1, 2)       # [b, h, s, d]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     if fwd_xla:
-        out, lse = _xla_fwd(qt, kt, vt, causal, scale)
+        out, lse = _xla_fwd(qt, kt, vt, causal, scale, bias=bias)
     else:
-        out, lse = _flash_fwd(qt, kt, vt, causal, scale, bq, bk, interpret)
+        out, lse = _flash_fwd(qt, kt, vt, causal, scale, bq, bk, interpret,
+                              bias=bias)
     # store lse as [b,h,s]: a trailing dim of 1 lane-pads to 128 on TPU,
     # bloating the saved residual 128x when it survives to the backward
     from jax.ad_checkpoint import checkpoint_name
@@ -398,40 +487,44 @@ def _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret,
     # lse — pinning the [b,h,s,1] form would lane-pad 128x (comment above)
     out = checkpoint_name(out, "flash_out")
     lse = checkpoint_name(lse[..., 0], "flash_lse")
-    return jnp.swapaxes(out, 1, 2), (qt, kt, vt, out, lse)
+    return jnp.swapaxes(out, 1, 2), (qt, kt, vt, out, lse, bias)
 
 
 def _vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-             fwd_xla=False):
+             fwd_xla=False, bias=None):
     out, res = _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret,
-                         fwd_xla)
+                         fwd_xla, bias)
     return out, res
 
 
 def _vjp_bwd(causal, scale, block_q, block_k, interpret, fwd_xla, res, g):
-    qt, kt, vt, out, lse = res
-    scale, bq, bk = _resolve(jnp.swapaxes(qt, 1, 2), scale, block_q, block_k)
+    qt, kt, vt, out, lse, bias = res
+    scale, bq, bk = _resolve(jnp.swapaxes(qt, 1, 2), jnp.swapaxes(kt, 1, 2),
+                             scale, block_q, block_k)
     do = jnp.swapaxes(g, 1, 2)
-    dq, dk, dv = _flash_bwd(qt, kt, vt, out, lse[..., None], do,
-                            causal, scale, bq, bk, interpret)
+    dq, dk, dv, dbias = _flash_bwd(qt, kt, vt, out, lse[..., None], do,
+                                   causal, scale, bq, bk, interpret,
+                                   bias=bias)
     return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
-            jnp.swapaxes(dv, 1, 2))
+            jnp.swapaxes(dv, 1, 2), dbias)
 
 
 flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
 
 
-def supported(q_shape) -> bool:
-    """Shapes the Pallas kernels handle: seq a multiple of 128, head_dim
-    ≤ 256 (one VMEM tile of lanes per block row)."""
-    _, s, _, d = q_shape
-    return s % 128 == 0 and d <= 256
+def supported(q_shape, k_shape=None) -> bool:
+    """Shapes the Pallas kernels handle: each sequence a multiple of
+    128, head_dim ≤ 256 (one VMEM tile of lanes per block row). q and
+    kv lengths may differ (cross-attention)."""
+    _, sq, _, d = q_shape
+    sk = sq if k_shape is None else k_shape[1]
+    return sq % 128 == 0 and sk % 128 == 0 and d <= 256
 
 
 _warned_fallback = set()
 
 
-def attention(q, k, v, causal=False, scale=None, impl="auto"):
+def attention(q, k, v, causal=False, scale=None, impl="auto", bias=None):
     """Dispatcher: Pallas flash kernels on TPU, blockwise JAX elsewhere.
 
     impl: "auto" | "flash" | "hybrid" | "naive". "hybrid" = XLA-fused
@@ -439,20 +532,24 @@ def attention(q, k, v, causal=False, scale=None, impl="auto"):
     (inference/eval: BERT-large seq-512 fwd measured 261→239 ms) but
     loses on the rematted train step (69.0 vs 73.7 samples/s — the
     recompute re-materializes the [s,s] scores inside the backward),
-    so "auto" stays pure flash and hybrid is opt-in.
+    so "auto" stays pure flash and hybrid is opt-in. ``bias``
+    [heads, sq, sk]: additive score bias (T5 relative position),
+    differentiable on every impl.
     """
     if impl not in ("auto", "flash", "hybrid", "naive"):
         raise ValueError(
             f"attn impl must be auto|flash|hybrid|naive, got {impl!r}")
     from ..parallel.ring import local_attention
     if impl == "naive":
-        return local_attention(q, k, v, causal=causal, scale=scale)
+        return local_attention(q, k, v, causal=causal, scale=scale,
+                               bias=bias)
     on_tpu = jax.default_backend() == "tpu"
     if impl == "hybrid":
         return flash_attention(q, k, v, causal=causal, scale=scale,
-                               fwd_xla=True)
-    if impl == "flash" or (on_tpu and supported(q.shape)):
-        return flash_attention(q, k, v, causal=causal, scale=scale)
+                               fwd_xla=True, bias=bias)
+    if impl == "flash" or (on_tpu and supported(q.shape, k.shape)):
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               bias=bias)
     if on_tpu and tuple(q.shape) not in _warned_fallback:
         # a silent fall-through here once cost 28x at seq 8k (an s-1 shift
         # broke seq % 128) — make the downgrade loud, once per shape
@@ -461,4 +558,4 @@ def attention(q, k, v, causal=False, scale=None, impl="auto"):
         get_logger().warning(
             "attention %s falls back to naive O(s^2) on TPU (flash needs "
             "seq %% 128 == 0 and head_dim <= 256)", tuple(q.shape))
-    return local_attention(q, k, v, causal=causal, scale=scale)
+    return local_attention(q, k, v, causal=causal, scale=scale, bias=bias)

@@ -253,14 +253,16 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, interpret, res, g):
         # (dq, dk, dv) are exact contributions that just sum.
         def visible(_):
             return _flash_bwd(q_t, k_t, v_t, out_t, lse, do_t,
-                              False, scale, bq, bk, interpret, delta=delta)
+                              False, scale, bq, bk, interpret,
+                              delta=delta)[:3]    # no bias on the ring
 
         if case is None:
             return visible(None)
 
         def diagonal(_):
             return _flash_bwd(q_t, k_t, v_t, out_t, lse, do_t,
-                              True, scale, bq, bk, interpret, delta=delta)
+                              True, scale, bq, bk, interpret,
+                              delta=delta)[:3]
 
         def hidden(_):
             return (jnp.zeros_like(q_t), jnp.zeros_like(k_t),
@@ -304,14 +306,24 @@ _ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
 
 
 def local_attention(q, k, v, causal: bool = False,
-                    scale: Optional[float] = None):
-    """Single-device reference attention, same layout [b, s, h, d]."""
+                    scale: Optional[float] = None, bias=None):
+    """Single-device reference attention, same layout [b, s, h, d]
+    (q and kv lengths may differ; ``bias`` [h, sq, sk] adds to the
+    scores — the T5 relative-position contract)."""
     b, s, h, d = q.shape
     if scale is None:
         scale = d ** -0.5
     sc = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                     preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        sc = sc + bias[None].astype(jnp.float32)
     if causal:
+        if k.shape[1] != s:
+            # same contract (and message) as the flash path
+            raise ValueError(
+                "causal masking requires equal q/kv lengths (got "
+                f"{s} vs {k.shape[1]}); cross-attention is "
+                "bidirectional")
         mask = jnp.tril(jnp.ones((s, s), bool))
         sc = jnp.where(mask[None, None], sc, -jnp.inf)
     p = jax.nn.softmax(sc, axis=-1)

@@ -79,6 +79,44 @@ def measure(model: str, seq: int, tokens_per_step: int, sp: int,
     return batch * seq * iters / (time.perf_counter() - t0)
 
 
+def measure_cross(enc_len: int, dec_len: int, heads: int, d: int,
+                  iters: int, naive_cap: int) -> dict:
+    """T5-style cross-attention (round 4): ``dec_len`` queries over an
+    ``enc_len`` encoder memory, fwd+bwd, flash vs naive einsum. The
+    flash path never materializes the [sq, sk] scores in HBM — the
+    long-encoder seq2seq enabler (summarization at 8k+ source)."""
+    import jax.numpy as jnp
+
+    from byteps_tpu.ops.flash_attention import flash_attention
+    from byteps_tpu.parallel.ring import local_attention
+
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (1, dec_len, heads, d), jnp.bfloat16)
+    k = jax.random.normal(jax.random.fold_in(key, 1),
+                          (1, enc_len, heads, d), jnp.bfloat16)
+    v = jax.random.normal(jax.random.fold_in(key, 2),
+                          (1, enc_len, heads, d), jnp.bfloat16)
+
+    def bench(fn) -> float:
+        g = jax.jit(jax.grad(
+            lambda q, k, v: (fn(q, k, v).astype(jnp.float32) ** 2).sum(),
+            argnums=(0, 1, 2)))
+        r = g(q, k, v)
+        float(r[0].sum())                    # real readback (tunnel)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = g(q, k, v)
+        float(r[0].sum())
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    row = {"enc_len": enc_len, "dec_len": dec_len,
+           "flash_ms": round(bench(flash_attention), 2)}
+    if enc_len <= naive_cap:                 # [h, sq, sk] fp32 blowup
+        row["naive_ms"] = round(bench(local_attention), 2)
+        row["speedup"] = round(row["naive_ms"] / row["flash_ms"], 2)
+    return row
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="gpt2-small", choices=sorted(MODELS))
@@ -87,7 +125,30 @@ def main() -> None:
     ap.add_argument("--sp", type=int, default=1,
                     help="sequence-parallel (ring) shards")
     ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--cross-encoder", action="store_true",
+                    help="bench T5 cross-attention: --dec-len queries "
+                         "over encoder memories of --seqs lengths")
+    ap.add_argument("--dec-len", type=int, default=512)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--head-dim", type=int, default=64)
+    ap.add_argument("--naive-cap", type=int, default=16384,
+                    help="skip the naive einsum arm above this encoder "
+                         "length (its [sq,sk] scores blow HBM)")
     args = ap.parse_args()
+
+    if args.cross_encoder:
+        rows = []
+        for enc in (int(s) for s in args.seqs.split(",")):
+            row = measure_cross(enc, args.dec_len, args.heads,
+                                args.head_dim, args.iters, args.naive_cap)
+            rows.append(row)
+            print(f"enc={enc:7d} dec={args.dec_len}  "
+                  f"flash={row['flash_ms']:8.2f} ms  "
+                  f"naive={row.get('naive_ms', float('nan')):8.2f} ms")
+        print(json.dumps({"metric": "t5_cross_attention_flash_ms",
+                          "value": rows[-1]["flash_ms"], "unit": "ms",
+                          "rows": rows}))
+        return
 
     rows = {}
     for seq in (int(s) for s in args.seqs.split(",")):

@@ -120,3 +120,89 @@ def test_flash_ht_override_clamped_by_vmem(monkeypatch):
     monkeypatch.setenv("BPS_FLASH_HT", "2")
     assert _head_tile(h=64, nq=1, nk=1, bq=128, bk=128, d=64,
                       interpret=False) == 2
+
+
+# ---------------------------------------------------------------------------
+# round 4: mismatched q/kv lengths (cross-attention) + additive score bias
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("sq,sk", [(128, 384), (384, 128), (256, 256)])
+def test_cross_attention_mismatched_lengths(sq, sk):
+    """The tiling contract is per-axis: q and kv sequence lengths may
+    differ (decoder queries over encoder memory). Forward and all
+    three gradients must match the einsum reference."""
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(2, sq, 2, 64).astype(np.float32))
+    k = jnp.asarray(rng.randn(2, sk, 2, 64).astype(np.float32))
+    v = jnp.asarray(rng.randn(2, sk, 2, 64).astype(np.float32))
+    out = flash_attention(q, k, v, False, None, 128, 128, True)
+    ref = local_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss(f):
+        return lambda q, k, v: (f(q, k, v) ** 2).sum()
+    gf = jax.grad(loss(lambda *a: flash_attention(
+        *a, False, None, 128, 128, True)), argnums=(0, 1, 2))(q, k, v)
+    gn = jax.grad(loss(local_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b, nm in zip(gf, gn, "qkv"):
+        assert a.shape == b.shape, nm
+        scale = float(jnp.abs(b).max())
+        np.testing.assert_allclose(np.asarray(a) / scale,
+                                   np.asarray(b) / scale,
+                                   rtol=1e-4, atol=1e-5, err_msg=nm)
+
+
+def test_causal_cross_attention_rejected():
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.randn(1, 128, 2, 64).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 256, 2, 64).astype(np.float32))
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, q, True, None, 128, 128, True)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_bias_forward_backward_exact(causal):
+    """Additive [h, sq, sk] score bias (T5 relative position): forward
+    plus dq/dk/dv AND the dbias reduction (accumulated per-batch in
+    the dq kernel, summed outside) against the reference."""
+    rng = np.random.RandomState(3)
+    b, s, h, d = 2, 256, 2, 64
+    q, k, v = make_qkv(rng, b, s, h, d, np.float32)
+    bias = jnp.asarray(rng.randn(h, s, s).astype(np.float32))
+    out = flash_attention(q, k, v, causal, None, 128, 128, True, False,
+                          bias=bias)
+    ref = local_attention(q, k, v, causal=causal, bias=bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    def f_loss(q, k, v, bb):
+        return (flash_attention(q, k, v, causal, None, 128, 128, True,
+                                False, bias=bb) ** 2).sum()
+
+    def n_loss(q, k, v, bb):
+        return (local_attention(q, k, v, causal=causal, bias=bb)
+                ** 2).sum()
+
+    gf = jax.grad(f_loss, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    gn = jax.grad(n_loss, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for a, b_, nm in zip(gf, gn, ["dq", "dk", "dv", "dbias"]):
+        scale = float(jnp.abs(b_).max())
+        np.testing.assert_allclose(np.asarray(a) / scale,
+                                   np.asarray(b_) / scale,
+                                   rtol=1e-4, atol=1e-5, err_msg=nm)
+
+
+def test_mismatched_bias_cross():
+    """bias + mismatched lengths together (biased cross-attention is
+    not a T5 case but the kernel contract covers it)."""
+    rng = np.random.RandomState(4)
+    q = jnp.asarray(rng.randn(1, 128, 2, 64).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 384, 2, 64).astype(np.float32))
+    v = jnp.asarray(rng.randn(1, 384, 2, 64).astype(np.float32))
+    bias = jnp.asarray(rng.randn(2, 128, 384).astype(np.float32))
+    out = flash_attention(q, k, v, False, None, 128, 128, True, False,
+                          bias=bias)
+    ref = local_attention(q, k, v, bias=bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
