@@ -146,3 +146,101 @@ def test_moe_all_to_all_rides_expert_axis_only():
                                     axis_sizes=info["axis_sizes"])
         out = verify_moe_schedule(sched, info)
         assert out["all_to_all"] == 4, out   # fwd+bwd x dispatch+return
+
+
+# ---------------------------------------------------------------------------
+# round 4: weld the analytic model to the throttle rig (VERDICT r3 #4)
+# ---------------------------------------------------------------------------
+
+def test_comm_model_dcn_term_matches_throttled_emulation():
+    """The scaling table's cross-slice (DCN) comm term — the piece the
+    94.1%@256 efficiency claim leans on — validated by EXECUTION, not
+    arithmetic: the flagship schedule's ar-dcn collectives (the 1/ici
+    shards) are run as a real ring all-reduce over throttled sockets at
+    a scaled-down bandwidth, and CommModel's prediction at that same
+    bandwidth must land within a ±30% band of the measured wall time
+    (the rig carries real framing/threading overheads; the ring itself
+    tracks its analytic form within ~4% when idle)."""
+    from byteps_tpu.server.allreduce_emu import ring_allreduce
+
+    n, dcn = 16, 4
+    lowered, info = _lower(n, dcn=dcn)
+    sched = collective_schedule(lowered, n, dcn=dcn)
+    ars = [c for c in sched
+           if c.kind == "all_reduce" and c.crosses_dcn
+           and c.operand_bytes > 4096]
+    assert ars, "no cross-slice all_reduce in the hybrid schedule"
+    for c in ars:
+        assert c.group_size == dcn
+    shard_bytes = sum(c.operand_bytes for c in ars)
+
+    # pick the emulation bandwidth so the predicted hop lands at
+    # ~150 ms — slow enough that socket/CPU overheads are noise, fast
+    # enough for CI (self-calibrating: the tiny model's shard total
+    # sets W, the RATIO is what's under test)
+    wire_factor = 2 * (dcn - 1) / dcn
+    W = wire_factor * shard_bytes / 0.15
+    model = CommModel(ici_bw=1e30, dcn_bw=W, latency=0.0)
+    t_model = sum(model.time(c) for c in ars)
+    # one ring all-reduce of the concatenated shards between dcn
+    # endpoints — the same algorithm (reduce-scatter + all-gather),
+    # same 2(g-1)/g wire factor, real sockets
+    t_emu = ring_allreduce(dcn, shard_bytes, rate=W, iters=2)
+    assert t_model > 0.05, (t_model, "regime too fast to measure")
+    ratio = t_emu / t_model
+    assert 0.7 < ratio < 1.3, (
+        f"CommModel dcn term {t_model*1e3:.0f} ms vs emulated "
+        f"{t_emu*1e3:.0f} ms (ratio {ratio:.2f}) — the analytic model "
+        f"and the throttle rig disagree")
+
+
+def test_slow_dcn_degrades_and_compression_recovers():
+    """The slower-DCN sweep point: at dcn_bw/10 the overlapped
+    efficiency bound degrades; shrinking the cross-slice bytes by the
+    onebit codec ratio (32x) recovers it. Model-level here — the
+    EXECUTED version of the compression recovery is
+    test_ps_vs_allreduce.py::test_compressed_ps_crushes_bandwidth_bound_regime
+    and the training-level A/B (test_train_emu.py)."""
+    import dataclasses as _dc
+
+    n, dcn = 64, 8
+    lowered, info = _lower(n, dcn=dcn)
+    sched = collective_schedule(lowered, n, dcn=dcn)
+    verify_dp_schedule(sched, info)
+
+    # latency=0: the tiny CI model's collectives are so small that the
+    # 15 us/op launch cost would swamp the BANDWIDTH term this test is
+    # about (the flagship's buckets are 4 MB; per-op latency is noise
+    # there)
+    fast = _dc.replace(CommModel(), latency=0.0)
+    slow = _dc.replace(fast, dcn_bw=fast.dcn_bw / 10)
+
+    def comm_time(comm, byte_scale=1.0):
+        t = 0.0
+        for c in sched:
+            if c.operand_bytes <= 4096:
+                continue
+            dt = comm.time(c)
+            if c.crosses_dcn and byte_scale != 1.0:
+                # compression shrinks only the WIRE bytes of the
+                # cross-slice hop (the in-slice stages stay dense)
+                dt = comm.latency + c.wire_bytes() * byte_scale / comm.dcn_bw
+            t += dt
+        return t
+
+    # compute window calibrated to the tiny model: 2x the fast-fabric
+    # comm, so overlap fully hides comm at the documented bandwidths
+    # (the flagship table's regime) and the RATIOS carry the test
+    compute_s = 2 * comm_time(fast)
+
+    def eff(comm, byte_scale=1.0):
+        return compute_s / max(compute_s, comm_time(comm, byte_scale))
+
+    e_fast = eff(fast)
+    e_slow = eff(slow)
+    e_recovered = eff(slow, byte_scale=1 / 32)   # onebit on the dcn hop
+    assert e_fast == 1.0
+    assert e_slow < 0.95, f"10x slower DCN should break overlap: {e_slow}"
+    assert e_recovered > 0.99, (
+        f"32x fewer cross-slice bytes should restore full overlap at "
+        f"this scale: {e_recovered}")
