@@ -14,13 +14,16 @@ Round 4 fidelity upgrades (the two signature T5 mechanisms):
 - **Relative position bias** (`pos_encoding="relative"`, the default):
   no absolute position embedding; each stack owns ONE learned
   [num_buckets, heads] table (shared across its layers, exactly T5's
-  weight sharing), turned into a [heads, sq, sk] additive score bias
-  through the log-spaced bucket function — bidirectional buckets in
-  the encoder, causal buckets in the decoder. The bias rides the
-  flash kernels' differentiable ``bias`` input (dbias accumulated in
-  the dq kernel). T5's no-1/√d-scaling convention applies in this
-  mode. ``pos_encoding="absolute"`` keeps the learned-positions
-  variant.
+  weight sharing) — bidirectional buckets in the encoder, causal in
+  the decoder. The table rides the flash kernels' IN-KERNEL rel-bias
+  input: each (q-block, kv-block) derives its bucket map from block
+  offsets and folds the table into the scores inside VMEM, dtable
+  accumulated in kernel scratch — no [heads, s, s] bias ever
+  materializes in HBM, so relative-bias self-attention stays O(s)
+  memory at ANY length (a materialized bias is 34 GB at s=32k, h=8;
+  the in-kernel form runs it in ~0.85 s fwd+bwd on one chip). T5's
+  no-1/√d-scaling convention applies in this mode.
+  ``pos_encoding="absolute"`` keeps the learned-positions variant.
 - **Flash cross-attention**: the kernels' tiling contract is per-axis
   (q and kv lengths independent), so decoder-over-encoder attention
   runs the same Pallas path as self-attention — the O(sq·sk) score
@@ -192,48 +195,15 @@ def t5_param_specs(cfg: T5Config):
 
 
 # ------------------------------------------------------ relative positions
+# (shared with the Pallas kernels — byteps_tpu/ops/relpos.py; re-exported
+# here for the model-facing API and backward compatibility)
 
-def relative_position_bucket(rel, bidirectional: bool,
-                             num_buckets: int = 32,
-                             max_distance: int = 128):
-    """T5's log-spaced relative-position bucketing. ``rel`` is
-    (memory_pos - query_pos), any int array. Bidirectional (encoder):
-    half the buckets for each sign; causal (decoder): future positions
-    collapse to bucket 0. Near offsets get exact buckets, far ones
-    log-spaced up to ``max_distance``."""
-    ret = jnp.zeros_like(rel)
-    n = -rel
-    if bidirectional:
-        num_buckets //= 2
-        ret = ret + (n < 0).astype(rel.dtype) * num_buckets
-        n = jnp.abs(n)
-    else:
-        n = jnp.maximum(n, 0)
-    max_exact = num_buckets // 2
-    is_small = n < max_exact
-    val_large = max_exact + (
-        jnp.log(jnp.maximum(n, 1).astype(jnp.float32) / max_exact)
-        / np.log(max_distance / max_exact)
-        * (num_buckets - max_exact)).astype(rel.dtype)
-    val_large = jnp.minimum(val_large, num_buckets - 1)
-    return ret + jnp.where(is_small, n, val_large)
-
-
-def relative_bias(table, sq: int, sk: int, bidirectional: bool,
-                  num_buckets: int = 32, max_distance: int = 128):
-    """[num_buckets, heads] table → [heads, sq, sk] additive score
-    bias (fp32), computed once per stack and shared by its layers."""
-    ctx = jnp.arange(sq, dtype=jnp.int32)[:, None]
-    mem = jnp.arange(sk, dtype=jnp.int32)[None, :]
-    bucket = relative_position_bucket(mem - ctx, bidirectional,
-                                      num_buckets, max_distance)
-    bias = jnp.take(table.astype(jnp.float32), bucket, axis=0)
-    return jnp.transpose(bias, (2, 0, 1))            # [heads, sq, sk]
+from ..ops.relpos import relative_bias, relative_position_bucket  # noqa: E402,F401
 
 
 # ------------------------------------------------------------------ layers
 
-def _self_attention(x, blk, cfg: T5Config, causal: bool, bias=None):
+def _self_attention(x, blk, cfg: T5Config, causal: bool, rel_table=None):
     # local sibling of transformer._attention rather than a reuse: the
     # encoder/decoder pair varies ``causal`` per stack (the shared fn
     # reads it from its config) and T5 has no sp_axis/ring branch
@@ -241,10 +211,16 @@ def _self_attention(x, blk, cfg: T5Config, causal: bool, bias=None):
     qkv = jnp.einsum("bsh,hcnd->bscnd", x, blk["qkv"].astype(x.dtype))
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     from ..ops.flash_attention import attention
-    # T5's convention: no 1/sqrt(d) score scaling in relative mode
+    # T5's convention: no 1/sqrt(d) score scaling in relative mode;
+    # the [nb, heads] stack table rides the flash kernels' in-kernel
+    # rel-bias input ([heads, nb] layout) — no [h, s, s] bias in HBM,
+    # so relative-bias self-attention stays O(s) memory at any length
     scale = 1.0 if cfg.relative else None
     out = attention(q, k, v, causal=causal, impl=cfg.attn_impl,
-                    scale=scale, bias=bias)
+                    scale=scale,
+                    rel_table=None if rel_table is None else rel_table.T,
+                    rel_bidirectional=not causal,
+                    rel_max_distance=cfg.rel_max_distance)
     out = out.reshape(b, s, -1)
     out = out @ blk["attn_out"].astype(x.dtype)
     if cfg.tp_axis is not None:
@@ -272,19 +248,19 @@ def _cross_attention(x, memory, blk, cfg: T5Config):
     return out
 
 
-def _enc_block(x, blk, cfg: T5Config, bias=None):
+def _enc_block(x, blk, cfg: T5Config, rel_table=None):
     x = x + _self_attention(
         _layernorm(x, blk["ln1"]["scale"], blk["ln1"]["bias"]),
-        blk, cfg, False, bias=bias)
+        blk, cfg, False, rel_table=rel_table)
     # transformer._mlp reads only cfg.tp_axis, which T5Config has
     return x + _mlp(_layernorm(x, blk["ln2"]["scale"], blk["ln2"]["bias"]),
                     blk, cfg)
 
 
-def _dec_block(x, memory, blk, cfg: T5Config, bias=None):
+def _dec_block(x, memory, blk, cfg: T5Config, rel_table=None):
     x = x + _self_attention(
         _layernorm(x, blk["ln1"]["scale"], blk["ln1"]["bias"]),
-        blk, cfg, True, bias=bias)
+        blk, cfg, True, rel_table=rel_table)
     x = x + _cross_attention(
         _layernorm(x, blk["lnx"]["scale"], blk["lnx"]["bias"]),
         memory, blk, cfg)
@@ -306,14 +282,10 @@ def _embed(params, cfg: T5Config, tokens):
 def encode(params, cfg: T5Config, src_tokens: jnp.ndarray) -> jnp.ndarray:
     """Encoder memory [b, s_src, hidden]."""
     x = _embed(params, cfg, src_tokens)
-    bias = None
-    if cfg.relative:
-        s = src_tokens.shape[1]
-        # computed ONCE, closed over by every scan step — T5's
-        # shared-across-layers bias
-        bias = relative_bias(params["enc_rel_bias"], s, s, True,
-                             cfg.rel_buckets, cfg.rel_max_distance)
-    fn = partial(_enc_block, cfg=cfg, bias=bias)
+    # the [nb, heads] table is closed over by every scan step — T5's
+    # shared-across-layers bias; the kernels expand it per block
+    rel = params["enc_rel_bias"] if cfg.relative else None
+    fn = partial(_enc_block, cfg=cfg, rel_table=rel)
     if cfg.remat:
         fn = jax.checkpoint(fn)
 
@@ -329,12 +301,8 @@ def decode(params, cfg: T5Config, tgt_tokens: jnp.ndarray,
            memory: jnp.ndarray) -> jnp.ndarray:
     """Decoder hidden states [b, s_tgt, hidden] (teacher forcing)."""
     x = _embed(params, cfg, tgt_tokens)
-    bias = None
-    if cfg.relative:
-        s = tgt_tokens.shape[1]
-        bias = relative_bias(params["dec_rel_bias"], s, s, False,
-                             cfg.rel_buckets, cfg.rel_max_distance)
-    fn = partial(_dec_block, cfg=cfg, bias=bias)
+    rel = params["dec_rel_bias"] if cfg.relative else None
+    fn = partial(_dec_block, cfg=cfg, rel_table=rel)
     if cfg.remat:
         fn = jax.checkpoint(fn)
     x, _ = jax.lax.scan(lambda c, b: (fn(c, memory, b), None), x,

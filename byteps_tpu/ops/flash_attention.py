@@ -46,6 +46,57 @@ def _pick_block(s: int, want: int) -> int:
     return s
 
 
+# ---- in-kernel T5 relative-position bias (see ops/relpos.py) ----
+# The bucket index depends only on (col - row), so each (qb, kb) block
+# derives its [bq, bk] bucket map from iotas and folds the small
+# [heads, num_buckets] table into the scores — NO [h, sq, sk] bias in
+# HBM, which is what keeps relative-bias self-attention O(s) memory at
+# long sequence lengths.
+
+def _bucket_block(qb, kb, bq, bk, bidirectional, nb, maxd):
+    from .relpos import relative_position_bucket
+    rows = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return relative_position_bucket(cols - rows, bidirectional, nb, maxd)
+
+
+def _table_bias(table_vec, bucket, nb):
+    """[nb] table row + [bq, bk] bucket map → [bq, bk] bias. An
+    unrolled select-sum (nb is 32): cheap VPU work next to the block's
+    two MXU matmuls; a gather would not vectorize on TPU."""
+    bias = jnp.zeros(bucket.shape, jnp.float32)
+    for b in range(nb):
+        bias = bias + jnp.where(bucket == b, table_vec[b], 0.0)
+    return bias
+
+
+def _rel_row(rel_ref, ih, ht, t):
+    """Head (ih·ht + t)'s [nb] table row. The table rides as ONE
+    full-array block (TPU block rules reject a (ht, nb) tile when
+    ht < 8 — and the whole table is ~1 KB anyway). The row index is
+    dynamic in the grid's head coordinate and Pallas TPU cannot lower
+    dynamic_slice on values, so the row is selected by a masked
+    reduction over the (tiny) head dim."""
+    tab = rel_ref[...]                                   # [h, nb]
+    idx = ih * ht + t
+    mask = (jax.lax.broadcasted_iota(jnp.int32, tab.shape, 0)
+            == idx)
+    return jnp.sum(jnp.where(mask, tab, 0.0), axis=0)    # [nb]
+
+
+# dtable output tile: padded to the minimum legal TPU block (8
+# sublanes × 128 lanes); rows ≥ ht and lanes ≥ nb are zero
+_DT_PAD = (8, 128)
+
+
+def _table_grad(ds32, bucket, nb):
+    """dL/d(table row), padded to the _DT_PAD lane count: sum of dS
+    over positions in each bucket."""
+    g = jnp.stack([jnp.sum(jnp.where(bucket == b, ds32, 0.0))
+                   for b in range(nb)])
+    return jnp.pad(g, (0, _DT_PAD[1] - nb))
+
+
 def _head_tile(h: int, nq: int, nk: int, bq: int, bk: int, d: int,
                interpret: bool, mats: int = 1) -> int:
     """Heads per kernel program. Short sequences (one block pair per
@@ -89,14 +140,18 @@ def _head_tile(h: int, nq: int, nk: int, bq: int, bk: int, d: int,
 # --------------------------------------------------------------- forward
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, bq, bk, nk,
-                ht, has_bias=False):
+                ht, has_bias=False, rel=None):
+    bias_ref = rel_ref = None
     if has_bias:
         bias_ref, o_ref, lse_ref, acc, m_scr, l_scr = rest
+    elif rel is not None:
+        rel_ref, o_ref, lse_ref, acc, m_scr, l_scr = rest
     else:
-        bias_ref = None
         o_ref, lse_ref, acc, m_scr, l_scr = rest
     kb = pl.program_id(3)
     qb = pl.program_id(2)
+    ih = pl.program_id(1)     # evaluated OUTSIDE pl.when: the traced
+                              # cond body can't introduce program_id
 
     @pl.when(kb == 0)
     def _init():
@@ -113,6 +168,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, bq, bk, nk,
         # overhead — at seq 512 the per-(b,h) program is only ~0.2 GFLOP
         # and a 1024-program grid was overhead-bound (measured 2.0 ms vs
         # ~0.5 ms of matmul work per BERT-large layer call)
+        if rel is not None:
+            bidirectional, nb, maxd = rel
+            bucket = _bucket_block(qb, kb, bq, bk, bidirectional, nb,
+                                   maxd)          # shared by the heads
         for t in range(ht):
             q = q_ref[0, t]                  # [bq, d]
             k = k_ref[0, t]                  # [bk, d]
@@ -124,6 +183,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, bq, bk, nk,
                 # additive score bias (T5 relative position): S =
                 # qkᵀ·scale + B — folded in BEFORE the online softmax
                 s = s + bias_ref[t].astype(jnp.float32)
+            if rel is not None:
+                row = _rel_row(rel_ref, ih, ht, t)
+                s = s + _table_bias(row.astype(jnp.float32), bucket,
+                                    rel[1])
             if causal:
                 rows = qb * bq + jax.lax.broadcasted_iota(
                     jnp.int32, (bq, bk), 0)
@@ -153,7 +216,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, bq, bk, nk,
 
 
 def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret, out_dtype=None,
-               bias=None):
+               bias=None, rel_table=None, rel=None):
     """q: [b, h, sq, d]; k,v: [b, h, sk, d] → (out [b,h,sq,d],
     lse [b,h,sq,1] fp32). sq and sk may DIFFER (cross-attention: the
     decoder's queries over the encoder's keys) — the kernels only ever
@@ -165,12 +228,15 @@ def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret, out_dtype=None,
     b, h, sq, d = q.shape
     sk = k.shape[2]
     nq, nk = sq // bq, sk // bk
-    ht = _head_tile(h, nq, nk, bq, bk, d, interpret)
+    ht = _head_tile(h, nq, nk, bq, bk, d, interpret,
+                    mats=3 if rel is not None else 1)
+    if rel is not None:
+        ht = min(ht, _DT_PAD[0])   # matches the bwd dtable tile bound
     grid = (b, h // ht, nq, nk)
     has_bias = bias is not None
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                bq=bq, bk=bk, nk=nk, ht=ht,
-                               has_bias=has_bias)
+                               has_bias=has_bias, rel=rel)
     in_specs = [
         pl.BlockSpec((1, ht, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
         pl.BlockSpec((1, ht, bk, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
@@ -181,6 +247,10 @@ def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret, out_dtype=None,
         in_specs.append(pl.BlockSpec(
             (ht, bq, bk), lambda ib, ih, iq, ik: (ih, iq, ik)))
         inputs.append(bias)
+    elif rel is not None:
+        in_specs.append(pl.BlockSpec(
+            rel_table.shape, lambda ib, ih, iq, ik: (0, 0)))
+        inputs.append(rel_table)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -233,18 +303,29 @@ def _xla_fwd(qt, kt, vt, causal, scale, out_dtype=None, bias=None):
 # -------------------------------------------------------------- backward
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-               scale, causal, bq, bk, nk, ht, has_bias=False):
+               scale, causal, bq, bk, nk, ht, has_bias=False, rel=None,
+               nq=0):
+    bias_ref = dbias_ref = rel_ref = dt_ref = dt_scr = None
     if has_bias:
         bias_ref, dq_ref, dbias_ref, dq_acc = rest
+    elif rel is not None:
+        rel_ref, dq_ref, dt_ref, dq_acc, dt_scr = rest
     else:
-        bias_ref = dbias_ref = None
         dq_ref, dq_acc = rest
     kb = pl.program_id(3)
     qb = pl.program_id(2)
+    ih = pl.program_id(1)     # outside pl.when (see _fwd_kernel)
 
     @pl.when(kb == 0)
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    if rel is not None:
+        # dtable accumulates across BOTH block dims (its output block
+        # is per (b, h)); the rel grid runs iq as carried too
+        @pl.when(jnp.logical_and(kb == 0, qb == 0))
+        def _init_dt():
+            dt_scr[...] = jnp.zeros_like(dt_scr)
 
     run = True if not causal else (kb * bk <= qb * bq + bq - 1)
 
@@ -257,6 +338,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
     @pl.when(run)
     def _block():
+        if rel is not None:
+            bucket = _bucket_block(qb, kb, bq, bk, rel[0], rel[1], rel[2])
         for t in range(ht):                  # heads per program (see fwd)
             q = q_ref[0, t]
             k = k_ref[0, t]
@@ -269,6 +352,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
                 preferred_element_type=jnp.float32) * scale
             if has_bias:
                 s = s + bias_ref[t].astype(jnp.float32)
+            if rel is not None:
+                row = _rel_row(rel_ref, ih, ht, t)
+                s = s + _table_bias(row.astype(jnp.float32), bucket,
+                                    rel[1])
             p = jnp.exp(s - lse)                            # [bq, bk]
             if causal:
                 rows = qb * bq + jax.lax.broadcasted_iota(
@@ -282,7 +369,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
             ds32 = p * (dp - delta)           # dL/dS, S = qkᵀ·scale + B
             if has_bias:
                 dbias_ref[0, t] = ds32        # dB = dS (summed over batch
-            ds = ds32.astype(k.dtype)         # by the caller)
+            if rel is not None:               # by the caller)
+                dt_scr[t] += _table_grad(ds32, bucket, rel[1])
+            ds = ds32.astype(k.dtype)
             r = slice(t * bq, (t + 1) * bq)
             dq_acc[r] += jax.lax.dot_general(
                 ds, k, (((1,), (0,)), ((), ())),
@@ -293,16 +382,24 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         for t in range(ht):
             dq_ref[0, t] = dq_acc[t * bq:(t + 1) * bq].astype(dq_ref.dtype)
 
+    if rel is not None:
+        @pl.when(jnp.logical_and(kb == nk - 1, qb == nq - 1))
+        def _finish_dt():
+            dt_ref[0, 0] = dt_scr[...]
+
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-                scale, causal, bq, bk, nq, ht, has_bias=False):
+                scale, causal, bq, bk, nq, ht, has_bias=False, rel=None):
+    bias_ref = rel_ref = None
     if has_bias:
         bias_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    elif rel is not None:
+        rel_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
     else:
-        bias_ref = None
         dk_ref, dv_ref, dk_acc, dv_acc = rest
     qb = pl.program_id(3)
     kb = pl.program_id(2)
+    ih = pl.program_id(1)     # outside pl.when (see _fwd_kernel)
 
     @pl.when(qb == 0)
     def _init():
@@ -313,6 +410,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
     @pl.when(run)
     def _block():
+        if rel is not None:
+            bucket = _bucket_block(qb, kb, bq, bk, rel[0], rel[1], rel[2])
         for t in range(ht):                  # heads per program (see fwd)
             q = q_ref[0, t]                                 # [bq, d]
             k = k_ref[0, t]                                 # [bk, d]
@@ -325,6 +424,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
                 preferred_element_type=jnp.float32) * scale  # [bq, bk]
             if has_bias:
                 s = s + bias_ref[t].astype(jnp.float32)
+            if rel is not None:
+                row = _rel_row(rel_ref, ih, ht, t)
+                s = s + _table_bias(row.astype(jnp.float32), bucket,
+                                    rel[1])
             p = jnp.exp(s - lse)
             if causal:
                 rows = qb * bq + jax.lax.broadcasted_iota(
@@ -354,7 +457,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 
 def _flash_bwd(q, k, v, out, lse, do, causal, scale, bq, bk, interpret,
-               delta=None, bias=None):
+               delta=None, bias=None, rel_table=None, rel=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     nq, nk = sq // bq, sk // bk
@@ -363,8 +466,14 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, bq, bk, interpret,
                         axis=-1, keepdims=True)             # [b,h,s,1]
 
     has_bias = bias is not None
+    has_rel = rel is not None
     ht = _head_tile(h, nq, nk, bq, bk, d, interpret,
-                    mats=4 if has_bias else 3)
+                    mats=5 if has_rel else (4 if has_bias else 3))
+    if has_rel:
+        # the dtable scratch and output tiles are hard-sized to
+        # _DT_PAD rows — a BPS_FLASH_HT override above that would
+        # write out of bounds and break the drel reshape
+        ht = min(ht, _DT_PAD[0])
     qspec = pl.BlockSpec((1, ht, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0))
     kspec = pl.BlockSpec((1, ht, bk, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0))
     r1spec = pl.BlockSpec((1, ht, bq, 1), lambda ib, ih, iq, ik: (ib, ih, iq, 0))
@@ -373,33 +482,57 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, bq, bk, interpret,
     inputs = [q, k, v, do, lse, delta]
     out_specs = qspec
     out_shape = jax.ShapeDtypeStruct((b, h, sq, d), q.dtype)
+    scratches = [pltpu.VMEM((ht * bq, d), jnp.float32)]
+    params = _DIM_SEMANTICS
     if has_bias:
         bspec = pl.BlockSpec((ht, bq, bk), lambda ib, ih, iq, ik: (ih, iq, ik))
         in_specs.append(bspec)
         inputs.append(bias)
         # per-batch dbias blocks (dB = dS); summed over batch below.
-        # O(b·h·sq·sk) fp32 — the biased path is for MODERATE lengths
-        # (T5 self-attention); long-context stays unbiased.
+        # O(b·h·sq·sk) fp32 — the biased path is for MODERATE lengths;
+        # the rel_table path below is the O(s)-memory long-length form.
         out_specs = [qspec, pl.BlockSpec(
             (1, ht, bq, bk), lambda ib, ih, iq, ik: (ib, ih, iq, ik))]
         out_shape = [out_shape,
                      jax.ShapeDtypeStruct((b, h, sq, sk), jnp.float32)]
+    elif has_rel:
+        nb = rel_table.shape[1]
+        in_specs.append(pl.BlockSpec(
+            rel_table.shape, lambda ib, ih, iq, ik: (0, 0)))
+        inputs.append(rel_table)
+        # dtable accumulates in VMEM scratch across BOTH block dims —
+        # iq must therefore be CARRIED (arbitrary), not parallel.
+        # Output tiles are padded to the minimum legal TPU block
+        # (_DT_PAD); real rows/lanes sliced back out below.
+        out_specs = [qspec, pl.BlockSpec(
+            (1, 1) + _DT_PAD, lambda ib, ih, iq, ik: (ib, ih, 0, 0))]
+        out_shape = [out_shape, jax.ShapeDtypeStruct(
+            (b, h // ht) + _DT_PAD, jnp.float32)]
+        scratches.append(pltpu.VMEM(_DT_PAD, jnp.float32))
+        params = pltpu.CompilerParams(dimension_semantics=(
+            "parallel", "parallel", "arbitrary", "arbitrary"))
     res = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk, ht=ht, has_bias=has_bias),
+                          bq=bq, bk=bk, nk=nk, ht=ht, has_bias=has_bias,
+                          rel=rel, nq=nq),
         grid=(b, h // ht, nq, nk),
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
-        scratch_shapes=[pltpu.VMEM((ht * bq, d), jnp.float32)],
-        compiler_params=_DIM_SEMANTICS,
+        scratch_shapes=scratches,
+        compiler_params=params,
         interpret=interpret,
     )(*inputs)
+    dbias = drel = None
     if has_bias:
         dq, dbias_b = res
         dbias = jnp.sum(dbias_b, axis=0)                   # [h, sq, sk]
+    elif has_rel:
+        dq, dt_b = res                 # [b, h//ht, 8, 128] padded tiles
+        nb = rel_table.shape[1]
+        drel = jnp.sum(dt_b[:, :, :ht, :nb], axis=0).reshape(h, nb)
     else:
-        dq, dbias = res, None
+        dq = res
 
     # dk/dv: kv block is the outer (carried) grid dim, q block inner
     qspec2 = pl.BlockSpec((1, ht, bq, d), lambda ib, ih, ik, iq: (ib, ih, iq, 0))
@@ -411,9 +544,14 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, bq, bk, interpret,
         in_specs2.append(pl.BlockSpec(
             (ht, bq, bk), lambda ib, ih, ik, iq: (ih, iq, ik)))
         inputs2.append(bias)
+    elif has_rel:
+        in_specs2.append(pl.BlockSpec(
+            rel_table.shape, lambda ib, ih, ik, iq: (0, 0)))
+        inputs2.append(rel_table)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq, ht=ht, has_bias=has_bias),
+                          bq=bq, bk=bk, nq=nq, ht=ht, has_bias=has_bias,
+                          rel=rel),
         grid=(b, h // ht, nk, nq),
         in_specs=in_specs2,
         out_specs=[kspec2, kspec2],
@@ -424,15 +562,16 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, bq, bk, interpret,
         compiler_params=_DIM_SEMANTICS,
         interpret=interpret,
     )(*inputs2)
-    return dq, dk, dv, dbias
+    return dq, dk, dv, dbias, drel
 
 
 # ------------------------------------------------------------ public API
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 11, 12))
 def flash_attention(q, k, v, causal=False, scale=None,
                     block_q=512, block_k=512, interpret=False,
-                    fwd_xla=False, bias=None):
+                    fwd_xla=False, bias=None, rel_table=None,
+                    rel_bidirectional=True, rel_max_distance=128):
     """Pallas flash attention. q: [b, sq, heads, d]; k,v: [b, sk, heads,
     d] → [b, sq, heads, d]. sq and sk may differ (cross-attention).
 
@@ -442,14 +581,22 @@ def flash_attention(q, k, v, causal=False, scale=None,
     full-width MXU tiles); VMEM stays comfortable through d=256
     (p-block 1MB + acc 512KB). ``fwd_xla`` swaps the forward for the
     XLA-fused one (see ``_xla_fwd``) while keeping the flash backward —
-    the "hybrid" impl. ``bias`` [heads, sq, sk] is an additive score
-    bias (T5 relative position), differentiable; its BACKWARD
-    materializes per-batch dbias blocks — O(batch·heads·sq·sk) fp32 —
-    before the batch sum, so the biased path is for MODERATE-length
-    self-attention; long-context runs unbiased.
+    the "hybrid" impl.
+
+    Two additive-score-bias forms (mutually exclusive):
+
+    - ``rel_table`` [heads, num_buckets]: T5 relative-position bias
+      computed IN-KERNEL from block offsets — no [h, sq, sk] bias ever
+      materializes (O(s) memory at any length), dtable accumulated in
+      VMEM scratch. This is the long-sequence form.
+    - ``bias`` [heads, sq, sk]: an arbitrary materialized bias; its
+      BACKWARD materializes per-batch dbias blocks
+      (O(batch·heads·sq·sk) fp32) before the batch sum — moderate
+      lengths only.
     """
     out, _ = _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret,
-                       fwd_xla, bias)
+                       fwd_xla, bias, rel_table, rel_bidirectional,
+                       rel_max_distance)
     return out
 
 
@@ -463,22 +610,44 @@ def _resolve(q, k, scale, block_q, block_k):
     return scale, bq, bk
 
 
+def _rel_static(rel_table, bidirectional, max_distance):
+    """(bidirectional, num_buckets, max_distance) static tuple the
+    kernels close over, or None."""
+    if rel_table is None:
+        return None
+    return (bool(bidirectional), int(rel_table.shape[1]),
+            int(max_distance))
+
+
 def _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret,
-              fwd_xla=False, bias=None):
+              fwd_xla=False, bias=None, rel_table=None,
+              rel_bidirectional=True, rel_max_distance=128):
+    if rel_table is not None and rel_table.shape[1] > _DT_PAD[1]:
+        raise ValueError(
+            f"rel_table has {rel_table.shape[1]} buckets; the in-kernel "
+            f"path supports at most {_DT_PAD[1]} (one dtable lane tile)")
     if causal and q.shape[1] != k.shape[1]:
         raise ValueError(
             "causal masking requires equal q/kv lengths (got "
             f"{q.shape[1]} vs {k.shape[1]}); cross-attention is "
             "bidirectional")
+    if bias is not None and rel_table is not None:
+        raise ValueError("bias and rel_table are mutually exclusive")
+    rel = _rel_static(rel_table, rel_bidirectional, rel_max_distance)
     scale, bq, bk = _resolve(q, k, scale, block_q, block_k)
     qt = jnp.swapaxes(q, 1, 2)       # [b, h, s, d]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     if fwd_xla:
-        out, lse = _xla_fwd(qt, kt, vt, causal, scale, bias=bias)
+        xbias = bias
+        if rel is not None:
+            from .relpos import relative_bias
+            xbias = relative_bias(rel_table.T, q.shape[1], k.shape[1],
+                                  rel[0], rel[1], rel[2])
+        out, lse = _xla_fwd(qt, kt, vt, causal, scale, bias=xbias)
     else:
         out, lse = _flash_fwd(qt, kt, vt, causal, scale, bq, bk, interpret,
-                              bias=bias)
+                              bias=bias, rel_table=rel_table, rel=rel)
     # store lse as [b,h,s]: a trailing dim of 1 lane-pads to 128 on TPU,
     # bloating the saved residual 128x when it survives to the backward
     from jax.ad_checkpoint import checkpoint_name
@@ -487,26 +656,30 @@ def _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret,
     # lse — pinning the [b,h,s,1] form would lane-pad 128x (comment above)
     out = checkpoint_name(out, "flash_out")
     lse = checkpoint_name(lse[..., 0], "flash_lse")
-    return jnp.swapaxes(out, 1, 2), (qt, kt, vt, out, lse, bias)
+    return jnp.swapaxes(out, 1, 2), (qt, kt, vt, out, lse, bias, rel_table)
 
 
 def _vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-             fwd_xla=False, bias=None):
+             fwd_xla=False, bias=None, rel_table=None,
+             rel_bidirectional=True, rel_max_distance=128):
     out, res = _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret,
-                         fwd_xla, bias)
+                         fwd_xla, bias, rel_table, rel_bidirectional,
+                         rel_max_distance)
     return out, res
 
 
-def _vjp_bwd(causal, scale, block_q, block_k, interpret, fwd_xla, res, g):
-    qt, kt, vt, out, lse, bias = res
+def _vjp_bwd(causal, scale, block_q, block_k, interpret, fwd_xla,
+             rel_bidirectional, rel_max_distance, res, g):
+    qt, kt, vt, out, lse, bias, rel_table = res
     scale, bq, bk = _resolve(jnp.swapaxes(qt, 1, 2), jnp.swapaxes(kt, 1, 2),
                              scale, block_q, block_k)
+    rel = _rel_static(rel_table, rel_bidirectional, rel_max_distance)
     do = jnp.swapaxes(g, 1, 2)
-    dq, dk, dv, dbias = _flash_bwd(qt, kt, vt, out, lse[..., None], do,
-                                   causal, scale, bq, bk, interpret,
-                                   bias=bias)
+    dq, dk, dv, dbias, drel = _flash_bwd(
+        qt, kt, vt, out, lse[..., None], do, causal, scale, bq, bk,
+        interpret, bias=bias, rel_table=rel_table, rel=rel)
     return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
-            jnp.swapaxes(dv, 1, 2), dbias)
+            jnp.swapaxes(dv, 1, 2), dbias, drel)
 
 
 flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
@@ -524,7 +697,9 @@ def supported(q_shape, k_shape=None) -> bool:
 _warned_fallback = set()
 
 
-def attention(q, k, v, causal=False, scale=None, impl="auto", bias=None):
+def attention(q, k, v, causal=False, scale=None, impl="auto", bias=None,
+              rel_table=None, rel_bidirectional=True,
+              rel_max_distance=128):
     """Dispatcher: Pallas flash kernels on TPU, blockwise JAX elsewhere.
 
     impl: "auto" | "flash" | "hybrid" | "naive". "hybrid" = XLA-fused
@@ -532,24 +707,42 @@ def attention(q, k, v, causal=False, scale=None, impl="auto", bias=None):
     (inference/eval: BERT-large seq-512 fwd measured 261→239 ms) but
     loses on the rematted train step (69.0 vs 73.7 samples/s — the
     recompute re-materializes the [s,s] scores inside the backward),
-    so "auto" stays pure flash and hybrid is opt-in. ``bias``
-    [heads, sq, sk]: additive score bias (T5 relative position),
-    differentiable on every impl.
+    so "auto" stays pure flash and hybrid is opt-in.
+
+    ``rel_table`` [heads, num_buckets]: T5 relative-position bias,
+    computed in-kernel on the flash path (no materialized [h, sq, sk]
+    bias); materialized only on the naive/hybrid fallbacks. ``bias``
+    [heads, sq, sk]: arbitrary materialized bias. Mutually exclusive.
     """
     if impl not in ("auto", "flash", "hybrid", "naive"):
         raise ValueError(
             f"attn impl must be auto|flash|hybrid|naive, got {impl!r}")
     from ..parallel.ring import local_attention
-    if impl == "naive":
+
+    def _naive():
+        b = bias
+        if rel_table is not None:
+            from .relpos import relative_bias
+            b = relative_bias(rel_table.T, q.shape[1], k.shape[1],
+                              rel_bidirectional, rel_table.shape[1],
+                              rel_max_distance)
         return local_attention(q, k, v, causal=causal, scale=scale,
-                               bias=bias)
+                               bias=b)
+
+    if impl == "naive":
+        return _naive()
     on_tpu = jax.default_backend() == "tpu"
     if impl == "hybrid":
         return flash_attention(q, k, v, causal=causal, scale=scale,
-                               fwd_xla=True, bias=bias)
+                               fwd_xla=True, bias=bias,
+                               rel_table=rel_table,
+                               rel_bidirectional=rel_bidirectional,
+                               rel_max_distance=rel_max_distance)
     if impl == "flash" or (on_tpu and supported(q.shape, k.shape)):
         return flash_attention(q, k, v, causal=causal, scale=scale,
-                               bias=bias)
+                               bias=bias, rel_table=rel_table,
+                               rel_bidirectional=rel_bidirectional,
+                               rel_max_distance=rel_max_distance)
     if on_tpu and tuple(q.shape) not in _warned_fallback:
         # a silent fall-through here once cost 28x at seq 8k (an s-1 shift
         # broke seq % 128) — make the downgrade loud, once per shape
@@ -558,4 +751,4 @@ def attention(q, k, v, causal=False, scale=None, impl="auto", bias=None):
         get_logger().warning(
             "attention %s falls back to naive O(s^2) on TPU (flash needs "
             "seq %% 128 == 0 and head_dim <= 256)", tuple(q.shape))
-    return local_attention(q, k, v, causal=causal, scale=scale, bias=bias)
+    return _naive()

@@ -1,0 +1,61 @@
+"""T5 relative-position bucketing, shared by the model layer and the
+Pallas kernels.
+
+The bucket index depends only on (memory_pos - query_pos), so the
+flash kernels can derive it from block offsets with iotas and fold the
+[num_buckets, heads] table into the scores INSIDE the kernel — no
+[heads, sq, sk] bias ever materializes in HBM, which is what makes
+RELATIVE-bias self-attention viable at long sequence lengths (a
+materialized bias is 32 GB at s=32k, h=8). ``relative_bias`` (the
+materializing form) remains for the XLA/naive reference paths and for
+tests. Parity with the public T5 implementation is pinned in
+tests/test_t5.py.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["relative_position_bucket", "relative_bias"]
+
+
+def relative_position_bucket(rel, bidirectional: bool,
+                             num_buckets: int = 32,
+                             max_distance: int = 128):
+    """T5's log-spaced relative-position bucketing. ``rel`` is
+    (memory_pos - query_pos), any int array. Bidirectional (encoder):
+    half the buckets for each sign; causal (decoder): future positions
+    collapse to bucket 0. Near offsets get exact buckets, far ones
+    log-spaced up to ``max_distance``. jnp ops only, so it runs
+    unchanged inside Pallas kernels."""
+    ret = jnp.zeros_like(rel)
+    n = -rel
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (n < 0).astype(rel.dtype) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_large = max_exact + (
+        jnp.log(jnp.maximum(n, 1).astype(jnp.float32) / max_exact)
+        / np.log(max_distance / max_exact)
+        * (num_buckets - max_exact)).astype(rel.dtype)
+    val_large = jnp.minimum(val_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_large)
+
+
+def relative_bias(table, sq: int, sk: int, bidirectional: bool,
+                  num_buckets: int = 32, max_distance: int = 128):
+    """[num_buckets, heads] table → MATERIALIZED [heads, sq, sk]
+    additive score bias (fp32). O(h·sq·sk) HBM — the reference path
+    for tests and the XLA/naive impls; the flash kernels compute the
+    same values in-block from the table instead."""
+    ctx = jnp.arange(sq, dtype=jnp.int32)[:, None]
+    mem = jnp.arange(sk, dtype=jnp.int32)[None, :]
+    bucket = relative_position_bucket(mem - ctx, bidirectional,
+                                      num_buckets, max_distance)
+    bias = jnp.take(table.astype(jnp.float32), bucket, axis=0)
+    return jnp.transpose(bias, (2, 0, 1))            # [heads, sq, sk]
