@@ -67,25 +67,30 @@ def shard_local_batch(batch, mesh: Mesh, spec: Optional[P] = None,
 
 
 def prefetch_to_mesh(it: Iterable, mesh: Mesh, spec: Optional[P] = None,
-                     buffer_size: int = 2) -> Iterator:
+                     buffer_size: int = 2, local: bool = False) -> Iterator:
     """Iterate ``it``, yielding mesh-sharded batches, transferring up to
     ``buffer_size`` batches ahead on a background thread.
 
     device_put is async, but issuing it from a separate thread also
     overlaps the host-side work (pytree traversal, layout, page pinning)
     with the training loop's Python time.
+
+    ``local=True``: each process's iterator yields only ITS slice of
+    the global batch (``shard_local_batch`` assembly) — the multi-host
+    input contract; identical to the default in a single process.
     """
     q: "queue.Queue" = queue.Queue(maxsize=buffer_size)
     stop = threading.Event()
     _END = object()
     sharding = data_sharding(mesh, spec)
+    place = shard_local_batch if local else shard_batch
 
     def producer():
         try:
             for batch in it:
                 if stop.is_set():
                     return
-                q.put(shard_batch(batch, mesh, sharding=sharding))
+                q.put(place(batch, mesh, sharding=sharding))
             q.put(_END)
         except BaseException as e:          # propagate into the consumer
             q.put(e)
@@ -140,3 +145,89 @@ def imagenet_stream(batch: int, seed: int = 0,
     return synthetic_batches(
         lambda rng: synth_imagenet_batch(rng, batch),
         seed=seed, steps=steps)
+
+
+# ---------------------------------------------------- file-backed sources
+
+def write_npz_shards(path, arrays_fn: Callable[[int], dict],
+                     n_shards: int) -> list:
+    """Write ``n_shards`` dataset shard files (``shard-00042.npz``) to
+    ``path``; ``arrays_fn(i)`` returns shard i's named arrays. Returns
+    the file list. The reference's recipes read RecordIO/ImageRecord
+    shard files (example/mxnet/train_gluon_imagenet_byteps_gc.py) —
+    npz is the dependency-free stand-in with the same access pattern:
+    many sequential-read shard files, sample-addressable after load."""
+    import os
+    os.makedirs(path, exist_ok=True)
+    files = []
+    for i in range(n_shards):
+        f = os.path.join(path, f"shard-{i:05d}.npz")
+        np.savez(f, **arrays_fn(i))
+        files.append(f)
+    return files
+
+
+class NpzShardDataset:
+    """File-backed training dataset over a directory of .npz shards.
+
+    The distributed contract (reference: every per-framework recipe
+    shards its record files by rank —
+    train_gluon_imagenet_byteps_gc.py's split DataLoader): worker
+    ``rank`` of ``world`` reads only shard files ``rank::world``
+    (disjoint and complete), shuffles WITHIN its shards per epoch with
+    a seed derived from (seed, epoch) — the same permutation on every
+    restart, different every epoch — and yields ``batch``-sized dicts
+    of arrays. Ragged tails are dropped (distributed steps need
+    identical batch shapes on every worker).
+
+    Every rank must take the SAME number of steps per epoch or the
+    stragglers' collectives hang the job, so the shard count must
+    divide evenly by ``world`` (enforced) and shards are assumed
+    equal-sized (the writer's contract — ``write_npz_shards``).
+
+    Feed the iterator to ``prefetch_to_mesh`` for the device side."""
+
+    def __init__(self, path, rank: int = 0, world: int = 1,
+                 seed: int = 0) -> None:
+        import glob
+        import os
+        self.files = sorted(glob.glob(os.path.join(path, "shard-*.npz")))
+        if not self.files:
+            raise FileNotFoundError(f"no shard-*.npz files under {path}")
+        if len(self.files) % max(world, 1) != 0:
+            raise ValueError(
+                f"{len(self.files)} shard files don't divide over "
+                f"{world} workers — unequal per-rank step counts would "
+                f"hang the stragglers' collectives; re-shard the "
+                f"dataset to a multiple of the worker count")
+        self.rank, self.world, self.seed = rank, world, seed
+        self.my_files = self.files[rank::world]
+
+    def epoch(self, epoch: int, batch: int) -> Iterator:
+        """One epoch of ``batch``-sized dicts from this rank's shards."""
+        rng = np.random.RandomState((self.seed * 1000003 + epoch)
+                                    & 0x7FFFFFFF)
+        order = rng.permutation(len(self.my_files))
+        yielded = 0
+        for fi in order:
+            with np.load(self.my_files[fi]) as z:
+                arrays = {k: z[k] for k in z.files}
+            n = len(next(iter(arrays.values())))
+            perm = rng.permutation(n)
+            for s in range(0, n - batch + 1, batch):
+                idx = perm[s:s + batch]
+                yield {k: v[idx] for k, v in arrays.items()}
+                yielded += 1
+        if yielded == 0:
+            # without this a too-large batch silently trains for zero
+            # steps and reports untrained "results"
+            raise ValueError(
+                f"batch={batch} exceeds every shard's sample count — "
+                f"no batches produced (batches never span shard files)")
+
+    def batches(self, batch: int, epochs: Optional[int] = None) -> Iterator:
+        """Epoch-concatenated stream (``epochs=None`` → endless)."""
+        e = 0
+        while epochs is None or e < epochs:
+            yield from self.epoch(e, batch)
+            e += 1
