@@ -1,0 +1,163 @@
+"""Server concurrency ceiling via frame REPLAY — clients that cost
+(almost) no CPU.
+
+The round-3 load bench (server_load_bench.py) runs full worker stacks
+— codec compress, RemotePSBackend framing, numpy — in W subprocesses,
+so past ~4 workers on a small box the CLIENTS saturate the CPU and the
+"server" row measures the box (docs/performance.md noted exactly
+this). Here the wire frames are PRE-GENERATED (headers with correct
+per-round dedup tokens + one reusable codec payload per key) and W
+lightweight threads just blast bytes and drain replies — pipelined
+sends, fixed-size acks, discard-only pull bodies. What remains is the
+SERVER: per-connection handler threads, native codec work, engine
+summation, round bookkeeping (reference comparison: the multi-threaded
+server engine sizing, server.cc:77-198).
+
+Usage:
+  python examples/server_replay_bench.py --workers 2,4,8,16 \
+      --keys 8 --elems 262144 --rounds 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from byteps_tpu.ops.compression.host import HostOnebit, serialize_kwargs
+from byteps_tpu.server.engine import PSServer
+from byteps_tpu.server.transport import (_HDR, _RSP, OP_INIT_C, OP_PUSH_C,
+                                         OP_PULL_C, PSTransportServer,
+                                         _recv_exact)
+
+KW = {"compressor_type": "onebit", "compressor_onebit_scaling": "true"}
+
+
+def _hdr(op: int, key: int, rnd: int, nbytes: int, plen: int,
+         timeout_ms: int = 120000) -> bytes:
+    return _HDR.pack(op, key, rnd, nbytes, timeout_ms, plen,
+                     b"float32\0")
+
+
+def replay_round(n_workers: int, keys: int, elems: int, rounds: int,
+                 port: int) -> float:
+    """W replay threads × ``rounds`` sync rounds of ``keys``
+    onebit-compressed keys. Returns wall seconds (max over threads)."""
+    codec = HostOnebit(elems, use_scale=True)
+    payload = codec.compress(
+        np.random.RandomState(0).randn(elems).astype(np.float32))
+    plen = len(payload)
+    dense_nbytes = elems * 4
+
+    # one INIT_C per key from a setup connection (idempotent server-side)
+    kwblob = serialize_kwargs(KW)
+    s = socket.create_connection(("127.0.0.1", port))
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    for k in range(keys):
+        s.sendall(_hdr(OP_INIT_C, k, 0, dense_nbytes, len(kwblob)) + kwblob)
+        status, _ = _RSP.unpack(bytes(_recv_exact(s, _RSP.size)))
+        assert status == 0, f"init key {k} failed"
+    s.close()
+
+    barrier = threading.Barrier(n_workers + 1)
+    errors = []
+
+    def client(wid: int) -> None:
+        try:
+            sock = socket.create_connection(("127.0.0.1", port))
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            inc = (wid + 1) << 32            # dedup incarnation
+            # PRE-BUILT frames: per (round, key) push header (the dedup
+            # token must advance) + the shared payload; pull headers
+            push_frames = [
+                [_hdr(OP_PUSH_C, k, inc | (r * keys + k + 1),
+                      dense_nbytes, plen)
+                 for k in range(keys)] for r in range(rounds)]
+            pull_frames = [
+                [_hdr(OP_PULL_C, k, r + 1, dense_nbytes, 0)
+                 for k in range(keys)] for r in range(rounds)]
+            barrier.wait()
+            for r in range(rounds):
+                # pipelined: all pushes on the wire, then drain acks
+                for k in range(keys):
+                    sock.sendall(push_frames[r][k])
+                    sock.sendall(payload)
+                for k in range(keys):
+                    status, _ = _RSP.unpack(
+                        bytes(_recv_exact(sock, _RSP.size)))
+                    assert status == 0, f"push r{r} k{k} -> {status}"
+                for k in range(keys):
+                    sock.sendall(pull_frames[r][k])
+                for k in range(keys):
+                    status, rlen = _RSP.unpack(
+                        bytes(_recv_exact(sock, _RSP.size)))
+                    assert status == 0, f"pull r{r} k{k} -> {status}"
+                    _recv_exact(sock, rlen)      # discard the payload
+            barrier.wait()
+            sock.close()
+        except BaseException as e:          # noqa: BLE001 — surfaced below
+            errors.append(e)
+            try:
+                barrier.abort()
+            except Exception:
+                pass
+
+    ts = [threading.Thread(target=client, args=(w,))
+          for w in range(n_workers)]
+    [t.start() for t in ts]
+    try:
+        barrier.wait()
+        t0 = time.perf_counter()
+        barrier.wait()
+        wall = time.perf_counter() - t0
+    except threading.BrokenBarrierError:
+        wall = float("nan")
+    [t.join() for t in ts]
+    if errors:
+        raise errors[0]
+    return wall
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workers", default="2,4,8,16")
+    ap.add_argument("--keys", type=int, default=8)
+    ap.add_argument("--elems", type=int, default=262144)
+    ap.add_argument("--rounds", type=int, default=20)
+    ap.add_argument("--threads", type=int, default=4,
+                    help="server engine threads")
+    args = ap.parse_args()
+
+    rows = []
+    for w in (int(x) for x in args.workers.split(",")):
+        be = PSServer(num_workers=w, engine_threads=args.threads)
+        srv = PSTransportServer(be, host="127.0.0.1", port=0)
+        try:
+            wall = replay_round(w, args.keys, args.elems, args.rounds,
+                                srv.port)
+        finally:
+            srv.close()
+            be.close()
+        n_rpc = w * args.keys * args.rounds * 2
+        dense_mb = w * args.keys * args.rounds * args.elems * 4 / 1e6
+        rows.append({"workers": w, "wall_s": round(wall, 3),
+                     "rpc_per_s": round(n_rpc / wall, 1),
+                     "dense_mb_per_s": round(dense_mb / wall, 1)})
+        print(rows[-1])
+    peak = max(rows, key=lambda r: r["dense_mb_per_s"])
+    print(json.dumps({"metric": "server_replay_ceiling",
+                      "value": peak["dense_mb_per_s"],
+                      "unit": "dense MB/s",
+                      "at_workers": peak["workers"], "rows": rows}))
+
+
+if __name__ == "__main__":
+    main()
