@@ -167,6 +167,22 @@ def write_npz_shards(path, arrays_fn: Callable[[int], dict],
     return files
 
 
+def _npz_sample_count(path) -> int:
+    """Leading-axis length of the first array in an .npz, read from the
+    member's npy header only — no array data is decompressed."""
+    import zipfile
+    with zipfile.ZipFile(path) as zf:
+        name = next((n for n in zf.namelist() if n.endswith(".npy")), None)
+        if name is None:
+            raise ValueError(f"{path} holds no arrays — not a dataset shard")
+        with zf.open(name) as f:
+            version = np.lib.format.read_magic(f)
+            reader = (np.lib.format.read_array_header_1_0 if version[0] == 1
+                      else np.lib.format.read_array_header_2_0)
+            shape, _, _ = reader(f)
+    return shape[0] if shape else 0
+
+
 class NpzShardDataset:
     """File-backed training dataset over a directory of .npz shards.
 
@@ -182,8 +198,14 @@ class NpzShardDataset:
 
     Every rank must take the SAME number of steps per epoch or the
     stragglers' collectives hang the job, so the shard count must
-    divide evenly by ``world`` (enforced) and shards are assumed
-    equal-sized (the writer's contract — ``write_npz_shards``).
+    divide evenly by ``world`` AND every shard must hold the same
+    number of samples. Both are enforced at construction when
+    ``world > 1`` — sample counts are read from the npz headers
+    (cheap; no array data is loaded) so externally produced unequal
+    shards fail loudly here instead of hanging a collective
+    mid-epoch. Single-process runs skip the size check: with one
+    rank there is no collective to hang and a short tail shard is
+    harmless.
 
     Feed the iterator to ``prefetch_to_mesh`` for the device side."""
 
@@ -200,6 +222,16 @@ class NpzShardDataset:
                 f"{world} workers — unequal per-rank step counts would "
                 f"hang the stragglers' collectives; re-shard the "
                 f"dataset to a multiple of the worker count")
+        counts = ([_npz_sample_count(f) for f in self.files]
+                  if world > 1 else [])
+        if len(set(counts)) > 1:
+            detail = ", ".join(
+                f"{os.path.basename(f)}={c}"
+                for f, c in zip(self.files, counts))
+            raise ValueError(
+                f"shard sample counts differ ({detail}) — ranks would "
+                f"take different per-epoch step counts and hang the "
+                f"stragglers' collectives; re-shard to equal sizes")
         self.rank, self.world, self.seed = rank, world, seed
         self.my_files = self.files[rank::world]
 

@@ -89,6 +89,24 @@ def _rel_row(rel_ref, ih, ht, t):
 _DT_PAD = (8, 128)
 
 
+def _clamp_ht(ht: int, h: int) -> int:
+    """Clamp a head tile to the dtable row bound (_DT_PAD[0]) while
+    keeping h % ht == 0. A plain min() can break divisibility — e.g. a
+    BPS_FLASH_HT=12 override with h=12 clamps to 8, the grid covers only
+    heads 0-7, and the kernel silently emits garbage for the rest — so
+    fall back to the largest divisor of h that fits the bound."""
+    clamped = min(ht, _DT_PAD[0])
+    while clamped > 1 and h % clamped != 0:
+        clamped -= 1
+    if clamped != ht:
+        from ..common.logging import get_logger
+        get_logger().warning(
+            "rel_table head tile clamped %d -> %d (dtable rows are "
+            "hard-sized to %d and h=%d must divide)", ht, clamped,
+            _DT_PAD[0], h)
+    return clamped
+
+
 def _table_grad(ds32, bucket, nb):
     """dL/d(table row), padded to the _DT_PAD lane count: sum of dS
     over positions in each bucket."""
@@ -231,7 +249,7 @@ def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret, out_dtype=None,
     ht = _head_tile(h, nq, nk, bq, bk, d, interpret,
                     mats=3 if rel is not None else 1)
     if rel is not None:
-        ht = min(ht, _DT_PAD[0])   # matches the bwd dtable tile bound
+        ht = _clamp_ht(ht, h)   # matches the bwd dtable tile bound
     grid = (b, h // ht, nq, nk)
     has_bias = bias is not None
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
@@ -473,7 +491,7 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, bq, bk, interpret,
         # the dtable scratch and output tiles are hard-sized to
         # _DT_PAD rows — a BPS_FLASH_HT override above that would
         # write out of bounds and break the drel reshape
-        ht = min(ht, _DT_PAD[0])
+        ht = _clamp_ht(ht, h)
     qspec = pl.BlockSpec((1, ht, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0))
     kspec = pl.BlockSpec((1, ht, bk, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0))
     r1spec = pl.BlockSpec((1, ht, bq, 1), lambda ib, ih, iq, ik: (ib, ih, iq, 0))

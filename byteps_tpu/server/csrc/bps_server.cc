@@ -904,6 +904,13 @@ void bps_codec_xorshift_indices(uint64_t n_range, uint64_t k,
 // exactly the loop that belongs here. ``scale`` is computed by the
 // caller (max or L2 — numpy's pairwise L2 sum is kept on both paths
 // by construction). qbits 8 → int8 out, else int16.
+//
+// NaN input is UNDEFINED for this codec (on both paths): the branchless
+// sign below maps NaN to 0 while numpy's np.sign(NaN)*q propagates NaN
+// and casts it to an unspecified int — byte equality between the native
+// and Python paths is only contracted for finite gradients. A NaN
+// blowup should be caught upstream (debug sampling / grad clipping),
+// not inside a lossy quantizer.
 void bps_codec_dithering_compress(const float* x, uint64_t n, float scale,
                                   int s, int ptype, int qbits,
                                   uint64_t* state, void* out_q) {

@@ -137,6 +137,22 @@ def test_npz_shards_refuses_underprovisioned_world(tmp_path):
         NpzShardDataset(str(tmp_path / "ds"), rank=0, world=3)
 
 
+def test_npz_shards_refuses_unequal_sample_counts(tmp_path):
+    """ADVICE r4: externally produced shards with unequal sample counts
+    give ranks different per-epoch step counts — the exact distributed
+    hang the class exists to prevent. Must fail loudly at construction,
+    not hang a collective mid-epoch."""
+    from byteps_tpu.data import NpzShardDataset, write_npz_shards
+
+    def uneven(i):
+        n = 32 if i == 0 else 24
+        return {"x": np.zeros((n, 3), np.float32)}
+
+    write_npz_shards(str(tmp_path / "ds"), uneven, 2)
+    with pytest.raises(ValueError, match="sample counts differ"):
+        NpzShardDataset(str(tmp_path / "ds"), rank=0, world=2)
+
+
 def test_file_backed_training_end_to_end(tmp_path, mesh):
     """The full recipe: shard files → NpzShardDataset →
     prefetch_to_mesh → DistributedTrainer with a compressed exchange.

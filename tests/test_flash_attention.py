@@ -208,6 +208,33 @@ def test_mismatched_bias_cross():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_rel_table_ht_clamp_keeps_divisibility(monkeypatch):
+    """ADVICE r4 (medium): clamping a BPS_FLASH_HT override to the
+    dtable row bound must re-check h % ht — BPS_FLASH_HT=12 with h=12
+    clamped to min(12, 8)=8 would cover only heads 0-7 and silently
+    emit garbage for the rest. The clamp must land on a divisor (6)."""
+    from byteps_tpu.ops.flash_attention import _clamp_ht
+    assert _clamp_ht(12, 12) == 6
+    assert _clamp_ht(8, 16) == 8
+    assert _clamp_ht(16, 16) == 8
+    assert _clamp_ht(7, 7) == 7
+    assert _clamp_ht(5, 5) == 5      # already <= bound, kept
+    assert _clamp_ht(13, 13) == 1    # prime > bound: no divisor fits
+
+    from byteps_tpu.ops.relpos import relative_bias
+    monkeypatch.setenv("BPS_FLASH_HT", "12")
+    rng = np.random.RandomState(7)
+    b, s, h, d, nb = 1, 128, 12, 8, 16
+    q, k, v = make_qkv(rng, b, s, h, d, np.float32)
+    table = jnp.asarray(rng.randn(h, nb).astype(np.float32))
+    out = flash_attention(q, k, v, False, 1.0, 128, 128, True, False,
+                          rel_table=table)
+    mat = relative_bias(table.T, s, s, True, nb, 128)
+    ref = local_attention(q, k, v, causal=False, scale=1.0, bias=mat)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
 @pytest.mark.parametrize("causal,bidir", [(False, True), (True, False)])
 def test_rel_table_in_kernel_exact(causal, bidir):
     """T5 relative bias computed IN-KERNEL from the [h, nb] table
