@@ -33,6 +33,7 @@
 #include <deque>
 #include <mutex>
 #include <memory>
+#include <queue>
 #include <thread>
 #include <unordered_map>
 #include <vector>
@@ -200,9 +201,21 @@ class EngineThread {
   }
 
   void Push(Task&& t) {
+    // snapshot the priority BEFORE taking mu_: PushCount waits on the
+    // key lock, which Apply holds across a long OMP reduce — taking it
+    // under mu_ would serialize every producer (and the engine's next
+    // wakeup) behind that reduce
+    const int count = schedule_ ? CurCount(t.key) : 0;
     {
       std::lock_guard<std::mutex> lk(mu_);
-      queue_.push_back(std::move(t));
+      if (schedule_) {
+        const uint64_t key = t.key;
+        buckets_[key].push_back(std::move(t));
+        heap_.push(HeapEntry{count, seq_++, key});
+        ++pending_;
+      } else {
+        queue_.push_back(std::move(t));
+      }
     }
     cv_.notify_one();
   }
@@ -211,14 +224,45 @@ class EngineThread {
 
  private:
   void Run();
-  size_t PickNext();  // index into queue_, priority-aware
+  bool PopNext(Task* out);   // callers hold mu_; false iff nothing queued
+  int CurCount(uint64_t key);
+
+  // Scheduled mode is a max-heap over (push count, FIFO seq) with
+  // per-key FIFO buckets. Priorities go stale when a round applies or
+  // publishes, but every key is sticky to ONE engine thread, so a
+  // key's push count only moves while THIS thread runs Apply. Two
+  // mechanisms keep the heap honest without rescanning it:
+  //   - downward (publish reset): a popped entry whose snapshot no
+  //     longer matches is re-pushed with the fresh count;
+  //   - upward (a push applied): Run() inserts a fresh-count entry for
+  //     the applied key if it still has queued tasks, so a key climbing
+  //     toward publication surfaces above keys it now outranks —
+  //     buried stale-low entries can never starve it.
+  // Residual window: a push whose pre-lock snapshot raced the same
+  // key's Apply can sit one notch low until popped-and-refreshed or
+  // until the key's next Apply — a transient mis-ordering, never a
+  // drop. O(log n) amortized per task vs the previous O(queue) scan
+  // per pick, which went O(n^2) under deep backlogs.
+  struct HeapEntry {
+    int count;
+    uint64_t seq;
+    uint64_t key;
+    bool operator<(const HeapEntry& o) const {
+      if (count != o.count) return count < o.count;  // higher count wins
+      return seq > o.seq;                            // then FIFO
+    }
+  };
 
   Server* srv_;
   int id_;
   bool schedule_;
   std::mutex mu_;
   std::condition_variable cv_;
-  std::deque<Task> queue_;
+  std::deque<Task> queue_;                              // FIFO mode
+  std::unordered_map<uint64_t, std::deque<Task>> buckets_;  // scheduled
+  std::priority_queue<HeapEntry> heap_;
+  uint64_t seq_ = 0;
+  size_t pending_ = 0;
   bool stop_ = false;
   std::thread thread_;
 };
@@ -698,18 +742,43 @@ class Server {
   std::vector<std::unique_ptr<EngineThread>> engines_;
 };
 
-size_t EngineThread::PickNext() {
-  if (!schedule_ || queue_.size() == 1) return 0;
-  // priority: the key with the most pushes already applied this round is
-  // closest to publishing — run its tasks first (reference: queue.h
-  // compare on push_cnt under BYTEPS_SERVER_ENABLE_SCHEDULE)
-  size_t best = 0;
-  int best_cnt = -1;
-  for (size_t i = 0; i < queue_.size(); ++i) {
-    int c = srv_->PushCount(queue_[i].key);
-    if (c > best_cnt) { best_cnt = c; best = i; }
+int EngineThread::CurCount(uint64_t key) { return srv_->PushCount(key); }
+
+// Priority: the key with the most pushes already applied this round is
+// closest to publishing — run its tasks first (reference: queue.h
+// compare on push_cnt under BYTEPS_SERVER_ENABLE_SCHEDULE). Caller
+// holds mu_.
+bool EngineThread::PopNext(Task* out) {
+  if (!schedule_) {
+    if (queue_.empty()) return false;
+    *out = std::move(queue_.front());
+    queue_.pop_front();
+    return true;
   }
-  return best;
+  while (!heap_.empty()) {
+    HeapEntry e = heap_.top();
+    auto it = buckets_.find(e.key);
+    if (it == buckets_.end() || it->second.empty()) {
+      heap_.pop();               // entry outlived its bucket — drop it
+      continue;
+    }
+    const int cur = CurCount(e.key);
+    if (cur != e.count) {
+      // stale snapshot: refresh in place. Counts are frozen while we
+      // hold the pick (only this thread's Apply moves them), so each
+      // entry refreshes at most once per pick loop — no livelock.
+      heap_.pop();
+      heap_.push(HeapEntry{cur, e.seq, e.key});
+      continue;
+    }
+    *out = std::move(it->second.front());
+    it->second.pop_front();
+    if (it->second.empty()) buckets_.erase(it);
+    heap_.pop();
+    --pending_;
+    return true;
+  }
+  return false;
 }
 
 void EngineThread::Run() {
@@ -717,13 +786,26 @@ void EngineThread::Run() {
     Task t;
     {
       std::unique_lock<std::mutex> lk(mu_);
-      cv_.wait(lk, [this] { return stop_ || !queue_.empty(); });
-      if (stop_ && queue_.empty()) return;
-      size_t idx = PickNext();
-      t = std::move(queue_[idx]);
-      queue_.erase(queue_.begin() + idx);
+      cv_.wait(lk, [this] {
+        return stop_ || pending_ != 0 || !queue_.empty();
+      });
+      if (!PopNext(&t)) {
+        if (stop_) return;
+        continue;
+      }
     }
     srv_->Apply(t);
+    if (schedule_) {
+      // the applied key's count just moved (one push closer to
+      // publishing, or reset by the publish): surface its new rank so
+      // its remaining queued tasks compete at the fresh priority.
+      // Count read outside mu_ (same reasoning as Push).
+      const int cur = CurCount(t.key);
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = buckets_.find(t.key);
+      if (it != buckets_.end() && !it->second.empty())
+        heap_.push(HeapEntry{cur, seq_++, t.key});
+    }
   }
 }
 
