@@ -49,11 +49,11 @@ def mlm_setup(cfg, batch: int, seq: int):
     return params, data, loss_fn
 
 
-def time_plain_steps(params, data, loss_fn, batch: int, iters: int,
-                     warm: int) -> float:
-    """samples/sec of a donated, jitted plain-JAX train step (no
-    framework wrapper). Consumes ``params`` (donation)."""
-    tx = optax.adamw(1e-4)
+def make_plain_step(loss_fn, tx):
+    """The baseline arm: a donated, jitted plain-JAX train step with no
+    framework wrapper. ONE definition shared by the headline bench's
+    alternating windows, the dh128 variant and examples/perf_lab.py, so
+    the arms can never silently diverge."""
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def step(p, s, b):
@@ -61,6 +61,15 @@ def time_plain_steps(params, data, loss_fn, batch: int, iters: int,
         u, s = tx.update(g, s, p)
         return optax.apply_updates(p, u), s, l
 
+    return step
+
+
+def time_plain_steps(params, data, loss_fn, batch: int, iters: int,
+                     warm: int) -> float:
+    """samples/sec of the plain baseline step (one timed window).
+    Consumes ``params`` (donation)."""
+    tx = optax.adamw(1e-4)
+    step = make_plain_step(loss_fn, tx)
     state = tx.init(params)
     jb = jax.tree_util.tree_map(np.asarray, data)
     for _ in range(warm):
@@ -198,33 +207,93 @@ def main() -> None:
     else:  # CPU smoke fallback so the bench always emits a line
         cfg = bert.bert_tiny()
         batch, seq = 8, 32
-        iters = 3
+        iters = 25      # tiny-model steps are ~ms: enough iters that the
+                        # smoke ratio isn't scheduler noise (3 iters
+                        # measured anywhere in 0.47-1.04x run to run)
 
     params, data, loss_fn = mlm_setup(cfg, batch, seq)
 
     # The first seconds of execution on a fresh process/tunnel run a few
-    # percent slow, so EACH phase runs `warm` untimed steps before its
-    # timed window — enough to saturate chip warmup so phase order doesn't
-    # bias the ratio. (The two phases can't coexist: two param+adam copies
-    # of BERT-large exceed one chip's HBM, hence the del/gc between them.)
+    # percent slow, and the tunnel's speed drifts on the scale of a
+    # phase (±0.05% swung vs_baseline across whole runs). So instead of
+    # one long window per arm, the two arms ALTERNATE short timed
+    # windows (A-B-A-B-A-B): slow drift hits both arms equally and
+    # cancels in the ratio. The arms still can't hold params+adam state
+    # resident simultaneously (two BERT-large copies + activations
+    # don't fit HBM), so each window re-inits its arm's state and
+    # del/gc's it after — the jitted executables stay cached, only the
+    # ~1 GB state transfer is repaid, outside the timed region.
     warm = 3 if on_tpu else 1
-
-    # donate a COPY: `params` itself seeds the framework phase below
-    p2 = jax.tree_util.tree_map(jax.numpy.array, params)
-    plain_sps = time_plain_steps(p2, data, loss_fn, batch, iters, warm)
-    del p2
+    windows = 3 if on_tpu else 2
     import gc
+
+    tx = optax.adamw(1e-4)
+    plain_step = make_plain_step(loss_fn, tx)
+
+    jb = jax.tree_util.tree_map(np.asarray, data)
+    trainer = DistributedTrainer(loss_fn, params, optax.adamw(1e-4))
+    tr_params0, tr_ostate0 = trainer.params, trainer.opt_state
+    # the trainer holds its own copy; keeping the construction copy
+    # resident would press on HBM through every timed window
+    del params
     gc.collect()
 
-    trainer = DistributedTrainer(loss_fn, params, optax.adamw(1e-4))
-    for _ in range(warm):                   # compile + chip warmup (readback
-        loss = trainer.step(data)           # forces real execution on the
-    float(loss)                             # tunnel)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = trainer.step(data)
-    float(loss)                             # chained deps -> full timing
-    fw_sps = batch * iters / (time.perf_counter() - t0)
+    # per-window re-seed runs ON DEVICE (the jitted init recomputes the
+    # same params from the seed) — a host-side stash would re-cross the
+    # tunnel with >1 GB per window and dominate the bench wall clock
+    from byteps_tpu.models import transformer as _transformer
+    reinit = jax.jit(
+        lambda: _transformer.init_params(jax.random.PRNGKey(0), cfg))
+
+    def plain_window(first: bool) -> float:
+        p = reinit()
+        s = tx.init(p)
+        for _ in range(warm if first else 1):
+            p, s, l = plain_step(p, s, jb)
+        float(l)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            p, s, l = plain_step(p, s, jb)
+        float(l)
+        dt = time.perf_counter() - t0
+        del p, s
+        gc.collect()
+        return dt
+
+    def fw_window(first: bool) -> float:
+        if first:
+            trainer.params, trainer.opt_state = tr_params0, tr_ostate0
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            rep = NamedSharding(trainer.mesh, P())
+            trainer.params = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, rep), reinit())
+            from byteps_tpu.parallel.sharding import init_sharded_state
+            trainer.opt_state = init_sharded_state(
+                trainer.tx, trainer.params, trainer._ostate_spec,
+                trainer.mesh)
+        for _ in range(warm if first else 1):
+            loss = trainer.step(data)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = trainer.step(data)
+        float(loss)                         # chained deps -> full timing
+        dt = time.perf_counter() - t0
+        trainer.params = trainer.opt_state = None
+        gc.collect()
+        return dt
+
+    # framework windows run FIRST in each pair: the trainer's resident
+    # param+adam state is freed at the end of its window, so the plain
+    # arm never shares HBM with it (the reverse order measured the
+    # plain arm 2.4x slow from exactly that pressure)
+    plain_t = fw_t = 0.0
+    for w in range(windows):
+        fw_t += fw_window(first=w == 0)
+        plain_t += plain_window(first=w == 0)
+    plain_sps = batch * iters * windows / plain_t
+    fw_sps = batch * iters * windows / fw_t
 
     # absolute chip accountability: analytic model FLOPs (no remat
     # recompute counted) against the chip's bf16 peak — "1.0 vs baseline"
@@ -260,7 +329,7 @@ def main() -> None:
         # plateau analysis: the d-64 gap is head-geometry, not kernel
         # quality (docs/performance.md "Where the other 61% goes")
         import dataclasses
-        del trainer, params, data
+        del trainer, data
         gc.collect()
         try:   # a transient here must not cost the headline line above
             cfg128 = dataclasses.replace(cfg, heads=8)

@@ -24,6 +24,7 @@ shapes allow, pure-JAX blockwise otherwise (CPU tests, odd shapes).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -474,6 +475,73 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
             dv_ref[0, t] = dv_acc[r].astype(dv_ref.dtype)
 
 
+def _dqkv_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dq_ref, dk_ref, dv_ref, *, scale, causal, bq, bk,
+                       ht):
+    """Single-block-pair fused backward: when the whole sequence is one
+    (bq, bk) block per (b, head) — the flagship seq-512 geometry — the
+    split dq / dkv kernels each recompute s, p and dp just to emit
+    their own outputs (7 matmuls + 2 exp sweeps total). One kernel
+    computes the shared recompute once and emits all three gradients:
+    5 matmuls + 1 exp, and q/k/v/do cross HBM once instead of twice."""
+    for t in range(ht):
+        q = q_ref[0, t]                                     # [bq, d]
+        k = k_ref[0, t]                                     # [bk, d]
+        v = v_ref[0, t]
+        do = do_ref[0, t]
+        lse = lse_ref[0, t]                                 # [bq, 1]
+        delta = delta_ref[0, t]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # [bq, bk]
+        p = jnp.exp(s - lse)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            p = jnp.where(rows >= cols, p, 0.0)
+        pt = p.astype(do.dtype)
+        dv_ref[0, t] = jax.lax.dot_general(
+            pt, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [bq, bk]
+        ds32 = p * (dp - delta)
+        ds = ds32.astype(q.dtype)
+        dq_ref[0, t] = (jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+            * scale).astype(dq_ref.dtype)
+        dk_ref[0, t] = (jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+            * scale).astype(dk_ref.dtype)
+
+
+def _flash_bwd_fused(q, k, v, lse, do, delta, causal, scale, bq, bk,
+                     interpret, ht):
+    """One pallas_call emitting (dq, dk, dv); caller guarantees
+    nq == nk == 1 and no bias/rel_table."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    spec_q = pl.BlockSpec((1, ht, bq, d), lambda ib, ih: (ib, ih, 0, 0))
+    spec_k = pl.BlockSpec((1, ht, bk, d), lambda ib, ih: (ib, ih, 0, 0))
+    spec_r1 = pl.BlockSpec((1, ht, bq, 1), lambda ib, ih: (ib, ih, 0, 0))
+    return pl.pallas_call(
+        functools.partial(_dqkv_fused_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, ht=ht),
+        grid=(b, h // ht),
+        in_specs=[spec_q, spec_k, spec_k, spec_q, spec_r1, spec_r1],
+        out_specs=[spec_q, spec_k, spec_k],
+        out_shape=[jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+                   jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, h, sk, d), v.dtype)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+
 def _flash_bwd(q, k, v, out, lse, do, causal, scale, bq, bk, interpret,
                delta=None, bias=None, rel_table=None, rel=None):
     b, h, sq, d = q.shape
@@ -485,6 +553,13 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, bq, bk, interpret,
 
     has_bias = bias is not None
     has_rel = rel is not None
+    if (not has_bias and not has_rel and nq == 1 and nk == 1
+            and os.environ.get("BPS_FLASH_FUSED_BWD", "1") != "0"):
+        # mats=4: p, dp, ds32 and the cast ds are live per unrolled head
+        ht_f = _head_tile(h, nq, nk, bq, bk, d, interpret, mats=4)
+        dq, dk, dv = _flash_bwd_fused(q, k, v, lse, do, delta, causal,
+                                      scale, bq, bk, interpret, ht_f)
+        return dq, dk, dv, None, None
     ht = _head_tile(h, nq, nk, bq, bk, d, interpret,
                     mats=5 if has_rel else (4 if has_bias else 3))
     if has_rel:

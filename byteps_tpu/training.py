@@ -258,14 +258,17 @@ class DistributedTrainer:
     def _build_step(self, donate: bool):
         axes, mesh, loss_fn, tx = self.axes, self.mesh, self._loss_fn, self.tx
         batch_spec = P(axes) if axes else P()
+        # size-1 axes are identity means — keep them out of the lowered
+        # collective (they cost an HLO op and a fusion barrier for nothing)
+        loss_axes = tuple(a for a in axes if mesh.shape[a] > 1)
 
         def step(params, opt_state, batch):
             loss, grads = jax.value_and_grad(loss_fn)(params, batch)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             # loss is per-shard; report the global mean
-            if axes:
-                loss = jax.lax.pmean(loss, axes)
+            if loss_axes:
+                loss = jax.lax.pmean(loss, loss_axes)
             return params, opt_state, loss
 
         shard_fn = jax.shard_map(
@@ -274,7 +277,20 @@ class DistributedTrainer:
             out_specs=(P(), self._ostate_spec, P()),
             check_vma=False)
         donate_argnums = (0, 1) if donate else ()
-        return jax.jit(shard_fn, donate_argnums=donate_argnums)
+        # Explicit in_shardings let step() hand a HOST batch straight to
+        # the jitted call — placement happens inside the one dispatch,
+        # like a plain jitted step — instead of paying a separate eager
+        # device_put dispatch per step (measured as the entire
+        # vs_baseline gap on the flagship bench, docs/performance.md).
+        rep = NamedSharding(mesh, P())
+        ostate_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), self._ostate_spec,
+            is_leaf=lambda x: isinstance(x, P))
+        return jax.jit(
+            shard_fn,
+            in_shardings=(rep, ostate_shardings,
+                          NamedSharding(mesh, batch_spec)),
+            donate_argnums=donate_argnums)
 
     def _build_ps_step(self, donate: bool):
         """Split step for PS deployments: grads and update are separate
@@ -398,7 +414,20 @@ class DistributedTrainer:
             return self._async_ps_step(batch)
         if self._ps_engine is not None:
             return self._ps_step(batch)
-        batch = self.shard_batch(batch)
+        if (jax.process_count() > 1
+                or any(isinstance(l, jax.Array)
+                       for l in jax.tree_util.tree_leaves(batch))):
+            # committed device arrays must be resharded eagerly (jit's
+            # explicit in_shardings rejects a mismatched committed
+            # array rather than resharding it; device_put is a no-op
+            # when the placement already matches, e.g. prefetch_to_mesh)
+            # — and multi-process meshes can't place raw numpy through
+            # in_shardings at all ("non-trivial shardings for numpy
+            # inputs"), so they always take the device_put path
+            batch = self.shard_batch(batch)
+        # single-process host (numpy) batches go straight in: the step's
+        # in_shardings place them inside the jit dispatch — one dispatch
+        # per step
         self.params, self.opt_state, loss = self._step_fn(
             self.params, self.opt_state, batch)
         self.step_count += 1
