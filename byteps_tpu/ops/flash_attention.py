@@ -698,6 +698,14 @@ def _resolve(q, k, scale, block_q, block_k):
     sk = k.shape[1]
     if scale is None:
         scale = d ** -0.5
+    # sweep/tuning overrides (examples/flash_block_sweep.py): applied
+    # before the shape-shrink so every call site is covered uniformly
+    env_q = int(os.environ.get("BPS_FLASH_BQ", "0"))
+    env_k = int(os.environ.get("BPS_FLASH_BK", "0"))
+    if env_q:
+        block_q = env_q
+    if env_k:
+        block_k = env_k
     bq = _pick_block(sq, min(block_q, sq))
     bk = _pick_block(sk, min(block_k, sk))
     return scale, bq, bk

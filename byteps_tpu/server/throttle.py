@@ -82,14 +82,29 @@ class Nic:
         self.latency = float(latency)
         self.tx = TokenBucket(rate, burst)
         self.rx = TokenBucket(rate, burst)
+        # wire accounting (every byte, incl. exempt control frames):
+        # the scaling-curve rig asserts these against the analytic
+        # per-endpoint byte model — noise-free evidence the stack's
+        # wire pattern matches the scaling story, where wall clock on
+        # a shared-core box cannot be (examples/scaling_curve_emu.py).
+        # Locked: one Nic is shared by concurrent connections/threads
+        # (that sharing is the whole point, see TokenBucket), and an
+        # unlocked += loses updates under interleaving
+        self.tx_bytes = 0
+        self.rx_bytes = 0
+        self._count_lock = threading.Lock()
 
     def on_send(self, n: int) -> None:
+        with self._count_lock:
+            self.tx_bytes += n
         if self.latency:
             time.sleep(self.latency)
         if n > self.SMALL_FRAME:
             self.tx.consume(n)
 
     def on_recv(self, n: int) -> None:
+        with self._count_lock:
+            self.rx_bytes += n
         if n > self.SMALL_FRAME:
             self.rx.consume(n)
 

@@ -123,6 +123,7 @@ class RingPeer:
         if err:
             raise err[0]
         self._tx_raw, self._rx_raw = out_sock[0], conn
+        self.nic = nic             # byte counters read by the curve rig
         if nic is not None:
             self._tx = ThrottledSocket(out_sock[0], nic)
             self._rx = ThrottledSocket(conn, nic)
@@ -258,9 +259,12 @@ def _worker_ring() -> Dict:
     losses = []
     t0 = None
     warm = 1
+    tx0 = rx0 = 0
     for step in range(steps + warm):
         if step == warm:
             t0 = time.perf_counter()
+            if ring.nic is not None:       # wire accounting: timed steps
+                tx0, rx0 = ring.nic.tx_bytes, ring.nic.rx_bytes
         opt.zero_grad()
         loss = torch.nn.functional.mse_loss(model(x), y)
         loss.backward()
@@ -277,8 +281,12 @@ def _worker_ring() -> Dict:
     dt = time.perf_counter() - t0
     q.put(None)
     ct.join(10)
+    out = {"sps": batch * steps / dt, "losses": losses}
+    if ring.nic is not None:
+        out["tx_per_step"] = (ring.nic.tx_bytes - tx0) / steps
+        out["rx_per_step"] = (ring.nic.rx_bytes - rx0) / steps
     ring.close()
-    return {"sps": batch * steps / dt, "losses": losses}
+    return out
 
 
 def _worker_ps() -> Dict:
@@ -311,9 +319,14 @@ def _worker_ps() -> Dict:
     bps.broadcast_parameters(model.state_dict(), root_rank=0)
     x, y = _global_batch(width, batch)
 
+    from ..common.global_state import GlobalState
+    gs = GlobalState._instance
+    nic = getattr(gs.ps_backend, "_nic", None) if gs is not None else None
+
     losses = []
     t0 = None
     warm = 1
+    tx0 = rx0 = 0
     if mode == "cb":
         opt.step()                        # step 0 (init)
     for step in range(steps + warm):
@@ -321,6 +334,8 @@ def _worker_ps() -> Dict:
             if mode == "cb":
                 opt.flush()               # timing starts clean
             t0 = time.perf_counter()
+            if nic is not None:           # wire accounting: timed steps
+                tx0, rx0 = nic.tx_bytes, nic.rx_bytes
         opt.zero_grad()
         loss = torch.nn.functional.mse_loss(model(x), y)
         loss.backward()
@@ -329,10 +344,14 @@ def _worker_ps() -> Dict:
     if mode == "cb":
         opt.flush()
     dt = time.perf_counter() - t0
+    out = {"sps": batch * steps / dt, "losses": losses}
+    if nic is not None:
+        out["tx_per_step"] = (nic.tx_bytes - tx0) / steps
+        out["rx_per_step"] = (nic.rx_bytes - rx0) / steps
     if mode == "cb":
         opt.close()
     bps.shutdown()
-    return {"sps": batch * steps / dt, "losses": losses}
+    return out
 
 
 def _worker_main() -> None:
@@ -466,9 +485,15 @@ def run_training(mode: str, n_workers: int, rate: float,
                                f"{out[-2000:]}")
         results.append(json.loads(line[-1].split(" ", 1)[1]))
     # the straggler sets training speed; trajectories must agree anyway
-    return {"sps": min(r["sps"] for r in results),
-            "losses": results[0]["losses"],
-            "all_losses": [r["losses"] for r in results]}
+    out = {"sps": min(r["sps"] for r in results),
+           "losses": results[0]["losses"],
+           "all_losses": [r["losses"] for r in results]}
+    if results and "tx_per_step" in results[0]:
+        out["tx_per_step"] = (sum(r["tx_per_step"] for r in results)
+                              / len(results))
+        out["rx_per_step"] = (sum(r["rx_per_step"] for r in results)
+                              / len(results))
+    return out
 
 
 if __name__ == "__main__":

@@ -1,0 +1,61 @@
+"""CI guard for the emulated scaling curve (VERDICT r4 #2).
+
+Runs the REAL stack — torch plugin workers, transport frames, native
+server engine, token-bucket NICs — at N worker processes and asserts
+the per-endpoint wire bytes against the analytic model the scaling
+story rests on:
+
+    ring worker: tx = rx = 2(N-1)/N * G
+    ps   worker: tx = rx = G            (flat in N — the PS claim)
+
+Byte accounting is noise-free (counted by throttle.Nic under the real
+framing), so the tolerance is tight; wall clock on this shared-core CI
+box is scheduler-dominated and is NOT asserted here (see
+examples/scaling_curve_emu.py for the full measured table).
+"""
+
+import sys
+
+import pytest
+
+from byteps_tpu.server.train_emu import run_training
+
+WIDTH, DEPTH = 256, 8
+GRAD_BYTES = DEPTH * (WIDTH * WIDTH + WIDTH) * 4
+RATE = 40e6
+
+
+def model_bytes(mode: str, n: int) -> float:
+    if mode == "ring":
+        return 2 * (n - 1) / n * GRAD_BYTES
+    return float(GRAD_BYTES)
+
+
+@pytest.mark.parametrize("mode,n", [("ring", 8), ("ps", 8), ("ps", 16)])
+def test_wire_bytes_match_scaling_model(mode, n):
+    if sys.platform != "linux":
+        pytest.skip("process-fleet emulation is linux-only in CI")
+    r = run_training(mode, n, rate=RATE, steps=4, width=WIDTH,
+                     depth=DEPTH, batch=64, timeout=1500.0)
+    mb = model_bytes(mode, n)
+    # ring payload is exact (raw numpy chunks); PS pays frame headers +
+    # key-addressed requests — measured 0.3% at N=8, bounded at 5%
+    tol = 0.02 if mode == "ring" else 0.05
+    for d in ("tx_per_step", "rx_per_step"):
+        ratio = r[d] / mb
+        assert abs(ratio - 1) <= tol, (
+            f"{mode} N={n} {d}: {r[d]:.0f} B vs model {mb:.0f} B "
+            f"(ratio {ratio:.4f}) — the stack's wire pattern diverged "
+            f"from the scaling model")
+
+
+def test_ps_bytes_flat_in_n():
+    """The PS scaling claim in one assert: per-worker wire bytes do not
+    grow with N (ring's grow toward 2G)."""
+    if sys.platform != "linux":
+        pytest.skip("process-fleet emulation is linux-only in CI")
+    r8 = run_training("ps", 8, rate=RATE, steps=3, width=WIDTH,
+                      depth=DEPTH, batch=64, timeout=1500.0)
+    r16 = run_training("ps", 16, rate=RATE, steps=3, width=WIDTH,
+                       depth=DEPTH, batch=64, timeout=1500.0)
+    assert r16["tx_per_step"] <= r8["tx_per_step"] * 1.05
