@@ -1,0 +1,117 @@
+"""Transport wire-speed work (VERDICT r4 #4): connection striping
+protocol correctness + a utilization regression floor.
+
+The round-5 fast paths (whole-frame token charge, zero-copy pull
+receive, reused server recv buffer) lifted the 10 Gbps emulated-NIC
+push utilization from 32% (r4 single-stream) to 79-98% depending on
+payload mix — the floor asserted here is far below the measured band
+but far above the r4 number, so a regression to chunked-Python
+pacing fails CI without flaking on a busy box.
+
+Striping (BPS_STRIPE_MIN > 0) splits one logical push/pull over the
+connection pool with server-side reassembly/scatter. It is OFF by
+default (measured negative on single-core hosts — no extra cycles to
+win) but the protocol must stay exact for the multi-core deployments
+it exists for.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.server.engine import PSServer
+from byteps_tpu.server.throttle import Nic
+from byteps_tpu.server.transport import PSTransportServer, RemotePSBackend
+
+
+@pytest.fixture
+def rig():
+    made = []
+
+    def make(nic_rate: float = 0.0, stripe_min: int = 0):
+        os.environ["BPS_STRIPE_MIN"] = str(stripe_min)
+        mk = (lambda: Nic(nic_rate)) if nic_rate else (lambda: None)
+        be = PSServer(num_workers=1, engine_threads=2)
+        srv = PSTransportServer(be, host="127.0.0.1", port=0, nic=mk())
+        cli = RemotePSBackend([f"127.0.0.1:{srv.port}"], nic=mk())
+        made.append((cli, srv, be))
+        return cli
+
+    yield make
+    os.environ.pop("BPS_STRIPE_MIN", None)
+    for cli, srv, be in made:
+        cli.close()
+        srv.close()
+        be.close()
+
+
+def test_striped_push_pull_matches_dense(rig):
+    """The striped wire path must be byte-exact with the dense one,
+    across rounds, for sizes that do and don't divide the part count."""
+    cli = rig(stripe_min=1 << 20)
+    rs = np.random.RandomState(0)
+    for key, elems in ((0, (8 << 20) // 4), (1, 1_000_003)):
+        x = rs.randn(elems).astype(np.float32)
+        cli.init_key(key, x.nbytes)
+        out = np.empty_like(x)
+        for rnd in range(1, 4):
+            cli.push(key, x)
+            cli.pull(key, out, round=rnd, timeout_ms=60000)
+            np.testing.assert_array_equal(out, x)
+
+
+def test_striped_retry_applies_once(rig):
+    """Re-sent parts (same dedup token) must not double-apply: the
+    server reassembles per (key, token) and dedups the logical push."""
+    cli = rig(stripe_min=1 << 20)
+    x = np.ones((4 << 20) // 4, np.float32)
+    cli.init_key(0, x.nbytes)
+    tok = cli._push_token(0)
+    view = memoryview(x).cast("B")
+    from byteps_tpu.server.transport import _PART, OP_PUSH_PART
+    ranges = cli._stripe_ranges(len(view))
+    assert ranges and len(ranges) >= 2
+    n = len(ranges)
+    for _ in range(2):                     # send the whole set TWICE
+        for pi, (off, ln) in enumerate(ranges):
+            cli._rpc(OP_PUSH_PART, 0, tok, len(view), 0, "float32",
+                     (_PART.pack(off, ln, pi, n), view[off:off + ln]))
+    out = np.empty_like(x)
+    cli.pull(0, out, round=1, timeout_ms=60000)
+    np.testing.assert_array_equal(out, x)  # ones, not twos
+
+
+def test_throttled_push_utilization_floor(rig):
+    """Regression floor for the wire fast path: ≥45% of a 10 Gbps NIC
+    on 8 MB pushes (r4's chunked path measured 32%; round 5 measures
+    79-98% — see docs/performance.md)."""
+    rate = 10e9 / 8
+    cli = rig(nic_rate=rate)
+    NB = 8 << 20
+    x = np.random.RandomState(0).randn(NB // 4).astype(np.float32)
+    cli.init_key(0, NB)
+    cli.push(0, x)                         # warm (dials, first buffers)
+    iters = 12
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        cli.push(0, x)
+    dt = time.perf_counter() - t0
+    util = NB * iters / dt / rate
+    assert util >= 0.45, f"push utilization regressed: {util:.2%}"
+
+
+def test_byte_accounting_exact_for_large_frames(rig):
+    """tx accounting must cover EVERY chunk of a multi-chunk frame (the
+    r4 counter only saw the first 64 KB of each chunked send)."""
+    rate = 10e9 / 8
+    cli = rig(nic_rate=rate)
+    NB = 8 << 20
+    x = np.zeros(NB // 4, np.float32)
+    cli.init_key(0, NB)
+    nic = cli._nic
+    tx0 = nic.tx_bytes
+    cli.push(0, x)
+    sent = nic.tx_bytes - tx0
+    assert NB <= sent <= NB * 1.01, sent
