@@ -1032,4 +1032,26 @@ void bps_codec_dithering_compress(const float* x, uint64_t n, float scale,
   }
 }
 
+// ---- bucket pack/unpack (role of core_loops.cc:538-618's zero-copy
+// push/pull staging). The Python exchange's per-segment numpy slice
+// assignments hold the GIL for every copy; these run the same segment
+// plan as flat memcpys with the GIL released (ctypes) and OpenMP
+// across segments — the uncompressed sync hop's interpreter cost
+// drops to two native calls per bucket. Offsets/lengths in BYTES.
+
+void bps_pack_segments(const void* const* srcs, const uint64_t* dst_offs,
+                       const uint64_t* lens, uint64_t n, char* dst) {
+#pragma omp parallel for schedule(static)
+  for (uint64_t i = 0; i < n; ++i)
+    std::memcpy(dst + dst_offs[i], srcs[i], lens[i]);
+}
+
+void bps_unpack_segments(const char* src, const uint64_t* src_offs,
+                         void* const* dsts, const uint64_t* lens,
+                         uint64_t n) {
+#pragma omp parallel for schedule(static)
+  for (uint64_t i = 0; i < n; ++i)
+    std::memcpy(dsts[i], src + src_offs[i], lens[i]);
+}
+
 }  // extern "C"

@@ -100,8 +100,36 @@ def _lib() -> ctypes.CDLL:
     lib.bps_codec_dithering_compress.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_float, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p]
+    lib.bps_pack_segments.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_uint64, ctypes.c_void_p]
+    lib.bps_unpack_segments.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_uint64]
     _LIB = lib
     return lib
+
+
+def pack_segments(srcs, dst_offs, lens, dst: np.ndarray) -> None:
+    """Gather ``len(srcs)`` byte ranges into ``dst`` natively (GIL
+    released, OMP across segments). ``srcs``: raw source addresses;
+    offsets/lengths in bytes."""
+    n = len(srcs)
+    _lib().bps_pack_segments(
+        (ctypes.c_void_p * n)(*srcs),
+        (ctypes.c_uint64 * n)(*dst_offs),
+        (ctypes.c_uint64 * n)(*lens),
+        n, dst.ctypes.data_as(ctypes.c_void_p))
+
+
+def unpack_segments(src: np.ndarray, src_offs, dsts, lens) -> None:
+    """Scatter byte ranges of ``src`` to raw destination addresses."""
+    n = len(dsts)
+    _lib().bps_unpack_segments(
+        src.ctypes.data_as(ctypes.c_void_p),
+        (ctypes.c_uint64 * n)(*src_offs),
+        (ctypes.c_void_p * n)(*dsts),
+        (ctypes.c_uint64 * n)(*lens), n)
 
 
 def reduce_sum_inplace(dst: np.ndarray, src: np.ndarray) -> None:

@@ -80,6 +80,15 @@ class PSGradientExchange:
         # (reference: per-partition compressor_list in BPSContext,
         # common.h:202, operations.cc:380-385)
         self._chains: Dict[int, object] = {}
+        # native bucket pack/unpack (BPS_NATIVE_PACK=0 forces the numpy
+        # per-segment path for A/B); falls back when the .so is absent
+        self._native_pack = os.environ.get("BPS_NATIVE_PACK", "1") != "0"
+        if self._native_pack:
+            try:
+                from .engine import _lib
+                _lib()
+            except Exception:   # noqa: BLE001 — toolchain-less install
+                self._native_pack = False
 
     def close(self) -> None:
         """Stop the pipeline executors (idempotent). bps.shutdown() calls
@@ -229,7 +238,10 @@ class PSGradientExchange:
                 return v
             with flat_lock:
                 if flat[i] is None:
-                    flat[i] = np.asarray(leaves[i]).reshape(-1)
+                    # ascontiguousarray: the native pack does raw
+                    # pointer math (no-op for device readbacks)
+                    flat[i] = np.ascontiguousarray(
+                        np.asarray(leaves[i])).reshape(-1)
                 return flat[i]
 
         out = [np.empty(int(np.prod(l.shape)), np.dtype(l.dtype))
@@ -240,10 +252,24 @@ class PSGradientExchange:
             rounds[idx] = self._next_round(pskey)
             t0 = time.time()
             buf = np.empty(b.size, dtype=b.dtype)
-            for s in b.segments:
-                buf[s.bucket_offset:s.bucket_offset + s.length] = \
-                    get_flat(s.leaf_index)[
-                        s.leaf_offset:s.leaf_offset + s.length]
+            if self._native_pack:
+                # native gather: one GIL-released call per bucket
+                # instead of a GIL-held numpy copy per segment
+                # (VERDICT r4 #5 — the uncompressed hop's interpreter
+                # cost; reference core_loops.cc:538-618 stages
+                # zero-copy in C++ too)
+                item = np.dtype(b.dtype).itemsize
+                from .engine import pack_segments
+                pack_segments(
+                    [get_flat(s.leaf_index).ctypes.data
+                     + s.leaf_offset * item for s in b.segments],
+                    [s.bucket_offset * item for s in b.segments],
+                    [s.length * item for s in b.segments], buf)
+            else:
+                for s in b.segments:
+                    buf[s.bucket_offset:s.bucket_offset + s.length] = \
+                        get_flat(s.leaf_index)[
+                            s.leaf_offset:s.leaf_offset + s.length]
             t0 = self._record(decl_name, "PS_PACK", pskey, t0)
             try:
                 self._push_bucket(pskey, b, buf)
@@ -263,9 +289,20 @@ class PSGradientExchange:
             t0 = time.time()
             merged = self._pull_bucket(pskey, b, buf, rounds[idx])
             t0 = self._record(decl_name, "PS_PULL", pskey, t0)
-            for s in b.segments:        # disjoint segments: thread-safe
-                out[s.leaf_index][s.leaf_offset:s.leaf_offset + s.length] = \
-                    merged[s.bucket_offset:s.bucket_offset + s.length]
+            if self._native_pack and merged.flags["C_CONTIGUOUS"]:
+                item = np.dtype(b.dtype).itemsize
+                from .engine import unpack_segments
+                unpack_segments(
+                    merged,
+                    [s.bucket_offset * item for s in b.segments],
+                    [out[s.leaf_index].ctypes.data + s.leaf_offset * item
+                     for s in b.segments],
+                    [s.length * item for s in b.segments])
+            else:
+                for s in b.segments:    # disjoint segments: thread-safe
+                    out[s.leaf_index][
+                        s.leaf_offset:s.leaf_offset + s.length] = \
+                        merged[s.bucket_offset:s.bucket_offset + s.length]
             self._record(decl_name, "PS_UNPACK", pskey, t0)
 
         def assemble():

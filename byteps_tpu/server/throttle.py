@@ -46,14 +46,18 @@ class TokenBucket:
         # rate (measured 7× slow with a 64 KB burst and 50 ms sleeps)
         self._quantum = min(0.05, max(0.002, self.burst / self.rate / 2))
 
+    def _refill(self) -> None:
+        """Accrue tokens up to the burst cap (caller holds _lock)."""
+        now = time.monotonic()
+        self._tokens = min(
+            self.burst, self._tokens + (now - self._t) * self.rate)
+        self._t = now
+
     def consume(self, n: int) -> None:
         left = float(n)
         while left > 0:
             with self._lock:
-                now = time.monotonic()
-                self._tokens = min(
-                    self.burst, self._tokens + (now - self._t) * self.rate)
-                self._t = now
+                self._refill()
                 take = min(left, self._tokens)
                 self._tokens -= take
                 left -= take
@@ -68,10 +72,7 @@ class TokenBucket:
         chunk loop is what lifts high-rate links from ~0.4 GB/s of
         Python chunk overhead to wire speed."""
         with self._lock:
-            now = time.monotonic()
-            self._tokens = min(
-                self.burst, self._tokens + (now - self._t) * self.rate)
-            self._t = now
+            self._refill()
             if self._tokens >= n:
                 self._tokens -= n
                 return True
@@ -110,11 +111,17 @@ class Nic:
         self.rx_bytes = 0
         self._count_lock = threading.Lock()
 
-    def on_send(self, n: int) -> None:
+    def count_tx(self, n: int) -> None:
+        """Frame-level tx accounting + latency: the ONE place the
+        'every byte counted, latency once per frame' invariant lives —
+        on_send and ThrottledSocket.sendall both charge through here."""
         with self._count_lock:
             self.tx_bytes += n
         if self.latency:
             time.sleep(self.latency)
+
+    def on_send(self, n: int) -> None:
+        self.count_tx(n)
         if n > self.SMALL_FRAME:
             self.tx.consume(n)
 
@@ -158,10 +165,9 @@ class ThrottledSocket:
         view = memoryview(data)
         n = len(view)
         nic = self._nic
-        with nic._count_lock:            # full frame counted, always —
-            nic.tx_bytes += n            # the chunk loop must not split
-        if nic.latency:                  # the accounting (curve rig)
-            time.sleep(nic.latency)
+        nic.count_tx(n)                  # full frame counted, always —
+                                         # the chunk loop must not split
+                                         # the accounting (curve rig)
         if n <= nic.SMALL_FRAME or nic.tx.try_consume(n):
             self._sock.sendall(view)
             return

@@ -492,17 +492,7 @@ class PSTransportServer:
                     key, rnd, lambda: self.backend.push(key, arr))
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PULL:
-                elems = nbytes // np.dtype(dtype).itemsize
-                meta = self._key_meta.get(key)
-                if meta is not None and meta[1] != dtype:
-                    store = np.empty(elems, dtype=meta[1])
-                    self.backend.pull(key, store, round=int(rnd),
-                                      timeout_ms=int(timeout) or 30000)
-                    out = store.astype(dtype)   # downcast on the wire
-                else:
-                    out = np.empty(elems, dtype=dtype)
-                    self.backend.pull(key, out, round=int(rnd),
-                                      timeout_ms=int(timeout) or 30000)
+                out = self._pull_dense(key, rnd, nbytes, dtype, timeout)
                 conn.sendall(_RSP.pack(ST_OK, out.nbytes))
                 conn.sendall(_as_bytes(out))    # zero-copy: contiguous
             elif op == OP_INIT_C:
@@ -560,16 +550,20 @@ class PSTransportServer:
                               "seen": set(), "t": now}
                         self._push_stage[stage_key] = st
                     st["t"] = now
-                    # a retried part overwrites its own range (idempotent)
-                    # but only counts once toward completion
-                    memoryview(st["buf"])[off:off + plen_] = \
-                        payload[_PART.size:_PART.size + plen_]
+                # the multi-MB copy runs OUTSIDE the lock — part ranges
+                # are disjoint, and copying under a server-wide lock
+                # would serialize exactly the parallel staging striping
+                # exists for. A retried part overwrites its own range
+                # (idempotent) but only counts once toward completion
+                memoryview(st["buf"])[off:off + plen_] = \
+                    payload[_PART.size:_PART.size + plen_]
+                with self._stripe_lock:
                     if idx not in st["seen"]:
                         st["seen"].add(idx)
                         st["got"] += plen_
                     complete = st["got"] >= int(nbytes)
                     if complete:
-                        del self._push_stage[stage_key]
+                        self._push_stage.pop(stage_key, None)
                 if complete:
                     arr = np.frombuffer(st["buf"], dtype=dtype)
                     meta = self._key_meta.get(key)
@@ -595,28 +589,23 @@ class PSTransportServer:
                         st["t"] = now
                         fetch = False
                 if fetch:
-                    # ONE round-blocked engine pull feeds every part;
-                    # same wire-dtype transcode as the unstriped OP_PULL
+                    # ONE round-blocked engine pull feeds every part
                     try:
-                        elems = int(nbytes) // np.dtype(dtype).itemsize
-                        meta = self._key_meta.get(key)
-                        if meta is not None and meta[1] != dtype:
-                            store = np.empty(elems, dtype=meta[1])
-                            self.backend.pull(
-                                key, store, round=int(rnd),
-                                timeout_ms=int(timeout) or 30000)
-                            out = store.astype(dtype)
-                        else:
-                            out = np.empty(elems, dtype=dtype)
-                            self.backend.pull(
-                                key, out, round=int(rnd),
-                                timeout_ms=int(timeout) or 30000)
-                        st["data"] = _as_bytes(out)
+                        st["data"] = _as_bytes(
+                            self._pull_dense(key, rnd, nbytes, dtype,
+                                             timeout))
                     except Exception as e:  # noqa: BLE001 — relayed below
                         st["err"] = e
                     finally:
                         st["ev"].set()
-                st["ev"].wait(timeout=(int(timeout) or 30000) / 1e3 + 5)
+                if not st["ev"].wait(
+                        timeout=(int(timeout) or 30000) / 1e3 + 5):
+                    # fetch still in flight: surface a retryable timeout
+                    # WITHOUT counting ourselves served — a premature
+                    # served count could pop the stage under the fetch
+                    raise TimeoutError(
+                        f"pull({key}) round={rnd}: striped fetch did "
+                        f"not resolve in time")
                 with self._stripe_lock:
                     st["served"] += 1
                     if st["served"] >= st["nparts"]:
@@ -652,6 +641,22 @@ class PSTransportServer:
             else:   # backend rejections (bad length, key, …)
                 msg = f"{type(e).__name__}: {e}".encode()[:4096]
                 conn.sendall(_RSP.pack(ST_ERR, len(msg)) + msg)
+
+    def _pull_dense(self, key, rnd, nbytes, dtype, timeout) -> np.ndarray:
+        """Round-blocked engine pull in WIRE dtype — the one transcode
+        rule shared by OP_PULL and the striped fetch: a frame dtype
+        narrower than the store downcasts on the way out."""
+        elems = int(nbytes) // np.dtype(dtype).itemsize
+        meta = self._key_meta.get(key)
+        if meta is not None and meta[1] != dtype:
+            store = np.empty(elems, dtype=meta[1])
+            self.backend.pull(key, store, round=int(rnd),
+                              timeout_ms=int(timeout) or 30000)
+            return store.astype(dtype)
+        out = np.empty(elems, dtype=dtype)
+        self.backend.pull(key, out, round=int(rnd),
+                          timeout_ms=int(timeout) or 30000)
+        return out
 
     _STRIPE_TTL_SECS = 120.0
 
