@@ -79,7 +79,12 @@ def run_local(args, cmd: List[str]) -> int:
         from ..server.transport import PSTransportServer
         import signal
         import time
-        n = int(env.get("BPS_NUM_PROCESSES", "1"))
+        # the round-completion gate: how many workers push each key.
+        # BPS_NUM_WORKER is the deployment-wide contract every worker
+        # already sets (docs/env.md); BPS_NUM_PROCESSES remains as the
+        # launcher-local spelling for single-host fan-outs
+        n = int(env.get("BPS_NUM_WORKER",
+                        env.get("BPS_NUM_PROCESSES", "1")))
         srv = PSServer(num_workers=n,
                        engine_threads=int(env.get("BPS_SERVER_ENGINE_THREAD", "4")),
                        enable_schedule=env.get("BPS_SERVER_ENABLE_SCHEDULE", "") == "1",
