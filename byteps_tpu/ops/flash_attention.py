@@ -475,22 +475,34 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
             dv_ref[0, t] = dv_acc[r].astype(dv_ref.dtype)
 
 
-def _dqkv_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                       dq_ref, dk_ref, dv_ref, *, scale, causal, bq, bk,
-                       ht):
+def _dqkv_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, *rest,
+                       scale, causal, bq, bk, ht, has_delta):
     """Single-block-pair fused backward: when the whole sequence is one
     (bq, bk) block per (b, head) — the flagship seq-512 geometry — the
     split dq / dkv kernels each recompute s, p and dp just to emit
     their own outputs (7 matmuls + 2 exp sweeps total). One kernel
     computes the shared recompute once and emits all three gradients:
-    5 matmuls + 1 exp, and q/k/v/do cross HBM once instead of twice."""
+    5 matmuls + 1 exp, and q/k/v/do cross HBM once instead of twice.
+
+    ``has_delta=False`` computes the softmax-gradient correction
+    IN-KERNEL via the identity delta_i = sum_j p_ij·dp_ij (equal to
+    sum_d do_id·out_id since out = p̂V) — valid because nk == 1 means
+    the whole kv row is in this block. That removes ``out`` from the
+    backward's inputs entirely, so under remat XLA dead-code-eliminates
+    the recompute's p·V matmul (1 of its 2 matmuls) AND the host-level
+    delta pass over out/do. Ring callers pass their hoisted GLOBAL
+    delta instead (has_delta=True): a local p·dp sum cannot span the
+    other kv shards' contributions."""
+    if has_delta:
+        delta_ref, dq_ref, dk_ref, dv_ref = rest
+    else:
+        dq_ref, dk_ref, dv_ref = rest
     for t in range(ht):
         q = q_ref[0, t]                                     # [bq, d]
         k = k_ref[0, t]                                     # [bk, d]
         v = v_ref[0, t]
         do = do_ref[0, t]
         lse = lse_ref[0, t]                                 # [bq, 1]
-        delta = delta_ref[0, t]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale     # [bq, bk]
@@ -506,6 +518,10 @@ def _dqkv_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)             # [bq, bk]
+        if has_delta:
+            delta = delta_ref[0, t]                         # [bq, 1]
+        else:
+            delta = jnp.sum(p * dp, -1, keepdims=True)      # [bq, 1]
         ds32 = p * (dp - delta)
         ds = ds32.astype(q.dtype)
         dq_ref[0, t] = (jax.lax.dot_general(
@@ -521,17 +537,24 @@ def _dqkv_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd_fused(q, k, v, lse, do, delta, causal, scale, bq, bk,
                      interpret, ht):
     """One pallas_call emitting (dq, dk, dv); caller guarantees
-    nq == nk == 1 and no bias/rel_table."""
+    nq == nk == 1 and no bias/rel_table. ``delta=None`` computes it
+    in-kernel (see _dqkv_fused_kernel) — the no-``out``-input form."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     spec_q = pl.BlockSpec((1, ht, bq, d), lambda ib, ih: (ib, ih, 0, 0))
     spec_k = pl.BlockSpec((1, ht, bk, d), lambda ib, ih: (ib, ih, 0, 0))
     spec_r1 = pl.BlockSpec((1, ht, bq, 1), lambda ib, ih: (ib, ih, 0, 0))
+    has_delta = delta is not None
+    in_specs = [spec_q, spec_k, spec_k, spec_q, spec_r1]
+    inputs = [q, k, v, do, lse]
+    if has_delta:
+        in_specs.append(spec_r1)
+        inputs.append(delta)
     return pl.pallas_call(
         functools.partial(_dqkv_fused_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, ht=ht),
+                          bq=bq, bk=bk, ht=ht, has_delta=has_delta),
         grid=(b, h // ht),
-        in_specs=[spec_q, spec_k, spec_k, spec_q, spec_r1, spec_r1],
+        in_specs=in_specs,
         out_specs=[spec_q, spec_k, spec_k],
         out_shape=[jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
                    jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
@@ -539,7 +562,7 @@ def _flash_bwd_fused(q, k, v, lse, do, delta, causal, scale, bq, bk,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*inputs)
 
 
 def _flash_bwd(q, k, v, out, lse, do, causal, scale, bq, bk, interpret,
@@ -547,19 +570,24 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, bq, bk, interpret,
     b, h, sq, d = q.shape
     sk = k.shape[2]
     nq, nk = sq // bq, sk // bk
-    if delta is None:      # ring callers hoist this loop-invariant reduction
-        delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                        axis=-1, keepdims=True)             # [b,h,s,1]
 
     has_bias = bias is not None
     has_rel = rel is not None
     if (not has_bias and not has_rel and nq == 1 and nk == 1
             and os.environ.get("BPS_FLASH_FUSED_BWD", "1") != "0"):
-        # mats=4: p, dp, ds32 and the cast ds are live per unrolled head
+        # mats=4: p, dp, ds32 and the cast ds are live per unrolled
+        # head. delta passes through as given: None lets the kernel
+        # compute it in-kernel (dropping `out` from the backward's
+        # inputs — under remat the recompute's p·V matmul DCEs away);
+        # ring callers' hoisted GLOBAL delta is honored
         ht_f = _head_tile(h, nq, nk, bq, bk, d, interpret, mats=4)
         dq, dk, dv = _flash_bwd_fused(q, k, v, lse, do, delta, causal,
                                       scale, bq, bk, interpret, ht_f)
         return dq, dk, dv, None, None
+
+    if delta is None:      # ring callers hoist this loop-invariant reduction
+        delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1, keepdims=True)             # [b,h,s,1]
     ht = _head_tile(h, nq, nk, bq, bk, d, interpret,
                     mats=5 if has_rel else (4 if has_bias else 3))
     if has_rel:

@@ -212,7 +212,13 @@ def test_mismatched_bias_cross():
 def test_fused_bwd_matches_split(monkeypatch, causal):
     """VERDICT r4 #1: the single-block-pair fused backward (one kernel,
     shared p/dp recompute, 5 matmuls) must produce the same dq/dk/dv as
-    the split dq + dkv kernels (7 matmuls) it replaces."""
+    the split dq + dkv kernels (7 matmuls) it replaces.
+
+    Tolerance is float-level, not bitwise: the fused kernel computes
+    the softmax correction IN-KERNEL as sum_j p_ij*dp_ij while the
+    split path sums do*out over d — mathematically identical, but the
+    fp32 summation order differs (~1e-5 absolute on unit-scale
+    inputs)."""
     rng = np.random.RandomState(11)
     q = jnp.asarray(rng.randn(2, 256, 4, 64).astype(np.float32))
     k = jnp.asarray(rng.randn(2, 256, 4, 64).astype(np.float32))
@@ -230,7 +236,7 @@ def test_fused_bwd_matches_split(monkeypatch, causal):
     split = grads()
     for a, b_, nm in zip(fused, split, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
-                                   rtol=1e-5, atol=1e-6, err_msg=nm)
+                                   rtol=1e-4, atol=2e-5, err_msg=nm)
 
 
 def test_rel_table_ht_clamp_keeps_divisibility(monkeypatch):
