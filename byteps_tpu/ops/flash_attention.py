@@ -133,11 +133,18 @@ def _head_tile(h: int, nq: int, nk: int, bq: int, bk: int, d: int,
     def _vmem(cand: int) -> int:
         return cand * (mats * bq * bk * 4 + 8 * max(bq, bk) * d)
 
+    # scoped-VMEM budget for the tile chooser (heuristic: real usage
+    # exceeds the estimate by the io double-buffers; 10M of estimate
+    # keeps Mosaic's 16M limit safe). Raising it to 11M admits ht=8
+    # for the d64 fwd — measured NEUTRAL (80.22 vs 80.2 sps), so the
+    # validated default stands and the knob exists for experiments
+    budget = int(_os.environ.get("BPS_FLASH_VMEM_BUDGET",
+                                 str(10 << 20)))
     env = int(_os.environ.get("BPS_FLASH_HT", "0"))
     if env:
         if h % env != 0:
             return 1
-        if _vmem(env) >= 10 << 20:
+        if _vmem(env) >= budget:
             # an oversized override would blow the 16M scoped-vmem limit
             # and fail Mosaic compilation at runtime — clamp to the same
             # budget the auto path enforces
@@ -151,7 +158,7 @@ def _head_tile(h: int, nq: int, nk: int, bq: int, bk: int, d: int,
     if interpret or nq != 1 or nk != 1:
         return 1
     for cand in (8, 4, 2):
-        if h % cand == 0 and _vmem(cand) < 10 << 20:
+        if h % cand == 0 and _vmem(cand) < budget:
             return cand
     return 1
 
