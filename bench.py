@@ -226,7 +226,9 @@ def main() -> None:
     # del/gc's it after — the jitted executables stay cached, only the
     # ~1 GB state transfer is repaid, outside the timed region.
     warm = 3 if on_tpu else 1
-    windows = 5 if on_tpu else 2
+    windows = 6 if on_tpu else 2   # EVEN: the lead-arm alternation
+                                   # below needs a balanced split to
+                                   # cancel the within-pair order bias
     import gc
 
     tx = optax.adamw(1e-4)
@@ -286,16 +288,34 @@ def main() -> None:
         gc.collect()
         return dt
 
-    # framework windows run FIRST in each pair: the trainer's resident
-    # param+adam state is freed at the end of its window, so the plain
-    # arm never shares HBM with it (the reverse order measured the
-    # plain arm 2.4x slow from exactly that pressure)
+    # Pair w=0 MUST run the framework arm first: the trainer's
+    # construction-time param+adam state is still resident until its
+    # first window frees it, and a plain window sharing HBM with it
+    # measured 2.4x slow. Every later window frees its own arm's state
+    # before returning, so from w=1 on the lead arm ALTERNATES — a
+    # monotone speed trend within a pair otherwise favors whichever
+    # arm runs second (measured as a systematic ~0.1-0.2% ratio bias);
+    # the even window count keeps the lead split balanced
     plain_t = fw_t = 0.0
+    pair_ratios = []
     for w in range(windows):
-        fw_t += fw_window(first=w == 0)
-        plain_t += plain_window(first=w == 0)
+        if w % 2 == 0:
+            ft = fw_window(first=w == 0)
+            pt = plain_window(first=w == 0)
+        else:
+            pt = plain_window(first=False)
+            ft = fw_window(first=False)
+        fw_t += ft
+        plain_t += pt
+        pair_ratios.append(pt / ft)
     plain_sps = batch * iters * windows / plain_t
     fw_sps = batch * iters * windows / fw_t
+    # headline ratio = total throughput ratio (what a user experiences);
+    # the per-pair MEDIAN rides along as a drift-robust cross-check —
+    # the two agree within ±0.15% run noise at true parity
+    vs_baseline = fw_sps / plain_sps
+    import statistics
+    vs_baseline_median = statistics.median(pair_ratios)
 
     # absolute chip accountability: analytic model FLOPs (no remat
     # recompute counted) against the chip's bf16 peak — "1.0 vs baseline"
@@ -310,7 +330,8 @@ def main() -> None:
                   else "bert_tiny_cpu_smoke",
         "value": round(fw_sps, 2),
         "unit": "samples/sec/chip",
-        "vs_baseline": round(fw_sps / plain_sps, 4),
+        "vs_baseline": round(vs_baseline, 4),
+        "vs_baseline_median_pair": round(vs_baseline_median, 4),
         "tflops": round(fw_sps * fps / 1e12, 2),
     }
     if peak:
