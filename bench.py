@@ -138,6 +138,76 @@ def verify_kernels() -> bool:
     return True
 
 
+def ps_tail_breakdown(iters: int = 12, warm: int = 3) -> dict:
+    """Exchange-tail breakdown of the sync-PS step (the pull → H2D →
+    chunked-apply pipeline): run the same small MLM config through the
+    PS-mode trainer with tracing on, once with the streamed chunked
+    tail and once with the monolithic tail (``BPS_APPLY_CHUNKED`` A/B),
+    and report per-stage totals, the pull/H2D/apply overlap, and the
+    step-rate ratio — so the overlap win is measured, not asserted.
+
+    Small in-process config on purpose: the PS hop is host-bound, so
+    the tail's stage mix is representative without burning TPU time;
+    ``partition_bytes`` is forced low so the exchange spans several
+    buckets (no buckets → nothing to overlap)."""
+    import tempfile
+
+    import byteps_tpu as bps
+    from byteps_tpu.models import bert
+    from byteps_tpu.telemetry import exchange_tail_overlap, summarize_stages
+    from byteps_tpu.training import DistributedTrainer
+
+    cfg = bert.bert_tiny()
+    batch, seq = 8, 32
+    params, data, loss_fn = mlm_setup(cfg, batch, seq)
+    saved = {k: os.environ.get(k) for k in
+             ("BPS_ENABLE_PS", "BPS_APPLY_CHUNKED", "BPS_TRACE_ON",
+              "BPS_TRACE_START_STEP", "BPS_TRACE_END_STEP",
+              "BPS_TRACE_DIR")}
+    out: dict = {}
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            os.environ.update(BPS_ENABLE_PS="1", BPS_TRACE_ON="1",
+                              # skip the warm steps: first-step compile
+                              # time would swamp the stage averages
+                              BPS_TRACE_START_STEP=str(warm + 1),
+                              BPS_TRACE_END_STEP="1000000000",
+                              BPS_TRACE_DIR=td)
+            for mode, flag in (("chunked", "1"), ("fused", "0")):
+                os.environ["BPS_APPLY_CHUNKED"] = flag
+                bps.init(config=bps.Config.from_env())
+                trainer = DistributedTrainer(
+                    loss_fn, params, optax.adamw(1e-4),
+                    partition_bytes=256 << 10, name=f"ps-tail-{mode}")
+                for _ in range(warm):
+                    loss = trainer.step(data)
+                float(loss)
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    loss = trainer.step(data)
+                float(loss)
+                dt = time.perf_counter() - t0
+                from byteps_tpu.common.global_state import GlobalState
+                events = GlobalState.get().timeline.snapshot()
+                out[f"{mode}_sps"] = round(batch * iters / dt, 2)
+                if mode == "chunked":
+                    out["stages_ms"] = summarize_stages(
+                        [e for e in events
+                         if e["name"].startswith("PS_")])
+                    out["overlap"] = exchange_tail_overlap(events)
+                trainer.close()
+                bps.shutdown()
+        out["chunked_vs_fused"] = round(
+            out["chunked_sps"] / out["fused_sps"], 4)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
 def probe_tpu(attempts: int = 3, timeout: float = 150.0,
               backoff: float = 20.0):
     """Bounded TPU-reachability probe. jax.devices() can hang
@@ -366,6 +436,13 @@ def main() -> None:
                 line["dh128_mfu"] = round(sps128 * fps128 / peak, 4)
         except Exception as e:   # noqa: BLE001 — recorded, not fatal
             line["dh128_error"] = f"{type(e).__name__}: {e}"[:300]
+    # sync-PS step-tail breakdown (host-bound; rides along on CPU and
+    # TPU runs alike). A transient must not cost the headline line.
+    bps.shutdown()               # the ambient collective-path runtime
+    try:
+        line["ps_tail"] = ps_tail_breakdown()
+    except Exception as e:       # noqa: BLE001 — recorded, not fatal
+        line["ps_tail_error"] = f"{type(e).__name__}: {e}"[:300]
     print(json.dumps(line))
 
 

@@ -22,6 +22,31 @@ from typing import Optional
 
 import jax
 
+# jax API drift: ``jax.shard_map`` was promoted from
+# ``jax.experimental.shard_map`` (where the kwarg is ``check_rep``, not
+# ``check_vma``). Alias it on older installs so every call site can use
+# the modern spelling unconditionally.
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def _shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                   check_vma=None, **kw):
+        if check_vma is not None and "check_rep" not in kw:
+            kw["check_rep"] = check_vma
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kw)
+
+    jax.shard_map = _shard_map
+
+# ``jax.lax.axis_size`` is likewise newer than some installs; a psum of
+# a concrete 1 over the named axis resolves to the axis size at trace
+# time with no runtime collective.
+if not hasattr(jax.lax, "axis_size"):
+    def _axis_size(axis_name):
+        return jax.lax.psum(1, axis_name)
+
+    jax.lax.axis_size = _axis_size
+
 from .common.config import Config
 from .common.global_state import GlobalState
 from .common import naming

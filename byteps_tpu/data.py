@@ -168,19 +168,35 @@ def write_npz_shards(path, arrays_fn: Callable[[int], dict],
 
 
 def _npz_sample_count(path) -> int:
-    """Leading-axis length of the first array in an .npz, read from the
-    member's npy header only — no array data is decompressed."""
+    """Leading-axis length of the arrays in an .npz, read from the npy
+    headers only — no array data is decompressed.
+
+    EVERY member's header is checked and their leading axes must agree:
+    zip member order is whatever the writer produced (externally built
+    shards reorder freely), so "first member in zip order" was not a
+    stable notion of the shard's sample count — two workers reading
+    differently-ordered but equal shards could disagree, and a shard
+    whose arrays disagree internally (truncated write) must fail here,
+    loudly, not desynchronize a collective mid-epoch."""
     import zipfile
     with zipfile.ZipFile(path) as zf:
-        name = next((n for n in zf.namelist() if n.endswith(".npy")), None)
-        if name is None:
+        names = sorted(n for n in zf.namelist() if n.endswith(".npy"))
+        if not names:
             raise ValueError(f"{path} holds no arrays — not a dataset shard")
-        with zf.open(name) as f:
-            version = np.lib.format.read_magic(f)
-            reader = (np.lib.format.read_array_header_1_0 if version[0] == 1
-                      else np.lib.format.read_array_header_2_0)
-            shape, _, _ = reader(f)
-    return shape[0] if shape else 0
+        counts = {}
+        for name in names:
+            with zf.open(name) as f:
+                version = np.lib.format.read_magic(f)
+                reader = (np.lib.format.read_array_header_1_0
+                          if version[0] == 1
+                          else np.lib.format.read_array_header_2_0)
+                shape, _, _ = reader(f)
+            counts[name[:-4]] = shape[0] if shape else 0
+    if len(set(counts.values())) > 1:
+        raise ValueError(
+            f"{path}: arrays disagree on the leading (sample) axis: "
+            f"{counts} — not a consistent dataset shard")
+    return next(iter(counts.values()))
 
 
 class NpzShardDataset:

@@ -134,3 +134,108 @@ def distributed_optimizer(inner: optax.GradientTransformation,
 # Horovod/BytePS-style alias: bps.DistributedOptimizer(optax.adam(1e-3))
 def DistributedOptimizer(inner: optax.GradientTransformation, **kwargs):  # noqa: N802
     return distributed_optimizer(inner, **kwargs)
+
+
+# ------------------------------------------------------- chunked apply
+#
+# The sync-PS step tail used to be a barrier: wait for EVERY bucket's
+# pull, device_put the whole tree, one monolithic optimizer jit. The
+# weight update itself is decomposable for the common optimizers
+# (PAPERS.md: "Automatic Cross-Replica Sharding of Weight Update in
+# Data-Parallel Training" decomposes it across replicas; here the same
+# observation is applied across BUCKETS in time): applying adam to leaf
+# group k needs nothing from group j, so group 0's weights can update
+# while group N's gradients are still on the wire.
+
+def leafwise_decomposable(inner: optax.GradientTransformation,
+                          leaves, groups) -> bool:
+    """Cheap numeric probe: is ``inner``'s update for a leaf independent
+    of the other leaves, so per-group apply equals fused apply?
+
+    Runs the transformation on a tiny same-structure tree (one (2,)
+    vector per leaf, deterministic pseudo-random values) fused and
+    per-group, and compares the per-leaf updates. Value-coupled
+    transformations (``clip_by_global_norm``: the norm spans the tree)
+    diverge on any non-degenerate values and are caught here;
+    structure-coupled ones (path-keyed masks) raise on the list-shaped
+    probe and are caught by the except. A transformation that is
+    coupled ONLY on inputs the probe can't reach would slip through —
+    acceptable for the stock optax chains this targets, and the
+    ``BPS_APPLY_CHUNKED=0`` escape hatch covers the exotic rest."""
+    import numpy as np
+    rng = np.random.RandomState(0)
+
+    def tiny(leaf):
+        dt = np.dtype(getattr(leaf, "dtype", np.float32))
+        return (rng.standard_normal(2)).astype(dt)
+
+    probe = [tiny(l) for l in leaves]
+    grads = [tiny(l) for l in leaves]
+    try:
+        fused_u, _ = inner.update(grads, inner.init(probe), probe)
+        fused = [np.asarray(u) for u in fused_u]
+        for g in groups:
+            sub_p = [probe[i] for i in g]
+            sub_g = [grads[i] for i in g]
+            part_u, _ = inner.update(sub_g, inner.init(sub_p), sub_p)
+            for li, u in zip(g, part_u):
+                if not np.allclose(fused[li], np.asarray(u),
+                                   rtol=1e-6, atol=1e-8):
+                    return False
+    except Exception:       # noqa: BLE001 — structure-coupled tx, or a
+        return False        # tx that can't run on list pytrees: fused
+    return True
+
+
+class ChunkedApply:
+    """Per-group jitted optimizer apply over a fixed partition of the
+    parameter tree's flat leaves (the exchange's bucket groups,
+    ``PSGradientExchange.leaf_groups``).
+
+    When ``inner`` is leafwise-decomposable (probe above), optimizer
+    state is held PER GROUP (``inner.init`` on each group's leaf list)
+    and ``apply_group`` updates one group as its gradients arrive —
+    bit-identical to the fused apply for elementwise chains because
+    each leaf sees the exact same op sequence either way. Otherwise
+    ``decomposable`` is False and the caller keeps its fused apply
+    (streamed H2D still overlaps; only the apply stays monolithic).
+
+    One jitted callable serves every group: jax retraces per input
+    structure, so each group compiles once and reuses thereafter.
+    """
+
+    def __init__(self, inner: optax.GradientTransformation, params,
+                 groups, donate: bool = True) -> None:
+        import jax
+        self.inner = inner
+        leaves, _ = jax.tree_util.tree_flatten(params)
+        self.groups = [tuple(g) for g in groups if g]
+        self.leaf_group = {}
+        for gi, g in enumerate(self.groups):
+            for li in g:
+                self.leaf_group[li] = gi
+        covered = sorted(self.leaf_group) == list(range(len(leaves)))
+        self.decomposable = covered and leafwise_decomposable(
+            inner, leaves, self.groups)
+        self.states = None
+        self._apply = None
+        if not self.decomposable:
+            return
+        self.states = [inner.init([leaves[i] for i in g])
+                       for g in self.groups]
+
+        def _apply(plist, state, glist):
+            updates, state = inner.update(glist, state, plist)
+            return optax.apply_updates(plist, updates), state
+
+        self._apply = jax.jit(
+            _apply, donate_argnums=(0, 1) if donate else ())
+
+    def apply_group(self, gi: int, params_list, grads_list):
+        """Update group ``gi``'s leaves; returns the new leaf list.
+        ``params_list``/``grads_list`` follow ``self.groups[gi]`` order.
+        The old leaves and the group's state are donated when the
+        ChunkedApply was built with ``donate=True``."""
+        new, self.states[gi] = self._apply(params_list, self.states[gi],
+                                           grads_list)
+        return new

@@ -204,7 +204,8 @@ class EngineThread {
     // snapshot the priority BEFORE taking mu_: PushCount waits on the
     // key lock, which Apply holds across a long OMP reduce — taking it
     // under mu_ would serialize every producer (and the engine's next
-    // wakeup) behind that reduce
+    // wakeup) behind that reduce. The snapshot also refreshes counts_,
+    // the cache PopNext reads instead of re-taking the key lock.
     const int count = schedule_ ? CurCount(t.key) : 0;
     {
       std::lock_guard<std::mutex> lk(mu_);
@@ -212,6 +213,7 @@ class EngineThread {
         const uint64_t key = t.key;
         buckets_[key].push_back(std::move(t));
         heap_.push(HeapEntry{count, seq_++, key});
+        counts_[key] = count;
         ++pending_;
       } else {
         queue_.push_back(std::move(t));
@@ -243,6 +245,17 @@ class EngineThread {
   // until the key's next Apply — a transient mis-ordering, never a
   // drop. O(log n) amortized per task vs the previous O(queue) scan
   // per pick, which went O(n^2) under deep backlogs.
+  //
+  // counts_ caches each queued key's last-sampled push count. Both
+  // writers (Push pre-lock, Run post-Apply) sample OUTSIDE mu_ and
+  // store under mu_, so PopNext's stale-entry refresh reads the cache
+  // instead of calling CurCount — which takes the per-key mutex that
+  // Apply holds across a long OMP reduce: the old form could park the
+  // pick loop (and every producer queued on mu_ behind it) on another
+  // key's in-flight reduce. Cached values are frozen while a pick
+  // holds mu_, so each entry still refreshes at most once per pick —
+  // no livelock; a racing producer's stale store only widens the
+  // transient mis-ordering window above, never drops a task.
   struct HeapEntry {
     int count;
     uint64_t seq;
@@ -260,6 +273,7 @@ class EngineThread {
   std::condition_variable cv_;
   std::deque<Task> queue_;                              // FIFO mode
   std::unordered_map<uint64_t, std::deque<Task>> buckets_;  // scheduled
+  std::unordered_map<uint64_t, int> counts_;  // cached push counts (mu_)
   std::priority_queue<HeapEntry> heap_;
   uint64_t seq_ = 0;
   size_t pending_ = 0;
@@ -762,18 +776,25 @@ bool EngineThread::PopNext(Task* out) {
       heap_.pop();               // entry outlived its bucket — drop it
       continue;
     }
-    const int cur = CurCount(e.key);
+    // stale-entry refresh from the CACHED count (see counts_ above):
+    // calling CurCount here would take the per-key mutex while holding
+    // mu_ — parking the pick loop on whatever Apply that key's store
+    // is in the middle of. Cached values are frozen while we hold mu_
+    // (both writers store under mu_), so each entry refreshes at most
+    // once per pick loop — no livelock.
+    auto c = counts_.find(e.key);
+    const int cur = c == counts_.end() ? e.count : c->second;
     if (cur != e.count) {
-      // stale snapshot: refresh in place. Counts are frozen while we
-      // hold the pick (only this thread's Apply moves them), so each
-      // entry refreshes at most once per pick loop — no livelock.
       heap_.pop();
       heap_.push(HeapEntry{cur, e.seq, e.key});
       continue;
     }
     *out = std::move(it->second.front());
     it->second.pop_front();
-    if (it->second.empty()) buckets_.erase(it);
+    if (it->second.empty()) {
+      buckets_.erase(it);
+      counts_.erase(e.key);      // re-seeded by the key's next Push
+    }
     heap_.pop();
     --pending_;
     return true;
@@ -799,12 +820,15 @@ void EngineThread::Run() {
       // the applied key's count just moved (one push closer to
       // publishing, or reset by the publish): surface its new rank so
       // its remaining queued tasks compete at the fresh priority.
-      // Count read outside mu_ (same reasoning as Push).
+      // Count read outside mu_ (same reasoning as Push); the store
+      // refreshes counts_ so PopNext's next refresh sees it.
       const int cur = CurCount(t.key);
       std::lock_guard<std::mutex> lk(mu_);
       auto it = buckets_.find(t.key);
-      if (it != buckets_.end() && !it->second.empty())
+      if (it != buckets_.end() && !it->second.empty()) {
         heap_.push(HeapEntry{cur, seq_++, t.key});
+        counts_[t.key] = cur;
+      }
     }
   }
 }

@@ -45,6 +45,38 @@ class _PendingExchange:
         return self._drain()
 
 
+class _StreamingExchange:
+    """Handle returned by ``PSGradientExchange.exchange_stream``: the
+    pushes are in flight and ``ready()`` yields ``(leaf_index, flat host
+    array)`` in COMPLETION order, each the moment its last covering
+    bucket's pull unpacks — the consumer can start H2D / apply work for
+    early buckets while later buckets are still on the wire. A failed
+    push or pull surfaces as an exception from the iterator (and from
+    ``result()``)."""
+
+    __slots__ = ("_n", "_q", "_drain")
+
+    def __init__(self, n_leaves: int, q, drain) -> None:
+        self._n = n_leaves
+        self._q = q
+        self._drain = drain
+
+    def ready(self):
+        """Iterate (leaf_index, flat host array) as leaves complete."""
+        yielded = 0
+        while yielded < self._n:
+            item = self._q.get()
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+            yielded += 1
+
+    def result(self):
+        """Drain every pull and return the assembled summed tree (usable
+        with or without consuming ``ready()``)."""
+        return self._drain()
+
+
 class PSGradientExchange:
     """Sync-mode bucketed gradient exchange through the host PS service.
 
@@ -147,6 +179,30 @@ class PSGradientExchange:
         later diverges (the declaration-order contract above)."""
         self._plan(tree, name)
 
+    def leaf_groups(self, tree, name: Optional[str] = None):
+        """Partition ``tree``'s flat leaf indices into groups by the LAST
+        bucket that covers each leaf — the bucket whose pull completes
+        the leaf. Consumers that apply per group (chunked optimizer
+        apply) see group k's leaves become ready together around bucket
+        k's pull, so group-granular work pipelines with later buckets
+        still in flight. Groups are returned in bucket order with empty
+        groups dropped; together they cover every leaf exactly once."""
+        _, _, keyed = self._plan(tree, name)
+        nleaves = len(jax.tree_util.tree_leaves(tree))
+        last: Dict[int, int] = {}
+        for bi, (_, b) in enumerate(keyed):
+            for s in b.segments:
+                last[s.leaf_index] = bi       # ascending bi: max wins
+        groups: List[List[int]] = [[] for _ in keyed]
+        for li in sorted(last):
+            groups[last[li]].append(li)
+        extras = [li for li in range(nleaves) if li not in last]
+        if extras:                  # zero-size leaves: no covering
+            if not groups:          # bucket, ready immediately — group 0
+                groups = [[]]
+            groups[0].extend(sorted(extras))
+        return [g for g in groups if g]
+
     def _record(self, name: str, stage: str, key: int, t0: float) -> float:
         """Timeline helper; returns a fresh t0."""
         import time
@@ -216,7 +272,18 @@ class PSGradientExchange:
         core_loops.cc:538-618)."""
         return self._exchange_impl(tree, name, detach=True)
 
-    def _exchange_impl(self, tree, name: Optional[str], detach: bool):
+    def exchange_stream(self, tree, name: Optional[str] = None):
+        """Streaming sync round: returns a ``_StreamingExchange`` whose
+        ``ready()`` iterator yields each leaf the moment its last
+        covering bucket's pull unpacks. This makes leaf completion
+        first-class: the trainer overlaps H2D upload and the chunked
+        optimizer apply with still-in-flight pulls of later buckets —
+        the step-tail analogue of the reference's free-running pull loop
+        feeding the framework as partitions land (operations.cc:140-180)."""
+        return self._exchange_impl(tree, name, detach=True, stream=True)
+
+    def _exchange_impl(self, tree, name: Optional[str], detach: bool,
+                       stream: bool = False):
         import time
         decl_name, treedef, keyed = self._plan(tree, name)
         leaves, _ = jax.tree_util.tree_flatten(tree)
@@ -246,6 +313,29 @@ class PSGradientExchange:
 
         out = [np.empty(int(np.prod(l.shape)), np.dtype(l.dtype))
                for l in leaves]
+
+        # leaf-completion tracking for the streaming form: a leaf is
+        # ready when its LAST outstanding covering segment unpacks, in
+        # whatever order the pipelined pulls land
+        readyq = None
+        if stream:
+            import queue as _queue
+            readyq = _queue.Queue()
+            seg_left = [0] * len(leaves)
+            for _, b in keyed:
+                for s in b.segments:
+                    seg_left[s.leaf_index] += 1
+            seg_lock = threading.Lock()
+            for li, n in enumerate(seg_left):
+                if n == 0:          # zero-size leaf: no segments cover
+                    readyq.put((li, out[li]))   # it — ready immediately
+
+            def _segment_done(li: int) -> None:
+                with seg_lock:
+                    seg_left[li] -= 1
+                    done = seg_left[li] == 0
+                if done:
+                    readyq.put((li, out[li]))
 
         def push_one(idx: int) -> np.ndarray:
             pskey, b = keyed[idx]
@@ -304,12 +394,16 @@ class PSGradientExchange:
                         s.leaf_offset:s.leaf_offset + s.length] = \
                         merged[s.bucket_offset:s.bucket_offset + s.length]
             self._record(decl_name, "PS_UNPACK", pskey, t0)
+            if stream:
+                for s in b.segments:
+                    _segment_done(s.leaf_index)
 
         def assemble():
             shaped = [o.reshape(l.shape) for o, l in zip(out, leaves)]
             return jax.tree_util.tree_unflatten(treedef, shaped)
 
-        if not detach and (self.pipeline_depth <= 1 or len(keyed) == 1):
+        if not detach and not stream and (self.pipeline_depth <= 1
+                                          or len(keyed) == 1):
             # serial: push everything (the server sums as they land),
             # then drain pulls in the same order
             bufs = [push_one(i) for i in range(len(keyed))]
@@ -340,6 +434,21 @@ class PSGradientExchange:
                 f.result()          # propagate the first failure
             return assemble()
 
+        if stream:
+            # a failed push/pull would otherwise leave the ready-stream
+            # consumer blocked on leaves that will never complete:
+            # surface the first failure as a queue sentinel
+            def _relay_failure(f) -> None:
+                try:
+                    exc = f.exception()
+                except BaseException as e:   # noqa: BLE001 — cancelled
+                    exc = e
+                if exc is not None:
+                    readyq.put(exc)
+
+            for f in pull_futs:
+                f.add_done_callback(_relay_failure)
+            return _StreamingExchange(len(leaves), readyq, drain)
         if not detach:
             return drain()
         return _PendingExchange(drain)

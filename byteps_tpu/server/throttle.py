@@ -111,14 +111,28 @@ class Nic:
         self.rx_bytes = 0
         self._count_lock = threading.Lock()
 
-    def count_tx(self, n: int) -> None:
-        """Frame-level tx accounting + latency: the ONE place the
-        'every byte counted, latency once per frame' invariant lives —
-        on_send and ThrottledSocket.sendall both charge through here."""
+    def book_tx(self, n: int) -> None:
+        """Record ``n`` tx bytes as SENT. ThrottledSocket.sendall books
+        per successful chunk write, AFTER the write: booking the whole
+        frame up front meant a mid-frame send failure plus reconnect
+        counted the frame twice (the aborted attempt's unsent remainder
+        plus the full resend) — the curve rig's analytic byte model
+        only tolerates bytes that actually went to the kernel."""
         with self._count_lock:
             self.tx_bytes += n
+
+    def frame_latency(self) -> None:
+        """The per-frame latency charge — exactly once per send call,
+        never per chunk (a chunked 8 MB frame is still ONE frame)."""
         if self.latency:
             time.sleep(self.latency)
+
+    def count_tx(self, n: int) -> None:
+        """Frame-level tx accounting + latency in one call — the form
+        control-frame senders (on_send) use, where the write either
+        happens whole or not at all."""
+        self.book_tx(n)
+        self.frame_latency()
 
     def on_send(self, n: int) -> None:
         self.count_tx(n)
@@ -165,17 +179,21 @@ class ThrottledSocket:
         view = memoryview(data)
         n = len(view)
         nic = self._nic
-        nic.count_tx(n)                  # full frame counted, always —
-                                         # the chunk loop must not split
-                                         # the accounting (curve rig)
+        nic.frame_latency()              # once per FRAME, never per chunk
         if n <= nic.SMALL_FRAME or nic.tx.try_consume(n):
             self._sock.sendall(view)
+            nic.book_tx(n)
             return
         chunk = nic.chunk_size()
         for off in range(0, n, chunk):
             part = view[off:off + chunk]
             nic.tx.consume(len(part))
             self._sock.sendall(part)
+            # booked per successful chunk write: a send failure mid-
+            # frame leaves only the chunks that reached the kernel
+            # counted, so the reconnect's resend can't double-count
+            # the frame
+            nic.book_tx(len(part))
 
     def recv(self, n: int, *flags):
         data = self._sock.recv(n, *flags)

@@ -81,14 +81,21 @@ OP_PULL_SHM = 11   # same; the server PULLs INTO the segment
 #   OP_PUSH_PART: nbytes = TOTAL length, rnd = dedup token shared by
 #     all parts; payload = _PART prefix + the part's bytes. The server
 #     stages parts per (key, token) and applies ONCE when complete.
+#     The prefix's nonce is 0 (the token already identifies the op and
+#     MUST be stable across retries for the staging dedup).
 #   OP_PULL_PART: rnd = round; payload = _PART prefix (no data). The
-#     server round-blocks once per (key, round), caches the merged
-#     bytes while its parts drain, and each part response carries its
-#     [offset, offset+len) slice — the client receives straight into
-#     the caller's buffer (zero-copy scatter).
+#     server round-blocks once per (key, round, nonce), caches the
+#     merged bytes while the op's parts drain, and each part response
+#     carries its [offset, offset+len) slice — the client receives
+#     straight into the caller's buffer (zero-copy scatter). The nonce
+#     is fresh per LOGICAL pull attempt: without it, concurrent
+#     striped pullers of the same async key share a (key, round=0)
+#     stage, and the second fetch after the first op's parts drain it
+#     can serve a NEWER store value to the first op's stragglers — a
+#     torn tensor assembled from two different rounds (ADVICE.md).
 OP_PUSH_PART = 12
 OP_PULL_PART = 13
-_PART = struct.Struct("!IIHH")   # offset, part_len, part_idx, nparts
+_PART = struct.Struct("!IIHHQ")  # offset, part_len, part_idx, nparts, nonce
 ST_OK, ST_ERR, ST_TIMEOUT, ST_GONE = 0, 1, 2, 3
 
 
@@ -283,20 +290,33 @@ def _send_req(sock: socket.socket, op: int, key: int, rnd: int, nbytes: int,
         sock.sendall(p)
 
 
+# The reused-recv-buffer invariant: an op's handler must CONSUME its
+# payload before the connection reads the next frame, because the next
+# frame overwrites the shared buffer. This allowlist names the ops whose
+# handlers are known to copy synchronously (the engine/stage copies the
+# bytes before the handler returns); any op NOT listed gets a fresh
+# buffer — a new op that stashes a payload view past its handler return
+# degrades to an allocation instead of silently corrupting frames.
+_REUSE_SAFE_OPS = frozenset(
+    {OP_INIT, OP_PUSH, OP_PUSH_C, OP_PUSH_RS, OP_PUSH_PART})
+
+
 def _recv_req(sock: socket.socket, rholder: Optional[list] = None):
     op, key, rnd, nbytes, timeout, plen, dt = _HDR.unpack(
         _recv_exact(sock, _HDR.size))
     if not plen:
         payload = None
-    elif rholder is not None and plen > (64 << 10):
+    elif (rholder is not None and plen > (64 << 10)
+            and op in _REUSE_SAFE_OPS):
         # large payloads land in the connection's REUSED buffer: a fresh
         # bytearray(n) zero-fills n bytes before the recv overwrites
         # them — at 8 MB pushes that zeroing alone was a measurable
-        # slice of the wire path. Safe because every handler consumes
-        # its payload synchronously (the engine copies before returning).
-        # Grown by REPLACEMENT, never resize: the caller's loop still
-        # holds the previous frame's memoryview, and resizing an
-        # exported bytearray raises BufferError and kills the connection
+        # slice of the wire path. Safe because the allowlisted handlers
+        # consume their payload synchronously (the engine copies before
+        # returning). Grown by REPLACEMENT, never resize: the caller's
+        # loop still holds the previous frame's memoryview, and resizing
+        # an exported bytearray raises BufferError and kills the
+        # connection
         if len(rholder[0]) < plen:
             rholder[0] = bytearray(plen)
         payload = memoryview(rholder[0])[:plen]
@@ -539,7 +559,8 @@ class PSTransportServer:
                     del out, view
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PUSH_PART:
-                off, plen_, idx, nparts = _PART.unpack(payload[:_PART.size])
+                off, plen_, idx, nparts, _ = _PART.unpack(
+                    payload[:_PART.size])
                 stage_key = (key, int(rnd))
                 now = time.time()
                 with self._stripe_lock:
@@ -573,8 +594,12 @@ class PSTransportServer:
                         key, rnd, lambda: self.backend.push(key, arr))
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PULL_PART:
-                off, plen_, idx, nparts = _PART.unpack(payload[:_PART.size])
-                stage_key = (key, int(rnd))
+                off, plen_, idx, nparts, nonce = _PART.unpack(
+                    payload[:_PART.size])
+                # nonce in the stage key: concurrent striped pulls of
+                # one async key (round=0) must each get their OWN
+                # fetch, or a late part can be served a newer value
+                stage_key = (key, int(rnd), int(nonce))
                 now = time.time()
                 with self._stripe_lock:
                     self._sweep_stages(now)
@@ -1274,7 +1299,7 @@ class RemotePSBackend:
         def send_part(args):
             pi, (off, ln) = args
             self._rpc(OP_PUSH_PART, key, tok, len(view), 0, dtype,
-                      (_PART.pack(off, ln, pi, nparts),
+                      (_PART.pack(off, ln, pi, nparts, 0),
                        view[off:off + ln]))
 
         self._stripe_run(send_part, list(enumerate(ranges)))
@@ -1320,16 +1345,22 @@ class RemotePSBackend:
                           str(out.dtype), None, pull_into=out)
                 return
             # striped pull: each part round-blocks on the SAME (key,
-            # round) server stage (one engine pull feeds all parts) and
-            # its slice lands straight in `out` (zero-copy scatter)
+            # round, nonce) server stage (one engine pull feeds all of
+            # THIS op's parts) and its slice lands straight in `out`
+            # (zero-copy scatter). The nonce is fresh per attempt so a
+            # retry can never race its own (or a concurrent puller's)
+            # stragglers on a shared stage — the abandoned stage is
+            # TTL-swept server-side
             flat = out.view(np.uint8).reshape(-1)
             nparts = len(ranges)
             dtype = str(out.dtype)
+            import os as _os
+            nonce = int.from_bytes(_os.urandom(8), "big")
 
             def pull_part(args):
                 pi, (off, ln) = args
                 self._rpc(OP_PULL_PART, key, round, out.nbytes, slice_ms,
-                          dtype, (_PART.pack(off, ln, pi, nparts),),
+                          dtype, (_PART.pack(off, ln, pi, nparts, nonce),),
                           pull_into=flat[off:off + ln])
 
             self._stripe_run(pull_part, list(enumerate(ranges)))

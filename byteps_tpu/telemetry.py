@@ -38,3 +38,53 @@ class PushPullSpeed:
             total = sum(n for _, n in self._events)
             span = max(now - self._events[0][0], 1e-6)
             return total / span / 1e6
+
+
+# ------------------------------------------------- stage aggregation
+#
+# Consumers of Timeline spans (bench.py's exchange-tail breakdown, the
+# overlap regression test) need per-stage totals and the one question
+# the streamed tail is judged on: did PS_H2D / PS_APPLY_CHUNK work
+# actually START before the last PS_PULL FINISHED (real pipeline), or
+# did the stages merely get renamed?
+
+def summarize_stages(events) -> dict:
+    """Aggregate Chrome-trace events (Timeline.snapshot()/comm.json
+    ``traceEvents``) into ``{stage: {"count": n, "total_ms": ms}}``."""
+    out: dict = {}
+    for e in events:
+        s = out.setdefault(e["name"], {"count": 0, "total_ms": 0.0})
+        s["count"] += 1
+        s["total_ms"] += e["dur"] / 1e3
+    for s in out.values():
+        s["total_ms"] = round(s["total_ms"], 3)
+    return out
+
+
+def exchange_tail_overlap(events) -> dict:
+    """Overlap stats for the streamed sync-PS tail.
+
+    Computed PER STEP (events carry ``args.step``; comparing step 1's
+    H2D against step N's pulls would overlap trivially): within a step,
+    ``overlap_ms`` is how long before that step's LAST ``PS_PULL``
+    finished its FIRST ``PS_H2D``/``PS_APPLY_CHUNK`` span started.
+    Returns the max over steps and ``overlapped`` = any step's tail
+    span started strictly before its last pull end. Empty/absent
+    stages yield ``overlapped: False``."""
+    pull_end: dict = {}
+    tail_start: dict = {}
+    for e in events:
+        step = e.get("args", {}).get("step", 0)
+        if e["name"] == "PS_PULL":
+            pull_end[step] = max(pull_end.get(step, 0), e["ts"] + e["dur"])
+        elif e["name"] in ("PS_H2D", "PS_APPLY_CHUNK"):
+            tail_start[step] = min(tail_start.get(step, 1 << 62), e["ts"])
+    best = None
+    for step, first_tail in tail_start.items():
+        if step in pull_end:
+            gap = pull_end[step] - first_tail
+            best = gap if best is None else max(best, gap)
+    if best is None:
+        return {"overlapped": False, "overlap_ms": 0.0}
+    return {"overlapped": best > 0,
+            "overlap_ms": round(max(0.0, best) / 1e3, 3)}

@@ -10,7 +10,12 @@ bucket index (pid = key, like the reference's per-key rows): DISPATCH
 (program launch), REDUCE (dispatch → device completion, i.e. queue +
 execution), CREDIT_BLOCK (credit-gate stall), and on the PS path
 REDUCE_WAIT / COPYD2H / PS_PACK / PS_PUSH / PS_PULL / PS_UNPACK per
-bucket. With ``BPS_TRACE_PROFILER=1`` the same step window also
+bucket, plus the streamed step tail's PS_H2D (per-leaf device_put as a
+leaf's last covering bucket unpacks; pid = leaf index) and
+PS_APPLY_CHUNK (per-bucket-group optimizer apply; pid = group index) —
+overlap of those two with still-running PS_PULL rows is the pipeline
+the chunked tail exists for (BPS_APPLY_CHUNKED=0 disables it).
+With ``BPS_TRACE_PROFILER=1`` the same step window also
 captures a ``jax.profiler`` device trace into
 ``<trace_dir>/<local_rank>/profile`` — host spans land in comm.json
 (reference schema, existing viewers work), device-side op timing in the
@@ -101,6 +106,13 @@ class Timeline:
                 return False
 
         return _Span()
+
+    def snapshot(self) -> List[dict]:
+        """Copy of the events recorded so far WITHOUT flushing — for
+        in-process consumers (bench's exchange-tail breakdown, overlap
+        tests) that want the spans before the trace file is written."""
+        with self._lock:
+            return list(self._events)
 
     def flush(self) -> None:
         with self._lock:

@@ -199,7 +199,17 @@ class DistributedTrainer:
             self._ps_exchange = PSGradientExchange(
                 gs.ps_backend, partition_bytes=partition_bytes,
                 registry=gs.registry, min_compress_bytes=min_compress_bytes)
+            self._ps_exchange.timeline = gs.timeline
             self._ps_world = eng.ps_world
+            # streamed step tail (pull → H2D → chunked apply pipelined
+            # per bucket); BPS_APPLY_CHUNKED=0 restores the monolithic
+            # wait-all → device_put-all → fused-apply tail for A/B
+            self._apply_chunked = os.environ.get(
+                "BPS_APPLY_CHUNKED", "1") != "0"
+            self._ps_donate = donate
+            self._chunked = None        # built on first streamed step
+            self._h2d_ex = None         # lazy single-thread H2D dispatcher
+            self._opt_state_at_init = None   # set below: restore detection
             self.tx = tx          # plain inner optimizer: sync is the hop
             replicated = NamedSharding(mesh, P())
             self.params = jax.tree_util.tree_map(
@@ -208,6 +218,7 @@ class DistributedTrainer:
             from .parallel.sharding import init_sharded_state
             self.opt_state = init_sharded_state(self.tx, self.params,
                                                 self._ostate_spec, mesh)
+            self._opt_state_at_init = self.opt_state
             self._loss_fn = loss_fn
             self._grad_fn, self._apply_fn = self._build_ps_step(donate)
             self._accum = None
@@ -356,7 +367,14 @@ class DistributedTrainer:
             t0 = time.time()
             jax.block_until_ready(grads)
             tl.record(self._name, "REDUCE_WAIT", t0, time.time() - t0)
-            t0 = time.time()
+        if self._apply_chunked:
+            loss2 = self._ps_step_streamed(grads, loss, tl)
+            if tl is not None:
+                tl.set_step(self.step_count)
+            return loss2
+        # monolithic tail (BPS_APPLY_CHUNKED=0): wait for every bucket,
+        # one whole-tree device_put, one fused apply
+        t0 = time.time()
         summed = self._ps_exchange.exchange(grads, name=self._name)
         if tl is not None:
             tl.record(self._name, "PS_PUSH_PULL", t0, time.time() - t0)
@@ -370,6 +388,168 @@ class DistributedTrainer:
             self.params, self.opt_state, gdev)
         if tl is not None:
             tl.set_step(self.step_count)
+        return loss
+
+    def _ensure_streamed_tail(self, grads) -> None:
+        """First streamed step: derive the exchange's bucket groups and
+        build the chunked apply (or learn that the tx isn't leafwise-
+        decomposable and keep the fused apply for the tail)."""
+        if self._chunked is not None:
+            self._sync_chunk_states()
+            return
+        from .optim import ChunkedApply
+        groups = self._ps_exchange.leaf_groups(grads, name=self._name)
+        self._chunked = ChunkedApply(self.tx, self.params, groups,
+                                     donate=self._ps_donate)
+        if (self._chunked.decomposable
+                and self.opt_state is not self._opt_state_at_init):
+            # the caller installed its own state (checkpoint restore)
+            # between construction and the first step: a chunked
+            # re-init would silently discard it, so keep the fused
+            # apply, which consumes self.opt_state as-is
+            from .common.logging import get_logger
+            get_logger().info(
+                "opt_state was replaced before the first step — keeping "
+                "the fused optimizer apply so the restored state is "
+                "honored (streamed H2D overlap stays on)")
+            self._chunked.decomposable = False
+            self._chunked.states = None   # unused duplicate: free it
+        if self._chunked.decomposable:
+            # per-group states REPLACE the fused full-tree state (same
+            # per-leaf init values; count scalars live per group) — the
+            # source of truth the chunked applies update in place, and
+            # what checkpoints of a chunked-mode trainer round-trip
+            self.opt_state = self._chunked.states
+        # the restore-detection compare above is one-shot; keeping the
+        # alias would pin a full optimizer-state tree (2× params for
+        # adam) on device for the trainer's lifetime
+        self._opt_state_at_init = None
+        if self._h2d_ex is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._h2d_ex = ThreadPoolExecutor(
+                1, thread_name_prefix="bps-ps-h2d")
+
+    def _sync_chunk_states(self) -> None:
+        """Adopt an external write to the public ``opt_state`` attribute
+        after chunked mode engaged (e.g. restoring a checkpoint of a
+        chunked-mode trainer, whose state IS the per-group list).
+        A write whose structure doesn't match the group states can't be
+        split generically — fail loudly instead of silently ignoring it."""
+        if not self._chunked.decomposable \
+                or self.opt_state is self._chunked.states:
+            return
+        import jax as _jax
+        if (_jax.tree_util.tree_structure(list(self.opt_state))
+                == _jax.tree_util.tree_structure(self._chunked.states)):
+            self._chunked.states = list(self.opt_state)
+            self.opt_state = self._chunked.states
+            return
+        raise ValueError(
+            "opt_state was replaced mid-training with a structure that "
+            "doesn't match the chunked per-group states — restore the "
+            "state before the first step, or set BPS_APPLY_CHUNKED=0 "
+            "to keep the fused full-tree optimizer state")
+
+    def close(self) -> None:
+        """Release the trainer's PS-tail resources (H2D dispatch thread,
+        private exchange executors). Idempotent; only meaningful for
+        PS-mode trainers — collective-path and async-PS trainers hold
+        none of these (getattr: their __init__ branches never create
+        the attributes)."""
+        h2d = getattr(self, "_h2d_ex", None)
+        if h2d is not None:
+            h2d.shutdown(wait=False)
+            self._h2d_ex = None
+        ex = getattr(self, "_ps_exchange", None)
+        if ex is not None:
+            ex.close()
+
+    def _ps_step_streamed(self, grads, loss, tl) -> jnp.ndarray:
+        """Streamed step tail: consume the exchange's leaf-ready stream,
+        device_put each leaf from a dispatch thread the moment it lands
+        (H2D overlaps still-in-flight pulls of later buckets), and
+        jit-apply the optimizer per bucket group as its leaves arrive —
+        bucket 0's weights update while bucket N is still on the wire.
+        Non-decomposable optimizers keep the fused apply at the end but
+        still get the streamed H2D overlap."""
+        self._ensure_streamed_tail(grads)
+        t_ex = time.time()
+        handle = self._ps_exchange.exchange_stream(grads, name=self._name)
+        rep = NamedSharding(self.mesh, P())
+        flat, treedef = jax.tree_util.tree_flatten(self.params)
+        shapes = [l.shape for l in flat]
+        world = self._ps_world
+        name = self._name
+
+        def h2d(li: int, arr: np.ndarray):
+            t0 = time.time()
+            a = arr.reshape(shapes[li])
+            if world > 1:
+                a = a / world         # same host-side divide per leaf as
+            d = jax.device_put(a, rep)  # the monolithic tail's tree_map
+            if tl is not None:
+                tl.record(name, "PS_H2D", t0, time.time() - t0, li)
+            return d
+
+        chunked = self._chunked
+        futs: dict = {}
+        remaining = [len(g) for g in chunked.groups]
+        applied = 0
+        try:
+            for li, arr in handle.ready():
+                futs[li] = self._h2d_ex.submit(h2d, li, arr)
+                gi = chunked.leaf_group.get(li)
+                if gi is None or not chunked.decomposable:
+                    continue
+                remaining[gi] -= 1
+                if remaining[gi] == 0:
+                    group = chunked.groups[gi]
+                    gdev = [futs.pop(i).result() for i in group]
+                    t0 = time.time()
+                    new = chunked.apply_group(
+                        gi, [flat[i] for i in group], gdev)
+                    if tl is not None:
+                        tl.record(name, "PS_APPLY_CHUNK", t0,
+                                  time.time() - t0, gi)
+                    for i, leaf in zip(group, new):
+                        flat[i] = leaf
+                    applied += 1
+            if not chunked.decomposable:
+                # fused fallback: streamed H2D overlapped the pulls;
+                # the apply itself stays one program
+                gdev = jax.tree_util.tree_unflatten(
+                    treedef, [futs.pop(i).result()
+                              for i in range(len(flat))])
+                t0 = time.time()
+                new_params, self.opt_state = self._apply_fn(
+                    self.params, self.opt_state, gdev)
+                if tl is not None:
+                    tl.record(name, "PS_APPLY_CHUNK", t0,
+                              time.time() - t0)
+                flat = jax.tree_util.tree_leaves(new_params)
+        except BaseException as e:
+            if applied:
+                # the chunked tail is NOT atomic like the fused one: a
+                # failure after any group applied leaves params/opt
+                # state partially stepped. Blind-retrying the step
+                # would apply the early groups twice — surface the
+                # partial state loudly instead of letting that happen
+                raise RuntimeError(
+                    f"streamed PS step failed after {applied}/"
+                    f"{len(chunked.groups)} optimizer groups applied — "
+                    f"params and optimizer state are PARTIALLY stepped; "
+                    f"do not retry this step on the same trainer "
+                    f"(restore a checkpoint, or run with "
+                    f"BPS_APPLY_CHUNKED=0 for an all-or-nothing tail)"
+                ) from e
+            raise
+        finally:
+            # applied groups' old leaves were donated: rebuild params
+            # from the live leaf list even on a mid-stream failure so
+            # the trainer never holds invalidated buffers
+            self.params = jax.tree_util.tree_unflatten(treedef, flat)
+            if tl is not None:
+                tl.record(name, "PS_PUSH_PULL", t_ex, time.time() - t_ex)
         return loss
 
     def _async_ps_step(self, batch) -> jnp.ndarray:

@@ -186,3 +186,27 @@ def test_file_backed_training_end_to_end(tmp_path, mesh):
         for batch in prefetch_to_mesh(ds.epoch(epoch, 16), mesh):
             losses.append(float(trainer.step(batch)))
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_npz_sample_count_ignores_member_order(tmp_path):
+    """Zip member order is writer-defined: the count must come from a
+    deterministic member choice with ALL members' leading axes verified
+    — an out-of-order shard (names written z-first) reads the same."""
+    from byteps_tpu.data import _npz_sample_count
+    f = str(tmp_path / "shard-00000.npz")
+    # written z-first: zip order is (z, a); sorted order is (a, z)
+    np.savez(f, z=np.zeros((4, 2), np.float32),
+             a=np.zeros((4, 7), np.float32))
+    assert _npz_sample_count(f) == 4
+
+
+def test_npz_sample_count_rejects_disagreeing_leading_axes(tmp_path):
+    """A shard whose members disagree on the sample axis (truncated or
+    corrupt write) must fail at header-read time, not desynchronize a
+    collective mid-epoch."""
+    from byteps_tpu.data import _npz_sample_count
+    f = str(tmp_path / "shard-00000.npz")
+    np.savez(f, x=np.zeros((4, 2), np.float32),
+             y=np.zeros((3, 2), np.float32))
+    with pytest.raises(ValueError, match="disagree"):
+        _npz_sample_count(f)

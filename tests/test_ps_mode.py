@@ -178,3 +178,56 @@ def test_async_bf16_delta_wire(monkeypatch):
                               applied_rounds=lambda: be.servers[0].round(0))
     finally:
         be.close()
+
+
+def test_exchange_stream_yields_every_leaf_ready():
+    """Streaming exchange: ``ready()`` yields each (leaf_index, flat
+    array) exactly once, with the correct summed values, the moment the
+    leaf's last covering bucket unpacks — and ``leaf_groups`` covers
+    every leaf exactly once in bucket order."""
+    be = HostPSBackend(num_servers=1, num_workers=1, engine_threads=1)
+    try:
+        ex = PSGradientExchange(be, partition_bytes=256)
+        rng = np.random.RandomState(3)
+        tree = {"a": rng.randn(100).astype(np.float32),
+                "b": rng.randn(31, 3).astype(np.float32),
+                "c": rng.randn(7).astype(np.float32)}
+        leaves = jax.tree_util.tree_leaves(tree)
+        groups = ex.leaf_groups(tree)
+        flat = sorted(i for g in groups for i in g)
+        assert flat == list(range(len(leaves)))
+        handle = ex.exchange_stream(tree)
+        seen = {}
+        for li, arr in handle.ready():
+            assert li not in seen
+            seen[li] = np.array(arr)     # copy: buffers are reused views
+        assert sorted(seen) == list(range(len(leaves)))
+        for li, leaf in enumerate(leaves):
+            np.testing.assert_allclose(
+                seen[li], np.asarray(leaf).reshape(-1), rtol=1e-6)
+        # result() after draining still assembles the full tree
+        out = handle.result()
+        np.testing.assert_allclose(np.asarray(out["b"]), tree["b"],
+                                   rtol=1e-6)
+    finally:
+        be.close()
+
+
+def test_exchange_stream_surfaces_pull_failure():
+    """A failed pull must raise from the ready() iterator instead of
+    leaving the consumer blocked on leaves that never complete."""
+    be = HostPSBackend(num_servers=1, num_workers=1, engine_threads=1)
+    try:
+        ex = PSGradientExchange(be, partition_bytes=256)
+        tree = {"a": np.ones(100, np.float32)}
+        ex.exchange(tree)                      # plan + one clean round
+
+        def boom(key, out, round=0, timeout_ms=30000):
+            raise RuntimeError("injected pull failure")
+
+        be.pull = boom          # instance attr shadows the method
+        with pytest.raises(RuntimeError, match="injected"):
+            for _ in ex.exchange_stream(tree).ready():
+                pass
+    finally:
+        be.close()

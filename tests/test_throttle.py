@@ -1,0 +1,66 @@
+"""Emulated-NIC accounting invariants: every byte that reached the
+kernel is counted exactly once — including across a mid-frame send
+failure plus resend, where the old frame-up-front booking double-counted
+the whole frame (the curve rig's analytic byte model would drift)."""
+
+import numpy as np
+import pytest
+
+from byteps_tpu.server.throttle import Nic, ThrottledSocket
+
+
+class _FlakySock:
+    """sendall succeeds ``ok_writes`` times, then raises once; writes
+    after the failure succeed (the 'reconnected' socket)."""
+
+    def __init__(self, ok_writes: int) -> None:
+        self.ok_writes = ok_writes
+        self.written = 0
+        self.failed = False
+
+    def sendall(self, data) -> None:
+        if not self.failed and self.ok_writes <= 0:
+            self.failed = True
+            raise ConnectionError("injected mid-frame failure")
+        self.ok_writes -= 1
+        self.written += len(data)
+
+
+def test_mid_frame_failure_plus_resend_counts_once():
+    # burst far below the frame size forces the chunked path; the high
+    # rate keeps pacing sleeps negligible
+    nic = Nic(rate=4e9, burst=64 << 10)
+    frame = bytes(1 << 20)
+    sock = _FlakySock(ok_writes=2)     # fail on the 3rd chunk
+    ts = ThrottledSocket(sock, nic)
+    with pytest.raises(ConnectionError):
+        ts.sendall(frame)
+    assert nic.tx_bytes == sock.written        # only what hit the kernel
+    assert 0 < nic.tx_bytes < len(frame)
+    ts.sendall(frame)                          # the reconnect's resend
+    assert nic.tx_bytes == sock.written
+    # old behavior booked len(frame) on the failed attempt too:
+    assert nic.tx_bytes < 2 * len(frame)
+
+
+def test_success_path_counts_every_chunk_exactly_once():
+    nic = Nic(rate=4e9, burst=64 << 10)
+    sock = _FlakySock(ok_writes=1 << 30)
+    ts = ThrottledSocket(sock, nic)
+    frame = bytes((8 << 20) + 13)              # non-chunk-aligned tail
+    ts.sendall(frame)
+    assert nic.tx_bytes == len(frame) == sock.written
+
+
+def test_latency_charged_once_per_frame():
+    """A chunked frame pays the per-frame latency ONCE — per-chunk
+    latency would inflate emulated RTTs by the chunk count."""
+    import time
+
+    nic = Nic(rate=4e9, latency=0.05, burst=64 << 10)
+    sock = _FlakySock(ok_writes=1 << 30)
+    ts = ThrottledSocket(sock, nic)
+    t0 = time.perf_counter()
+    ts.sendall(bytes(1 << 20))                 # 16 chunks at 64 KB
+    dt = time.perf_counter() - t0
+    assert dt < 0.05 * 3, dt                   # one charge, not sixteen

@@ -16,6 +16,7 @@ it exists for.
 """
 
 import os
+import threading
 import time
 
 import numpy as np
@@ -77,7 +78,7 @@ def test_striped_retry_applies_once(rig):
     for _ in range(2):                     # send the whole set TWICE
         for pi, (off, ln) in enumerate(ranges):
             cli._rpc(OP_PUSH_PART, 0, tok, len(view), 0, "float32",
-                     (_PART.pack(off, ln, pi, n), view[off:off + ln]))
+                     (_PART.pack(off, ln, pi, n, 0), view[off:off + ln]))
     out = np.empty_like(x)
     cli.pull(0, out, round=1, timeout_ms=60000)
     np.testing.assert_array_equal(out, x)  # ones, not twos
@@ -115,3 +116,59 @@ def test_byte_accounting_exact_for_large_frames(rig):
     cli.push(0, x)
     sent = nic.tx_bytes - tx0
     assert NB <= sent <= NB * 1.01, sent
+
+
+def test_concurrent_striped_async_pulls_never_tear():
+    """ADVICE.md medium: pull stages keyed by bare (key, round) collide
+    across workers in async mode (round=0) — one puller's stragglers
+    could be served a NEWER store value fetched for the other puller,
+    assembling a torn tensor. The per-logical-op nonce gives every
+    striped pull its own stage, so each op's parts all come from ONE
+    engine fetch: with a pusher continuously bumping a uniform vector,
+    every pulled tensor must still be internally uniform."""
+    os.environ["BPS_STRIPE_MIN"] = "262144"
+    be = PSServer(num_workers=1, engine_threads=2, async_mode=True)
+    srv = PSTransportServer(be, host="127.0.0.1", port=0)
+    clis = [RemotePSBackend([f"127.0.0.1:{srv.port}"], async_mode=True)
+            for _ in range(2)]
+    try:
+        n = (2 << 20) // 4
+        clis[0].init_key(0, n * 4, init=np.zeros(n, np.float32))
+        stop = threading.Event()
+        errs: list = []
+
+        def pusher():
+            one = np.ones(n, np.float32)
+            while not stop.is_set():
+                clis[0].push(0, one)     # store accumulates: stays uniform
+
+        def puller(cli):
+            out = np.empty(n, np.float32)
+            try:
+                for _ in range(30):
+                    cli.pull(0, out, round=0, timeout_ms=30000)
+                    assert cli._stripe_ranges(out.nbytes), \
+                        "test rig: pull was not striped"
+                    lo, hi = out.min(), out.max()
+                    if lo != hi:
+                        errs.append(f"torn pull: min={lo} max={hi}")
+                        return
+            except Exception as e:        # noqa: BLE001 — surfaced below
+                errs.append(repr(e))
+
+        ts = [threading.Thread(target=puller, args=(c,)) for c in clis]
+        pt = threading.Thread(target=pusher)
+        pt.start()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        stop.set()
+        pt.join()
+        assert not errs, errs
+    finally:
+        os.environ.pop("BPS_STRIPE_MIN", None)
+        for c in clis:
+            c.close()
+        srv.close()
+        be.close()
