@@ -208,6 +208,130 @@ def ps_tail_breakdown(iters: int = 12, warm: int = 3) -> dict:
     return out
 
 
+def ps_head_breakdown(iters: int = 5, warm: int = 2,
+                      dim: int = 2048, depth: int = 6,
+                      batch: int = 32, nic_rate: float = 3.5e8,
+                      pairs: int = 3) -> dict:
+    """Step-HEAD breakdown of the sync-PS step (the staged backward ∥
+    D2H ∥ push pipeline, the mirror of ``ps_tail_breakdown``): run a
+    comm/compute-balanced MLP chain through the PS-mode trainer with
+    tracing on, once with the staged head and once with the monolithic
+    one-program backward (``BPS_BWD_STAGED`` A/B), and report per-stage
+    totals, the backward/push overlap, and the step-rate ratio — so the
+    head overlap win is measured, not asserted.
+
+    An MLP chain on purpose: a layer CHAIN (no lax.scan) gives the
+    gradient jaxpr one cut point per layer, so the staged head gets
+    several real segments; the 1-device mesh is the staged head's
+    geometry (the classic one-chip-per-worker PS deployment, the host
+    hop the only reduction); ``partition_bytes`` is sized so each
+    layer's 16 MB weight lands in its own bucket.
+
+    The exchange runs over the REAL transport stack (PSTransportServer
+    on loopback) under the repo's emulated-NIC throttle at ``nic_rate``
+    bytes/sec — the same methodology as the PS-vs-allreduce bench
+    (throttle.py): on an in-process backend the "wire" is host memcpys
+    that CONTEND with the backward's own CPU cores, so head overlap is
+    unmeasurable on a one-box smoke; under an emulated NIC the push
+    spans are genuine wire time and hiding them behind the backward is
+    exactly what the staged head claims. 350 MB/s ≈ a 2.8 Gb/s
+    worker→server share, the regime the reference targets.
+
+    The A/B runs ``pairs`` independent init pairs and reports the
+    MEDIAN per-pair ratio (plus the list): the monolithic arm submits
+    every push at once, so its wire schedule phase-locks per init
+    (token-bucket round-robin) and single pairs are bimodal — the same
+    drift-robustness move as the headline bench's window pairs."""
+    import tempfile
+
+    import byteps_tpu as bps
+    from byteps_tpu.models.mlp import mlp_init, mlp_loss
+    from byteps_tpu.parallel.mesh import make_mesh
+    from byteps_tpu.server.engine import PSServer
+    from byteps_tpu.server.transport import PSTransportServer
+    from byteps_tpu.telemetry import exchange_head_overlap, summarize_stages
+    from byteps_tpu.training import DistributedTrainer
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, dim).astype(np.float32)
+    data = (x, np.tanh(x))
+    params = mlp_init(jax.random.PRNGKey(0), dim, depth)
+    saved = {k: os.environ.get(k) for k in
+             ("BPS_ENABLE_PS", "BPS_BWD_STAGED", "BPS_APPLY_CHUNKED",
+              "BPS_SERVER_ADDRS", "BPS_EMU_NIC_RATE", "BPS_PS_CONNS",
+              "BPS_PS_PIPELINE", "BPS_TRACE_ON", "BPS_TRACE_START_STEP",
+              "BPS_TRACE_END_STEP", "BPS_TRACE_DIR")}
+    out: dict = {}
+    engine = PSServer(num_workers=1, engine_threads=2)
+    server = PSTransportServer(engine, host="127.0.0.1", port=0)
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            os.environ.update(BPS_ENABLE_PS="1", BPS_TRACE_ON="1",
+                              BPS_SERVER_ADDRS=f"127.0.0.1:{server.port}",
+                              BPS_EMU_NIC_RATE=str(nic_rate),
+                              # every bucket's push/pull pair must hold
+                              # a live channel at once or later pushes
+                              # queue behind rx-throttled pulls and the
+                              # wire idles (conns are cheap; wire time
+                              # is the throttled resource being shared)
+                              BPS_PS_CONNS=str(2 * depth + 4),
+                              BPS_PS_PIPELINE=str(2 * depth + 4),
+                              # skip the warm steps: staged-head build
+                              # + compile time would swamp the averages
+                              BPS_TRACE_START_STEP=str(warm + 1),
+                              BPS_TRACE_END_STEP="1000000000",
+                              BPS_TRACE_DIR=td)
+            sps: dict = {"staged": [], "monolithic": []}
+            for rep in range(pairs):
+                for mode, flag in (("staged", "1"), ("monolithic", "0")):
+                    os.environ["BPS_BWD_STAGED"] = flag
+                    bps.init(config=bps.Config.from_env())
+                    mesh = make_mesh({"data": 1},
+                                     devices=jax.devices()[:1])
+                    trainer = DistributedTrainer(
+                        mlp_loss, params, optax.adamw(1e-4), mesh=mesh,
+                        partition_bytes=dim * dim * 4,
+                        name=f"ps-head-{mode}-{rep}")
+                    for _ in range(warm):
+                        float(trainer.step(data))
+                    t0 = time.perf_counter()
+                    for _ in range(iters):
+                        loss = trainer.step(data)
+                    float(loss)
+                    dt = time.perf_counter() - t0
+                    from byteps_tpu.common.global_state import GlobalState
+                    events = GlobalState.get().timeline.snapshot()
+                    sps[mode].append(batch * iters / dt)
+                    if mode == "staged" and rep == 0:
+                        out["staged_engaged"] = bool(trainer._staged)
+                        out["segments"] = getattr(trainer._staged,
+                                                  "n_segments", 0)
+                        out["head_stages_ms"] = summarize_stages(
+                            [e for e in events if e["name"] in
+                             ("PS_BWD_SEG", "PS_D2H", "PS_PACK",
+                              "PS_PUSH")])
+                        out["head_overlap"] = exchange_head_overlap(
+                            events)
+                    trainer.close()
+                    bps.shutdown()
+        import statistics
+        out["staged_sps"] = round(statistics.median(sps["staged"]), 2)
+        out["monolithic_sps"] = round(
+            statistics.median(sps["monolithic"]), 2)
+        ratios = [s / m for s, m in zip(sps["staged"], sps["monolithic"])]
+        out["pair_ratios"] = [round(r, 4) for r in ratios]
+        out["staged_vs_monolithic"] = round(statistics.median(ratios), 4)
+    finally:
+        server.close()
+        engine.close()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
 def probe_tpu(attempts: int = 3, timeout: float = 150.0,
               backoff: float = 20.0):
     """Bounded TPU-reachability probe. jax.devices() can hang
@@ -443,6 +567,12 @@ def main() -> None:
         line["ps_tail"] = ps_tail_breakdown()
     except Exception as e:       # noqa: BLE001 — recorded, not fatal
         line["ps_tail_error"] = f"{type(e).__name__}: {e}"[:300]
+    # sync-PS step-HEAD breakdown (staged backward ∥ D2H ∥ push), the
+    # mirror A/B of ps_tail — same ride-along contract
+    try:
+        line["ps_head"] = ps_head_breakdown()
+    except Exception as e:       # noqa: BLE001 — recorded, not fatal
+        line["ps_head_error"] = f"{type(e).__name__}: {e}"[:300]
     print(json.dumps(line))
 
 

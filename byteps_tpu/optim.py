@@ -192,6 +192,13 @@ class ChunkedApply:
     parameter tree's flat leaves (the exchange's bucket groups,
     ``PSGradientExchange.leaf_groups``).
 
+    The same groups serve BOTH ends of the streamed PS step: the staged
+    backward (``staged_grad.build_staged_grad``) places its candidate
+    segment cuts where each group's last gradient is produced, and this
+    class applies the optimizer per group as the pulls land — so one
+    bucket partition defines the whole pipeline's granularity
+    (bwd seg ∥ push ∥ server ∥ pull ∥ apply all advance per group).
+
     When ``inner`` is leafwise-decomposable (probe above), optimizer
     state is held PER GROUP (``inner.init`` on each group's leaf list)
     and ``apply_group`` updates one group as its gradients arrive —

@@ -77,6 +77,306 @@ class _StreamingExchange:
         return self._drain()
 
 
+class _IngestExchange:
+    """Handle returned by ``PSGradientExchange.exchange_ingest``: the
+    INGRESS mirror of ``exchange_stream``. The caller ``feed``s leaves
+    the moment their values materialize (the staged backward hands over
+    each layer group as its segment finishes); every bucket's D2H +
+    pack + push is submitted the instant its last covering leaf arrives
+    — no waiting for the full tree, the head analogue of the
+    reference's per-tensor push interception. The pull side is the same
+    leaf-completion stream as ``exchange_stream``: ``ready()`` /
+    ``result()`` behave identically, so the streamed step tail composes
+    unchanged. ``finish()`` asserts every leaf was fed; ``abort(exc)``
+    unblocks a consumer when the producer dies mid-backward."""
+
+    __slots__ = ("_r",)
+
+    def __init__(self, round_) -> None:
+        self._r = round_
+
+    def feed(self, leaf_ids, values) -> None:
+        """Hand over device (or host) arrays for ``leaf_ids`` (flat
+        indices). Starts ``copy_to_host_async`` immediately; buckets
+        completed by these leaves are packed+pushed on worker threads."""
+        self._r.feed(leaf_ids, values)
+
+    def finish(self) -> None:
+        """Declare feeding complete; raises if any leaf is missing."""
+        self._r.finish_feed()
+
+    def abort(self, exc: BaseException) -> None:
+        """Producer-side failure: wake ``ready()``/``result()`` with
+        ``exc`` instead of leaving them blocked on leaves that will
+        never complete."""
+        self._r.abort(exc)
+
+    def ready(self):
+        """Iterate (leaf_index, flat host array) as leaves complete."""
+        return self._r.ready_iter()
+
+    def result(self):
+        """Drain every pull and return the assembled summed tree."""
+        return self._r.drain()
+
+
+class _Round:
+    """One sync exchange round's machinery, shared by the all-at-once
+    paths (``exchange``/``exchange_async``/``exchange_stream``) and the
+    incremental head path (``exchange_ingest``): lazily-materialized
+    host leaves with PER-LEAF locks (one slow D2H can no longer block
+    another bucket's pack worker behind a global lock), bucket
+    pack+push, pull+unpack, and leaf-completion streaming."""
+
+    def __init__(self, ex: "PSGradientExchange", tree,
+                 name: Optional[str], stream: bool,
+                 ingest: bool = False) -> None:
+        import queue as _queue
+        self.ex = ex
+        self.decl_name, self.treedef, self.keyed = ex._plan(tree, name)
+        leaves, _ = jax.tree_util.tree_flatten(tree)
+        self.shapes = [l.shape for l in leaves]
+        # ingest rounds get their sources fed later; the template tree
+        # (typically the params) only supplies structure/shapes/dtypes
+        self.sources: List = [None] * len(leaves) if ingest else list(leaves)
+        self.flat: List[Optional[np.ndarray]] = [None] * len(leaves)
+        self.flat_locks = [threading.Lock() for _ in leaves]
+        self.out = [np.empty(int(np.prod(l.shape)), np.dtype(l.dtype))
+                    for l in leaves]
+        self.rounds: List[Optional[int]] = [None] * len(self.keyed)
+        self.pull_futs: List = []
+        self._futs_lock = threading.Lock()
+        self.readyq = None
+        if stream or ingest:
+            self.readyq = _queue.Queue()
+            self.seg_left = [0] * len(leaves)
+            for _, b in self.keyed:
+                for s in b.segments:
+                    self.seg_left[s.leaf_index] += 1
+            self.seg_lock = threading.Lock()
+            for li, n in enumerate(self.seg_left):
+                if n == 0:          # zero-size leaf: no covering bucket,
+                    self.readyq.put((li, self.out[li]))  # ready at once
+        self.ingest = ingest
+        if ingest:
+            self.dtypes = [np.dtype(l.dtype) for l in leaves]
+            # bucket -> distinct covering leaves; a bucket is pushable
+            # when all of them have been fed
+            self.bucket_leaves = [
+                sorted({s.leaf_index for s in b.segments})
+                for _, b in self.keyed]
+            self.bucket_need = [len(ls) for ls in self.bucket_leaves]
+            self.leaf_buckets: Dict[int, List[int]] = {}
+            for bi, ls in enumerate(self.bucket_leaves):
+                for li in ls:
+                    self.leaf_buckets.setdefault(li, []).append(bi)
+            self.fed = [False] * len(leaves)
+            self.feed_lock = threading.Lock()
+            self.feed_done = False
+            self.aborted: Optional[BaseException] = None
+
+    # ------------------------------------------------------ host leaves
+
+    def get_flat(self, i: int) -> np.ndarray:
+        v = self.flat[i]         # double-checked: a ready leaf never waits
+        if v is not None:        # behind its own (or any) lock
+            return v
+        with self.flat_locks[i]:
+            if self.flat[i] is None:
+                import time
+                t0 = time.time()
+                # ascontiguousarray: the native pack does raw pointer
+                # math (no-op for device readbacks). np.asarray blocks
+                # on the leaf's D2H copy — only ITS OWN copy, per-leaf
+                self.flat[i] = np.ascontiguousarray(
+                    np.asarray(self.sources[i])).reshape(-1)
+                if self.ex.timeline is not None:
+                    self.ex.timeline.record(self.decl_name, "PS_D2H", t0,
+                                            time.time() - t0, i)
+            return self.flat[i]
+
+    # ------------------------------------------------------ push / pull
+
+    def push_one(self, idx: int) -> np.ndarray:
+        import time
+        ex = self.ex
+        pskey, b = self.keyed[idx]
+        self.rounds[idx] = ex._next_round(pskey)
+        t0 = time.time()
+        buf = np.empty(b.size, dtype=b.dtype)
+        if ex._native_pack:
+            # native gather: one GIL-released call per bucket instead
+            # of a GIL-held numpy copy per segment (VERDICT r4 #5 — the
+            # uncompressed hop's interpreter cost; reference
+            # core_loops.cc:538-618 stages zero-copy in C++ too)
+            item = np.dtype(b.dtype).itemsize
+            from .engine import pack_segments
+            pack_segments(
+                [self.get_flat(s.leaf_index).ctypes.data
+                 + s.leaf_offset * item for s in b.segments],
+                [s.bucket_offset * item for s in b.segments],
+                [s.length * item for s in b.segments], buf)
+        else:
+            for s in b.segments:
+                buf[s.bucket_offset:s.bucket_offset + s.length] = \
+                    self.get_flat(s.leaf_index)[
+                        s.leaf_offset:s.leaf_offset + s.length]
+        t0 = ex._record(self.decl_name, "PS_PACK", pskey, t0)
+        try:
+            ex._push_bucket(pskey, b, buf)
+        except Exception:
+            # the round counter advanced but the push never landed: drop
+            # the entry so a retried exchange() re-seeds from the
+            # server's round instead of pulling a round that will never
+            # complete (permanent sliced-pull timeout)
+            with ex._key_rounds_lock:
+                ex._key_rounds.pop(pskey, None)
+            raise
+        ex._record(self.decl_name, "PS_PUSH", pskey, t0)
+        return buf
+
+    def pull_one(self, idx: int, buf: np.ndarray) -> None:
+        import time
+        ex = self.ex
+        pskey, b = self.keyed[idx]
+        t0 = time.time()
+        merged = ex._pull_bucket(pskey, b, buf, self.rounds[idx])
+        t0 = ex._record(self.decl_name, "PS_PULL", pskey, t0)
+        if ex._native_pack and merged.flags["C_CONTIGUOUS"]:
+            item = np.dtype(b.dtype).itemsize
+            from .engine import unpack_segments
+            unpack_segments(
+                merged,
+                [s.bucket_offset * item for s in b.segments],
+                [self.out[s.leaf_index].ctypes.data + s.leaf_offset * item
+                 for s in b.segments],
+                [s.length * item for s in b.segments])
+        else:
+            for s in b.segments:        # disjoint segments: thread-safe
+                self.out[s.leaf_index][
+                    s.leaf_offset:s.leaf_offset + s.length] = \
+                    merged[s.bucket_offset:s.bucket_offset + s.length]
+        ex._record(self.decl_name, "PS_UNPACK", pskey, t0)
+        if self.readyq is not None:
+            for s in b.segments:
+                self._segment_done(s.leaf_index)
+
+    def _segment_done(self, li: int) -> None:
+        with self.seg_lock:
+            self.seg_left[li] -= 1
+            done = self.seg_left[li] == 0
+        if done:
+            self.readyq.put((li, self.out[li]))
+
+    def _relay_failure(self, f) -> None:
+        """A failed push/pull would otherwise leave the ready-stream
+        consumer blocked on leaves that will never complete: surface
+        the first failure as a queue sentinel."""
+        try:
+            exc = f.exception()
+        except BaseException as e:       # noqa: BLE001 — cancelled
+            exc = e
+        if exc is not None:
+            self.readyq.put(exc)
+
+    def assemble(self):
+        shaped = [o.reshape(shp) for o, shp in zip(self.out, self.shapes)]
+        return jax.tree_util.tree_unflatten(self.treedef, shaped)
+
+    def submit_bucket(self, idx: int) -> None:
+        """Queue bucket ``idx``'s pack+push and its chasing pull on the
+        pipeline executors."""
+        ex = self.ex
+        push_fut = ex._push_ex.submit(self.push_one, idx)
+        pull_fut = ex._pull_ex.submit(
+            lambda: self.pull_one(idx, push_fut.result()))
+        if self.readyq is not None:
+            pull_fut.add_done_callback(self._relay_failure)
+        with self._futs_lock:
+            self.pull_futs.append(pull_fut)
+
+    def drain(self):
+        if getattr(self, "aborted", None) is not None:
+            raise self.aborted
+        with self._futs_lock:
+            futs = list(self.pull_futs)
+        for f in futs:
+            f.result()              # propagate the first failure
+        if self.ingest:
+            # the futures above cover only SUBMITTED buckets — an
+            # incompletely-fed round has unfilled out[] buffers
+            # (np.empty garbage), and an abort() racing this drain
+            # must win over a silent partial result
+            if self.aborted is not None:
+                raise self.aborted
+            with self.feed_lock:
+                missing = sum(not f for f in self.fed)
+            if missing:
+                raise RuntimeError(
+                    f"exchange_ingest result() with {missing} leaves "
+                    f"never fed — call feed() for every leaf and "
+                    f"finish() before draining")
+        return self.assemble()
+
+    def ready_iter(self):
+        yielded = 0
+        n = len(self.out)
+        while yielded < n:
+            item = self.readyq.get()
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+            yielded += 1
+
+    # ------------------------------------------------------ ingest path
+
+    def feed(self, leaf_ids, values) -> None:
+        pairs = list(zip(leaf_ids, values))   # values may be one-shot
+        for li, v in pairs:
+            # the bucket plan's segment offsets were computed from the
+            # template — a mismatched leaf would make the native pack's
+            # pointer math read out of bounds, silently
+            if (int(np.prod(getattr(v, "shape", ()))) !=
+                    int(np.prod(self.shapes[li]))
+                    or np.dtype(v.dtype) != self.dtypes[li]):
+                raise ValueError(
+                    f"fed leaf {li} is {getattr(v, 'shape', ())}/"
+                    f"{v.dtype}, plan expects {self.shapes[li]}/"
+                    f"{self.dtypes[li]}")
+            if hasattr(v, "copy_to_host_async"):
+                v.copy_to_host_async()   # start D2H before any pack
+        fire: List[int] = []
+        with self.feed_lock:
+            if self.feed_done:
+                raise RuntimeError("feed() after finish()")
+            for li, v in pairs:
+                if self.fed[li]:
+                    raise ValueError(f"leaf {li} fed twice")
+                self.fed[li] = True
+                self.sources[li] = v
+                for bi in self.leaf_buckets.get(li, ()):
+                    self.bucket_need[bi] -= 1
+                    if self.bucket_need[bi] == 0:
+                        fire.append(bi)
+        for bi in fire:
+            self.submit_bucket(bi)
+
+    def finish_feed(self) -> None:
+        with self.feed_lock:
+            missing = [li for li, f in enumerate(self.fed) if not f]
+            self.feed_done = True
+        if missing:
+            raise ValueError(
+                f"exchange_ingest round finished with {len(missing)} "
+                f"leaves never fed (first missing: {missing[:5]}) — every "
+                f"flat leaf must be handed over exactly once")
+
+    def abort(self, exc: BaseException) -> None:
+        self.aborted = exc
+        if self.readyq is not None:
+            self.readyq.put(exc)
+
+
 class PSGradientExchange:
     """Sync-mode bucketed gradient exchange through the host PS service.
 
@@ -282,136 +582,24 @@ class PSGradientExchange:
         feeding the framework as partitions land (operations.cc:140-180)."""
         return self._exchange_impl(tree, name, detach=True, stream=True)
 
-    def _exchange_impl(self, tree, name: Optional[str], detach: bool,
-                       stream: bool = False):
-        import time
-        decl_name, treedef, keyed = self._plan(tree, name)
-        leaves, _ = jax.tree_util.tree_flatten(tree)
-        for l in leaves:                 # start ALL D2H copies first so the
-            if hasattr(l, "copy_to_host_async"):   # transfers overlap instead
-                l.copy_to_host_async()             # of serializing per leaf
-        # per-bucket rounds, assigned (and server-seeded on first use)
-        # inside the push workers — see _next_round
-        rounds: List[Optional[int]] = [None] * len(keyed)
+    def exchange_ingest(self, template, name: Optional[str] = None):
+        """Incremental-ingest sync round — the step-HEAD mirror of
+        ``exchange_stream``. ``template`` is any tree with the grads'
+        structure/shapes/dtypes (typically the param tree; no values
+        are read from it). Returns an ``_IngestExchange``: the caller
+        ``feed``s leaves group-by-group as the staged backward
+        materializes them, each bucket's ``copy_to_host_async`` → pack
+        → push fires the moment its last covering leaf arrives (instead
+        of requiring the full tree up front), and pulls chase pushes so
+        ``ready()``/``result()`` stream exactly like
+        ``exchange_stream``. With PR 1's streamed tail this closes the
+        full pipeline: bwd(group k+1) ∥ D2H/push(group k) ∥ server-sum
+        ∥ pull/H2D/apply."""
+        self._ensure_executors()
+        return _IngestExchange(_Round(self, template, name,
+                                      stream=True, ingest=True))
 
-        # lazily-materialized host leaves: bucket 0's pack waits only for
-        # ITS leaves' D2H, not the whole tree's
-        flat: List[Optional[np.ndarray]] = [None] * len(leaves)
-        flat_lock = threading.Lock()
-
-        def get_flat(i: int) -> np.ndarray:
-            v = flat[i]          # double-checked: a ready leaf never waits
-            if v is not None:    # behind another leaf's D2H copy
-                return v
-            with flat_lock:
-                if flat[i] is None:
-                    # ascontiguousarray: the native pack does raw
-                    # pointer math (no-op for device readbacks)
-                    flat[i] = np.ascontiguousarray(
-                        np.asarray(leaves[i])).reshape(-1)
-                return flat[i]
-
-        out = [np.empty(int(np.prod(l.shape)), np.dtype(l.dtype))
-               for l in leaves]
-
-        # leaf-completion tracking for the streaming form: a leaf is
-        # ready when its LAST outstanding covering segment unpacks, in
-        # whatever order the pipelined pulls land
-        readyq = None
-        if stream:
-            import queue as _queue
-            readyq = _queue.Queue()
-            seg_left = [0] * len(leaves)
-            for _, b in keyed:
-                for s in b.segments:
-                    seg_left[s.leaf_index] += 1
-            seg_lock = threading.Lock()
-            for li, n in enumerate(seg_left):
-                if n == 0:          # zero-size leaf: no segments cover
-                    readyq.put((li, out[li]))   # it — ready immediately
-
-            def _segment_done(li: int) -> None:
-                with seg_lock:
-                    seg_left[li] -= 1
-                    done = seg_left[li] == 0
-                if done:
-                    readyq.put((li, out[li]))
-
-        def push_one(idx: int) -> np.ndarray:
-            pskey, b = keyed[idx]
-            rounds[idx] = self._next_round(pskey)
-            t0 = time.time()
-            buf = np.empty(b.size, dtype=b.dtype)
-            if self._native_pack:
-                # native gather: one GIL-released call per bucket
-                # instead of a GIL-held numpy copy per segment
-                # (VERDICT r4 #5 — the uncompressed hop's interpreter
-                # cost; reference core_loops.cc:538-618 stages
-                # zero-copy in C++ too)
-                item = np.dtype(b.dtype).itemsize
-                from .engine import pack_segments
-                pack_segments(
-                    [get_flat(s.leaf_index).ctypes.data
-                     + s.leaf_offset * item for s in b.segments],
-                    [s.bucket_offset * item for s in b.segments],
-                    [s.length * item for s in b.segments], buf)
-            else:
-                for s in b.segments:
-                    buf[s.bucket_offset:s.bucket_offset + s.length] = \
-                        get_flat(s.leaf_index)[
-                            s.leaf_offset:s.leaf_offset + s.length]
-            t0 = self._record(decl_name, "PS_PACK", pskey, t0)
-            try:
-                self._push_bucket(pskey, b, buf)
-            except Exception:
-                # the round counter advanced but the push never landed: drop
-                # the entry so a retried exchange() re-seeds from the
-                # server's round instead of pulling a round that will never
-                # complete (permanent sliced-pull timeout)
-                with self._key_rounds_lock:
-                    self._key_rounds.pop(pskey, None)
-                raise
-            self._record(decl_name, "PS_PUSH", pskey, t0)
-            return buf
-
-        def pull_one(idx: int, buf: np.ndarray) -> None:
-            pskey, b = keyed[idx]
-            t0 = time.time()
-            merged = self._pull_bucket(pskey, b, buf, rounds[idx])
-            t0 = self._record(decl_name, "PS_PULL", pskey, t0)
-            if self._native_pack and merged.flags["C_CONTIGUOUS"]:
-                item = np.dtype(b.dtype).itemsize
-                from .engine import unpack_segments
-                unpack_segments(
-                    merged,
-                    [s.bucket_offset * item for s in b.segments],
-                    [out[s.leaf_index].ctypes.data + s.leaf_offset * item
-                     for s in b.segments],
-                    [s.length * item for s in b.segments])
-            else:
-                for s in b.segments:    # disjoint segments: thread-safe
-                    out[s.leaf_index][
-                        s.leaf_offset:s.leaf_offset + s.length] = \
-                        merged[s.bucket_offset:s.bucket_offset + s.length]
-            self._record(decl_name, "PS_UNPACK", pskey, t0)
-            if stream:
-                for s in b.segments:
-                    _segment_done(s.leaf_index)
-
-        def assemble():
-            shaped = [o.reshape(l.shape) for o, l in zip(out, leaves)]
-            return jax.tree_util.tree_unflatten(treedef, shaped)
-
-        if not detach and not stream and (self.pipeline_depth <= 1
-                                          or len(keyed) == 1):
-            # serial: push everything (the server sums as they land),
-            # then drain pulls in the same order
-            bufs = [push_one(i) for i in range(len(keyed))]
-            for i, buf in enumerate(bufs):
-                pull_one(i, buf)
-            return assemble()
-        # pipelined (always, for the detached form: its no-deadlock
-        # contract needs pushes on executor threads, not the caller's).
+    def _ensure_executors(self) -> None:
         # Creation is locked: the multi-channel torch dispatcher reaches
         # here concurrently, and a double-created pair would orphan
         # threads close() never shuts down
@@ -422,36 +610,32 @@ class PSGradientExchange:
                     width, thread_name_prefix="bps-ps-push")
                 self._pull_ex = ThreadPoolExecutor(
                     width, thread_name_prefix="bps-ps-pull")
-        push_futs = [self._push_ex.submit(push_one, i)
-                     for i in range(len(keyed))]
-        pull_futs = [
-            self._pull_ex.submit(
-                lambda i=i: pull_one(i, push_futs[i].result()))
-            for i in range(len(keyed))]
 
-        def drain():
-            for f in pull_futs:
-                f.result()          # propagate the first failure
-            return assemble()
+    def _exchange_impl(self, tree, name: Optional[str], detach: bool,
+                       stream: bool = False):
+        rnd = _Round(self, tree, name, stream=stream)
+        for l in rnd.sources:            # start ALL D2H copies first so the
+            if hasattr(l, "copy_to_host_async"):   # transfers overlap instead
+                l.copy_to_host_async()             # of serializing per leaf
 
+        if not detach and not stream and (self.pipeline_depth <= 1
+                                          or len(rnd.keyed) == 1):
+            # serial: push everything (the server sums as they land),
+            # then drain pulls in the same order
+            bufs = [rnd.push_one(i) for i in range(len(rnd.keyed))]
+            for i, buf in enumerate(bufs):
+                rnd.pull_one(i, buf)
+            return rnd.assemble()
+        # pipelined (always, for the detached form: its no-deadlock
+        # contract needs pushes on executor threads, not the caller's)
+        self._ensure_executors()
+        for i in range(len(rnd.keyed)):
+            rnd.submit_bucket(i)
         if stream:
-            # a failed push/pull would otherwise leave the ready-stream
-            # consumer blocked on leaves that will never complete:
-            # surface the first failure as a queue sentinel
-            def _relay_failure(f) -> None:
-                try:
-                    exc = f.exception()
-                except BaseException as e:   # noqa: BLE001 — cancelled
-                    exc = e
-                if exc is not None:
-                    readyq.put(exc)
-
-            for f in pull_futs:
-                f.add_done_callback(_relay_failure)
-            return _StreamingExchange(len(leaves), readyq, drain)
+            return _StreamingExchange(len(rnd.out), rnd.readyq, rnd.drain)
         if not detach:
-            return drain()
-        return _PendingExchange(drain)
+            return rnd.drain()
+        return _PendingExchange(rnd.drain)
 
 
 class AsyncPSWorker:

@@ -88,3 +88,33 @@ def exchange_tail_overlap(events) -> dict:
         return {"overlapped": False, "overlap_ms": 0.0}
     return {"overlapped": best > 0,
             "overlap_ms": round(max(0.0, best) / 1e3, 3)}
+
+
+def exchange_head_overlap(events) -> dict:
+    """Overlap stats for the staged sync-PS step HEAD.
+
+    The head's pipeline claim is the mirror of the tail's: push-side
+    work (``PS_D2H``/``PS_PACK``/``PS_PUSH``) for an early layer group
+    must START before the backward's LAST ``PS_BWD_SEG`` span FINISHED
+    — a staged backward whose pushes all fire after the final segment
+    would be renamed stages, not a pipeline. Computed per step (see
+    ``exchange_tail_overlap``); returns the max over steps and
+    ``overlapped`` = any step's push-side span started strictly before
+    its last backward segment ended."""
+    bwd_end: dict = {}
+    comm_start: dict = {}
+    for e in events:
+        step = e.get("args", {}).get("step", 0)
+        if e["name"] == "PS_BWD_SEG":
+            bwd_end[step] = max(bwd_end.get(step, 0), e["ts"] + e["dur"])
+        elif e["name"] in ("PS_D2H", "PS_PACK", "PS_PUSH"):
+            comm_start[step] = min(comm_start.get(step, 1 << 62), e["ts"])
+    best = None
+    for step, first_comm in comm_start.items():
+        if step in bwd_end:
+            gap = bwd_end[step] - first_comm
+            best = gap if best is None else max(best, gap)
+    if best is None:
+        return {"overlapped": False, "overlap_ms": 0.0}
+    return {"overlapped": best > 0,
+            "overlap_ms": round(max(0.0, best) / 1e3, 3)}

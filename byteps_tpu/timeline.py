@@ -15,6 +15,11 @@ leaf's last covering bucket unpacks; pid = leaf index) and
 PS_APPLY_CHUNK (per-bucket-group optimizer apply; pid = group index) —
 overlap of those two with still-running PS_PULL rows is the pipeline
 the chunked tail exists for (BPS_APPLY_CHUNKED=0 disables it).
+The staged step HEAD adds PS_BWD_SEG (one span per jitted backward
+segment; pid = segment index) and PS_D2H (per-leaf host
+materialization inside the pack workers; pid = leaf index) — push-side
+rows (PS_D2H/PS_PACK/PS_PUSH) starting before the last PS_BWD_SEG ends
+is the head pipeline (BPS_BWD_STAGED=0 disables it).
 With ``BPS_TRACE_PROFILER=1`` the same step window also
 captures a ``jax.profiler`` device trace into
 ``<trace_dir>/<local_rank>/profile`` — host spans land in comm.json

@@ -206,6 +206,21 @@ class DistributedTrainer:
             # wait-all → device_put-all → fused-apply tail for A/B
             self._apply_chunked = os.environ.get(
                 "BPS_APPLY_CHUNKED", "1") != "0"
+            # streamed step HEAD (staged backward → incremental ingest:
+            # bwd(group k+1) ∥ D2H/push(group k)); BPS_BWD_STAGED=0
+            # restores the monolithic one-program backward for A/B,
+            # BPS_BWD_GROUPS caps the number of backward segments
+            self._bwd_staged = os.environ.get(
+                "BPS_BWD_STAGED", "1") != "0"
+            self._bwd_groups = int(os.environ.get("BPS_BWD_GROUPS", "0")
+                                   or 0)
+            self._staged = None      # active signature's StagedGrad /
+            #                          False (fell back) / None (unbuilt)
+            self._staged_cache = {}  # batch signature -> StagedGrad|False
+            #                          (per-sig, like jit's retrace cache:
+            #                          alternating shapes must not
+            #                          rebuild, and one unstageable shape
+            #                          must not disable the others)
             self._ps_donate = donate
             self._chunked = None        # built on first streamed step
             self._h2d_ex = None         # lazy single-thread H2D dispatcher
@@ -354,6 +369,25 @@ class DistributedTrainer:
 
     def _ps_step(self, batch) -> jnp.ndarray:
         batch = self.shard_batch(batch)
+        if (self._bwd_staged and self._apply_chunked
+                and self.backward_passes_per_step == 1):
+            # the staged program is shape-specialized; each new batch
+            # signature (structure/shape/dtype) builds once and is
+            # cached, like a jit retrace — including a per-signature
+            # False for shapes that don't stage (bounded: real loops
+            # cycle few signatures; an unbounded shape stream would
+            # already be retracing every jit in the step)
+            sig = jax.tree_util.tree_structure(batch), tuple(
+                (tuple(l.shape), str(l.dtype))
+                for l in jax.tree_util.tree_leaves(batch))
+            staged = self._staged_cache.get(sig)
+            if staged is None and sig not in self._staged_cache \
+                    and len(self._staged_cache) < 8:
+                self._build_staged_head(batch)
+                self._staged_cache[sig] = staged = self._staged
+            self._staged = staged if staged is not None else False
+            if staged not in (None, False):
+                return self._ps_step_staged(batch)
         loss, grads = self._grad_fn(self.params, batch)
         grads = self._accumulate(grads)
         if grads is None:
@@ -450,6 +484,70 @@ class DistributedTrainer:
             "state before the first step, or set BPS_APPLY_CHUNKED=0 "
             "to keep the fused full-tree optimizer state")
 
+    def _build_staged_head(self, batch) -> None:
+        """First staged step: build the K-segment backward (staged_grad)
+        from the exchange's bucket groups, or learn why we can't and
+        pin the monolithic head. The build probes the staged program
+        against ``_grad_fn`` on this real (params, batch) and keeps it
+        only on BITWISE equality, so flipping ``BPS_BWD_STAGED`` can
+        never change training numerics."""
+        from .common.logging import get_logger
+        self._staged = False
+        if self.mesh.size != 1:
+            # the staged segments run outside shard_map, so the
+            # intra-worker pmean stage has nowhere to live — the staged
+            # head targets the classic one-chip-per-worker PS geometry
+            # where the host hop is the only reduction
+            get_logger().info(
+                "staged PS head falls back: local mesh has %d devices "
+                "(the staged backward bypasses the intra-worker pmean)",
+                self.mesh.size)
+            return
+        from .staged_grad import build_staged_grad
+        groups = self._ps_exchange.leaf_groups(self.params,
+                                               name=self._name)
+        staged = build_staged_grad(
+            self._loss_fn, self.params, batch, groups=groups,
+            fused_fn=self._grad_fn,
+            max_segments=self._bwd_groups or max(2, min(8, len(groups))),
+            name=self._name)
+        if staged is not None:
+            self._staged = staged
+
+    def _ps_step_staged(self, batch) -> jnp.ndarray:
+        """Streamed step HEAD: run the backward as K jitted segments and
+        feed each group's gradients to the exchange the moment its
+        segment finishes — D2H + pack + push of group k overlap the
+        differentiation of group k+1 (the reference's per-tensor push
+        interception), then the PR-1 streamed tail consumes the same
+        handle (pull → H2D → chunked apply). Composed, the full BytePS
+        pipeline: bwd ∥ push ∥ server-sum ∥ pull ∥ apply."""
+        gs = GlobalState._instance
+        tl = gs.timeline if gs is not None else None
+        self.step_count += 1
+        t_ex = time.time()
+        handle = self._ps_exchange.exchange_ingest(self.params,
+                                                   name=self._name)
+        loss = None
+        try:
+            for seg in self._staged.run(self.params, batch):
+                if tl is not None:
+                    tl.record(self._name, "PS_BWD_SEG", seg.t0, seg.dur,
+                              seg.index)
+                if seg.loss is not None:
+                    loss = seg.loss
+                if seg.leaf_ids:
+                    handle.feed(seg.leaf_ids, seg.grads)
+            handle.finish()
+        except BaseException as e:
+            handle.abort(e)     # unblock the tail consumer
+            raise
+        loss = self._ps_step_streamed(self.params, loss, tl,
+                                      handle=handle, t_ex=t_ex)
+        if tl is not None:
+            tl.set_step(self.step_count)
+        return loss
+
     def close(self) -> None:
         """Release the trainer's PS-tail resources (H2D dispatch thread,
         private exchange executors). Idempotent; only meaningful for
@@ -464,17 +562,27 @@ class DistributedTrainer:
         if ex is not None:
             ex.close()
 
-    def _ps_step_streamed(self, grads, loss, tl) -> jnp.ndarray:
+    def _ps_step_streamed(self, grads, loss, tl, handle=None,
+                          t_ex: Optional[float] = None) -> jnp.ndarray:
         """Streamed step tail: consume the exchange's leaf-ready stream,
         device_put each leaf from a dispatch thread the moment it lands
         (H2D overlaps still-in-flight pulls of later buckets), and
         jit-apply the optimizer per bucket group as its leaves arrive —
         bucket 0's weights update while bucket N is still on the wire.
         Non-decomposable optimizers keep the fused apply at the end but
-        still get the streamed H2D overlap."""
+        still get the streamed H2D overlap.
+
+        ``handle``: a pre-started leaf-ready stream (the staged head's
+        ``exchange_ingest`` round, whose pushes began mid-backward);
+        ``grads`` then only serves as the structure template for the
+        first-step group derivation. None = start an
+        ``exchange_stream`` round from the full ``grads`` tree."""
         self._ensure_streamed_tail(grads)
-        t_ex = time.time()
-        handle = self._ps_exchange.exchange_stream(grads, name=self._name)
+        if t_ex is None:
+            t_ex = time.time()
+        if handle is None:
+            handle = self._ps_exchange.exchange_stream(grads,
+                                                       name=self._name)
         rep = NamedSharding(self.mesh, P())
         flat, treedef = jax.tree_util.tree_flatten(self.params)
         shapes = [l.shape for l in flat]

@@ -100,6 +100,164 @@ def test_gpt2_sp_step_matches_single_device():
                                    rtol=2e-3, atol=3e-5)
 
 
+# ---------------------------------------------------------------- PS head
+# Staged-backward exactness: the streamed sync-PS HEAD splits the
+# gradient program into K jitted segments (staged_grad) so early layer
+# groups push while later groups still differentiate. The build's
+# contract is bitwise: it keeps only cut points that reproduce the
+# fused backward bit-for-bit on its probe batch, and falls back to the
+# monolithic head otherwise. These tests hold it to that contract on a
+# FRESH batch (the probe only proved itself) for every model in
+# byteps_tpu/models/, and pin the two provable-fallback classes:
+# mesh-collective losses (MoE expert all_to_all can't trace outside
+# shard_map) and fusion-sensitive numerics (ResNet batchnorm backward —
+# no cut survives the bitwise probe, so the head stays monolithic).
+
+def _staged_case_mlp():
+    from byteps_tpu.models.mlp import mlp_init, mlp_loss
+    params = mlp_init(jax.random.PRNGKey(0), 64, 4)
+
+    def mk(seed):
+        x = np.random.RandomState(seed).randn(16, 64).astype(np.float32)
+        return x, np.tanh(x)
+    return mlp_loss, params, mk(1), mk(2)
+
+
+def _staged_case_bert():
+    cfg = bert.bert_tiny()
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+
+    def mk(seed):
+        return bert.synth_mlm_batch(np.random.RandomState(seed), 4, 16,
+                                    cfg.vocab_size)
+    return (lambda p, b: bert.mlm_loss(p, cfg, b)), params, mk(1), mk(2)
+
+
+def _staged_case_gpt2():
+    cfg = gpt2.gpt2_tiny()
+    params = transformer.init_params(jax.random.PRNGKey(1), cfg)
+
+    def mk(seed):
+        return gpt2.synth_lm_batch(np.random.RandomState(seed), 4, 16,
+                                   cfg.vocab_size)
+    return (lambda p, b: gpt2.causal_lm_loss(p, cfg, b)), params, \
+        mk(1), mk(2)
+
+
+def _staged_case_t5():
+    from byteps_tpu.models import t5
+    cfg = t5.t5_tiny()
+    params = t5.init_t5_params(jax.random.PRNGKey(2), cfg)
+
+    def mk(seed):
+        return t5.synth_seq2seq_batch(np.random.RandomState(seed), 4, 16,
+                                      12, cfg.vocab_size)
+    return (lambda p, b: t5.seq2seq_loss(p, cfg, b)), params, mk(1), mk(2)
+
+
+def _staged_case_moe():
+    from byteps_tpu.models import moe
+    cfg = moe.moe_tiny()
+    params = moe.init_moe_params(jax.random.PRNGKey(3), cfg)
+
+    def mk(seed):
+        return bert.synth_mlm_batch(np.random.RandomState(seed), 4, 16,
+                                    cfg.vocab_size)
+    return (lambda p, b: moe.moe_lm_loss(p, cfg, b)), params, mk(1), mk(2)
+
+
+def _staged_case_vgg():
+    from byteps_tpu.models import vgg
+    params = vgg.init_vgg16(jax.random.PRNGKey(5), num_classes=8,
+                            in_hw=32)
+
+    def mk(seed):
+        from byteps_tpu.models import resnet
+        return resnet.synth_imagenet_batch(np.random.RandomState(seed),
+                                           2, 32, classes=8)
+    return (lambda p, b: vgg.vgg_loss(p, b)), params, mk(1), mk(2)
+
+
+_STAGED_CASES = {
+    "mlp": _staged_case_mlp,
+    "bert": _staged_case_bert,
+    "gpt2": _staged_case_gpt2,
+    "t5": _staged_case_t5,
+    "moe": _staged_case_moe,
+    "vgg": _staged_case_vgg,
+}
+
+# each case pays several model-scale XLA compiles (segment builds +
+# refinement trials + the fused arm); mlp/bert stay in tier-1 as the
+# chain + scan representatives, the rest run in CI's slow lane
+_STAGED_SLOW = {"gpt2", "t5", "moe", "vgg"}
+
+
+def _run_staged(staged, params, batch, n_grads):
+    got, loss = [None] * n_grads, None
+    for seg in staged.run(params, batch):
+        if seg.loss is not None:
+            loss = seg.loss
+        for li, g in zip(seg.leaf_ids, seg.grads):
+            got[li] = g
+    return loss, got
+
+
+@pytest.mark.parametrize(
+    "model",
+    [pytest.param(m, marks=pytest.mark.slow) if m in _STAGED_SLOW
+     else m for m in sorted(_STAGED_CASES)])
+def test_staged_backward_bit_identical_to_fused(model):
+    from byteps_tpu.staged_grad import build_staged_grad
+
+    loss_fn, params, probe_batch, fresh_batch = _STAGED_CASES[model]()
+    staged = build_staged_grad(loss_fn, params, probe_batch,
+                               max_segments=3, name=model)
+    assert staged is not None, f"{model}: staged head unexpectedly fell back"
+    assert staged.n_segments >= 2
+    fused = jax.jit(jax.value_and_grad(loss_fn))
+    want_l, want_g = fused(params, fresh_batch)
+    flat_want = jax.tree_util.tree_leaves(want_g)
+    got_l, got_g = _run_staged(staged, params, fresh_batch, len(flat_want))
+    assert np.asarray(got_l) == np.asarray(want_l)
+    for w, g in zip(flat_want, got_g):
+        assert g is not None
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_staged_backward_moe_ep_provably_falls_back():
+    """Expert parallelism routes tokens with lax.all_to_all over a mesh
+    axis — the loss cannot even trace outside its shard_map, so the
+    build must return None (the trainer keeps the monolithic head)."""
+    from byteps_tpu.models import moe
+    from byteps_tpu.staged_grad import build_staged_grad
+
+    cfg = moe.moe_tiny(ep_axis="expert")
+    params = moe.init_moe_params(jax.random.PRNGKey(3), cfg)
+    batch = bert.synth_mlm_batch(np.random.RandomState(1), 4, 16,
+                                 cfg.vocab_size)
+    assert build_staged_grad(lambda p, b: moe.moe_lm_loss(p, cfg, b),
+                             params, batch, max_segments=3,
+                             name="moe-ep") is None
+
+
+def test_staged_backward_resnet_provably_falls_back():
+    """ResNet's batchnorm backward is fusion-sensitive: splitting the
+    program at any candidate cut perturbs XLA's contraction and the
+    bitwise probe rejects every cut — the build must refuse rather than
+    ship not-quite-identical gradients."""
+    from byteps_tpu.models import resnet
+    from byteps_tpu.staged_grad import build_staged_grad
+
+    params = resnet.init_resnet50(jax.random.PRNGKey(4), num_classes=8,
+                                  stages=[(1, 16), (1, 32)])
+    batch = resnet.synth_imagenet_batch(np.random.RandomState(1), 2, 32,
+                                        classes=8)
+    assert build_staged_grad(lambda p, b: resnet.resnet_loss(p, b),
+                             params, batch, max_segments=3,
+                             name="resnet") is None
+
+
 # ---------------------------------------------------------------- PS tail
 # Chunked-apply exactness: the streamed sync-PS tail applies the
 # optimizer per bucket group as leaves arrive; for a stock optax chain

@@ -15,8 +15,9 @@ import pytest
 import byteps_tpu as bps
 from byteps_tpu.training import DistributedTrainer
 
-_ENV = ("BPS_ENABLE_PS", "BPS_APPLY_CHUNKED", "BPS_TRACE_ON",
-        "BPS_TRACE_START_STEP", "BPS_TRACE_END_STEP", "BPS_TRACE_DIR")
+_ENV = ("BPS_ENABLE_PS", "BPS_APPLY_CHUNKED", "BPS_BWD_STAGED",
+        "BPS_BWD_GROUPS", "BPS_TRACE_ON", "BPS_TRACE_START_STEP",
+        "BPS_TRACE_END_STEP", "BPS_TRACE_DIR")
 
 W = np.random.RandomState(0).randn(8, 1).astype(np.float32)
 
@@ -105,6 +106,212 @@ def test_h2d_and_apply_overlap_inflight_pulls(_ps_trace_env):
     # the stagger guarantees ≥ tens of ms of real overlap, far above
     # scheduler noise
     assert ov["overlap_ms"] > 10, ov
+
+
+def test_staged_head_overlaps_pushes_and_matches_monolithic(_ps_trace_env):
+    """Staged step head: PS_BWD_SEG spans must really overlap push-side
+    spans (PS_D2H/PS_PACK/PS_PUSH starting before the last backward
+    segment ends — a staged backward whose pushes all fire afterwards
+    would be renamed stages), and the staged head must land on
+    bit-identical weights vs the monolithic head."""
+    import jax
+
+    from byteps_tpu.parallel.mesh import make_mesh
+
+    # a chain loss with compute-heavy layers: each backward segment
+    # takes real milliseconds, so the first groups' push work runs
+    # while later segments still differentiate — deterministic overlap
+    def chain_loss(p, batch):
+        x, y = batch
+        h = x
+        for i in range(4):
+            h = jax.numpy.tanh(h @ p[f"w{i}"])
+        return ((h - y) ** 2).mean()
+
+    rng = np.random.RandomState(3)
+    params0 = {f"w{i}": (rng.randn(512, 512) / 22).astype(np.float32)
+               for i in range(4)}
+    bx = rng.randn(256, 512).astype(np.float32)
+    batch = (bx, np.tanh(bx))
+
+    finals = {}
+    for flag in ("1", "0"):
+        os.environ["BPS_BWD_STAGED"] = flag
+        bps.init(config=bps.Config.from_env())
+        mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
+        tr = DistributedTrainer(chain_loss, dict(params0),
+                                optax.adamw(1e-3), mesh=mesh,
+                                partition_bytes=512 * 512 * 4,
+                                name=f"head-{flag}")
+        for _ in range(3):
+            tr.step(batch)
+        if flag == "1":
+            assert tr._staged not in (None, False), "staged head fell back"
+            assert tr._staged.n_segments >= 3
+            from byteps_tpu.common.global_state import GlobalState
+            from byteps_tpu.telemetry import (exchange_head_overlap,
+                                              summarize_stages)
+            events = GlobalState.get().timeline.snapshot()
+            stages = summarize_stages(events)
+            assert stages.get("PS_BWD_SEG", {}).get("count", 0) > 0, stages
+            ov = exchange_head_overlap(events)
+            assert ov["overlapped"], (ov, stages)
+        finals[flag] = [np.asarray(l) for l in
+                        jax.tree_util.tree_leaves(tr.params)]
+        tr.close()
+        bps.shutdown()
+    os.environ.pop("BPS_BWD_STAGED", None)
+    for a, b in zip(finals["1"], finals["0"]):
+        np.testing.assert_array_equal(a, b)
+
+
+# ------------------------------------------------- error propagation
+# A failed push/pull must SURFACE — from the streaming iterator, from
+# the detached handle, and from the ingest round — not leave the
+# consumer blocked on leaves that will never complete.
+
+class _FailingBackend:
+    """Delegating proxy that raises on the n-th call of one method."""
+
+    def __init__(self, inner, method: str, fail_at: int = 0) -> None:
+        self._inner = inner
+        self._method = method
+        self._fail_at = fail_at
+        self._calls = 0
+        self._lock = threading.Lock()
+
+    def _maybe_fail(self, name):
+        with self._lock:
+            n = self._calls
+            self._calls += 1
+        if n >= self._fail_at:
+            raise RuntimeError(f"injected {name} failure")
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name != self._method:
+            return attr
+
+        def wrapped(*a, **k):
+            self._maybe_fail(name)
+            return attr(*a, **k)
+
+        return wrapped
+
+
+def _exchange_tree():
+    rng = np.random.RandomState(0)
+    return {"a": rng.randn(2048).astype(np.float32),
+            "b": rng.randn(2048).astype(np.float32),
+            "c": rng.randn(2048).astype(np.float32)}
+
+
+def test_stream_ready_surfaces_pull_failure():
+    from byteps_tpu.server.engine import HostPSBackend
+    from byteps_tpu.server.ps_mode import PSGradientExchange
+
+    be = HostPSBackend(num_servers=1, num_workers=1, engine_threads=1)
+    try:
+        ex = PSGradientExchange(_FailingBackend(be, "pull"),
+                                partition_bytes=4 << 10)
+        handle = ex.exchange_stream(_exchange_tree(), name="fail-pull")
+        with pytest.raises(RuntimeError, match="injected pull failure"):
+            for _ in handle.ready():
+                pass
+        ex.close()
+    finally:
+        be.close()
+
+
+def test_async_result_surfaces_push_failure():
+    from byteps_tpu.server.engine import HostPSBackend
+    from byteps_tpu.server.ps_mode import PSGradientExchange
+
+    be = HostPSBackend(num_servers=1, num_workers=1, engine_threads=1)
+    try:
+        ex = PSGradientExchange(_FailingBackend(be, "push", fail_at=1),
+                                partition_bytes=4 << 10)
+        handle = ex.exchange_async(_exchange_tree(), name="fail-push")
+        with pytest.raises(RuntimeError, match="injected push failure"):
+            handle.result()
+        ex.close()
+    finally:
+        be.close()
+
+
+def test_stream_result_surfaces_push_failure():
+    """result() without consuming ready() must also propagate."""
+    from byteps_tpu.server.engine import HostPSBackend
+    from byteps_tpu.server.ps_mode import PSGradientExchange
+
+    be = HostPSBackend(num_servers=1, num_workers=1, engine_threads=1)
+    try:
+        ex = PSGradientExchange(_FailingBackend(be, "push"),
+                                partition_bytes=4 << 10)
+        handle = ex.exchange_stream(_exchange_tree(), name="fail-push2")
+        with pytest.raises(RuntimeError, match="injected push failure"):
+            handle.result()
+        ex.close()
+    finally:
+        be.close()
+
+
+def test_ingest_surfaces_failure_and_abort_unblocks():
+    """exchange_ingest: a pull failure surfaces from ready(); abort()
+    wakes a consumer whose producer died mid-backward."""
+    from byteps_tpu.server.engine import HostPSBackend
+    from byteps_tpu.server.ps_mode import PSGradientExchange
+
+    tree = _exchange_tree()
+    be = HostPSBackend(num_servers=1, num_workers=1, engine_threads=1)
+    try:
+        ex = PSGradientExchange(_FailingBackend(be, "pull"),
+                                partition_bytes=4 << 10)
+        handle = ex.exchange_ingest(tree, name="fail-ingest")
+        handle.feed(range(3), [tree["a"], tree["b"], tree["c"]])
+        handle.finish()
+        with pytest.raises(RuntimeError, match="injected pull failure"):
+            for _ in handle.ready():
+                pass
+        ex.close()
+
+        ex2 = PSGradientExchange(be, partition_bytes=4 << 10)
+        h2 = ex2.exchange_ingest(tree, name="abort-ingest")
+        h2.feed([0], [tree["a"]])
+        h2.abort(RuntimeError("backward died"))
+        with pytest.raises(RuntimeError, match="backward died"):
+            h2.result()
+        ex2.close()
+    finally:
+        be.close()
+
+
+def test_ingest_matches_exchange_stream_sum():
+    """Feeding leaves incrementally (out of order, in groups) must
+    produce the same summed tree as the all-at-once stream."""
+    from byteps_tpu.server.engine import HostPSBackend
+    from byteps_tpu.server.ps_mode import PSGradientExchange
+
+    tree = _exchange_tree()
+    be = HostPSBackend(num_servers=1, num_workers=1, engine_threads=1)
+    try:
+        ex = PSGradientExchange(be, partition_bytes=4 << 10)
+        want = ex.exchange(tree, name="ingest-sum")
+        h = ex.exchange_ingest(tree, name="ingest-sum")
+        h.feed([2], [tree["c"]])
+        h.feed([0, 1], [tree["a"], tree["b"]])
+        h.finish()
+        seen = dict(h.ready())
+        got = h.result()
+        assert sorted(seen) == [0, 1, 2]
+        for k, li in zip(sorted(tree), range(3)):
+            np.testing.assert_array_equal(np.asarray(want[k]),
+                                          np.asarray(got[k]))
+            np.testing.assert_array_equal(
+                seen[li].reshape(tree[k].shape), np.asarray(got[k]))
+        ex.close()
+    finally:
+        be.close()
 
 
 def test_streamed_tail_matches_monolithic_tail(_ps_trace_env):
