@@ -161,13 +161,17 @@ def ps_tail_breakdown(iters: int = 12, warm: int = 3) -> dict:
     batch, seq = 8, 32
     params, data, loss_fn = mlm_setup(cfg, batch, seq)
     saved = {k: os.environ.get(k) for k in
-             ("BPS_ENABLE_PS", "BPS_APPLY_CHUNKED", "BPS_TRACE_ON",
-              "BPS_TRACE_START_STEP", "BPS_TRACE_END_STEP",
-              "BPS_TRACE_DIR")}
+             ("BPS_ENABLE_PS", "BPS_APPLY_CHUNKED", "BPS_CROSS_STEP",
+              "BPS_TRACE_ON", "BPS_TRACE_START_STEP",
+              "BPS_TRACE_END_STEP", "BPS_TRACE_DIR")}
     out: dict = {}
     try:
         with tempfile.TemporaryDirectory() as td:
+            # draining steps: this A/B isolates the intra-step tail
+            # pipeline; the cross-step pipeline (its own ps_cross A/B)
+            # would defer timed work past the window
             os.environ.update(BPS_ENABLE_PS="1", BPS_TRACE_ON="1",
+                              BPS_CROSS_STEP="0",
                               # skip the warm steps: first-step compile
                               # time would swamp the stage averages
                               BPS_TRACE_START_STEP=str(warm + 1),
@@ -258,15 +262,19 @@ def ps_head_breakdown(iters: int = 5, warm: int = 2,
     params = mlp_init(jax.random.PRNGKey(0), dim, depth)
     saved = {k: os.environ.get(k) for k in
              ("BPS_ENABLE_PS", "BPS_BWD_STAGED", "BPS_APPLY_CHUNKED",
-              "BPS_SERVER_ADDRS", "BPS_EMU_NIC_RATE", "BPS_PS_CONNS",
-              "BPS_PS_PIPELINE", "BPS_TRACE_ON", "BPS_TRACE_START_STEP",
-              "BPS_TRACE_END_STEP", "BPS_TRACE_DIR")}
+              "BPS_CROSS_STEP", "BPS_SERVER_ADDRS", "BPS_EMU_NIC_RATE",
+              "BPS_PS_CONNS", "BPS_PS_PIPELINE", "BPS_TRACE_ON",
+              "BPS_TRACE_START_STEP", "BPS_TRACE_END_STEP",
+              "BPS_TRACE_DIR")}
     out: dict = {}
     engine = PSServer(num_workers=1, engine_threads=2)
     server = PSTransportServer(engine, host="127.0.0.1", port=0)
     try:
         with tempfile.TemporaryDirectory() as td:
+            # draining steps (see ps_tail_breakdown): this A/B isolates
+            # the staged HEAD; ps_cross owns the inter-step pipeline
             os.environ.update(BPS_ENABLE_PS="1", BPS_TRACE_ON="1",
+                              BPS_CROSS_STEP="0",
                               BPS_SERVER_ADDRS=f"127.0.0.1:{server.port}",
                               BPS_EMU_NIC_RATE=str(nic_rate),
                               # every bucket's push/pull pair must hold
@@ -321,6 +329,194 @@ def ps_head_breakdown(iters: int = 5, warm: int = 2,
         ratios = [s / m for s, m in zip(sps["staged"], sps["monolithic"])]
         out["pair_ratios"] = [round(r, 4) for r in ratios]
         out["staged_vs_monolithic"] = round(statistics.median(ratios), 4)
+    finally:
+        server.close()
+        engine.close()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
+def ps_cross_breakdown(iters: int = 10, warm: int = 3,
+                       dim: int = 1024, depth: int = 8,
+                       batch: int = 384, nic_rate: float = 3.5e8,
+                       server_nic_rate: float = 7e7,
+                       nic_latency: float = 0.0,
+                       pipeline: int = 2,
+                       pairs: int = 5) -> dict:
+    """Cross-step A/B of the sync-PS step (the inter-step pipeline:
+    gated fwd/bwd(k+1) ∥ straggler pull/apply(k)): run the same MLP
+    chain as ``ps_head_breakdown`` through the PS-mode trainer over the
+    real transport under the emulated-NIC throttle, once with the
+    cross-step driver (``BPS_CROSS_STEP=1``, non-draining ``step()``)
+    and once with the draining barrier step (``=0``), and report the
+    step-rate ratio plus the timeline proof — ``cross_step_overlap``:
+    step k's ``PS_APPLY_CHUNK``/``PS_PULL`` spans must still be running
+    when step k+1's first ``PS_BWD_SEG`` has started, and ``gate_ms``
+    accounts what the per-segment readiness gates cost.
+
+    Same methodology notes as ``ps_head_breakdown`` (median of
+    ``pairs`` init pairs; throttled NIC so wire time is real), with one
+    difference: the PULL pipeline is kept NARROW (``BPS_PS_PIPELINE``)
+    so landed buckets actually queue — that is what lets the next-use
+    priority scheduler pull the input-side bucket first and open the
+    next step's forward gate while output-side pulls are still on the
+    wire. Both arms run the same width, so the ratio isolates the
+    cross-step change. The cross arm's timed window includes a final
+    ``drain()`` — the pipeline only ever defers work one step, so the
+    comparison is honest end-to-end.
+
+    The model is a FORWARD-HEAVY chain: each layer adds a frozen
+    (stop-gradient) auxiliary tower — forward compute with no backward
+    cost, the frozen-feature-extractor shape. Deliberate: the
+    cross-barrier win is bounded by the gateable forward compute the
+    straggler tail can hide into (the reference's CrossBarrier bench
+    reaches the same conclusion — wire-dominated rigs cap at ~1.05×,
+    docs/cross-barrier.md), and a plain MLP's forward is only a third
+    of its compute. The trailing per-layer gates still cover every
+    param, so the gating machinery is exercised end to end."""
+    import tempfile
+
+    import jax.numpy as jnp
+
+    import byteps_tpu as bps
+    from byteps_tpu.models.mlp import mlp_init
+    from byteps_tpu.parallel.mesh import make_mesh
+    from byteps_tpu.server.engine import PSServer
+    from byteps_tpu.server.transport import PSTransportServer
+    from byteps_tpu.telemetry import cross_step_overlap, summarize_stages
+    from byteps_tpu.training import DistributedTrainer
+
+    def fh_loss(p, batch):
+        x, y = batch
+        h = x
+        for i in range(depth):
+            w = p[f"w{i}"]
+            h = jnp.tanh(h @ w + p[f"b{i}"])
+            # frozen auxiliary tower: forward-only compute (the grads
+            # stop), but it READS w — so it still gates on the
+            # cross-step readiness of layer i's group
+            h = h + 0.01 * jax.lax.stop_gradient(
+                jnp.tanh(jnp.tanh(h @ w) @ w.T))
+        return ((h - y) ** 2).mean()
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, dim).astype(np.float32)
+    data = (x, np.tanh(x))
+    params = mlp_init(jax.random.PRNGKey(0), dim, depth)
+    saved = {k: os.environ.get(k) for k in
+             ("BPS_ENABLE_PS", "BPS_CROSS_STEP", "BPS_BWD_STAGED",
+              "BPS_APPLY_CHUNKED", "BPS_SERVER_ADDRS", "BPS_EMU_NIC_RATE",
+              "BPS_EMU_NIC_LATENCY", "BPS_PS_CONNS", "BPS_PS_PIPELINE",
+              "BPS_TRACE_ON", "BPS_TRACE_START_STEP",
+              "BPS_TRACE_END_STEP", "BPS_TRACE_DIR")}
+    out: dict = {}
+    engine = PSServer(num_workers=1, engine_threads=2)
+    # the SERVER's NIC is throttled below the worker's: in the
+    # reference's deployment a server's egress is shared by k pulling
+    # workers (incast), so each worker's pull bandwidth is a fraction
+    # of its own push bandwidth — the regime where round k's pulls
+    # straggle behind round k+1's compute and the cross-step window
+    # exists at all. A single balanced full-duplex link (ps_head's
+    # setup) drains every pull in lockstep with the pushes and leaves
+    # nothing for ANY inter-step scheduler to hide.
+    from byteps_tpu.server.throttle import Nic
+    server = PSTransportServer(engine, host="127.0.0.1", port=0,
+                               nic=Nic(server_nic_rate,
+                                       latency=nic_latency,
+                                       rx_rate=nic_rate))
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            os.environ.update(BPS_ENABLE_PS="1", BPS_TRACE_ON="1",
+                              BPS_BWD_STAGED="1", BPS_APPLY_CHUNKED="1",
+                              BPS_SERVER_ADDRS=f"127.0.0.1:{server.port}",
+                              BPS_EMU_NIC_RATE=str(nic_rate),
+                              # per-frame latency: the straggler-pull
+                              # regime the cross-step targets (a pull is
+                              # a request/response round trip; the
+                              # reference's CrossBarrier bench uses the
+                              # same knob)
+                              BPS_EMU_NIC_LATENCY=str(nic_latency),
+                              # conns cover push + pull concurrency, but
+                              # the pull EXECUTOR stays narrow so the
+                              # priority scheduler has a backlog to
+                              # reorder (see docstring)
+                              BPS_PS_CONNS=str(depth + 4),
+                              BPS_PS_PIPELINE=str(pipeline),
+                              # trace only the window's LAST steps: the
+                              # overlap proof needs two consecutive
+                              # steady-state steps, and tracing every
+                              # timed step taxes the arms unequally
+                              BPS_TRACE_START_STEP=str(warm + iters - 2),
+                              BPS_TRACE_END_STEP="1000000000",
+                              BPS_TRACE_DIR=td)
+            sps: dict = {"cross": [], "barrier": []}
+            all_walls: dict = {"cross": [], "barrier": []}
+            for rep in range(pairs):
+                arms = (("cross", "1"), ("barrier", "0"))
+                if rep % 2:        # alternate the lead arm: slow drift
+                    arms = arms[::-1]   # hits both arms equally
+                for mode, flag in arms:
+                    os.environ["BPS_CROSS_STEP"] = flag
+                    bps.init(config=bps.Config.from_env())
+                    mesh = make_mesh({"data": 1},
+                                     devices=jax.devices()[:1])
+                    trainer = DistributedTrainer(
+                        fh_loss, params, optax.adamw(1e-4), mesh=mesh,
+                        partition_bytes=dim * dim * 4,
+                        name=f"ps-cross-{mode}-{rep}")
+                    import statistics as _st
+                    for _ in range(warm):
+                        float(trainer.step(data))
+                    trainer.drain()
+                    walls = []
+                    for _ in range(iters):
+                        t0 = time.perf_counter()
+                        loss = trainer.step(data)
+                        walls.append(time.perf_counter() - t0)
+                    trainer.drain()
+                    float(loss)
+                    # steady-state rate = MEDIAN per-step wall: the
+                    # pipeline's fill (first gated step) and final
+                    # drain are one-off edges, and a single
+                    # noisy-neighbor step would otherwise dominate a
+                    # short window — medians are what the ps_head
+                    # bimodality note already argues for, applied at
+                    # step granularity
+                    dt = _st.median(walls)
+                    all_walls[mode].extend(walls)
+                    from byteps_tpu.common.global_state import GlobalState
+                    events = GlobalState.get().timeline.snapshot()
+                    sps[mode].append(batch / dt)
+                    if mode == "cross" and rep == 0:
+                        out["cross_engaged"] = \
+                            trainer._cross_driver is not None
+                        out["segments"] = getattr(trainer._staged,
+                                                  "n_segments", 0)
+                        out["cross_overlap"] = cross_step_overlap(events)
+                        out["gate_stages_ms"] = summarize_stages(
+                            [e for e in events if e["name"] in
+                             ("PS_XSTEP_GATE", "PS_BWD_SEG",
+                              "PS_APPLY_CHUNK", "PS_PULL")])
+                    trainer.close()
+                    bps.shutdown()
+        import statistics
+        out["cross_sps"] = round(statistics.median(sps["cross"]), 2)
+        out["barrier_sps"] = round(statistics.median(sps["barrier"]), 2)
+        ratios = [c / b for c, b in zip(sps["cross"], sps["barrier"])]
+        out["pair_ratios"] = [round(r, 4) for r in ratios]
+        # headline ratio from the POOLED per-step walls (pairs×iters
+        # samples per arm): a median over 50 steps is far steadier than
+        # a median of 5 short-window ratios on a shared box; the
+        # per-pair ratios ride along as the drift cross-check
+        out["cross_vs_barrier"] = round(
+            statistics.median(all_walls["barrier"])
+            / statistics.median(all_walls["cross"]), 4)
+        out["cross_vs_barrier_pair_median"] = round(
+            statistics.median(ratios), 4)
     finally:
         server.close()
         engine.close()
@@ -573,6 +769,12 @@ def main() -> None:
         line["ps_head"] = ps_head_breakdown()
     except Exception as e:       # noqa: BLE001 — recorded, not fatal
         line["ps_head_error"] = f"{type(e).__name__}: {e}"[:300]
+    # cross-step A/B (gated fwd/bwd(k+1) ∥ straggler pull/apply(k)) —
+    # same ride-along contract as ps_head/ps_tail
+    try:
+        line["ps_cross"] = ps_cross_breakdown()
+    except Exception as e:       # noqa: BLE001 — recorded, not fatal
+        line["ps_cross_error"] = f"{type(e).__name__}: {e}"[:300]
     print(json.dumps(line))
 
 

@@ -1,0 +1,319 @@
+"""Cross-step driver: gated fwd/bwd(k+1) ∥ straggler pull/apply(k).
+
+BytePS's second headline idea (after push/pull–compute overlap) is
+priority scheduling plus cross-barrier: parameters unblock
+*individually*, so the next iteration's forward starts while late
+gradients are still in flight (the ByteScheduler design the reference
+ships as ``bps.CrossBarrier`` for torch — docs/cross-barrier.md).
+Before this module, the JAX sync-PS step ended in a global barrier:
+``DistributedTrainer._ps_step_staged`` drained the whole streamed tail
+(every straggler pull + optimizer apply) before returning.
+
+``CrossStepDriver`` makes ``step()`` non-draining while preserving
+EXACT sync-SGD semantics:
+
+  - step k's tail (pull → H2D → per-group optimizer apply) moves to a
+    background thread; as each group's apply is dispatched,
+    ``ChunkedApply`` publishes the group's leaves in a per-leaf
+    readiness EPOCH table — the TPU-native analogue of the reference
+    cross-barrier's per-parameter locks;
+  - step k+1's staged program (``staged_grad`` built with
+    ``forward_cuts=True``, so the forward is also cut at the
+    exchange's bucket-group boundaries) runs segment by segment, each
+    segment gated on the readiness of exactly the param leaves it
+    reads (``PS_XSTEP_GATE`` timeline spans measure the stall);
+  - the exchange admits step k+1's pushes while step k's straggler
+    pulls are outstanding (two-round in-flight window — per-key
+    admission in ``PSGradientExchange`` keeps the single-published-
+    round server exact), and landed buckets are PULLED by next-step
+    first-use priority, so the input-side layers fwd(k+1) needs first
+    are applied first instead of last.
+
+Bit-exactness argument: a segment of step k+1 reads a param leaf only
+after that leaf's step-k apply was dispatched (gate) and never after
+its step-k+1 apply (the k+1 tail starts only once every segment ran),
+so every read observes exactly the step-k value; the applies
+themselves are the same ``ChunkedApply`` programs in the same
+per-group order (the tail enforces epoch order per group), so the
+trajectory is bit-identical to barrier stepping. ``BPS_CROSS_STEP=0``
+restores the draining step for A/B.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .common.global_state import GlobalState
+
+
+class CrossStepDriver:
+    """Owns the cross-step pipeline state for one PS-mode trainer.
+
+    Created by ``DistributedTrainer`` after the first (draining) staged
+    step has built the ``ChunkedApply`` groups; from then on every
+    staged step routes through ``step()``. The driver's ``_flat`` leaf
+    list is the single source of truth for parameters while a tail is
+    in flight; ``drain()`` (also triggered by reading
+    ``trainer.params``) joins the outstanding tails and writes the
+    assembled tree back to the trainer.
+    """
+
+    def __init__(self, trainer) -> None:
+        self._tr = trainer
+        self._chunked = trainer._chunked
+        self._ex = trainer._ps_exchange
+        self._name = trainer._name
+        self._world = trainer._ps_world
+        flat, treedef = jax.tree_util.tree_flatten(trainer._params)
+        self._flat: List = list(flat)
+        self._treedef = treedef
+        self._shapes = [l.shape for l in flat]
+        self._n = len(flat)
+        self._rep = NamedSharding(trainer.mesh, P())
+        self._epoch = 0              # steps whose segments have run
+        self._tails: List[threading.Thread] = []
+        self._err = None             # (exc, applied_groups, epoch)
+        self._err_lock = threading.Lock()
+        self._dirty = False          # params replaced outside the pipeline
+
+    # ------------------------------------------------------- lifecycle
+
+    @property
+    def busy(self) -> bool:
+        """True while any step's tail is still pulling/applying."""
+        return any(t.is_alive() for t in self._tails)
+
+    @property
+    def pending(self) -> bool:
+        """True when cross steps ran since the last drain — even if
+        their tails already finished, the trainer's ``_params`` tree
+        has not been refreshed from the live leaf list yet."""
+        return bool(self._tails)
+
+    @property
+    def failed(self) -> bool:
+        """True once any tail died: the weights are partially stepped,
+        and every subsequent synchronization point must keep raising —
+        a later ``params`` read returning the corrupt tree silently
+        would break the loud-partial-state contract."""
+        return self._err is not None
+
+    def invalidate(self) -> None:
+        """The trainer's params were assigned externally (checkpoint
+        restore, a fallback barrier step): resync ``_flat`` and the
+        readiness table before the next cross step."""
+        self._dirty = True
+
+    def supersede(self) -> None:
+        """An external params write is about to replace the pipeline's
+        state (the documented remedy for a failed tail): join the
+        in-flight tails WITHOUT raising — the caller is installing
+        fresh weights, so the partial-state poison is lifted — and
+        mark for resync. Does not touch ``trainer._params``; the
+        setter assigns it right after."""
+        for t in list(self._tails):
+            t.join()
+        self._tails = []
+        with self._err_lock:
+            self._err = None
+        self._dirty = True
+
+    def drain(self) -> None:
+        """Join every outstanding tail and publish the assembled param
+        tree back to the trainer — the explicit barrier. Raises the
+        first tail failure (params are refreshed first so the trainer
+        never holds donated leaves)."""
+        for t in list(self._tails):
+            t.join()
+        self._tails = []
+        self._tr._params = jax.tree_util.tree_unflatten(
+            self._treedef, list(self._flat))
+        self._check_err()
+
+    def _check_err(self) -> None:
+        with self._err_lock:
+            err = self._err
+        if err is None:
+            return
+        exc, applied, e = err
+        raise RuntimeError(
+            f"cross-step tail for step {e} failed after {applied}/"
+            f"{len(self._chunked.groups)} optimizer groups applied — "
+            f"params and optimizer state are PARTIALLY stepped; do not "
+            f"retry this step on the same trainer (restore a "
+            f"checkpoint, or run with BPS_CROSS_STEP=0 for draining "
+            f"barrier steps)") from exc
+
+    # ------------------------------------------------------------ step
+
+    def step(self, staged, batch):
+        """One non-draining training step: run ``staged``'s segments
+        gated on the previous step's per-group applies, feed each
+        group's gradients to a fresh ingest round, hand the pull →
+        H2D → apply tail to a background thread, return the loss."""
+        self._check_err()
+        self._tails = [t for t in self._tails if t.is_alive()]
+        if not self._tails and self._dirty:
+            flat, treedef = jax.tree_util.tree_flatten(self._tr._params)
+            if treedef != self._treedef:
+                raise ValueError(
+                    "params were replaced with a different tree "
+                    "structure mid-training — build a new trainer")
+            self._flat = list(flat)
+            self._tr._sync_chunk_states()
+            # the externally-installed values are fully applied state:
+            # every leaf is ready at the current epoch
+            self._chunked.mark_epoch(range(self._n), self._epoch)
+            self._dirty = False
+        e = self._epoch = self._epoch + 1
+        gs = GlobalState._instance
+        tl = gs.timeline if gs is not None else None
+        chunked = self._chunked
+        t_ex = time.time()
+        template = jax.tree_util.tree_unflatten(self._treedef, self._flat)
+        handle = self._ex.exchange_ingest(template, name=self._name,
+                                          step=e)
+
+        def gate(si: int, leaf_ids) -> None:
+            if not leaf_ids:
+                return
+            t0 = time.time()
+            chunked.wait_epoch(
+                leaf_ids, e - 1,
+                should_abort=lambda: self._err is not None)
+            self._check_err()
+            if tl is not None:
+                tl.record(self._name, "PS_XSTEP_GATE", t0,
+                          time.time() - t0, si, step=e)
+
+        loss = None
+        try:
+            for seg in staged.run(template, batch, gate=gate,
+                                  params_flat=self._flat,
+                                  block_nonemitting=False):
+                if tl is not None:
+                    tl.record(self._name, "PS_BWD_SEG", seg.t0, seg.dur,
+                              seg.index, step=e)
+                if seg.loss is not None:
+                    loss = seg.loss
+                if seg.leaf_ids:
+                    handle.feed(seg.leaf_ids, seg.grads)
+            handle.finish()
+        except BaseException as exc:
+            # no tail will ever mark epoch ``e`` (no applies ran, the
+            # params are untouched) — roll the counter back or every
+            # later step's gate waits forever on marks that can't come
+            self._epoch = e - 1
+            handle.abort(exc)        # unblock any tail consumer
+            raise
+        t = threading.Thread(target=self._tail, args=(handle, e, t_ex, tl),
+                             name=f"bps-xstep-tail-{e}", daemon=True)
+        self._tails.append(t)
+        t.start()
+        return loss
+
+    # ------------------------------------------------------------ tail
+
+    def _h2d(self, li: int, arr, tl, e: int):
+        t0 = time.time()
+        a = arr.reshape(self._shapes[li])
+        if self._world > 1:
+            a = a / self._world      # same host-side divide per leaf as
+        d = jax.device_put(a, self._rep)   # the barrier tails
+        if tl is not None:
+            tl.record(self._name, "PS_H2D", t0, time.time() - t0, li,
+                      step=e)
+        return d
+
+    def _tail(self, handle, e: int, t_ex: float, tl) -> None:
+        """Step ``e``'s straggler consumer: iterate leaf completions,
+        H2D each, apply the optimizer per group the moment the group's
+        leaves land AND its step-``e-1`` apply has been dispatched
+        (two tails can be alive at once; per-group epoch order is what
+        keeps momentum-style state exact)."""
+        import heapq
+        chunked = self._chunked
+        flat = self._flat
+        applied = 0
+        # arrival is decoupled from apply: a reader thread consumes the
+        # leaf-completion stream (H2D fires per leaf immediately) and
+        # accumulates COMPLETE groups in a next-use priority heap; this
+        # thread pops the group the next step's forward reads first.
+        # Applies are long, and while one runs more groups land —
+        # arrival-order applies would park the gate-critical input-side
+        # group behind output-side ones.
+        cv = threading.Condition()
+        ready_groups: List = []        # (next-use prio, gi) min-heap
+        futs: dict = {}
+        state = {"done": False, "exc": None}
+
+        def reader() -> None:
+            remaining = [len(g) for g in chunked.groups]
+            try:
+                for li, arr in handle.ready():
+                    fut = self._tr._h2d_ex.submit(self._h2d, li, arr,
+                                                  tl, e)
+                    gi = chunked.leaf_group.get(li)
+                    with cv:
+                        futs[li] = fut
+                        if gi is not None:
+                            remaining[gi] -= 1
+                            if remaining[gi] == 0:
+                                heapq.heappush(
+                                    ready_groups,
+                                    (min(chunked.groups[gi]), gi))
+                                cv.notify()
+            except BaseException as exc:   # noqa: BLE001 — rethrown
+                with cv:                   # by the apply loop below
+                    state["exc"] = exc
+            finally:
+                with cv:
+                    state["done"] = True
+                    cv.notify()
+
+        rt = threading.Thread(target=reader, daemon=True,
+                              name=f"bps-xstep-ready-{e}")
+        rt.start()
+        try:
+            while True:
+                with cv:
+                    while not ready_groups and not state["done"]:
+                        cv.wait()
+                    if state["exc"] is not None:
+                        raise state["exc"]
+                    if not ready_groups and state["done"]:
+                        break
+                    _, gi = heapq.heappop(ready_groups)
+                group = chunked.groups[gi]
+                chunked.wait_epoch(
+                    group, e - 1,
+                    should_abort=lambda: self._err is not None)
+                self._check_err()
+                with cv:
+                    gfuts = [futs.pop(i) for i in group]
+                gdev = [f.result() for f in gfuts]
+                t0 = time.time()
+                new = chunked.apply_group(gi, [flat[i] for i in group],
+                                          gdev)
+                if tl is not None:
+                    tl.record(self._name, "PS_APPLY_CHUNK", t0,
+                              time.time() - t0, gi, step=e)
+                for i, leaf in zip(group, new):
+                    flat[i] = leaf
+                # publish only AFTER the new leaves are installed — a
+                # gate waking between mark and install would read the
+                # pre-apply array (stale step k-1 weights)
+                chunked.mark_epoch(group, e)
+                applied += 1
+            if tl is not None:
+                tl.record(self._name, "PS_PUSH_PULL", t_ex,
+                          time.time() - t_ex, 0, step=e)
+        except BaseException as exc:   # noqa: BLE001 — surfaced on the
+            with self._err_lock:       # next step()/drain()/params read
+                if self._err is None:
+                    self._err = (exc, applied, e)

@@ -214,6 +214,7 @@ class ChunkedApply:
     def __init__(self, inner: optax.GradientTransformation, params,
                  groups, donate: bool = True) -> None:
         import jax
+        import threading
         self.inner = inner
         leaves, _ = jax.tree_util.tree_flatten(params)
         self.groups = [tuple(g) for g in groups if g]
@@ -224,6 +225,14 @@ class ChunkedApply:
         covered = sorted(self.leaf_group) == list(range(len(leaves)))
         self.decomposable = covered and leafwise_decomposable(
             inner, leaves, self.groups)
+        # per-leaf readiness EPOCH table (cross-step gating): entry li
+        # is the last step whose optimizer apply for leaf li has been
+        # dispatched. The cross-step driver launches step k+1's staged
+        # segments the moment every param leaf a segment reads shows
+        # epoch >= k — the TPU-native form of the reference
+        # cross-barrier's per-parameter locks (torch/cross_barrier.py).
+        self.ready_epoch = [0] * len(leaves)
+        self._epoch_cv = threading.Condition()
         self.states = None
         self._apply = None
         if not self.decomposable:
@@ -242,7 +251,34 @@ class ChunkedApply:
         """Update group ``gi``'s leaves; returns the new leaf list.
         ``params_list``/``grads_list`` follow ``self.groups[gi]`` order.
         The old leaves and the group's state are donated when the
-        ChunkedApply was built with ``donate=True``."""
+        ChunkedApply was built with ``donate=True``.
+
+        Cross-step callers publish the group via ``mark_epoch`` ONLY
+        after installing the returned leaves wherever gated readers
+        look them up — marking at dispatch would open a window where a
+        gate observes the epoch but still reads the pre-apply array."""
         new, self.states[gi] = self._apply(params_list, self.states[gi],
                                            grads_list)
         return new
+
+    def mark_epoch(self, leaf_ids, epoch: int) -> None:
+        """Publish ``leaf_ids`` as applied through step ``epoch``."""
+        with self._epoch_cv:
+            for li in leaf_ids:
+                self.ready_epoch[li] = epoch
+            self._epoch_cv.notify_all()
+
+    def wait_epoch(self, leaf_ids, epoch: int, should_abort=None) -> float:
+        """Block until every leaf in ``leaf_ids`` reaches ``epoch``;
+        returns the seconds spent waiting (the cross-step gate span).
+        ``should_abort()`` is polled so a dead tail thread cannot leave
+        the gate waiting on marks that will never come."""
+        import time
+        t0 = time.time()
+        with self._epoch_cv:
+            while not all(self.ready_epoch[li] >= epoch
+                          for li in leaf_ids):
+                if should_abort is not None and should_abort():
+                    break
+                self._epoch_cv.wait(0.05)
+        return time.time() - t0

@@ -7,9 +7,12 @@ Two modes, mirroring the reference's two PS deployments:
     by the sharded key stores — the reference's steady-state push/pull
     pipeline (core_loops.cc:538-618) with the ICI collective playing the
     role of the intra-node NCCL stage. Buckets are pushed in priority
-    order and pulled in the same order, so the server sums bucket k while
-    bucket k+1 is still uploading (the reference's pipelining-by-partition,
-    operations.cc:140-180).
+    (backward-completion) order, so the server sums bucket k while
+    bucket k+1 is still uploading (the reference's
+    pipelining-by-partition, operations.cc:140-180); LANDED buckets are
+    pulled by next-step first-use priority (forward order), and up to
+    two rounds may be in flight per key (cross-step) under a per-key
+    admission gate.
 
   - **Async** (``AsyncPSWorker``): no worker barrier at all — each worker
     pushes *weight deltas* and pulls fresh weights whenever it finishes a
@@ -19,8 +22,10 @@ Two modes, mirroring the reference's two PS deployments:
 
 from __future__ import annotations
 
+import heapq
 import os
 import threading
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
@@ -130,9 +135,14 @@ class _Round:
 
     def __init__(self, ex: "PSGradientExchange", tree,
                  name: Optional[str], stream: bool,
-                 ingest: bool = False) -> None:
+                 ingest: bool = False,
+                 step: Optional[int] = None) -> None:
         import queue as _queue
         self.ex = ex
+        # cross-step rounds tag their timeline spans with the TRUE
+        # owning step: the round's spans outlive the step that started
+        # it, and the overlap aggregates group per step
+        self.step_tag = step
         self.decl_name, self.treedef, self.keyed = ex._plan(tree, name)
         leaves, _ = jax.tree_util.tree_flatten(tree)
         self.shapes = [l.shape for l in leaves]
@@ -144,8 +154,23 @@ class _Round:
         self.out = [np.empty(int(np.prod(l.shape)), np.dtype(l.dtype))
                     for l in leaves]
         self.rounds: List[Optional[int]] = [None] * len(self.keyed)
-        self.pull_futs: List = []
-        self._futs_lock = threading.Lock()
+        # pull ORDER is decoupled from push order: pushes go out in
+        # backward-completion (bucket) order, but landed buckets are
+        # pulled by NEXT-STEP FIRST-USE priority — the bucket holding
+        # the earliest-declared (input-side) leaves first, since those
+        # params gate the next forward's first layers (the reference's
+        # BYTEPS_SCHEDULING forward-position priority, here on the pull
+        # side). Lower = pulled earlier among landed buckets.
+        self.pull_prio = [min((s.leaf_index for s in b.segments),
+                              default=0) for _, b in self.keyed]
+        self.round_seq = ex._next_round_seq()
+        self._pulls_left = len(self.keyed)
+        self._pull_lock = threading.Lock()
+        self._pull_err: Optional[BaseException] = None
+        self._pull_done = threading.Event()
+        if not self.keyed:
+            self._pull_done.set()
+        self.aborted: Optional[BaseException] = None
         self.readyq = None
         if stream or ingest:
             self.readyq = _queue.Queue()
@@ -173,7 +198,6 @@ class _Round:
             self.fed = [False] * len(leaves)
             self.feed_lock = threading.Lock()
             self.feed_done = False
-            self.aborted: Optional[BaseException] = None
 
     # ------------------------------------------------------ host leaves
 
@@ -192,7 +216,8 @@ class _Round:
                     np.asarray(self.sources[i])).reshape(-1)
                 if self.ex.timeline is not None:
                     self.ex.timeline.record(self.decl_name, "PS_D2H", t0,
-                                            time.time() - t0, i)
+                                            time.time() - t0, i,
+                                            step=self.step_tag)
             return self.flat[i]
 
     # ------------------------------------------------------ push / pull
@@ -221,7 +246,8 @@ class _Round:
                 buf[s.bucket_offset:s.bucket_offset + s.length] = \
                     self.get_flat(s.leaf_index)[
                         s.leaf_offset:s.leaf_offset + s.length]
-        t0 = ex._record(self.decl_name, "PS_PACK", pskey, t0)
+        t0 = ex._record(self.decl_name, "PS_PACK", pskey, t0,
+                        step=self.step_tag)
         try:
             ex._push_bucket(pskey, b, buf)
         except Exception:
@@ -232,7 +258,8 @@ class _Round:
             with ex._key_rounds_lock:
                 ex._key_rounds.pop(pskey, None)
             raise
-        ex._record(self.decl_name, "PS_PUSH", pskey, t0)
+        ex._record(self.decl_name, "PS_PUSH", pskey, t0,
+                   step=self.step_tag)
         return buf
 
     def pull_one(self, idx: int, buf: np.ndarray) -> None:
@@ -241,7 +268,8 @@ class _Round:
         pskey, b = self.keyed[idx]
         t0 = time.time()
         merged = ex._pull_bucket(pskey, b, buf, self.rounds[idx])
-        t0 = ex._record(self.decl_name, "PS_PULL", pskey, t0)
+        t0 = ex._record(self.decl_name, "PS_PULL", pskey, t0,
+                        step=self.step_tag)
         if ex._native_pack and merged.flags["C_CONTIGUOUS"]:
             item = np.dtype(b.dtype).itemsize
             from .engine import unpack_segments
@@ -256,7 +284,8 @@ class _Round:
                 self.out[s.leaf_index][
                     s.leaf_offset:s.leaf_offset + s.length] = \
                     merged[s.bucket_offset:s.bucket_offset + s.length]
-        ex._record(self.decl_name, "PS_UNPACK", pskey, t0)
+        ex._record(self.decl_name, "PS_UNPACK", pskey, t0,
+                   step=self.step_tag)
         if self.readyq is not None:
             for s in b.segments:
                 self._segment_done(s.leaf_index)
@@ -268,47 +297,56 @@ class _Round:
         if done:
             self.readyq.put((li, self.out[li]))
 
-    def _relay_failure(self, f) -> None:
-        """A failed push/pull would otherwise leave the ready-stream
-        consumer blocked on leaves that will never complete: surface
-        the first failure as a queue sentinel."""
-        try:
-            exc = f.exception()
-        except BaseException as e:       # noqa: BLE001 — cancelled
-            exc = e
-        if exc is not None:
-            self.readyq.put(exc)
-
     def assemble(self):
         shaped = [o.reshape(shp) for o, shp in zip(self.out, self.shapes)]
         return jax.tree_util.tree_unflatten(self.treedef, shaped)
 
     def submit_bucket(self, idx: int) -> None:
-        """Queue bucket ``idx``'s pack+push and its chasing pull on the
-        pipeline executors."""
+        """Queue bucket ``idx``'s pack+push; its pull is enqueued into
+        the exchange's priority scheduler when the push lands. The push
+        is ADMITTED per PS key: with two rounds in flight (cross-step),
+        round k+1's push for a key waits until round k's pull of that
+        key completed — the server publishes one round per key at a
+        time, so an earlier push would overwrite the merge a straggler
+        pull still needs (torn assembly)."""
         ex = self.ex
-        push_fut = ex._push_ex.submit(self.push_one, idx)
-        pull_fut = ex._pull_ex.submit(
-            lambda: self.pull_one(idx, push_fut.result()))
-        if self.readyq is not None:
-            pull_fut.add_done_callback(self._relay_failure)
-        with self._futs_lock:
-            self.pull_futs.append(pull_fut)
+        pskey, _ = self.keyed[idx]
+        ex._admit_key(pskey, lambda: ex._push_ex.submit(self._push_task,
+                                                        idx))
+
+    def _push_task(self, idx: int) -> None:
+        pskey, _ = self.keyed[idx]
+        try:
+            buf = self.push_one(idx)
+        except BaseException as e:   # noqa: BLE001 — relayed to consumers
+            self.ex._release_key(pskey)
+            self._pull_finished(e)
+            return
+        self.ex._enqueue_pull(self, idx, buf)
+
+    def _pull_finished(self, exc: Optional[BaseException]) -> None:
+        """Bucket-terminal accounting (pull done, or push/pull failed):
+        completes ``drain()`` and surfaces the first failure to the
+        ready-stream consumer."""
+        if exc is not None:
+            if self._pull_err is None:
+                self._pull_err = exc
+            if self.readyq is not None:
+                self.readyq.put(exc)
+        with self._pull_lock:
+            self._pulls_left -= 1
+            done = self._pulls_left <= 0
+        if done:
+            self._pull_done.set()
 
     def drain(self):
         if getattr(self, "aborted", None) is not None:
             raise self.aborted
-        with self._futs_lock:
-            futs = list(self.pull_futs)
-        for f in futs:
-            f.result()              # propagate the first failure
         if self.ingest:
-            # the futures above cover only SUBMITTED buckets — an
-            # incompletely-fed round has unfilled out[] buffers
-            # (np.empty garbage), and an abort() racing this drain
-            # must win over a silent partial result
-            if self.aborted is not None:
-                raise self.aborted
+            # an incompletely-fed round never submits some buckets, so
+            # their terminal accounting never fires — waiting would
+            # hang; fail loudly instead (and an abort() racing this
+            # drain must win over a silent partial result)
             with self.feed_lock:
                 missing = sum(not f for f in self.fed)
             if missing:
@@ -316,6 +354,11 @@ class _Round:
                     f"exchange_ingest result() with {missing} leaves "
                     f"never fed — call feed() for every leaf and "
                     f"finish() before draining")
+        self._pull_done.wait()
+        if self.aborted is not None:
+            raise self.aborted
+        if self._pull_err is not None:
+            raise self._pull_err
         return self.assemble()
 
     def ready_iter(self):
@@ -373,7 +416,8 @@ class _Round:
 
     def abort(self, exc: BaseException) -> None:
         self.aborted = exc
-        if self.readyq is not None:
+        self._pull_done.set()       # a drain() blocked on straggler
+        if self.readyq is not None:  # pulls must wake and raise
             self.readyq.put(exc)
 
 
@@ -407,6 +451,19 @@ class PSGradientExchange:
         self._push_ex: Optional[ThreadPoolExecutor] = None
         self._pull_ex: Optional[ThreadPoolExecutor] = None
         self._ex_lock = threading.Lock()
+        # two-round in-flight window (cross-step): per-key admission —
+        # a key with a pushed-but-not-yet-pulled bucket holds later
+        # rounds' pushes for the SAME key in a FIFO until its pull
+        # completes (the server publishes one round per key at a time)
+        self._key_lock = threading.Lock()
+        self._key_busy: set = set()
+        self._key_waiters: Dict[int, deque] = {}
+        # landed-bucket pull scheduler: a min-heap ordered by (round
+        # age, next-step first-use priority) — see _Round.pull_prio
+        self._pull_heap: List = []
+        self._pull_heap_lock = threading.Lock()
+        self._pull_seq = 0
+        self._round_seq = 0
         # per-PS-key worker compressor chain (momentum→ef→codec) — holds
         # EF error / momentum state, so it outlives the plan cache entry
         # (reference: per-partition compressor_list in BPSContext,
@@ -503,12 +560,13 @@ class PSGradientExchange:
             groups[0].extend(sorted(extras))
         return [g for g in groups if g]
 
-    def _record(self, name: str, stage: str, key: int, t0: float) -> float:
+    def _record(self, name: str, stage: str, key: int, t0: float,
+                step: Optional[int] = None) -> float:
         """Timeline helper; returns a fresh t0."""
         import time
         now = time.time()
         if self.timeline is not None:
-            self.timeline.record(name, stage, t0, now - t0, key)
+            self.timeline.record(name, stage, t0, now - t0, key, step=step)
         return now
 
     def _next_round(self, pskey: int) -> int:
@@ -519,18 +577,85 @@ class PSGradientExchange:
         pushes, leaving keys at different rounds, so a single per-decl
         seed would misalign the lagging keys forever. Fresh jobs see 0
         everywhere (one extra RPC per key, amortized across the
-        pipeline workers). Called from the pipelined push workers —
-        at most one task per key per exchange, lock only guards the
-        dict."""
+        pipeline workers). The per-key admission gate serializes two
+        live rounds' tasks on one key, but the increment is still
+        atomic under the lock — "one task per key per EXCHANGE" is no
+        longer "one task per key in flight"."""
         with self._key_rounds_lock:
             cur = self._key_rounds.get(pskey)
         if cur is None:
+            # the server RPC stays outside the lock; losing the seed
+            # race is fine (both see the same server round)
             cur = (int(self.backend.round(pskey))
                    if hasattr(self.backend, "round") else 0)
-        nxt = cur + 1
         with self._key_rounds_lock:
+            nxt = self._key_rounds.get(pskey, cur) + 1
             self._key_rounds[pskey] = nxt
         return nxt
+
+    def _next_round_seq(self) -> int:
+        with self._pull_heap_lock:
+            self._round_seq += 1
+            return self._round_seq
+
+    # ------------------------------------------------ pull scheduling
+    #
+    # Pushes keep backward-completion order (bucket 0 = output-side
+    # layers, available first), but pulls drain by NEXT-STEP FIRST-USE
+    # priority: among landed buckets, the one holding the earliest-
+    # declared (input-side) leaves is pulled first, because those
+    # params gate fwd(k+1)'s first gated segment. Without this, the
+    # reverse-packed plan applies the input layers LAST and the
+    # cross-step overlap window collapses to zero.
+
+    def _enqueue_pull(self, rnd: "_Round", idx: int, buf) -> None:
+        with self._pull_heap_lock:
+            seq = self._pull_seq
+            self._pull_seq += 1
+            heapq.heappush(self._pull_heap,
+                           (rnd.round_seq, rnd.pull_prio[idx], seq,
+                            rnd, idx, buf))
+        self._pull_ex.submit(self._pull_next)
+
+    def _pull_next(self) -> None:
+        """One pull slot: drain the highest-priority landed bucket
+        (not necessarily the one whose push scheduled this slot)."""
+        with self._pull_heap_lock:
+            _, _, _, rnd, idx, buf = heapq.heappop(self._pull_heap)
+        pskey, _ = rnd.keyed[idx]
+        exc: Optional[BaseException] = None
+        try:
+            rnd.pull_one(idx, buf)
+        except BaseException as e:   # noqa: BLE001 — relayed below
+            exc = e
+        finally:
+            self._release_key(pskey)
+            rnd._pull_finished(exc)
+
+    # ------------------------------------------------ per-key admission
+
+    def _admit_key(self, pskey: int, submit) -> None:
+        """Run ``submit`` now if ``pskey`` has no pushed-but-unpulled
+        bucket in flight, else defer it until that bucket's pull
+        completes (FIFO per key, so rounds stay ordered on the wire)."""
+        with self._key_lock:
+            if pskey in self._key_busy:
+                self._key_waiters.setdefault(pskey, deque()).append(submit)
+                return
+            self._key_busy.add(pskey)
+        submit()
+
+    def _release_key(self, pskey: int) -> None:
+        with self._key_lock:
+            waiters = self._key_waiters.get(pskey)
+            if waiters:
+                submit = waiters.popleft()
+                if not waiters:
+                    del self._key_waiters[pskey]
+            else:
+                self._key_busy.discard(pskey)
+                return
+        submit()                     # key stays busy for the successor
 
     def _push_bucket(self, pskey, b, buf) -> None:
         chain = self._chains.get(pskey)
@@ -582,7 +707,8 @@ class PSGradientExchange:
         feeding the framework as partitions land (operations.cc:140-180)."""
         return self._exchange_impl(tree, name, detach=True, stream=True)
 
-    def exchange_ingest(self, template, name: Optional[str] = None):
+    def exchange_ingest(self, template, name: Optional[str] = None,
+                        step: Optional[int] = None):
         """Incremental-ingest sync round — the step-HEAD mirror of
         ``exchange_stream``. ``template`` is any tree with the grads'
         structure/shapes/dtypes (typically the param tree; no values
@@ -597,7 +723,8 @@ class PSGradientExchange:
         ∥ pull/H2D/apply."""
         self._ensure_executors()
         return _IngestExchange(_Round(self, template, name,
-                                      stream=True, ingest=True))
+                                      stream=True, ingest=True,
+                                      step=step))
 
     def _ensure_executors(self) -> None:
         # Creation is locked: the multi-channel torch dispatcher reaches

@@ -94,11 +94,16 @@ class Nic:
     SMALL_FRAME = 64
 
     def __init__(self, rate: float, latency: float = 0.0,
-                 burst: Optional[int] = None) -> None:
+                 burst: Optional[int] = None,
+                 rx_rate: Optional[float] = None) -> None:
+        """``rx_rate``: optional asymmetric ingress rate (defaults to
+        ``rate``). Models contended directions independently — e.g. a
+        PS server whose egress is the k-worker incast bottleneck while
+        its ingress keeps line rate (bench.ps_cross_breakdown)."""
         self.rate = float(rate)
         self.latency = float(latency)
         self.tx = TokenBucket(rate, burst)
-        self.rx = TokenBucket(rate, burst)
+        self.rx = TokenBucket(rate if rx_rate is None else rx_rate, burst)
         # wire accounting (every byte, incl. exempt control frames):
         # the scaling-curve rig asserts these against the analytic
         # per-endpoint byte model — noise-free evidence the stack's

@@ -19,6 +19,14 @@ exchange's priority order): the caller hands each group to
 so D2H + pack + push of group k run while group k+1 is still
 differentiating.
 
+For the cross-step pipeline (``BPS_CROSS_STEP``, cross_step.py) the
+FORWARD is cut at the same group boundaries too (``forward_cuts``):
+forward segment s then reads only group s's params, each segment
+carries the param leaves it is the first to read
+(``param_first_use``), and ``run`` can bind params lazily from a live
+leaf list behind a readiness gate — the per-parameter unblocking of
+the reference's cross-barrier, at bucket-group granularity.
+
 Exactness contract: a cut point survives only if the segmented program
 reproduces the fused head BIT-FOR-BIT on a real (params, batch) probe.
 Splitting a program at an arbitrary boundary can perturb XLA's fusion
@@ -59,6 +67,8 @@ class _Segment:
     emit_leaves: Tuple[int, ...]  # flat param-leaf indices ready after it
     emits_loss: bool
     free_after: Tuple             # env keys dead once this segment ran
+    param_first_use: Tuple[int, ...] = ()  # param leaves FIRST read here
+    #                                        (the cross-step gate set)
 
 
 @dataclass
@@ -83,7 +93,8 @@ class StagedGrad:
     """
 
     def __init__(self, segments: List[_Segment], invars, const_env,
-                 loss_var, grad_outvars, in_treedef, n_eqns: int) -> None:
+                 loss_var, grad_outvars, in_treedef, n_eqns: int,
+                 n_params: int = 0) -> None:
         self.segments = segments
         self._invars = invars
         self._const_env = const_env
@@ -91,6 +102,7 @@ class StagedGrad:
         self._grad_outvars = grad_outvars   # per param leaf: Var | Literal
         self._in_treedef = in_treedef
         self.n_eqns = n_eqns
+        self.n_params = n_params            # leading invars = param leaves
 
     @property
     def n_segments(self) -> int:
@@ -107,19 +119,56 @@ class StagedGrad:
                                     aval.shape)
         return env[v]
 
-    def run(self, params, batch):
-        """Generator of ``SegmentResult`` in execution order."""
+    def run(self, params, batch, gate=None, params_flat=None,
+            block_nonemitting=True):
+        """Generator of ``SegmentResult`` in execution order.
+
+        ``params_flat``: a LIVE flat param-leaf list read lazily — each
+        segment binds only the param leaves it is the first to read,
+        immediately before it runs. The cross-step driver hands the
+        list its tail thread updates in place, so a segment gated on
+        step k's apply reads the step-k value without the whole tree
+        having to exist up front. ``params`` then only supplies the
+        structure for the signature check.
+
+        ``gate(seg_index, param_leaf_ids)``: called before each
+        segment binds/runs — the cross-step readiness gate. With
+        neither argument this is exactly the eager PR-2 behavior.
+
+        ``block_nonemitting=False``: don't ``block_until_ready`` on
+        segments that emit no gradients (the forward slices) — their
+        compute then overlaps the NEXT gates' waits on the XLA pool
+        instead of serializing with them, which takes the forward off
+        the cross-step critical chain. Emitting segments always block,
+        so gradient handover timing (and the PS_BWD_SEG spans the head
+        overlap telemetry anchors on) keeps its meaning; non-emitting
+        spans are dispatch-only in this mode."""
         flat, treedef = jax.tree_util.tree_flatten((params, batch))
         if treedef != self._in_treedef:
             raise ValueError(
                 "staged backward was built for a different (params, batch) "
                 "structure — rebuild it for the new signature")
-        env = dict(zip(self._invars, flat))
+        if params_flat is None:
+            env = dict(zip(self._invars, flat))
+        else:
+            if len(params_flat) != self.n_params:
+                raise ValueError(
+                    f"params_flat has {len(params_flat)} leaves, staged "
+                    f"program was built for {self.n_params}")
+            env = dict(zip(self._invars[self.n_params:],
+                           flat[self.n_params:]))
         env.update(self._const_env)
+        pvars = self._invars[:self.n_params]
         for si, seg in enumerate(self.segments):
+            if gate is not None:
+                gate(si, seg.param_first_use)
+            if params_flat is not None:
+                for li in seg.param_first_use:
+                    env[pvars[li]] = params_flat[li]
             t0 = time.time()
             outs = seg.fn(*[env[v] for v in seg.invars])
-            jax.block_until_ready(outs)
+            if block_nonemitting or seg.emit_leaves or seg.emits_loss:
+                jax.block_until_ready(outs)
             dur = time.time() - t0
             env.update(zip(seg.outvars, outs))
             grads = [self._grad_value(env, li) for li in seg.emit_leaves]
@@ -130,7 +179,7 @@ class StagedGrad:
 
 
 def _assemble(cj, cuts: Sequence[int], leaf_ready, loss_var,
-              grad_outvars, in_treedef) -> StagedGrad:
+              grad_outvars, in_treedef, n_params: int = 0) -> StagedGrad:
     """Build the segment list for boundary-after-eqn indices ``cuts``."""
     jaxpr = cj.jaxpr
     n_eqns = len(jaxpr.eqns)
@@ -160,6 +209,20 @@ def _assemble(cj, cuts: Sequence[int], leaf_ready, loss_var,
                     last_use[v] = si
     loss_seg = produced_in.get(loss_var, 0)
     last_use[loss_var] = max(last_use.get(loss_var, 0), loss_seg)
+
+    # cross-step gating metadata: which segment FIRST reads each param
+    # invar (the leading n_params jaxpr invars). A segment's gate set is
+    # the params it binds; later segments reuse the env binding, so
+    # first-read is exactly when the value must be step-k fresh.
+    pvar_index = {v: li for li, v in enumerate(jaxpr.invars[:n_params])}
+    first_seg: dict = {}
+    for si, (s, e) in enumerate(bounds):
+        for eq in jaxpr.eqns[s:e]:
+            for v in eq.invars:
+                li = pvar_index.get(v) if isinstance(v, jcore.Var) else None
+                if li is not None and li not in first_seg:
+                    first_seg[li] = si
+
     emit_at: dict = {}
     for li, r in enumerate(leaf_ready):
         si = 0
@@ -171,6 +234,15 @@ def _assemble(cj, cuts: Sequence[int], leaf_ready, loss_var,
         gv = grad_outvars[li]
         if isinstance(gv, jcore.Var):
             last_use[gv] = max(last_use.get(gv, 0), si)
+            if gv in pvar_index:
+                # passthrough gradient (grad var IS a param invar): the
+                # emit reads it, so it must be bound by then
+                pi = pvar_index[gv]
+                first_seg[pi] = min(first_seg.get(pi, si), si)
+
+    first_use_at: dict = {}
+    for li, si in first_seg.items():
+        first_use_at.setdefault(si, []).append(li)
 
     segments: List[_Segment] = []
     for si, (s, e) in enumerate(bounds):
@@ -196,9 +268,11 @@ def _assemble(cj, cuts: Sequence[int], leaf_ready, loss_var,
         segments.append(_Segment(
             fn=fn, invars=tuple(invars), outvars=tuple(outs),
             emit_leaves=tuple(emit_at.get(si, ())),
-            emits_loss=si == loss_seg, free_after=free))
+            emits_loss=si == loss_seg, free_after=free,
+            param_first_use=tuple(sorted(first_use_at.get(si, ())))))
     return StagedGrad(segments, tuple(jaxpr.invars), const_env,
-                      loss_var, grad_outvars, in_treedef, n_eqns)
+                      loss_var, grad_outvars, in_treedef, n_eqns,
+                      n_params=n_params)
 
 
 def _bitwise_equal(a, b) -> bool:
@@ -235,7 +309,8 @@ def build_staged_grad(loss_fn: Callable, params, batch,
                       groups: Optional[Sequence[Sequence[int]]] = None,
                       fused_fn: Optional[Callable] = None,
                       max_segments: int = 4,
-                      name: str = "loss") -> Optional[StagedGrad]:
+                      name: str = "loss",
+                      forward_cuts: bool = False) -> Optional[StagedGrad]:
     """Build a bit-exact staged backward for ``loss_fn``, or None.
 
     ``groups``: partition of the flat param-leaf indices (the exchange's
@@ -248,6 +323,14 @@ def build_staged_grad(loss_fn: Callable, params, batch,
     ``value_and_grad(loss_fn)``. The probe runs BOTH arms on the given
     (params, batch) and requires bitwise equality, so pass the exact
     callable the staged head will replace.
+
+    ``forward_cuts``: also place candidate cuts in the FORWARD region,
+    right before each bucket group's params are first read — for a
+    sequential model, forward segment s then reads only group s's
+    params, which is what lets the cross-step driver launch next-step
+    forward segments as soon as individual groups' applies land
+    instead of gating the whole program on the full tree. Same bitwise
+    probe-or-drop contract as the backward cuts.
 
     Returns None (with a logged reason) whenever staging is impossible
     (mesh-collective loss, effects, no cut point) or not provably exact.
@@ -288,6 +371,25 @@ def build_staged_grad(loss_fn: Callable, params, batch,
                        for g in groups if len(g)})
     else:
         cand = sorted(set(leaf_ready))
+    if forward_cuts:
+        # one candidate boundary right before each group's params are
+        # first read: the forward then advances group-by-group in the
+        # same partition the exchange/apply use, so next-step segments
+        # gate on exactly one group's apply each
+        pvar_index = {v: li for li, v in
+                      enumerate(jaxpr.invars[:n_leaves])}
+        first_use: dict = {}
+        for i, eq in enumerate(jaxpr.eqns):
+            for v in eq.invars:
+                li = (pvar_index.get(v) if isinstance(v, jcore.Var)
+                      else None)
+                if li is not None and li not in first_use:
+                    first_use[li] = i
+        group_first = sorted(
+            {min(first_use[li] for li in g if li in first_use)
+             for g in (groups or [[li] for li in range(n_leaves)])
+             if any(li in first_use for li in g)})
+        cand = sorted(set(cand) | {c - 1 for c in group_first[1:]})
     # a boundary after the last eqn (or before the first) splits nothing
     cand = [c for c in cand if 0 <= c < len(jaxpr.eqns) - 1]
     cand = _coalesce(cand, max_segments)
@@ -303,7 +405,7 @@ def build_staged_grad(loss_fn: Callable, params, batch,
 
     def try_cuts(cuts):
         st = _assemble(cj, cuts, leaf_ready, loss_var, grad_outvars,
-                       in_treedef)
+                       in_treedef, n_params=n_leaves)
         return st if _probe(st, fused_flat, params, batch) else None
 
     staged = try_cuts(cand)

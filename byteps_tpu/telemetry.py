@@ -90,6 +90,43 @@ def exchange_tail_overlap(events) -> dict:
             "overlap_ms": round(max(0.0, best) / 1e3, 3)}
 
 
+def cross_step_overlap(events) -> dict:
+    """Overlap stats for the CROSS-STEP pipeline (BPS_CROSS_STEP).
+
+    The cross-barrier claim is inter-step: step k's straggler tail
+    (``PS_APPLY_CHUNK``/``PS_PULL``/``PS_H2D`` spans tagged step k)
+    must still be running when step k+1's first gated backward segment
+    (``PS_BWD_SEG`` tagged step k+1) has already STARTED — a
+    non-draining ``step()`` whose tail actually finished before the
+    next step began would be a renamed barrier. Events must carry
+    true-owner step tags (Timeline.record's explicit ``step``).
+    Returns the max overlap across consecutive step pairs,
+    ``overlapped`` = any pair overlapped, and ``gate_ms`` = total
+    PS_XSTEP_GATE wait (what the gating cost, for the same trace)."""
+    tail_end: dict = {}
+    bwd_start: dict = {}
+    gate_ms = 0.0
+    for e in events:
+        step = e.get("args", {}).get("step", 0)
+        if e["name"] in ("PS_APPLY_CHUNK", "PS_PULL", "PS_H2D"):
+            tail_end[step] = max(tail_end.get(step, 0), e["ts"] + e["dur"])
+        elif e["name"] == "PS_BWD_SEG":
+            bwd_start[step] = min(bwd_start.get(step, 1 << 62), e["ts"])
+        elif e["name"] == "PS_XSTEP_GATE":
+            gate_ms += e["dur"] / 1e3
+    best = None
+    for step, first_bwd in bwd_start.items():
+        if step - 1 in tail_end:
+            gap = tail_end[step - 1] - first_bwd
+            best = gap if best is None else max(best, gap)
+    if best is None:
+        return {"overlapped": False, "overlap_ms": 0.0,
+                "gate_ms": round(gate_ms, 3)}
+    return {"overlapped": best > 0,
+            "overlap_ms": round(max(0.0, best) / 1e3, 3),
+            "gate_ms": round(gate_ms, 3)}
+
+
 def exchange_head_overlap(events) -> dict:
     """Overlap stats for the staged sync-PS step HEAD.
 

@@ -20,6 +20,12 @@ segment; pid = segment index) and PS_D2H (per-leaf host
 materialization inside the pack workers; pid = leaf index) — push-side
 rows (PS_D2H/PS_PACK/PS_PUSH) starting before the last PS_BWD_SEG ends
 is the head pipeline (BPS_BWD_STAGED=0 disables it).
+The cross-step pipeline adds PS_XSTEP_GATE (per-segment wait for the
+previous step's param-group applies; pid = segment index) and tags its
+events with the TRUE owning step via record()'s explicit ``step`` —
+step k's straggler tail records while the ambient step is already k+1,
+and telemetry.cross_step_overlap groups per step
+(BPS_CROSS_STEP=0 disables it).
 With ``BPS_TRACE_PROFILER=1`` the same step window also
 captures a ``jax.profiler`` device trace into
 ``<trace_dir>/<local_rank>/profile`` — host spans land in comm.json
@@ -33,7 +39,7 @@ import json
 import os
 import threading
 import time
-from typing import List
+from typing import List, Optional
 
 from .common.config import Config
 
@@ -86,16 +92,20 @@ class Timeline:
             self.flush()
 
     def record(self, name: str, stage: str, start_s: float, dur_s: float,
-               key: int = 0) -> None:
+               key: int = 0, step: Optional[int] = None) -> None:
         """One complete ('X') event, microsecond timestamps like the
-        reference (global.cc:489-538)."""
+        reference (global.cc:489-538). ``step`` overrides the ambient
+        step tag — cross-step pipelines record step k's straggler tail
+        spans while the timeline has already advanced to k+1, and the
+        per-step overlap aggregates need the true owner."""
         if not self._active():
             return
         with self._lock:
             self._events.append({
                 "name": stage, "ph": "X", "pid": key, "tid": 0,
                 "ts": int((start_s - self._t0) * 1e6), "dur": int(dur_s * 1e6),
-                "args": {"name": name, "step": self.step},
+                "args": {"name": name,
+                         "step": self.step if step is None else step},
             })
 
     def span(self, name: str, stage: str, key: int = 0):

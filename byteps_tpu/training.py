@@ -59,6 +59,33 @@ class DistributedTrainer:
     # live PS server still holding the dead trainer's keys)
     _name_counts: dict = {}
 
+    @property
+    def params(self):
+        """The parameter tree. Reading it is a synchronization point:
+        with the cross-step pipeline engaged (``BPS_CROSS_STEP``) any
+        in-flight straggler tail is drained first, so external readers
+        (checkpointing, metrics, tests) always observe fully-applied
+        weights — the pipeline is invisible except to the clock. A
+        trainer whose tail FAILED keeps raising here: the weights are
+        partially stepped and must never be read as if healthy."""
+        d = getattr(self, "_cross_driver", None)
+        if d is not None and (d.pending or d.failed):
+            d.drain()
+        return self._params
+
+    @params.setter
+    def params(self, value):
+        # an external write (checkpoint restore) must not race the
+        # in-flight tails — and must not be refused on a POISONED
+        # trainer, since installing fresh state is exactly the
+        # documented remedy: join the tails without raising, lift the
+        # partial-state error, and mark the driver for resync (the
+        # next cross step re-reads the tree and re-syncs opt state)
+        d = getattr(self, "_cross_driver", None)
+        if d is not None:
+            d.supersede()
+        self._params = value
+
     @staticmethod
     def _default_name(params) -> str:
         """Structure-derived default so a restarted worker maps onto the
@@ -214,6 +241,15 @@ class DistributedTrainer:
                 "BPS_BWD_STAGED", "1") != "0"
             self._bwd_groups = int(os.environ.get("BPS_BWD_GROUPS", "0")
                                    or 0)
+            # cross-step pipeline (BPS_CROSS_STEP=0 for draining A/B
+            # barrier steps): step() hands the straggler pull/apply
+            # tail to a background thread and the NEXT step's staged
+            # segments gate on per-leaf param readiness — see
+            # cross_step.CrossStepDriver. Engages on top of the staged
+            # head + chunked tail; falls back with them.
+            self._cross_step = os.environ.get(
+                "BPS_CROSS_STEP", "1") != "0"
+            self._cross_driver = None
             self._staged = None      # active signature's StagedGrad /
             #                          False (fell back) / None (unbuilt)
             self._staged_cache = {}  # batch signature -> StagedGrad|False
@@ -221,6 +257,9 @@ class DistributedTrainer:
             #                          alternating shapes must not
             #                          rebuild, and one unstageable shape
             #                          must not disable the others)
+            self._staged_cache_cap = max(
+                1, int(os.environ.get("BPS_STAGED_CACHE", "8") or 8))
+            self._staged_cache_warned = False
             self._ps_donate = donate
             self._chunked = None        # built on first streamed step
             self._h2d_ex = None         # lazy single-thread H2D dispatcher
@@ -381,12 +420,39 @@ class DistributedTrainer:
                 (tuple(l.shape), str(l.dtype))
                 for l in jax.tree_util.tree_leaves(batch))
             staged = self._staged_cache.get(sig)
-            if staged is None and sig not in self._staged_cache \
-                    and len(self._staged_cache) < 8:
-                self._build_staged_head(batch)
-                self._staged_cache[sig] = staged = self._staged
+            if staged is None and sig not in self._staged_cache:
+                if len(self._staged_cache) < self._staged_cache_cap:
+                    self._build_staged_head(batch)
+                    self._staged_cache[sig] = staged = self._staged
+                elif not self._staged_cache_warned:
+                    # silent before: the 9th signature just stopped
+                    # staging with no trace of why
+                    self._staged_cache_warned = True
+                    from .common.logging import get_logger
+                    get_logger().warning(
+                        "staged-head signature cache is full (%d batch "
+                        "signatures): new shapes run the monolithic "
+                        "head from here on — raise BPS_STAGED_CACHE if "
+                        "the input pipeline legitimately cycles more "
+                        "shapes", self._staged_cache_cap)
             self._staged = staged if staged is not None else False
             if staged not in (None, False):
+                if self._cross_step:
+                    if (self._cross_driver is None
+                            and self._chunked is not None
+                            and self._chunked.decomposable):
+                        # first staged step ran the draining path and
+                        # built the chunked groups; engage the
+                        # cross-step pipeline from here on
+                        from .cross_step import CrossStepDriver
+                        self._cross_driver = CrossStepDriver(self)
+                    if self._cross_driver is not None:
+                        self.step_count += 1
+                        loss = self._cross_driver.step(staged, batch)
+                        gs = GlobalState._instance
+                        if gs is not None and gs.timeline is not None:
+                            gs.timeline.set_step(self.step_count)
+                        return loss
                 return self._ps_step_staged(batch)
         loss, grads = self._grad_fn(self.params, batch)
         grads = self._accumulate(grads)
@@ -506,11 +572,17 @@ class DistributedTrainer:
         from .staged_grad import build_staged_grad
         groups = self._ps_exchange.leaf_groups(self.params,
                                                name=self._name)
+        # cross-step mode also cuts the FORWARD at group boundaries
+        # (roughly doubling the useful segment count), so next-step
+        # forward segments can gate on individual groups' applies
+        if self._cross_step:
+            max_seg = self._bwd_groups or max(2, min(16, 2 * len(groups)))
+        else:
+            max_seg = self._bwd_groups or max(2, min(8, len(groups)))
         staged = build_staged_grad(
             self._loss_fn, self.params, batch, groups=groups,
-            fused_fn=self._grad_fn,
-            max_segments=self._bwd_groups or max(2, min(8, len(groups))),
-            name=self._name)
+            fused_fn=self._grad_fn, max_segments=max_seg,
+            name=self._name, forward_cuts=self._cross_step)
         if staged is not None:
             self._staged = staged
 
@@ -548,19 +620,32 @@ class DistributedTrainer:
             tl.set_step(self.step_count)
         return loss
 
+    def drain(self) -> None:
+        """Synchronize the cross-step pipeline (no-op otherwise): join
+        every in-flight straggler tail and publish the final weights —
+        the explicit end-of-training barrier. Reading ``params`` does
+        the same implicitly."""
+        d = getattr(self, "_cross_driver", None)
+        if d is not None and (d.pending or d.failed):
+            d.drain()
+
     def close(self) -> None:
         """Release the trainer's PS-tail resources (H2D dispatch thread,
         private exchange executors). Idempotent; only meaningful for
         PS-mode trainers — collective-path and async-PS trainers hold
         none of these (getattr: their __init__ branches never create
-        the attributes)."""
-        h2d = getattr(self, "_h2d_ex", None)
-        if h2d is not None:
-            h2d.shutdown(wait=False)
-            self._h2d_ex = None
-        ex = getattr(self, "_ps_exchange", None)
-        if ex is not None:
-            ex.close()
+        the attributes). Drains the cross-step pipeline first — the
+        tails need the executors being shut down."""
+        try:
+            self.drain()
+        finally:
+            h2d = getattr(self, "_h2d_ex", None)
+            if h2d is not None:
+                h2d.shutdown(wait=False)
+                self._h2d_ex = None
+            ex = getattr(self, "_ps_exchange", None)
+            if ex is not None:
+                ex.close()
 
     def _ps_step_streamed(self, grads, loss, tl, handle=None,
                           t_ex: Optional[float] = None) -> jnp.ndarray:
