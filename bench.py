@@ -18,10 +18,27 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 from functools import partial
 
 import jax
+
+# --stats: attach the obs metrics-registry summary (per-stage latency
+# histograms with p50/p95/p99, counters, step/wall_s StepStats rollup)
+# to the JSON line for the headline run AND every PS-breakdown variant
+# (docs/observability.md). The line stays single-line JSON.
+STATS = "--stats" in sys.argv
+
+
+def _reset_metrics() -> None:
+    from byteps_tpu.obs.metrics import get_registry
+    get_registry().reset()
+
+
+def _metrics_summary() -> dict:
+    from byteps_tpu.obs.metrics import get_registry
+    return get_registry().summary()
 
 # Honor JAX_PLATFORMS even when a sitecustomize force-selects a platform
 # via jax.config (which outranks the env var): re-assert the user's choice.
@@ -179,6 +196,8 @@ def ps_tail_breakdown(iters: int = 12, warm: int = 3) -> dict:
                               BPS_TRACE_DIR=td)
             for mode, flag in (("chunked", "1"), ("fused", "0")):
                 os.environ["BPS_APPLY_CHUNKED"] = flag
+                if STATS:
+                    _reset_metrics()
                 bps.init(config=bps.Config.from_env())
                 trainer = DistributedTrainer(
                     loss_fn, params, optax.adamw(1e-4),
@@ -199,6 +218,8 @@ def ps_tail_breakdown(iters: int = 12, warm: int = 3) -> dict:
                         [e for e in events
                          if e["name"].startswith("PS_")])
                     out["overlap"] = exchange_tail_overlap(events)
+                if STATS:
+                    out[f"{mode}_metrics"] = _metrics_summary()
                 trainer.close()
                 bps.shutdown()
         out["chunked_vs_fused"] = round(
@@ -293,6 +314,8 @@ def ps_head_breakdown(iters: int = 5, warm: int = 2,
             for rep in range(pairs):
                 for mode, flag in (("staged", "1"), ("monolithic", "0")):
                     os.environ["BPS_BWD_STAGED"] = flag
+                    if STATS and rep == 0:
+                        _reset_metrics()
                     bps.init(config=bps.Config.from_env())
                     mesh = make_mesh({"data": 1},
                                      devices=jax.devices()[:1])
@@ -320,6 +343,8 @@ def ps_head_breakdown(iters: int = 5, warm: int = 2,
                               "PS_PUSH")])
                         out["head_overlap"] = exchange_head_overlap(
                             events)
+                    if STATS and rep == 0:
+                        out[f"{mode}_metrics"] = _metrics_summary()
                     trainer.close()
                     bps.shutdown()
         import statistics
@@ -461,6 +486,8 @@ def ps_cross_breakdown(iters: int = 10, warm: int = 3,
                     arms = arms[::-1]   # hits both arms equally
                 for mode, flag in arms:
                     os.environ["BPS_CROSS_STEP"] = flag
+                    if STATS and rep == 0:
+                        _reset_metrics()
                     bps.init(config=bps.Config.from_env())
                     mesh = make_mesh({"data": 1},
                                      devices=jax.devices()[:1])
@@ -501,6 +528,8 @@ def ps_cross_breakdown(iters: int = 10, warm: int = 3,
                             [e for e in events if e["name"] in
                              ("PS_XSTEP_GATE", "PS_BWD_SEG",
                               "PS_APPLY_CHUNK", "PS_PULL")])
+                    if STATS and rep == 0:
+                        out[f"{mode}_metrics"] = _metrics_summary()
                     trainer.close()
                     bps.shutdown()
         import statistics
@@ -756,6 +785,10 @@ def main() -> None:
                 line["dh128_mfu"] = round(sps128 * fps128 / peak, 4)
         except Exception as e:   # noqa: BLE001 — recorded, not fatal
             line["dh128_error"] = f"{type(e).__name__}: {e}"[:300]
+    if STATS:
+        # headline-run registry summary (collective-path stages +
+        # step/wall_s) before the PS breakdowns reset it
+        line["metrics"] = _metrics_summary()
     # sync-PS step-tail breakdown (host-bound; rides along on CPU and
     # TPU runs alike). A transient must not cost the headline line.
     bps.shutdown()               # the ambient collective-path runtime

@@ -162,6 +162,16 @@ def replica_ranks() -> range:
     return range(start, start + per_proc)
 
 
+# -- observability ----------------------------------------------------------
+
+def get_metrics():
+    """The process-wide observability metrics registry (counters,
+    gauges, per-stage latency histograms — docs/observability.md).
+    Always available; recording obeys ``BPS_STATS``."""
+    from .obs.metrics import get_registry
+    return get_registry()
+
+
 # -- data plane -------------------------------------------------------------
 
 def declare_tensor(name: str, priority: Optional[int] = None, **kwargs) -> int:

@@ -116,6 +116,16 @@ class Config:
     telemetry_on: bool = False
     debug_sample_tensor: str = ""        # BYTEPS_DEBUG_SAMPLE_TENSOR
 
+    # --- observability (ours — byteps_tpu/obs/; docs/observability.md) ---
+    stats_on: bool = True                # BPS_STATS: metrics registry +
+                                         # per-step StepStats (cheap, on
+                                         # by default; 0 = A/B off)
+    stats_file: str = ""                 # BPS_STATS_FILE: rolling JSON
+                                         # dump of recent StepStats
+    stats_every: int = 50                # BPS_STATS_EVERY: dump cadence
+    watchdog_sec: float = 0.0            # BPS_WATCHDOG_SEC: stall
+                                         # watchdog threshold (0 = off)
+
     # --- logging ---
     log_level: str = "INFO"
 
@@ -152,6 +162,10 @@ class Config:
             trace_profiler=_env_bool("BPS_TRACE_PROFILER", None),
             telemetry_on=_env_bool("BPS_TELEMETRY_ON", "BYTEPS_TELEMETRY_ON"),
             debug_sample_tensor=_env("BPS_DEBUG_SAMPLE_TENSOR", "BYTEPS_DEBUG_SAMPLE_TENSOR", ""),
+            stats_on=_env_bool("BPS_STATS", None, True),
+            stats_file=_env("BPS_STATS_FILE", None, ""),
+            stats_every=_env_int("BPS_STATS_EVERY", None, 50),
+            watchdog_sec=float(_env("BPS_WATCHDOG_SEC", None, "0") or 0),
             log_level=_env("BPS_LOG_LEVEL", "BYTEPS_LOG_LEVEL", "INFO"),
         )
         cfg.update(overrides)

@@ -72,6 +72,17 @@ class GlobalState:
         self.registry = NameRegistry()
         self.telemetry = PushPullSpeed() if config.telemetry_on else None
         self.timeline = Timeline(config) if config.trace_on else None
+        # observability: re-resolve the metrics master switch for THIS
+        # init (the bench's BPS_STATS on/off A/B re-inits between
+        # variants) and stand up the per-step StepStats emitter
+        from ..obs import metrics as obs_metrics
+        obs_metrics.configure(config.stats_on)
+        self.stats = None
+        if config.stats_on:
+            from ..obs.stats import StepStatsEmitter
+            self.stats = StepStatsEmitter(
+                stats_file=config.stats_file or None,
+                every=config.stats_every)
         if config.host_only:
             if mesh is not None:
                 raise ValueError(
@@ -133,7 +144,8 @@ class GlobalState:
                 self.engine.ps_exchange = PSGradientExchange(
                     self.ps_backend, partition_bytes=config.partition_bytes,
                     registry=self.registry,
-                    min_compress_bytes=config.min_compress_bytes)
+                    min_compress_bytes=config.min_compress_bytes,
+                    watchdog_sec=config.watchdog_sec)
                 self.engine.ps_exchange.timeline = self.timeline
                 self.engine.ps_world = config.num_worker
         if self.mesh is None:
@@ -180,6 +192,8 @@ class GlobalState:
                 return
             if inst.timeline is not None:
                 inst.timeline.flush()
+            if inst.stats is not None:
+                inst.stats.flush()      # final rolling-dump write
             if inst.engine._handles:
                 log.warning(
                     "shutdown with %d unsynchronized push_pull_async "

@@ -49,6 +49,7 @@ import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .common.global_state import GlobalState
+from .obs.metrics import get_registry, observe_stage
 
 
 class CrossStepDriver:
@@ -174,6 +175,14 @@ class CrossStepDriver:
         gs = GlobalState._instance
         tl = gs.timeline if gs is not None else None
         chunked = self._chunked
+        # pipeline health gauges: how many straggler tails are alive,
+        # and how far the slowest leaf's applied epoch lags the step
+        # counter (steady-state 1; growing lag = the tail is losing)
+        reg = get_registry()
+        reg.gauge("xstep/tails_in_flight").set(len(self._tails) + 1)
+        reg.gauge("xstep/epoch_lag").set(
+            e - 1 - min(chunked.ready_epoch, default=0)
+            if chunked.ready_epoch else 0)
         t_ex = time.time()
         template = jax.tree_util.tree_unflatten(self._treedef, self._flat)
         handle = self._ex.exchange_ingest(template, name=self._name,
@@ -187,6 +196,7 @@ class CrossStepDriver:
                 leaf_ids, e - 1,
                 should_abort=lambda: self._err is not None)
             self._check_err()
+            observe_stage("PS_XSTEP_GATE", time.time() - t0)
             if tl is not None:
                 tl.record(self._name, "PS_XSTEP_GATE", t0,
                           time.time() - t0, si, step=e)
@@ -196,6 +206,7 @@ class CrossStepDriver:
             for seg in staged.run(template, batch, gate=gate,
                                   params_flat=self._flat,
                                   block_nonemitting=False):
+                observe_stage("PS_BWD_SEG", seg.dur)
                 if tl is not None:
                     tl.record(self._name, "PS_BWD_SEG", seg.t0, seg.dur,
                               seg.index, step=e)
@@ -225,6 +236,7 @@ class CrossStepDriver:
         if self._world > 1:
             a = a / self._world      # same host-side divide per leaf as
         d = jax.device_put(a, self._rep)   # the barrier tails
+        observe_stage("PS_H2D", time.time() - t0)
         if tl is not None:
             tl.record(self._name, "PS_H2D", t0, time.time() - t0, li,
                       step=e)
@@ -310,6 +322,7 @@ class CrossStepDriver:
                 # pre-apply array (stale step k-1 weights)
                 chunked.mark_epoch(group, e)
                 applied += 1
+            observe_stage("PS_PUSH_PULL", time.time() - t_ex)
             if tl is not None:
                 tl.record(self._name, "PS_PUSH_PULL", t_ex,
                           time.time() - t_ex, 0, step=e)

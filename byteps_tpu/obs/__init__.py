@@ -1,0 +1,33 @@
+"""Production observability for the sync-PS pipeline.
+
+Three perf PRs turned the step into a deeply asynchronous pipeline
+(staged backward ∥ D2H/push ∥ server sum ∥ pull/H2D/apply, crossing
+the step barrier) whose only windows were a Chrome-trace step window
+(timeline.py) and three ad-hoc overlap aggregators (telemetry.py).
+This package is the always-on counterpart:
+
+  - ``metrics``: a lock-cheap process-wide registry (counters, gauges,
+    fixed-bucket latency histograms with p50/p95/p99) every pipeline
+    layer reports into — per-stage latencies, rounds in flight,
+    admission-gate waits, bytes moved, queue depths, NIC stalls.
+  - ``stats``: a per-step ``StepStats`` record (step wall time,
+    per-stage deltas, overlap fractions reusing telemetry.py's
+    aggregators, throughput) with a structured one-line log and a
+    rolling JSON dump (``BPS_STATS_FILE`` / ``BPS_STATS_EVERY``).
+  - ``watchdog``: a stall detector (``BPS_WATCHDOG_SEC``) that snapshots
+    per-key exchange state when no bucket completes for N seconds and
+    dumps a loud per-key diagnostic instead of hanging silently — the
+    counter-measure to the failure mode the cross-step pipeline
+    created (one lost pull wedges the per-key admission gate forever).
+  - ``merge_trace``: a CLI (``python -m byteps_tpu.obs.merge_trace``)
+    unifying per-rank ``comm.json`` traces into one Chrome trace with
+    per-rank process rows and flow events linking each bucket's spans.
+"""
+
+from __future__ import annotations
+
+from .metrics import (MetricsRegistry, configure, get_registry,   # noqa: F401
+                      metrics_enabled, observe_stage)
+from .stats import StepStats, StepStatsEmitter                    # noqa: F401
+from .watchdog import StallWatchdog                               # noqa: F401
+from .merge_trace import merge_traces                             # noqa: F401

@@ -1,0 +1,196 @@
+"""Multi-rank Chrome-trace merge.
+
+Each rank writes its own ``<trace_dir>/<rank>/comm.json`` (timeline.py,
+reference schema: global.cc:469-564). Diagnosing a distributed stall —
+whose pull straggles, which worker's push the server sat waiting on —
+means eyeballing N viewer tabs with uncorrelated rows. This module
+unifies them:
+
+  - every rank becomes one PROCESS row (``pid`` = rank, named
+    ``rank <r>`` via metadata events); the original per-key ``pid``
+    moves to ``tid``, so buckets stay separate rows *within* a rank;
+  - FLOW events (``ph: "s"``/``"f"``) link each bucket's stage chain
+    (PS_PACK → PS_PUSH → PS_PULL → PS_UNPACK, and the collective path's
+    DISPATCH → REDUCE) across rows, and — when several ranks traced the
+    same window — every rank's PS_PUSH of a (key, round-step) to every
+    other rank's PS_PULL: a pull completes only after ALL pushes of its
+    round, so each edge is causal (no cross-rank clock comparison);
+  - timestamps are kept per-rank as written (each rank's ``ts`` is
+    relative to its own t0; the viewer aligns rows side-by-side, and
+    flow arrows make cross-rank causality readable even without a
+    shared clock).
+
+CLI::
+
+    python -m byteps_tpu.obs.merge_trace /tmp/bps_trace -o merged.json
+
+loadable in ``chrome://tracing`` / Perfetto.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# within-rank stage chains, linked in this order when present for the
+# same (trace pid, step): the PS bucket pipeline and the collective path
+_CHAINS = (
+    ("PS_PACK", "PS_PUSH", "PS_PULL", "PS_UNPACK"),
+    ("DISPATCH", "REDUCE"),
+)
+
+
+def load_rank_traces(trace_dir: str) -> Dict[int, List[dict]]:
+    """{rank: traceEvents} for every ``<trace_dir>/<rank>/comm.json``.
+
+    A corrupt/truncated rank file (the writer was SIGKILLed mid-flush —
+    common in exactly the killed-job scenario this tool diagnoses) is
+    skipped with a warning so the healthy ranks still merge."""
+    out: Dict[int, List[dict]] = {}
+    for entry in sorted(os.listdir(trace_dir)):
+        path = os.path.join(trace_dir, entry, "comm.json")
+        if not entry.isdigit() or not os.path.isfile(path):
+            continue
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"warning: skipping unreadable trace {path}: {e}",
+                  file=sys.stderr)
+            continue
+        out[int(entry)] = data.get("traceEvents", [])
+    return out
+
+
+def _span_key(e: dict) -> Tuple:
+    """(trace pid, step) — one bucket's identity within a rank."""
+    args = e.get("args") or {}
+    return e.get("pid", 0), args.get("step", 0)
+
+
+def _flow_pair(fid: int, a: dict, b: dict, name: str) -> List[dict]:
+    """One s→f flow arrow from the end of span ``a`` to the start of
+    span ``b`` (both already remapped into the merged pid/tid space)."""
+    return [
+        {"ph": "s", "cat": "bucket", "name": name, "id": fid,
+         "pid": a["pid"], "tid": a["tid"],
+         "ts": a["ts"] + a.get("dur", 0)},
+        {"ph": "f", "bp": "e", "cat": "bucket", "name": name, "id": fid,
+         "pid": b["pid"], "tid": b["tid"], "ts": b["ts"]},
+    ]
+
+
+def merge_traces(trace_dir: str) -> dict:
+    """Merge every per-rank comm.json under ``trace_dir`` into one
+    Chrome-trace dict (see module docstring for the layout)."""
+    ranks = load_rank_traces(trace_dir)
+    if not ranks:
+        raise FileNotFoundError(
+            f"no <rank>/comm.json traces under {trace_dir!r}")
+    merged: List[dict] = []
+    fid = 0
+    # chains[(chain, rank? no — cross-rank needs rank-agnostic key)]
+    by_chain: Dict[Tuple, Dict[str, List[dict]]] = {}
+    for rank, events in sorted(ranks.items()):
+        merged.append({"ph": "M", "pid": rank, "name": "process_name",
+                       "args": {"name": f"rank {rank}"}})
+        merged.append({"ph": "M", "pid": rank, "name": "process_sort_index",
+                       "args": {"sort_index": rank}})
+        for e in events:
+            if e.get("ph") not in (None, "X"):
+                continue            # keep complete spans; drop foreign phs
+            ne = dict(e)
+            ne["tid"] = e.get("pid", 0)
+            ne["pid"] = rank
+            args = dict(e.get("args") or {})
+            args["rank"] = rank
+            ne["args"] = args
+            merged.append(ne)
+            name = e.get("name")
+            for chain in _CHAINS:
+                if name in chain:
+                    key = (chain, rank) + _span_key(e)
+                    by_chain.setdefault(key, {}).setdefault(
+                        name, []).append(ne)
+    # within-rank flow arrows: consecutive stages of each bucket chain
+    for key, stages in by_chain.items():
+        chain = key[0]
+        prev_spans: Optional[List[dict]] = None
+        for stage in chain:
+            spans = sorted(stages.get(stage, []), key=lambda e: e["ts"])
+            if not spans:
+                continue
+            if prev_spans is not None:
+                # link pairwise in ts order; uneven counts link the tail
+                # of the shorter list to the first leftover
+                n = max(len(prev_spans), len(spans))
+                for i in range(n):
+                    a = prev_spans[min(i, len(prev_spans) - 1)]
+                    b = spans[min(i, len(spans) - 1)]
+                    merged.extend(_flow_pair(fid, a, b, "bucket"))
+                    fid += 1
+            prev_spans = spans
+    # cross-rank causal edges: a (key, step) pull can complete only
+    # after EVERY rank's push of that round landed, so link each
+    # cross-rank push to each pull. Deliberately no "last push"
+    # selection — each rank's ts is relative to its OWN t0, and
+    # comparing those unaligned clocks across ranks would routinely
+    # crown the earliest-started process's push as "last", pointing
+    # the operator at the wrong straggler. All edges are causal; the
+    # viewer's arrows make the genuinely late one visually obvious.
+    if len(ranks) > 1:
+        pushes: Dict[Tuple, List[dict]] = {}
+        pulls: Dict[Tuple, List[dict]] = {}
+        for e in merged:
+            if e.get("ph") not in (None, "X"):
+                continue
+            k = _span_key({"pid": e.get("tid", 0), "args": e.get("args")})
+            if e.get("name") == "PS_PUSH":
+                pushes.setdefault(k, []).append(e)
+            elif e.get("name") == "PS_PULL":
+                pulls.setdefault(k, []).append(e)
+        for k, push_spans in pushes.items():
+            for pull in pulls.get(k, ()):
+                for push in push_spans:
+                    if pull["pid"] == push["pid"]:
+                        continue    # within-rank already chained above
+                    merged.extend(_flow_pair(fid, push, pull,
+                                             "server-merge"))
+                    fid += 1
+    return {"traceEvents": merged, "displayTimeUnit": "ms",
+            "metadata": {"tool": "byteps_tpu.obs.merge_trace",
+                         "ranks": sorted(ranks)}}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    out_path = None
+    if "-o" in argv:
+        i = argv.index("-o")
+        if i + 1 >= len(argv):          # "-o" with nothing after it:
+            argv = ["--help"]           # usage, not an IndexError
+        else:
+            out_path = argv[i + 1]
+            del argv[i:i + 2]
+    if len(argv) != 1 or argv[0] in ("-h", "--help"):
+        print("usage: python -m byteps_tpu.obs.merge_trace "
+              "<trace_dir> [-o merged.json]", file=sys.stderr)
+        return 2
+    trace_dir = argv[0]
+    merged = merge_traces(trace_dir)
+    if out_path is None:
+        out_path = os.path.join(trace_dir, "merged.json")
+    with open(out_path, "w") as f:
+        json.dump(merged, f)
+    n_ev = sum(1 for e in merged["traceEvents"]
+               if e.get("ph") in (None, "X"))
+    n_flow = sum(1 for e in merged["traceEvents"] if e.get("ph") == "s")
+    print(f"merged {len(merged['metadata']['ranks'])} rank(s): "
+          f"{n_ev} spans, {n_flow} flow arrows -> {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,255 @@
+"""Per-step pipeline statistics (StepStats).
+
+One ``StepStats`` record per training step: wall time, throughput,
+the step's per-stage latency deltas out of the metrics registry, and —
+when a trace window is active — the three overlap aggregates the perf
+PRs are judged by (``telemetry.exchange_head_overlap`` /
+``exchange_tail_overlap`` / ``cross_step_overlap``), computed by those
+very functions so the numbers can never drift from the trace-based
+ones.
+
+``StepStatsEmitter`` is owned by ``GlobalState`` and driven by
+``DistributedTrainer.step`` / ``ShardedTrainer.step``:
+
+  - a structured one-line-per-step log (INFO when ``BPS_STATS``/
+    ``BPS_STATS_FILE`` were explicitly set, DEBUG otherwise — always-on
+    must not spam default consoles);
+  - a rolling JSON dump of the last ``window`` steps to
+    ``BPS_STATS_FILE`` every ``BPS_STATS_EVERY`` steps (atomic
+    tmp+rename, so a tail-ing reader never sees a torn file).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..common.config import _TRUE   # one truthiness rule, shared with
+from . import metrics as _metrics   # Config and the metrics switch
+
+SCHEMA = "byteps_tpu.StepStats/v1"
+
+
+def overlap_stats(events, wall_s: Optional[float] = None,
+                  step: Optional[int] = None) -> dict:
+    """The trace-window overlap aggregates for one snapshot, keyed
+    head/tail/cross — EXACTLY the dicts ``telemetry.exchange_head_overlap``
+    / ``exchange_tail_overlap`` / ``cross_step_overlap`` return (same
+    events in, same numbers out), plus ``*_frac`` = overlap_ms over the
+    step wall time when one was given.
+
+    ``step`` restricts the aggregation to the events carrying THAT
+    trace step tag (the cross aggregate to the (step-1, step) pair it
+    needs): the aggregators report the BEST overlap across every step
+    they see, so feeding them a whole trace window from a per-step
+    emitter would divide step 11's overlap by step 18's wall time — a
+    fraction that never happened. ``step`` is a TRACE TAG (``args.step``
+    as the timeline recorded it), not a trainer step count — the two
+    number bases differ per path (ambient tags lag by one; cross-step
+    tags are the driver's epoch). None = aggregate the snapshot as-is."""
+    from ..telemetry import (_step_of, cross_step_overlap,
+                             exchange_head_overlap, exchange_tail_overlap)
+    intra = events
+    pair = events
+    if step is not None:
+        intra = [e for e in events if _step_of(e) == step]
+        pair = [e for e in events if _step_of(e) in (step - 1, step)]
+    out = {
+        "head": exchange_head_overlap(intra),
+        "tail": exchange_tail_overlap(intra),
+        "cross": cross_step_overlap(pair),
+    }
+    if wall_s and wall_s > 0:
+        for k in ("head", "tail", "cross"):
+            out[f"{k}_frac"] = round(
+                out[k].get("overlap_ms", 0.0) / (wall_s * 1e3), 4)
+    return out
+
+
+@dataclass
+class StepStats:
+    """One step's pipeline accounting."""
+
+    step: int
+    wall_s: float
+    loss: Optional[float] = None
+    samples: Optional[int] = None
+    sps: Optional[float] = None            # samples / wall_s
+    stages: Dict[str, dict] = field(default_factory=dict)
+    #   {stage: {"count": n, "ms": total_ms}} — THIS step's delta
+    overlaps: Optional[dict] = None        # overlap_stats(), trace window only
+
+    def line(self) -> str:
+        """The structured one-line-per-step log record."""
+        parts = [f"step={self.step}", f"wall_ms={self.wall_s * 1e3:.2f}"]
+        if self.sps is not None:
+            parts.append(f"sps={self.sps:.1f}")
+        if self.loss is not None:
+            parts.append(f"loss={self.loss:.6g}")
+        for stage in sorted(self.stages):
+            d = self.stages[stage]
+            parts.append(f"{stage}={d['count']}x{d['ms']:.2f}ms")
+        if self.overlaps is not None:
+            for k in ("head", "tail", "cross"):
+                o = self.overlaps.get(k)
+                if o and o.get("overlapped"):
+                    parts.append(f"{k}_overlap_ms={o['overlap_ms']}")
+        return "bps.stats " + " ".join(parts)
+
+    def to_dict(self) -> dict:
+        d = {"step": self.step, "wall_ms": round(self.wall_s * 1e3, 3)}
+        if self.sps is not None:
+            d["sps"] = round(self.sps, 2)
+        if self.samples is not None:
+            d["samples"] = self.samples
+        if self.loss is not None:
+            d["loss"] = self.loss
+        if self.stages:
+            d["stages"] = self.stages
+        if self.overlaps is not None:
+            d["overlaps"] = self.overlaps
+        return d
+
+
+class StepStatsEmitter:
+    """Builds + emits StepStats from the trainer's step loop.
+
+    The per-step cost with ``BPS_STATS=1`` and no trace window is one
+    ``stage_totals()`` sweep of the registry (a dozen histogram reads)
+    plus a dict diff — host-side microseconds, gauged by the bench's
+    on/off A/B. Overlap aggregates run only while the timeline is in
+    its trace window (bounded snapshot)."""
+
+    def __init__(self, stats_file: Optional[str] = None,
+                 every: Optional[int] = None, window: int = 256,
+                 logger=None) -> None:
+        from ..common.logging import get_logger
+        self._log = logger or get_logger()
+        self._file = (stats_file if stats_file is not None
+                      else os.environ.get("BPS_STATS_FILE") or None)
+        if every is None:
+            every = int(os.environ.get("BPS_STATS_EVERY", "50") or 50)
+        self._every = max(1, every)
+        self.recent = deque(maxlen=window)
+        self._prev = _metrics.get_registry().stage_totals()
+        self._lock = threading.Lock()
+        # always-on default must not spam consoles: the per-step line
+        # is INFO only when the operator explicitly asked for stats
+        explicit = (os.environ.get("BPS_STATS", "").strip().lower()
+                    in _TRUE) or self._file is not None
+        self._level = logging.INFO if explicit else logging.DEBUG
+        self._steps = 0
+        # separate warn-once flags: an emission hiccup must not silence
+        # the dump path's first real failure (or vice versa)
+        self._warned_step = False
+        self._warned_flush = False
+
+    def on_step(self, step: int, wall_s: float, loss=None,
+                samples: Optional[int] = None,
+                timeline=None) -> Optional[StepStats]:
+        """Record one completed step. ``loss`` must already be host-side
+        (or None) — callers on async dispatch paths pass None rather
+        than forcing a device sync.
+
+        Never raises: observability I/O (a full disk, an unwritable
+        BPS_STATS_FILE dir) must not crash the training step it
+        observes — failures log one WARNING and stats go quiet."""
+        try:
+            return self._on_step(step, wall_s, loss=loss,
+                                 samples=samples, timeline=timeline)
+        except Exception as e:    # noqa: BLE001 — see docstring
+            if not self._warned_step:
+                self._warned_step = True
+                self._log.warning(
+                    "StepStats emission failed (%s: %s) — emission is "
+                    "still attempted each step, but further failures "
+                    "are silent", type(e).__name__, e)
+            return None
+
+    def _on_step(self, step: int, wall_s: float, loss=None,
+                 samples: Optional[int] = None,
+                 timeline=None) -> Optional[StepStats]:
+        if not _metrics.metrics_enabled():
+            return None
+        reg = _metrics.get_registry()
+        cur = reg.stage_totals()
+        with self._lock:
+            prev, self._prev = self._prev, cur
+        stages: Dict[str, dict] = {}
+        for stage, (count, tot) in cur.items():
+            pc, pt = prev.get(stage, (0, 0.0))
+            if count > pc:
+                stages[stage] = {"count": count - pc,
+                                 "ms": round((tot - pt) * 1e3, 3)}
+        overlaps = None
+        if timeline is not None and getattr(timeline, "enabled", False) \
+                and timeline._active():
+            snap = timeline.snapshot()
+            if snap:
+                # aggregate the NEWEST step tag present in the trace —
+                # the tag base differs from the trainer's step count
+                # per path (ambient tags lag one step; cross-step tags
+                # are the driver epoch), so the trace's own tagging is
+                # the only safe key. Pipelines record a step's
+                # straggler spans late; its tail/cross overlap appears
+                # once those spans land (typically the next record).
+                from ..telemetry import _step_of
+                newest = max(_step_of(e) for e in snap)
+                overlaps = overlap_stats(snap, wall_s, step=newest)
+        # float() of a jax scalar costs ~0.5 ms even when the value is
+        # ready — convert only when something will consume it (the log
+        # line fires, or the rolling dump is armed); the silent
+        # always-on default must not pay it per step
+        if loss is not None and (self._file is not None
+                                 or self._log.isEnabledFor(self._level)):
+            try:
+                loss = float(loss)
+            except TypeError:
+                loss = None
+        else:
+            loss = None
+        st = StepStats(
+            step=step, wall_s=wall_s, loss=loss, samples=samples,
+            sps=(samples / wall_s if samples and wall_s > 0 else None),
+            stages=stages, overlaps=overlaps)
+        reg.histogram("step/wall_s").observe(wall_s)
+        reg.counter("step/count").inc()
+        if self._log.isEnabledFor(self._level):
+            self._log.log(self._level, "%s", st.line())
+        with self._lock:
+            self.recent.append(st)
+            self._steps += 1
+            due = self._file is not None and self._steps % self._every == 0
+        if due:
+            self.flush()
+        return st
+
+    def flush(self) -> None:
+        """Dump the rolling window to ``BPS_STATS_FILE`` (atomic).
+        Swallows I/O failures with one WARNING — a full disk at the
+        shutdown flush must not mask the run's real exit path."""
+        if self._file is None:
+            return
+        with self._lock:
+            payload = {"schema": SCHEMA,
+                       "steps": [s.to_dict() for s in self.recent]}
+        try:
+            tmp = f"{self._file}.tmp"
+            d = os.path.dirname(self._file)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self._file)
+        except OSError as e:
+            if not self._warned_flush:
+                self._warned_flush = True
+                self._log.warning(
+                    "StepStats dump to %s failed (%s) — dumps are "
+                    "still attempted, but further failures are silent",
+                    self._file, e)

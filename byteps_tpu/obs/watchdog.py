@@ -1,0 +1,150 @@
+"""Stall watchdog for the asynchronous sync-PS pipeline.
+
+The cross-step pipeline created a new failure mode: a single lost pull
+leaves its PS key's admission gate held forever, every later round's
+push for that key queues behind it, and the job wedges SILENTLY — no
+exception, no progress, nothing in the logs. The reference's van
+aborts the process on a dead connection; our transport retries, so a
+wedge that outlives the retries needs an observer.
+
+``StallWatchdog`` polls an exchange-like target: when the target has
+in-flight buckets and none has completed for ``stall_sec`` seconds, it
+snapshots the per-key exchange state (round, landed/missing buckets,
+admission-gate holders and queued waiters) via ``debug_state()`` and
+dumps it loudly — once per stall period, re-armed by progress — so the
+operator (or the fault-injection harness) sees WHICH key wedged and
+what the gate was waiting on instead of a hung process.
+
+Enabled via ``BPS_WATCHDOG_SEC`` (``PSGradientExchange`` starts one
+alongside its pipeline executors); tests drive it directly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from . import metrics as _metrics
+
+
+def format_dump(state: dict, stalled_s: float) -> str:
+    """Render ``debug_state()`` as the loud multi-line diagnostic."""
+    lines = [
+        f"PS exchange stalled: no bucket completed for {stalled_s:.1f}s "
+        f"with {state.get('in_flight', '?')} bucket(s) in flight",
+    ]
+    for r in state.get("rounds", ()):
+        lines.append(
+            f"  round name={r.get('name')!r} step={r.get('step')} "
+            f"seq={r.get('seq')} pulls_left={r.get('pulls_left')}")
+        for b in r.get("buckets", ()):
+            st = b.get("state")
+            mark = ""
+            if st == "pushed":
+                mark = "  <-- pushed, pull never completed (wedge)"
+            elif st == "failed":
+                mark = "  <-- failed"
+            lines.append(
+                f"    key={b.get('pskey')} round={b.get('round')} "
+                f"state={st}{mark}")
+    adm = state.get("admission", {})
+    busy = adm.get("busy", ())
+    if busy:
+        lines.append(f"  admission gate held by keys: {sorted(busy)}")
+    waiters = adm.get("waiters", {})
+    for k, n in sorted(waiters.items()):
+        lines.append(f"    key={k}: {n} queued push(es) waiting on the "
+                     f"gate holder's pull")
+    if any(b.get("state") == "pushed"
+           for r in state.get("rounds", ()) for b in r.get("buckets", ())):
+        lines.append(
+            "  a pushed-but-never-pulled bucket above is the wedge: its "
+            "pull was lost (server death past the reconnect budget, or a "
+            "peer that never pushed its share) and the per-key admission "
+            "gate cannot release without it")
+    else:
+        lines.append(
+            "  no bucket reached the wire yet: the stall is upstream of "
+            "the exchange (a push blocked in the transport, or pushes "
+            "queued behind the admission gate)")
+    return "\n".join(lines)
+
+
+class StallWatchdog:
+    """Background stall detector over one exchange-like target.
+
+    ``target`` must expose ``progress_state() -> (last_progress_ts,
+    in_flight_buckets)`` — the timestamp on the MONOTONIC clock
+    (``time.monotonic()``), so an NTP wall-clock step can neither fake
+    a stall nor hide one — and ``debug_state() -> dict``. ``on_dump``
+    (tests, external telemetry) receives ``(state_dict, stalled_s)``
+    after the log line is emitted."""
+
+    def __init__(self, target, stall_sec: float,
+                 poll_sec: Optional[float] = None, logger=None,
+                 on_dump: Optional[Callable] = None) -> None:
+        from ..common.logging import get_logger
+        self._target = target
+        self.stall_sec = float(stall_sec)
+        self._poll = poll_sec if poll_sec is not None \
+            else max(0.05, min(1.0, self.stall_sec / 4))
+        self._log = logger or get_logger()
+        self._on_dump = on_dump
+        self._stop = threading.Event()
+        self.dumps = 0                   # diagnostics emitted so far
+        self.last_dump: Optional[dict] = None
+        self._next_allowed = 0.0
+        self._thread = threading.Thread(
+            target=self._run, name="bps-watchdog", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+    # ------------------------------------------------------------ loop
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll):
+            try:
+                self._check()
+            except Exception:   # noqa: BLE001 — a watchdog must never
+                pass            # kill (or be killed by) the pipeline
+
+    def _check(self) -> None:
+        last_progress, in_flight = self._target.progress_state()
+        now = time.monotonic()
+        if not in_flight:
+            return
+        stalled = now - last_progress
+        if stalled < self.stall_sec or now < self._next_allowed:
+            return
+        state = self._target.debug_state()
+        # an exchange wedge needs wire involvement: at least one bucket
+        # pushed (its pull is what's lost) or pushes queued behind the
+        # admission gate. In-flight rounds whose buckets are ALL still
+        # "pending" with an idle gate are upstream latency — e.g. the
+        # cross-step driver opens its ingest round before the first
+        # gated backward segment even runs, and a long first segment
+        # must not read as a per-step false-positive wedge dump
+        rounds = state.get("rounds", ())
+        wired = any(b.get("state") in ("pushed", "pulled", "failed")
+                    for r in rounds for b in r.get("buckets", ()))
+        if not wired and not state.get("admission", {}).get("waiters"):
+            return
+        # progress may have landed between the two reads — re-check so
+        # a racing completion can't produce a spurious dump
+        last2, in_flight2 = self._target.progress_state()
+        if last2 != last_progress or not in_flight2:
+            return
+        self._next_allowed = now + self.stall_sec   # once per stall period
+        self.dumps += 1
+        self.last_dump = state
+        _metrics.get_registry().counter("watchdog/dumps").inc()
+        self._log.error("%s", format_dump(state, stalled))
+        if self._on_dump is not None:
+            try:
+                self._on_dump(state, stalled)
+            except Exception:   # noqa: BLE001 — observer must not kill us
+                pass

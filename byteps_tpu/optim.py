@@ -257,8 +257,14 @@ class ChunkedApply:
         after installing the returned leaves wherever gated readers
         look them up — marking at dispatch would open a window where a
         gate observes the epoch but still reads the pre-apply array."""
+        import time
+        from .obs.metrics import observe_stage
+        t0 = time.time()
         new, self.states[gi] = self._apply(params_list, self.states[gi],
                                            grads_list)
+        # dispatch latency of the per-group apply (the same span the
+        # PS_APPLY_CHUNK timeline rows show) — always-on
+        observe_stage("PS_APPLY_CHUNK", time.time() - t0)
         return new
 
     def mark_epoch(self, leaf_ids, epoch: int) -> None:

@@ -22,6 +22,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..obs.metrics import metrics_enabled
+
 _DTYPES = {"float32": 0, "float64": 1, "int32": 2, "int64": 3,
            "float16": 4, "bfloat16": 5, "uint8": 6}
 
@@ -159,6 +161,7 @@ class PSServer:
         if not self._h:
             raise RuntimeError("bps_server_create failed")
         self.num_workers = num_workers
+        self.engine_threads = engine_threads
         self.async_mode = async_mode
         # close() may race concurrent callers (transport handler threads
         # blocked in pull): a Python-side inflight count plus the native
@@ -357,6 +360,11 @@ class PSServer:
         finally:
             self._exit()
 
+    def queue_depth(self) -> int:
+        """Total enqueued-but-unsummed pushes across the engine's sticky
+        per-key threads — the server-side backlog gauge."""
+        return sum(self.engine_load(t) for t in range(self.engine_threads))
+
     def key_thread(self, key: int) -> int:
         self._enter()
         try:
@@ -391,6 +399,11 @@ class HostPSBackend:
         self._rs_cols: Dict[int, int] = {}   # row-sparse: pinned cols/key
         from .compressed import CompressedKeyStore
         self.compressed = CompressedKeyStore()
+        from ..obs.metrics import get_registry
+        self._m_pull_wait = get_registry().histogram("server/pull_wait_s")
+        self._m_queue_depth = get_registry().gauge(
+            "server/engine_queue_depth")
+        self._qd_next_sample = 0.0
 
     def close(self) -> None:
         for s in self.servers:
@@ -421,11 +434,34 @@ class HostPSBackend:
                               self._shard_bytes, self.hash_fn)
 
     def push(self, key: int, data: np.ndarray) -> None:
+        import time
         self._shard(key).push(key, data)
+        # server-side backlog: how far the summation engine is behind
+        # the pushes (the reference's engine_load). RATE-LIMITED — the
+        # sample is engine_threads locked ctypes calls per shard, and a
+        # per-push cadence measurably taxed small-step pipelines
+        if metrics_enabled():
+            now = time.time()
+            if now >= self._qd_next_sample:
+                self._qd_next_sample = now + 0.05
+                try:
+                    self._m_queue_depth.set(self.queue_depth())
+                except Exception:   # noqa: BLE001 — the push LANDED; a
+                    pass            # metrics read racing close() must
+                    #                 not fail the data plane after it
+
+    def queue_depth(self) -> int:
+        """Enqueued-but-unsummed pushes across every shard's engine."""
+        return sum(s.queue_depth() for s in self.servers)
 
     def pull(self, key: int, out: np.ndarray, round: int = 0,
              timeout_ms: int = 30000) -> None:
+        import time
+        t0 = time.time()
         self._shard(key).pull(key, out, round, timeout_ms)
+        # how long the merge took to publish from this worker's view —
+        # server sum time plus the wait for the other workers' pushes
+        self._m_pull_wait.observe(time.time() - t0)
 
     def round(self, key: int) -> int:
         """Latest COMPLETED sync round for ``key`` (0 = none yet) — lets

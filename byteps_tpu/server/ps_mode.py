@@ -34,6 +34,7 @@ import numpy as np
 
 from ..common.naming import NameRegistry
 from ..common.partition import LeafSpec, plan_buckets
+from ..obs.metrics import get_registry, observe_stage
 from .engine import HostPSBackend
 
 
@@ -168,8 +169,15 @@ class _Round:
         self._pull_lock = threading.Lock()
         self._pull_err: Optional[BaseException] = None
         self._pull_done = threading.Event()
+        # per-bucket lifecycle for the watchdog's per-key diagnostic:
+        # pending -> pushed -> pulled (or failed). "pushed" forever is
+        # the wedge signature (a lost pull holding the admission gate).
+        self.bucket_state = ["pending"] * len(self.keyed)
+        self._finished = False
         if not self.keyed:
             self._pull_done.set()
+        else:
+            ex._register_round(self)
         self.aborted: Optional[BaseException] = None
         self.readyq = None
         if stream or ingest:
@@ -214,6 +222,7 @@ class _Round:
                 # on the leaf's D2H copy — only ITS OWN copy, per-leaf
                 self.flat[i] = np.ascontiguousarray(
                     np.asarray(self.sources[i])).reshape(-1)
+                observe_stage("PS_D2H", time.time() - t0)
                 if self.ex.timeline is not None:
                     self.ex.timeline.record(self.decl_name, "PS_D2H", t0,
                                             time.time() - t0, i,
@@ -260,6 +269,8 @@ class _Round:
             raise
         ex._record(self.decl_name, "PS_PUSH", pskey, t0,
                    step=self.step_tag)
+        self.bucket_state[idx] = "pushed"
+        ex._mark_progress()
         return buf
 
     def pull_one(self, idx: int, buf: np.ndarray) -> None:
@@ -286,6 +297,9 @@ class _Round:
                     merged[s.bucket_offset:s.bucket_offset + s.length]
         ex._record(self.decl_name, "PS_UNPACK", pskey, t0,
                    step=self.step_tag)
+        self.bucket_state[idx] = "pulled"
+        ex._m_buckets.inc()
+        ex._mark_progress()
         if self.readyq is not None:
             for s in b.segments:
                 self._segment_done(s.leaf_index)
@@ -319,6 +333,7 @@ class _Round:
         try:
             buf = self.push_one(idx)
         except BaseException as e:   # noqa: BLE001 — relayed to consumers
+            self.bucket_state[idx] = "failed"
             self.ex._release_key(pskey)
             self._pull_finished(e)
             return
@@ -337,7 +352,18 @@ class _Round:
             self._pulls_left -= 1
             done = self._pulls_left <= 0
         if done:
+            self._mark_finished()
             self._pull_done.set()
+
+    def _mark_finished(self) -> None:
+        """Terminal accounting for the rounds-in-flight gauge / watchdog
+        (idempotent: a drained round that is later abort()ed must not
+        double-decrement)."""
+        with self._pull_lock:
+            if self._finished:
+                return
+            self._finished = True
+        self.ex._m_rounds.dec()
 
     def drain(self):
         if getattr(self, "aborted", None) is not None:
@@ -388,6 +414,7 @@ class _Round:
                     f"{self.dtypes[li]}")
             if hasattr(v, "copy_to_host_async"):
                 v.copy_to_host_async()   # start D2H before any pack
+        self.ex._mark_progress()
         fire: List[int] = []
         with self.feed_lock:
             if self.feed_done:
@@ -416,6 +443,8 @@ class _Round:
 
     def abort(self, exc: BaseException) -> None:
         self.aborted = exc
+        if self.keyed:              # keep the in-flight gauge/watchdog
+            self._mark_finished()   # from counting a dead round forever
         self._pull_done.set()       # a drain() blocked on straggler
         if self.readyq is not None:  # pulls must wake and raise
             self.readyq.put(exc)
@@ -437,7 +466,8 @@ class PSGradientExchange:
     def __init__(self, backend: HostPSBackend, partition_bytes: int = 4 << 20,
                  registry: Optional[NameRegistry] = None,
                  min_compress_bytes: int = 65536,
-                 pipeline_depth: Optional[int] = None) -> None:
+                 pipeline_depth: Optional[int] = None,
+                 watchdog_sec: Optional[float] = None) -> None:
         self.backend = backend
         self.partition_bytes = partition_bytes
         self.registry = registry or NameRegistry()
@@ -478,15 +508,118 @@ class PSGradientExchange:
                 _lib()
             except Exception:   # noqa: BLE001 — toolchain-less install
                 self._native_pack = False
+        # observability: always-on registry handles (cached — the
+        # registry lookup is locked, the hot-path inc/observe is not)
+        # plus the stall watchdog (BPS_WATCHDOG_SEC>0), started with
+        # the first exchange so idle constructions stay thread-free
+        reg = get_registry()
+        self._m_push_bytes = reg.counter("ps/push_bytes")
+        self._m_pull_bytes = reg.counter("ps/pull_bytes")
+        self._m_buckets = reg.counter("ps/buckets_completed")
+        self._m_rounds = reg.gauge("ps/rounds_in_flight")
+        self._m_adm_wait = reg.histogram("ps/admission_wait_s")
+        self._m_adm_defer = reg.counter("ps/admission_deferred")
+        import time as _time
+        # MONOTONIC: an NTP step on the wall clock must neither fake a
+        # stall nor hide one (the watchdog diffs this against its own
+        # monotonic now)
+        self._progress_t = _time.monotonic()
+        self._live_rounds: List = []      # weakrefs, pruned on register
+        self._rounds_reg_lock = threading.Lock()
+        self._watchdog = None
+        # explicit arg (Config.watchdog_sec, wired by GlobalState and
+        # the trainer) wins; the env fallback covers directly-
+        # constructed exchanges (tests, scripts without bps.init)
+        self._watchdog_sec = (float(watchdog_sec)
+                              if watchdog_sec is not None else float(
+                                  os.environ.get("BPS_WATCHDOG_SEC", "0")
+                                  or 0))
 
     def close(self) -> None:
-        """Stop the pipeline executors (idempotent). bps.shutdown() calls
-        this — without it every init/shutdown cycle would strand
-        2×pipeline_depth idle threads."""
+        """Stop the pipeline executors and the watchdog (idempotent).
+        bps.shutdown() calls this — without it every init/shutdown
+        cycle would strand 2×pipeline_depth idle threads."""
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
         for ex in (self._push_ex, self._pull_ex):
             if ex is not None:
                 ex.shutdown(wait=False)
         self._push_ex = self._pull_ex = None
+
+    # -------------------------------------------- observability hooks
+
+    def _mark_progress(self) -> None:
+        """A bucket advanced (push landed / pull completed / leaf fed):
+        re-arm the stall watchdog's clock (monotonic — see __init__)."""
+        import time
+        self._progress_t = time.monotonic()
+
+    def _register_round(self, rnd: "_Round") -> None:
+        import weakref
+        with self._rounds_reg_lock:
+            alive = []
+            for ref in self._live_rounds:
+                r = ref()           # deref once: the target may be
+                if r is not None and not r._finished:   # GC'd between
+                    alive.append(ref)                   # two calls
+            alive.append(weakref.ref(rnd))
+            self._live_rounds = alive
+        self._m_rounds.inc()
+
+    def in_flight_buckets(self) -> int:
+        """Buckets of live rounds whose pull has not completed."""
+        n = 0
+        with self._rounds_reg_lock:
+            for ref in self._live_rounds:
+                r = ref()
+                if r is not None and not r._finished:
+                    n += max(0, r._pulls_left)
+        return n
+
+    def progress_state(self):
+        """(last progress MONOTONIC timestamp, in-flight bucket count)
+        — the StallWatchdog's poll target."""
+        return self._progress_t, self.in_flight_buckets()
+
+    def debug_state(self) -> dict:
+        """Per-key snapshot of the live exchange state: every unfinished
+        round's buckets (round number + pending/pushed/pulled/failed)
+        and the admission gate's holders and queued waiters — what the
+        watchdog dumps when the pipeline wedges."""
+        rounds = []
+        with self._rounds_reg_lock:
+            live = [r() for r in self._live_rounds]
+        for r in live:
+            if r is None or r._finished:
+                continue
+            rounds.append({
+                "name": r.decl_name,
+                "step": r.step_tag,
+                "seq": r.round_seq,
+                "pulls_left": r._pulls_left,
+                "buckets": [
+                    {"pskey": pskey, "round": r.rounds[i],
+                     "state": r.bucket_state[i]}
+                    for i, (pskey, _) in enumerate(r.keyed)],
+            })
+        with self._key_lock:
+            adm = {"busy": sorted(self._key_busy),
+                   "waiters": {k: len(v)
+                               for k, v in self._key_waiters.items()}}
+        return {"in_flight": self.in_flight_buckets(),
+                "rounds": rounds, "admission": adm}
+
+    def _ensure_watchdog(self) -> None:
+        if self._watchdog is not None or self._watchdog_sec <= 0:
+            return
+        from ..obs.watchdog import StallWatchdog
+        # locked check-and-create: two concurrent first exchanges must
+        # not each start a watchdog thread (close() could only ever
+        # stop the survivor)
+        with self._rounds_reg_lock:
+            if self._watchdog is None:
+                self._watchdog = StallWatchdog(self, self._watchdog_sec)
 
     def _plan(self, tree, name: Optional[str]):
         leaves, treedef = jax.tree_util.tree_flatten(tree)
@@ -562,9 +695,13 @@ class PSGradientExchange:
 
     def _record(self, name: str, stage: str, key: int, t0: float,
                 step: Optional[int] = None) -> float:
-        """Timeline helper; returns a fresh t0."""
+        """Timeline + stage-histogram helper; returns a fresh t0. The
+        histogram observation is ALWAYS on (the latency distributions
+        are the production signal); the timeline event only inside a
+        trace window."""
         import time
         now = time.time()
+        observe_stage(stage, now - t0)
         if self.timeline is not None:
             self.timeline.record(name, stage, t0, now - t0, key, step=step)
         return now
@@ -628,6 +765,7 @@ class PSGradientExchange:
             rnd.pull_one(idx, buf)
         except BaseException as e:   # noqa: BLE001 — relayed below
             exc = e
+            rnd.bucket_state[idx] = "failed"
         finally:
             self._release_key(pskey)
             rnd._pull_finished(exc)
@@ -637,10 +775,22 @@ class PSGradientExchange:
     def _admit_key(self, pskey: int, submit) -> None:
         """Run ``submit`` now if ``pskey`` has no pushed-but-unpulled
         bucket in flight, else defer it until that bucket's pull
-        completes (FIFO per key, so rounds stay ordered on the wire)."""
+        completes (FIFO per key, so rounds stay ordered on the wire).
+        Deferred admissions are counted and their wait timed — the
+        admission gate is where a lost pull turns into a silent wedge,
+        so its depth/latency are first-class signals."""
         with self._key_lock:
             if pskey in self._key_busy:
-                self._key_waiters.setdefault(pskey, deque()).append(submit)
+                import time
+                self._m_adm_defer.inc()
+                t0 = time.time()
+
+                def deferred(submit=submit, t0=t0):
+                    self._m_adm_wait.observe(time.time() - t0)
+                    submit()
+
+                self._key_waiters.setdefault(pskey,
+                                             deque()).append(deferred)
                 return
             self._key_busy.add(pskey)
         submit()
@@ -663,16 +813,21 @@ class PSGradientExchange:
             # COMPRESS stage right before PUSH (reference:
             # core_loops.cc:498-536): wire bytes are compressed; the
             # server decompresses, dense-sums, recompresses the merge
-            self.backend.push_bytes(pskey, chain.compress(buf))
+            payload = chain.compress(buf)
+            self._m_push_bytes.inc(len(payload))
+            self.backend.push_bytes(pskey, payload)
         else:
+            self._m_push_bytes.inc(buf.nbytes)
             self.backend.push(pskey, buf)
 
     def _pull_bucket(self, pskey, b, buf, rnd):
         chain = self._chains.get(pskey)
         if chain is not None:
             payload = self.backend.pull_bytes(pskey, round=rnd)
+            self._m_pull_bytes.inc(len(payload))
             return chain.decompress(payload).astype(b.dtype)
         self.backend.pull(pskey, buf, round=rnd)
+        self._m_pull_bytes.inc(buf.nbytes)
         return buf
 
     def exchange(self, tree, name: Optional[str] = None):
@@ -722,6 +877,7 @@ class PSGradientExchange:
         full pipeline: bwd(group k+1) ∥ D2H/push(group k) ∥ server-sum
         ∥ pull/H2D/apply."""
         self._ensure_executors()
+        self._ensure_watchdog()
         return _IngestExchange(_Round(self, template, name,
                                       stream=True, ingest=True,
                                       step=step))
@@ -740,6 +896,7 @@ class PSGradientExchange:
 
     def _exchange_impl(self, tree, name: Optional[str], detach: bool,
                        stream: bool = False):
+        self._ensure_watchdog()
         rnd = _Round(self, tree, name, stream=stream)
         for l in rnd.sources:            # start ALL D2H copies first so the
             if hasattr(l, "copy_to_host_async"):   # transfers overlap instead

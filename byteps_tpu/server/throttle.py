@@ -45,6 +45,10 @@ class TokenBucket:
         # time truncates accrual at the cap and silently paces BELOW
         # rate (measured 7× slow with a 64 KB burst and 50 ms sleeps)
         self._quantum = min(0.05, max(0.002, self.burst / self.rate / 2))
+        # cached metric handles: consume() is the pacing hot path
+        from ..obs.metrics import get_registry
+        self._m_stalls = get_registry().counter("nic/stalls")
+        self._m_stall_s = get_registry().histogram("nic/stall_s")
 
     def _refill(self) -> None:
         """Accrue tokens up to the burst cap (caller holds _lock)."""
@@ -55,7 +59,8 @@ class TokenBucket:
 
     def consume(self, n: int) -> None:
         left = float(n)
-        while left > 0:
+        t_stall = None          # set on first sleep: stall accounting
+        while left > 0:         # costs nothing on the no-wait fast path
             with self._lock:
                 self._refill()
                 take = min(left, self._tokens)
@@ -63,7 +68,12 @@ class TokenBucket:
                 left -= take
                 wait = left / self.rate if left > 0 else 0.0
             if wait > 0:
+                if t_stall is None:
+                    t_stall = time.monotonic()
                 time.sleep(min(wait, self._quantum))
+        if t_stall is not None:
+            self._m_stalls.inc()
+            self._m_stall_s.observe(time.monotonic() - t_stall)
 
     def try_consume(self, n: int) -> bool:
         """Deduct n tokens iff they are ALL available right now (no

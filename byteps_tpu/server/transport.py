@@ -437,6 +437,12 @@ class PSTransportServer:
         self._dedup_ttl = float(_os.environ.get(
             "BPS_PUSH_DEDUP_TTL_SECS", "600"))
         self._dedup_sweep_at = 0.0
+        # cached metric handles — _handle runs per request; a registry
+        # name lookup there is avoidable data-plane overhead
+        from ..obs.metrics import get_registry
+        self._m_requests = get_registry().counter("transport/requests")
+        self._m_merge_wait = get_registry().histogram(
+            "server/merge_wait_s")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -484,6 +490,7 @@ class PSTransportServer:
         """One request; backend errors become ST_ERR/ST_TIMEOUT responses
         (the connection survives — one bad request must not take down the
         worker's whole data plane)."""
+        self._m_requests.inc()
         try:
             if self._key_log and op in (OP_PUSH, OP_PULL, OP_PUSH_C,
                                         OP_PUSH_RS):
@@ -671,16 +678,22 @@ class PSTransportServer:
         """Round-blocked engine pull in WIRE dtype — the one transcode
         rule shared by OP_PULL and the striped fetch: a frame dtype
         narrower than the store downcasts on the way out."""
+        import time
+        t0 = time.time()
         elems = int(nbytes) // np.dtype(dtype).itemsize
         meta = self._key_meta.get(key)
         if meta is not None and meta[1] != dtype:
             store = np.empty(elems, dtype=meta[1])
             self.backend.pull(key, store, round=int(rnd),
                               timeout_ms=int(timeout) or 30000)
-            return store.astype(dtype)
-        out = np.empty(elems, dtype=dtype)
-        self.backend.pull(key, out, round=int(rnd),
-                          timeout_ms=int(timeout) or 30000)
+            out = store.astype(dtype)
+        else:
+            out = np.empty(elems, dtype=dtype)
+            self.backend.pull(key, out, round=int(rnd),
+                              timeout_ms=int(timeout) or 30000)
+        # server-side merge wait: sum time + the lag of the slowest
+        # worker's push — the transport server's bottleneck signal
+        self._m_merge_wait.observe(time.time() - t0)
         return out
 
     _STRIPE_TTL_SECS = 120.0
@@ -1025,6 +1038,8 @@ class RemotePSBackend:
         import time as _time
 
         from ..common.logging import get_logger
+        from ..obs.metrics import get_registry
+        get_registry().counter("transport/reconnects").inc()
         delay = 0.1
         while True:
             try:
@@ -1106,10 +1121,14 @@ class RemotePSBackend:
         except (ConnectionError, OSError):
             if self.reconnect_secs <= 0:
                 raise
+            from ..obs.metrics import get_registry
             deadline = _time.time() + self.reconnect_secs
             while True:
                 try:
                     self._reconnect(i, ch, deadline)
+                    # the request is re-sent whole on the fresh channel
+                    # (push dedup keeps it exactly-once server-side)
+                    get_registry().counter("transport/resends").inc()
                     return self._roundtrip(ch.sock, op, key, rnd, nbytes,
                                            timeout_ms, dtype, payload,
                                            recv_into=recv_into)

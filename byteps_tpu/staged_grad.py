@@ -50,6 +50,7 @@ import numpy as np
 from jax import core as jcore
 
 from .common.logging import get_logger
+from .obs.metrics import get_registry as _registry
 
 log = get_logger()
 
@@ -175,6 +176,7 @@ class StagedGrad:
             loss = env[self._loss_var] if seg.emits_loss else None
             for v in seg.free_after:    # residuals dead past this point:
                 env.pop(v, None)        # don't pin activation memory
+            _registry().counter("staged/segments_run").inc()
             yield SegmentResult(si, seg.emit_leaves, grads, loss, t0, dur)
 
 
@@ -312,6 +314,25 @@ def build_staged_grad(loss_fn: Callable, params, batch,
                       name: str = "loss",
                       forward_cuts: bool = False) -> Optional[StagedGrad]:
     """Build a bit-exact staged backward for ``loss_fn``, or None.
+    Outcomes are counted (``staged/builds`` vs ``staged/build_fallback``)
+    so a fleet silently running monolithic heads is visible without
+    log scraping."""
+    st = _build_staged_grad_impl(loss_fn, params, batch, groups=groups,
+                                 fused_fn=fused_fn,
+                                 max_segments=max_segments, name=name,
+                                 forward_cuts=forward_cuts)
+    _registry().counter(
+        "staged/builds" if st is not None else "staged/build_fallback"
+    ).inc()
+    return st
+
+
+def _build_staged_grad_impl(loss_fn: Callable, params, batch,
+                            groups=None, fused_fn=None,
+                            max_segments: int = 4, name: str = "loss",
+                            forward_cuts: bool = False
+                            ) -> Optional[StagedGrad]:
+    """(See ``build_staged_grad``.)
 
     ``groups``: partition of the flat param-leaf indices (the exchange's
     ``leaf_groups``) — candidate cuts are placed where each group's last

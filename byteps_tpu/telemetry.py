@@ -19,9 +19,36 @@ class PushPullSpeed:
         self._events: Deque[Tuple[float, int]] = deque()  # (ts, nbytes)
 
     def record(self, nbytes: int, duration_s: float = 0.0) -> None:
+        """Record a completed transfer. ``duration_s`` BACK-DATES the
+        event to the transfer's start: booking all bytes at completion
+        time made a long transfer look like an instantaneous burst and
+        skewed ``mbps()`` for bursty windows (a 5 s push landing "now"
+        doubled the apparent rate of the last instant). A duration
+        longer than the window clamps to the window edge — the bytes
+        then read as sustained window-rate instead of vanishing from
+        the deque immediately."""
         now = time.time()
+        # clamp inside the window (not exactly at its edge): an event
+        # back-dated to precisely now-window would be evicted by the
+        # first reader a microsecond later, vanishing the bytes of any
+        # transfer longer than the window
+        ts = now - min(max(float(duration_s), 0.0), self._window * 0.99)
         with self._lock:
-            self._events.append((now, nbytes))
+            # back-dated events may land behind newer completions; keep
+            # the deque ts-ordered so _evict's head-pop stays correct.
+            # Scan from the TAIL — a transfer's start lies at most its
+            # duration behind the newest event, so the insert point is
+            # near the right end and the scan touches only the few
+            # events that completed while this one was in flight (a
+            # full-window list rebuild here would be O(n) per record
+            # on the transfer hot path)
+            if self._events and ts < self._events[-1][0]:
+                idx = len(self._events)
+                while idx > 0 and self._events[idx - 1][0] > ts:
+                    idx -= 1
+                self._events.insert(idx, (ts, nbytes))
+            else:
+                self._events.append((ts, nbytes))
             self._evict(now)
 
     def _evict(self, now: float) -> None:
@@ -50,15 +77,28 @@ class PushPullSpeed:
 
 def summarize_stages(events) -> dict:
     """Aggregate Chrome-trace events (Timeline.snapshot()/comm.json
-    ``traceEvents``) into ``{stage: {"count": n, "total_ms": ms}}``."""
+    ``traceEvents``) into ``{stage: {"count": n, "total_ms": ms}}``.
+
+    Tolerates degenerate traces (hand-written fixtures, foreign
+    producers, metadata events): entries without a ``name`` are
+    skipped, a missing ``dur`` counts as 0 — previously a KeyError."""
     out: dict = {}
     for e in events:
-        s = out.setdefault(e["name"], {"count": 0, "total_ms": 0.0})
+        name = e.get("name")
+        if name is None:
+            continue
+        s = out.setdefault(name, {"count": 0, "total_ms": 0.0})
         s["count"] += 1
-        s["total_ms"] += e["dur"] / 1e3
+        s["total_ms"] += e.get("dur", 0) / 1e3
     for s in out.values():
         s["total_ms"] = round(s["total_ms"], 3)
     return out
+
+
+def _step_of(e: dict) -> int:
+    """The event's step tag; 0 for events with missing/None ``args``
+    (degenerate traces must group deterministically, not raise)."""
+    return (e.get("args") or {}).get("step", 0)
 
 
 def exchange_tail_overlap(events) -> dict:
@@ -74,11 +114,13 @@ def exchange_tail_overlap(events) -> dict:
     pull_end: dict = {}
     tail_start: dict = {}
     for e in events:
-        step = e.get("args", {}).get("step", 0)
-        if e["name"] == "PS_PULL":
-            pull_end[step] = max(pull_end.get(step, 0), e["ts"] + e["dur"])
-        elif e["name"] in ("PS_H2D", "PS_APPLY_CHUNK"):
-            tail_start[step] = min(tail_start.get(step, 1 << 62), e["ts"])
+        step = _step_of(e)
+        if e.get("name") == "PS_PULL":
+            pull_end[step] = max(pull_end.get(step, 0),
+                                 e.get("ts", 0) + e.get("dur", 0))
+        elif e.get("name") in ("PS_H2D", "PS_APPLY_CHUNK"):
+            tail_start[step] = min(tail_start.get(step, 1 << 62),
+                                   e.get("ts", 0))
     best = None
     for step, first_tail in tail_start.items():
         if step in pull_end:
@@ -107,13 +149,15 @@ def cross_step_overlap(events) -> dict:
     bwd_start: dict = {}
     gate_ms = 0.0
     for e in events:
-        step = e.get("args", {}).get("step", 0)
-        if e["name"] in ("PS_APPLY_CHUNK", "PS_PULL", "PS_H2D"):
-            tail_end[step] = max(tail_end.get(step, 0), e["ts"] + e["dur"])
-        elif e["name"] == "PS_BWD_SEG":
-            bwd_start[step] = min(bwd_start.get(step, 1 << 62), e["ts"])
-        elif e["name"] == "PS_XSTEP_GATE":
-            gate_ms += e["dur"] / 1e3
+        step = _step_of(e)
+        if e.get("name") in ("PS_APPLY_CHUNK", "PS_PULL", "PS_H2D"):
+            tail_end[step] = max(tail_end.get(step, 0),
+                                 e.get("ts", 0) + e.get("dur", 0))
+        elif e.get("name") == "PS_BWD_SEG":
+            bwd_start[step] = min(bwd_start.get(step, 1 << 62),
+                                  e.get("ts", 0))
+        elif e.get("name") == "PS_XSTEP_GATE":
+            gate_ms += e.get("dur", 0) / 1e3
     best = None
     for step, first_bwd in bwd_start.items():
         if step - 1 in tail_end:
@@ -141,11 +185,13 @@ def exchange_head_overlap(events) -> dict:
     bwd_end: dict = {}
     comm_start: dict = {}
     for e in events:
-        step = e.get("args", {}).get("step", 0)
-        if e["name"] == "PS_BWD_SEG":
-            bwd_end[step] = max(bwd_end.get(step, 0), e["ts"] + e["dur"])
-        elif e["name"] in ("PS_D2H", "PS_PACK", "PS_PUSH"):
-            comm_start[step] = min(comm_start.get(step, 1 << 62), e["ts"])
+        step = _step_of(e)
+        if e.get("name") == "PS_BWD_SEG":
+            bwd_end[step] = max(bwd_end.get(step, 0),
+                                e.get("ts", 0) + e.get("dur", 0))
+        elif e.get("name") in ("PS_D2H", "PS_PACK", "PS_PUSH"):
+            comm_start[step] = min(comm_start.get(step, 1 << 62),
+                                   e.get("ts", 0))
     best = None
     for step, first_comm in comm_start.items():
         if step in bwd_end:

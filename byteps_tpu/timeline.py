@@ -53,6 +53,8 @@ class Timeline:
         self._t0 = time.time()
         self.step = 0
         self._profiling = False
+        self._flushed = False    # first flush truncates stale files;
+        #                          later flushes merge (see flush())
 
     def _active(self) -> bool:
         return (self.enabled and
@@ -98,17 +100,29 @@ class Timeline:
         step tag — cross-step pipelines record step k's straggler tail
         spans while the timeline has already advanced to k+1, and the
         per-step overlap aggregates need the true owner."""
-        if not self._active():
+        # gate on the event's TRUE owning step, not the ambient one: a
+        # cross-step straggler tail records step k's spans after the
+        # timeline advanced to k+1 — if k+1 left the trace window, an
+        # ambient gate would silently drop the final window step's tail
+        # (and the post-window flush-merge would have nothing to merge)
+        owner = self.step if step is None else step
+        if not (self.enabled and self.cfg.trace_start_step <= owner
+                <= self.cfg.trace_end_step):
             return
         with self._lock:
             self._events.append({
                 "name": stage, "ph": "X", "pid": key, "tid": 0,
                 "ts": int((start_s - self._t0) * 1e6), "dur": int(dur_s * 1e6),
-                "args": {"name": name,
-                         "step": self.step if step is None else step},
+                "args": {"name": name, "step": owner},
             })
 
-    def span(self, name: str, stage: str, key: int = 0):
+    def span(self, name: str, stage: str, key: int = 0,
+             step: Optional[int] = None):
+        """Context-manager form of ``record``. ``step`` passes through
+        to ``record(step=)`` — cross-step tail code paths using spans
+        would otherwise tag a straggler span with the AMBIENT (already
+        advanced) step and corrupt ``cross_step_overlap``'s per-step
+        grouping."""
         tl = self
 
         class _Span:
@@ -117,7 +131,8 @@ class Timeline:
                 return self
 
             def __exit__(self, *exc):
-                tl.record(name, stage, self.t, time.time() - self.t, key)
+                tl.record(name, stage, self.t, time.time() - self.t, key,
+                          step=step)
                 return False
 
         return _Span()
@@ -138,5 +153,22 @@ class Timeline:
         outdir = os.path.join(self.cfg.trace_dir, str(rank))
         os.makedirs(outdir, exist_ok=True)
         path = os.path.join(outdir, "comm.json")
+        # MERGE with THIS process's earlier flushes instead of
+        # truncating: flush() runs more than once per process (the
+        # end-of-window flush, then an exit-time flush carrying the
+        # cross-step pipeline's straggler tail spans recorded after
+        # trace_end_step+1) — a plain rewrite would overwrite the whole
+        # window with only the late events. The FIRST flush still
+        # truncates: a comm.json left by a previous run has a different
+        # t0 base, and merging it would double-count spans and pair
+        # stages across unrelated runs.
+        if self._flushed and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    prior = json.load(f).get("traceEvents", [])
+            except (OSError, ValueError):
+                prior = []      # unreadable/torn file: keep new events
+            events = prior + events
         with open(path, "w") as f:
             json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        self._flushed = True

@@ -25,10 +25,21 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .common.global_state import GlobalState
+from .obs.metrics import observe_stage
 from .optim import distributed_optimizer
 from .parallel.collectives import Reducer, psum_reducer
 from .parallel.mesh import data_axes, make_mesh
 from .parallel.sharding import spec_axes as _spec_axes
+
+
+def _batch_samples(batch) -> Optional[int]:
+    """Global sample count of a batch (leading axis of its first
+    non-scalar leaf) for StepStats throughput; None when unknowable."""
+    for leaf in jax.tree_util.tree_leaves(batch):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) >= 1:
+            return int(shape[0])
+    return None
 
 
 class DistributedTrainer:
@@ -225,7 +236,8 @@ class DistributedTrainer:
             from .server.ps_mode import PSGradientExchange
             self._ps_exchange = PSGradientExchange(
                 gs.ps_backend, partition_bytes=partition_bytes,
-                registry=gs.registry, min_compress_bytes=min_compress_bytes)
+                registry=gs.registry, min_compress_bytes=min_compress_bytes,
+                watchdog_sec=gs.config.watchdog_sec)
             self._ps_exchange.timeline = gs.timeline
             self._ps_world = eng.ps_world
             # streamed step tail (pull → H2D → chunked apply pipelined
@@ -466,6 +478,7 @@ class DistributedTrainer:
         if tl is not None:
             t0 = time.time()
             jax.block_until_ready(grads)
+            observe_stage("REDUCE_WAIT", time.time() - t0)
             tl.record(self._name, "REDUCE_WAIT", t0, time.time() - t0)
         if self._apply_chunked:
             loss2 = self._ps_step_streamed(grads, loss, tl)
@@ -476,6 +489,7 @@ class DistributedTrainer:
         # one whole-tree device_put, one fused apply
         t0 = time.time()
         summed = self._ps_exchange.exchange(grads, name=self._name)
+        observe_stage("PS_PUSH_PULL", time.time() - t0)
         if tl is not None:
             tl.record(self._name, "PS_PUSH_PULL", t0, time.time() - t0)
         if self._ps_world > 1:
@@ -603,6 +617,7 @@ class DistributedTrainer:
         loss = None
         try:
             for seg in self._staged.run(self.params, batch):
+                observe_stage("PS_BWD_SEG", seg.dur)
                 if tl is not None:
                     tl.record(self._name, "PS_BWD_SEG", seg.t0, seg.dur,
                               seg.index)
@@ -680,6 +695,7 @@ class DistributedTrainer:
             if world > 1:
                 a = a / world         # same host-side divide per leaf as
             d = jax.device_put(a, rep)  # the monolithic tail's tree_map
+            observe_stage("PS_H2D", time.time() - t0)
             if tl is not None:
                 tl.record(name, "PS_H2D", t0, time.time() - t0, li)
             return d
@@ -716,6 +732,7 @@ class DistributedTrainer:
                 t0 = time.time()
                 new_params, self.opt_state = self._apply_fn(
                     self.params, self.opt_state, gdev)
+                observe_stage("PS_APPLY_CHUNK", time.time() - t0)
                 if tl is not None:
                     tl.record(name, "PS_APPLY_CHUNK", t0,
                               time.time() - t0)
@@ -741,6 +758,7 @@ class DistributedTrainer:
             # from the live leaf list even on a mid-stream failure so
             # the trainer never holds invalidated buffers
             self.params = jax.tree_util.tree_unflatten(treedef, flat)
+            observe_stage("PS_PUSH_PULL", time.time() - t_ex)
             if tl is not None:
                 tl.record(name, "PS_PUSH_PULL", t_ex, time.time() - t_ex)
         return loss
@@ -782,7 +800,29 @@ class DistributedTrainer:
         return shard_batch(batch, self.mesh)
 
     def step(self, batch) -> jnp.ndarray:
-        """One training step on a (host or device) global batch; returns loss."""
+        """One training step on a (host or device) global batch; returns
+        loss. With stats enabled (``BPS_STATS``, default on) each step
+        also emits a ``StepStats`` record — wall time, per-stage deltas,
+        throughput — through ``GlobalState.stats``."""
+        gs = GlobalState._instance
+        em = gs.stats if gs is not None else None
+        if em is None:
+            return self._step_impl(batch)
+        t0 = time.time()
+        loss = self._step_impl(batch)
+        # PS/async paths are host-synchronous by construction, so their
+        # loss is already materialized and float() is free; the
+        # collective path dispatches asynchronously and floating its
+        # loss would add a per-step device sync — report None there
+        sync_loss = (self._ps_engine is not None
+                     or self._async_worker is not None)
+        em.on_step(self.step_count, time.time() - t0,
+                   loss=loss if sync_loss else None,
+                   samples=_batch_samples(batch),
+                   timeline=gs.timeline if gs is not None else None)
+        return loss
+
+    def _step_impl(self, batch) -> jnp.ndarray:
         if self._async_worker is not None:
             return self._async_ps_step(batch)
         if self._ps_engine is not None:
@@ -923,10 +963,18 @@ class ShardedTrainer:
         return shard_batch(batch, self.mesh, self.batch_spec)
 
     def step(self, batch):
+        gs = GlobalState._instance
+        em = gs.stats if gs is not None else None
+        t0 = time.time() if em is not None else 0.0
         batch = self.shard_batch(batch)
         self.params, self.opt_state, loss = self._step_fn(
             self.params, self.opt_state, batch)
         self.step_count += 1
+        if em is not None:
+            # loss is still in flight (async dispatch): None, not a sync
+            em.on_step(self.step_count, time.time() - t0,
+                       samples=_batch_samples(batch),
+                       timeline=gs.timeline)
         return loss
 
 

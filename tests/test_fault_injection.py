@@ -17,6 +17,7 @@ import threading
 import time
 
 import numpy as np
+import pytest
 
 from byteps_tpu.server.engine import PSServer
 from byteps_tpu.server.transport import PSTransportServer, RemotePSBackend
@@ -169,3 +170,41 @@ def test_exchange_survives_random_connection_kills(monkeypatch):
         proxy.close()
         srv.close()
         be.close()
+
+
+@pytest.mark.slow
+def test_watchdog_dumps_on_lost_peer_push(monkeypatch):
+    """Watchdog integration over the REAL transport: a 2-worker server
+    where the second worker never pushes is exactly the wedge the
+    cross-step architecture fears — this worker's pulls block on a
+    merge that can never publish, no bucket completes, and before this
+    PR the process just hung until the 30 s pull timeout with nothing
+    in the logs. With BPS_WATCHDOG_SEC set, the exchange's watchdog
+    must emit the per-key diagnostic (pushed-but-never-pulled buckets,
+    held admission gate) within ~the configured threshold."""
+    monkeypatch.delenv("BPS_ENABLE_SHM", raising=False)
+    monkeypatch.setenv("BPS_WATCHDOG_SEC", "0.5")
+    from byteps_tpu.server.ps_mode import PSGradientExchange
+
+    be = PSServer(num_workers=2, engine_threads=2)   # peer never arrives
+    srv = PSTransportServer(be, host="127.0.0.1", port=0)
+    w = RemotePSBackend([f"127.0.0.1:{srv.port}"], reconnect_secs=5)
+    ex = PSGradientExchange(w, partition_bytes=8 << 10, pipeline_depth=4)
+    tree = {"g": np.ones(6_000, np.float32)}
+    try:
+        ex.exchange_async(tree, name="lonely")
+        t0 = time.time()
+        while ex._watchdog is None or ex._watchdog.dumps == 0:
+            assert time.time() - t0 < 5.0, "watchdog never fired"
+            time.sleep(0.05)
+        assert time.time() - t0 < 3.0, "dump came far after the threshold"
+        dump = ex._watchdog.last_dump
+        states = [b["state"] for r in dump["rounds"]
+                  for b in r["buckets"]]
+        assert "pushed" in states, dump  # the wedge signature, per key
+        assert dump["admission"]["busy"], dump
+    finally:
+        ex.close()
+        srv.close()
+        be.close()
+        w.close()

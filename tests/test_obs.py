@@ -1,0 +1,541 @@
+"""Observability subsystem: metrics registry, StepStats, stall
+watchdog, multi-rank trace merge — plus the timeline/telemetry
+satellites (flush merge, span step tags, back-dated bandwidth events,
+degenerate-trace tolerance)."""
+
+import json
+import os
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.obs import metrics as obs_metrics
+from byteps_tpu.obs.merge_trace import main as merge_main, merge_traces
+from byteps_tpu.obs.stats import StepStatsEmitter, overlap_stats
+from byteps_tpu.obs.watchdog import StallWatchdog
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    """Each test starts from zeroed metrics with recording enabled."""
+    obs_metrics.configure(True)
+    obs_metrics.get_registry().reset()
+    yield
+    obs_metrics.configure(None)
+    obs_metrics.get_registry().reset()
+
+
+# ---------------------------------------------------------- registry
+
+def test_counter_gauge_histogram_basics():
+    reg = obs_metrics.get_registry()
+    c = reg.counter("t/c")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    g = reg.gauge("t/g")
+    g.set(3)
+    g.inc()
+    g.dec(2)
+    assert g.value == 2
+    h = reg.histogram("t/h")
+    for v in (0.001, 0.002, 0.004, 0.008):
+        h.observe(v)
+    s = h.summary()
+    assert s["count"] == 4
+    assert s["sum_ms"] == pytest.approx(15.0, rel=1e-6)
+    assert 0 < h.percentile(50) <= h.percentile(95) <= h.percentile(99)
+    assert h.percentile(99) <= 0.008 + 1e-12   # clamped to observed max
+
+
+def test_registry_type_pinning_and_reuse():
+    reg = obs_metrics.get_registry()
+    c = reg.counter("t/pin")
+    assert reg.counter("t/pin") is c
+    with pytest.raises(TypeError):
+        reg.gauge("t/pin")
+
+
+def test_disabled_recording_is_noop():
+    reg = obs_metrics.get_registry()
+    obs_metrics.configure(False)
+    reg.counter("t/off").inc()
+    reg.gauge("t/offg").set(9)
+    reg.histogram("t/offh").observe(1.0)
+    obs_metrics.observe_stage("PS_PUSH", 1.0)
+    assert reg.counter("t/off").value == 0
+    assert reg.gauge("t/offg").value == 0
+    assert reg.histogram("t/offh").count == 0
+    assert reg.stage("PS_PUSH").count == 0
+
+
+def test_every_doc_stage_has_registry_histogram():
+    """Acceptance: every stage named in docs/timeline.md's stage table
+    has a corresponding pre-registered histogram."""
+    doc = open(os.path.join(os.path.dirname(__file__), "..",
+                            "docs", "timeline.md")).read()
+    table_stages = set()
+    for line in doc.splitlines():
+        if not line.startswith("| `"):
+            continue
+        head = line.split("|")[1]     # the stage column only
+        table_stages.update(re.findall(r"`([A-Z][A-Z0-9_]+)`", head))
+    assert table_stages, "stage table not found in docs/timeline.md"
+    names = set(obs_metrics.get_registry().names())
+    missing = {s for s in table_stages if f"stage/{s}" not in names}
+    assert not missing, f"stages without histograms: {sorted(missing)}"
+
+
+# ---------------------------------------------------------- StepStats
+
+def test_stepstats_deltas_line_and_rolling_dump(tmp_path):
+    path = str(tmp_path / "stats.json")
+    em = StepStatsEmitter(stats_file=path, every=2)
+    obs_metrics.observe_stage("PS_PUSH", 0.010)
+    obs_metrics.observe_stage("PS_PUSH", 0.010)
+    st1 = em.on_step(1, 0.05, loss=1.25, samples=8)
+    assert st1.stages["PS_PUSH"]["count"] == 2
+    assert st1.stages["PS_PUSH"]["ms"] == pytest.approx(20.0, rel=1e-6)
+    assert st1.sps == pytest.approx(160.0)
+    line = st1.line()
+    assert "step=1" in line and "PS_PUSH=2x" in line and "loss=1.25" in line
+    # second step saw NO new pushes: the delta must be empty, not the
+    # cumulative total again
+    st2 = em.on_step(2, 0.05)
+    assert "PS_PUSH" not in st2.stages
+    data = json.load(open(path))          # step 2 hit the every=2 dump
+    assert data["schema"].startswith("byteps_tpu.StepStats")
+    assert [s["step"] for s in data["steps"]] == [1, 2]
+    em.flush()
+    assert json.load(open(path))["steps"][0]["stages"]["PS_PUSH"]["count"] == 2
+
+
+def _synthetic_trace():
+    """Two steps of a staged+cross pipeline with known overlaps."""
+    ev = []
+
+    def x(name, ts, dur, step, pid=0):
+        ev.append({"name": name, "ph": "X", "pid": pid, "tid": 0,
+                   "ts": ts, "dur": dur, "args": {"name": "g",
+                                                  "step": step}})
+    # step 1: bwd 0-100, push starts 50 (head overlap 50); pull ends
+    # 300; h2d starts 250 (tail overlap 50); apply tail runs to 400
+    x("PS_BWD_SEG", 0, 100, 1)
+    x("PS_PUSH", 50, 40, 1)
+    x("PS_PULL", 200, 100, 1)
+    x("PS_H2D", 250, 20, 1)
+    x("PS_APPLY_CHUNK", 350, 50, 1)
+    # step 2's first backward segment starts at 360 — while step 1's
+    # apply (350-400) still runs: cross overlap 40
+    x("PS_XSTEP_GATE", 355, 5, 2)
+    x("PS_BWD_SEG", 360, 100, 2)
+    return ev
+
+
+def test_stepstats_overlaps_agree_with_telemetry_aggregators():
+    """Acceptance: StepStats' overlap blocks are byte-identical to the
+    telemetry aggregators run on the same trace."""
+    from byteps_tpu.telemetry import (cross_step_overlap,
+                                      exchange_head_overlap,
+                                      exchange_tail_overlap)
+    events = _synthetic_trace()
+    o = overlap_stats(events, wall_s=0.4)
+    assert o["head"] == exchange_head_overlap(events)
+    assert o["tail"] == exchange_tail_overlap(events)
+    assert o["cross"] == cross_step_overlap(events)
+    assert o["head"]["overlapped"] and o["tail"]["overlapped"] \
+        and o["cross"]["overlapped"]
+    assert o["head_frac"] == pytest.approx(
+        o["head"]["overlap_ms"] / 400.0, abs=5e-5)   # frac rounds to 4dp
+
+
+def test_trainer_step_emits_stepstats(tmp_path, monkeypatch):
+    """End to end: a PS-mode trainer step lands in the emitter's window
+    and the rolling dump, with PS stage deltas attached."""
+    path = str(tmp_path / "roll.json")
+    monkeypatch.setenv("BPS_ENABLE_PS", "1")
+    monkeypatch.setenv("BPS_STATS", "1")
+    monkeypatch.setenv("BPS_STATS_FILE", path)
+    monkeypatch.setenv("BPS_STATS_EVERY", "1")
+    import optax
+
+    import byteps_tpu as bps
+    from byteps_tpu.common.global_state import GlobalState
+    from byteps_tpu.models.mlp import mlp_init, mlp_loss
+    from byteps_tpu.parallel.mesh import make_mesh
+    from byteps_tpu.training import DistributedTrainer
+
+    bps.init(config=bps.Config.from_env())
+    import jax
+    mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 16).astype(np.float32)
+    params = mlp_init(jax.random.PRNGKey(0), 16, 2)
+    tr = DistributedTrainer(mlp_loss, params, optax.sgd(1e-2), mesh=mesh,
+                            name="obs-e2e")
+    try:
+        for _ in range(2):
+            float(tr.step((x, np.tanh(x))))
+        tr.drain()
+        em = GlobalState.get().stats
+        assert em is not None and len(em.recent) == 2
+        last = em.recent[-1]
+        assert last.loss is not None and last.sps is not None
+        assert any(s.startswith("PS_") for s in last.stages), last.stages
+        steps = json.load(open(path))["steps"]
+        assert steps and steps[-1]["wall_ms"] > 0
+    finally:
+        tr.close()
+        bps.shutdown()
+
+
+# ----------------------------------------------------------- watchdog
+
+class _WedgedBackend:
+    """In-memory PS backend whose pull for ``wedge_key`` blocks until
+    released — the lost-pull failure mode, injected deterministically."""
+
+    def __init__(self, wedge_key=None):
+        self.store = {}
+        self.wedge_key = wedge_key
+        self.release = threading.Event()
+
+    def init_key(self, key, nbytes, dtype="float32", init=None,
+                 compression=None):
+        self.store[key] = np.zeros(nbytes // np.dtype(dtype).itemsize,
+                                   dtype)
+
+    def push(self, key, data):
+        self.store[key] = np.array(data, copy=True)
+
+    def pull(self, key, out, round=0, timeout_ms=30000):
+        if key == self.wedge_key and not self.release.wait(timeout_ms / 1e3):
+            raise TimeoutError(f"pull({key}) wedged")
+        out[:] = self.store[key]
+
+    def round(self, key):
+        return 0
+
+
+def test_watchdog_unit_fires_and_rearms():
+    class Target:
+        def __init__(self):
+            self.t = time.monotonic()    # progress_state contract is
+            #                              the monotonic clock
+
+        def progress_state(self):
+            return self.t, 2
+
+        def debug_state(self):
+            return {"in_flight": 2, "rounds": [
+                {"name": "g", "step": 1, "seq": 1, "pulls_left": 2,
+                 "buckets": [{"pskey": 7, "round": 3,
+                              "state": "pushed"}]}],
+                "admission": {"busy": [7], "waiters": {}}}
+
+    tgt = Target()
+    dumps = []
+    wd = StallWatchdog(tgt, stall_sec=0.15, poll_sec=0.03,
+                       on_dump=lambda s, stalled: dumps.append(stalled))
+    try:
+        time.sleep(0.1)
+        assert not dumps                 # not stalled long enough yet
+        time.sleep(0.15)
+        assert len(dumps) == 1           # fired once...
+        tgt.t = time.monotonic()         # ...progress re-arms it
+        time.sleep(0.1)
+        assert len(dumps) == 1
+    finally:
+        wd.stop()
+    assert obs_metrics.get_registry().counter("watchdog/dumps").value == 1
+
+
+def test_watchdog_silent_while_nothing_on_the_wire():
+    """An ingest round opened before the first gated backward segment
+    has all-pending buckets and an idle admission gate — a long first
+    segment must NOT read as a wedge (false-positive regression)."""
+    class Target:
+        t = time.monotonic() - 60
+
+        def progress_state(self):
+            return self.t, 3
+
+        def debug_state(self):
+            return {"in_flight": 3, "rounds": [
+                {"name": "g", "step": 1, "seq": 1, "pulls_left": 3,
+                 "buckets": [{"pskey": 7, "round": None,
+                              "state": "pending"}]}],
+                "admission": {"busy": [], "waiters": {}}}
+
+    dumps = []
+    wd = StallWatchdog(Target(), stall_sec=0.1, poll_sec=0.03,
+                       on_dump=lambda s, stalled: dumps.append(s))
+    try:
+        time.sleep(0.3)
+        assert not dumps
+    finally:
+        wd.stop()
+
+
+def test_watchdog_detects_wedged_pull_in_exchange(monkeypatch):
+    """Acceptance: an injected wedged pull produces a per-key diagnostic
+    within BPS_WATCHDOG_SEC, naming the pushed-but-never-pulled bucket."""
+    monkeypatch.setenv("BPS_WATCHDOG_SEC", "0.3")
+    from byteps_tpu.server.ps_mode import PSGradientExchange
+
+    be = _WedgedBackend()
+    ex = PSGradientExchange(be, partition_bytes=4 << 10, pipeline_depth=2)
+    tree = {"a": np.ones(2048, np.float32), "b": np.ones(2048, np.float32)}
+    try:
+        # plan first so the wedge key (second bucket) is knowable
+        ex.plan_for(tree, name="wedge")
+        keys = [k for k, _ in ex._plans[next(iter(ex._plans))][2]]
+        assert len(keys) >= 2
+        be.wedge_key = keys[-1]
+        h = ex.exchange_async(tree, name="wedge")
+        t0 = time.time()
+        while ex._watchdog is None or ex._watchdog.dumps == 0:
+            assert time.time() - t0 < 5.0, "watchdog never fired"
+            time.sleep(0.02)
+        # fired within ~BPS_WATCHDOG_SEC of the wedge (generous CI slack)
+        assert time.time() - t0 < 3.0
+        dump = ex._watchdog.last_dump
+        wedged = [b for r in dump["rounds"] for b in r["buckets"]
+                  if b["pskey"] == be.wedge_key]
+        assert wedged and wedged[0]["state"] == "pushed"
+        assert be.wedge_key in dump["admission"]["busy"]
+        be.release.set()                 # unwedge; the round completes
+        out = h.result()
+        np.testing.assert_allclose(out["a"], 1.0)
+    finally:
+        be.release.set()
+        ex.close()
+    assert ex._watchdog is None          # close() stopped it
+
+
+def test_exchange_metrics_and_gauge_balance():
+    """Bytes/bucket counters tick and rounds_in_flight returns to 0."""
+    from byteps_tpu.server.ps_mode import PSGradientExchange
+
+    reg = obs_metrics.get_registry()
+    be = _WedgedBackend()
+    ex = PSGradientExchange(be, partition_bytes=4 << 10, pipeline_depth=2)
+    tree = {"a": np.ones(2048, np.float32)}
+    try:
+        out = ex.exchange(tree, name="bal")
+        np.testing.assert_allclose(out["a"], 1.0)
+    finally:
+        ex.close()
+    nbytes = 2048 * 4
+    assert reg.counter("ps/push_bytes").value == nbytes
+    assert reg.counter("ps/pull_bytes").value == nbytes
+    assert reg.counter("ps/buckets_completed").value >= 1
+    assert reg.gauge("ps/rounds_in_flight").value == 0
+    assert reg.stage("PS_PUSH").count >= 1
+    assert reg.stage("PS_PULL").count >= 1
+
+
+# --------------------------------------------------------- merge CLI
+
+def _write_rank_trace(td, rank, keys=(65536, 65537), step=3, skew=0):
+    os.makedirs(os.path.join(td, str(rank)), exist_ok=True)
+    ev = []
+    for key in keys:
+        base = skew + 100 * (key - keys[0])
+        for i, stg in enumerate(("PS_PACK", "PS_PUSH", "PS_PULL",
+                                 "PS_UNPACK")):
+            ev.append({"name": stg, "ph": "X", "pid": key, "tid": 0,
+                       "ts": base + i * 10, "dur": 8,
+                       "args": {"name": "g", "step": step}})
+    with open(os.path.join(td, str(rank), "comm.json"), "w") as f:
+        json.dump({"traceEvents": ev, "displayTimeUnit": "ms"}, f)
+    return len(keys)
+
+
+def test_merge_trace_two_rank_fixture(tmp_path, capsys):
+    td = str(tmp_path)
+    n_buckets = _write_rank_trace(td, 0) + _write_rank_trace(td, 1, skew=7)
+    # a rank SIGKILLed mid-flush leaves a truncated file: skipped with a
+    # warning, the healthy ranks still merge
+    os.makedirs(os.path.join(td, "2"))
+    with open(os.path.join(td, "2", "comm.json"), "w") as f:
+        f.write('{"traceEvents": [{"name": "PS_')
+    merged = merge_traces(td)
+    assert merged["metadata"]["ranks"] == [0, 1]
+    assert "skipping unreadable trace" in capsys.readouterr().err
+    events = merged["traceEvents"]
+    # per-rank process rows with metadata names
+    assert {e["pid"] for e in events if e.get("ph") == "X"} == {0, 1}
+    names = {e["pid"]: e["args"]["name"] for e in events
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    assert names == {0: "rank 0", 1: "rank 1"}
+    # spans keep their bucket identity in tid and gain a rank arg
+    spans = [e for e in events if e.get("ph") == "X"]
+    assert all(e["tid"] in (65536, 65537) and "rank" in e["args"]
+               for e in spans)
+    # >= 1 flow pair per bucket, every s has a matching f on the same id
+    starts = {e["id"]: e for e in events if e.get("ph") == "s"}
+    finishes = {e["id"]: e for e in events if e.get("ph") == "f"}
+    assert set(starts) == set(finishes)
+    assert len(starts) >= n_buckets
+    assert all(e.get("bp") == "e" for e in finishes.values())
+    # cross-rank causal edges exist (push on one rank -> pull on the other)
+    cross = [i for i in starts
+             if starts[i]["pid"] != finishes[i]["pid"]]
+    assert cross, "no cross-rank flow arrows"
+    # the whole thing survives a JSON round trip (viewer-loadable)
+    json.loads(json.dumps(merged))
+
+
+def test_merge_trace_cli(tmp_path, capsys):
+    td = str(tmp_path)
+    _write_rank_trace(td, 0)
+    _write_rank_trace(td, 1)
+    out = str(tmp_path / "merged.json")
+    assert merge_main([td, "-o", out]) == 0
+    assert "2 rank(s)" in capsys.readouterr().out
+    data = json.load(open(out))
+    assert data["metadata"]["ranks"] == [0, 1]
+    assert merge_main([]) == 2           # usage error, not a traceback
+
+
+def test_merge_trace_missing_dir(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        merge_traces(str(tmp_path / "nothing"))
+
+
+# ------------------------------------------------- timeline satellites
+
+def _mk_timeline(tmp_path, start=0, end=10**9):
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.timeline import Timeline
+    cfg = Config.from_env(trace_on=True, trace_start_step=start,
+                          trace_end_step=end, trace_dir=str(tmp_path))
+    return Timeline(cfg)
+
+
+def test_timeline_flush_merges_instead_of_truncating(tmp_path):
+    """A second flush (straggler tail spans after the window flush, then
+    the exit flush) must MERGE with the existing comm.json, not
+    overwrite the whole window with only the late events."""
+    tl = _mk_timeline(tmp_path)
+    tl.record("g", "PS_PUSH", 0.0, 0.01)
+    tl.flush()
+    tl.record("g", "PS_APPLY_CHUNK", 1.0, 0.01, step=1)   # straggler tail
+    tl.flush()
+    path = os.path.join(str(tmp_path), "0", "comm.json")
+    names = [e["name"] for e in json.load(open(path))["traceEvents"]]
+    assert names == ["PS_PUSH", "PS_APPLY_CHUNK"]
+    tl.flush()                                            # empty: no-op
+    assert len(json.load(open(path))["traceEvents"]) == 2
+
+
+def test_timeline_record_gates_on_owner_step(tmp_path):
+    """A straggler tail records step k's spans AFTER the ambient step
+    left the trace window: the explicit step tag is the owner and must
+    keep the event — and conversely an untagged event past the window
+    stays dropped."""
+    tl = _mk_timeline(tmp_path, start=5, end=8)
+    tl.set_step(9)                       # window is over, ambient-wise
+    tl.record("g", "PS_APPLY_CHUNK", 0.0, 0.01, step=8)   # step 8's tail
+    tl.record("g", "PS_PULL", 0.0, 0.01)                  # ambient: drop
+    tl.record("g", "PS_H2D", 0.0, 0.01, step=9)           # tagged out too
+    names = [e["name"] for e in tl.snapshot()]
+    assert names == ["PS_APPLY_CHUNK"]
+
+
+def test_timeline_span_step_passthrough(tmp_path):
+    tl = _mk_timeline(tmp_path)
+    tl.set_step(5)                       # ambient step has advanced
+    with tl.span("g", "PS_PULL", key=2, step=4):
+        pass
+    with tl.span("g", "PS_H2D"):
+        pass
+    ev = {e["name"]: e for e in tl.snapshot()}
+    assert ev["PS_PULL"]["args"]["step"] == 4     # true owner, not ambient
+    assert ev["PS_PULL"]["pid"] == 2
+    assert ev["PS_H2D"]["args"]["step"] == 5      # default: ambient
+
+
+# ------------------------------------------------ telemetry satellites
+
+def test_pushpull_speed_backdates_by_duration():
+    from byteps_tpu.telemetry import PushPullSpeed
+    ps = PushPullSpeed(window_sec=10.0)
+    ps.record(10_000_000, duration_s=5.0)
+    # 10 MB over a transfer that STARTED 5 s ago: ~2 MB/s, not the
+    # near-infinite rate an at-completion booking reports
+    assert ps.mbps() == pytest.approx(2.0, rel=0.15)
+    # longer than the window: clamped to the window edge, not evicted
+    ps2 = PushPullSpeed(window_sec=2.0)
+    ps2.record(4_000_000, duration_s=60.0)
+    assert ps2.mbps() == pytest.approx(2.0, rel=0.15)
+
+
+def test_pushpull_speed_backdated_insert_keeps_order():
+    from byteps_tpu.telemetry import PushPullSpeed
+    ps = PushPullSpeed(window_sec=10.0)
+    ps.record(1000)                       # instantaneous, ts = now
+    ps.record(1000, duration_s=8.0)       # lands BEHIND the head
+    ts = [t for t, _ in ps._events]
+    assert ts == sorted(ts)
+    assert ps.mbps() > 0
+
+
+def test_telemetry_aggregators_tolerate_degenerate_traces():
+    from byteps_tpu.telemetry import (cross_step_overlap,
+                                      exchange_head_overlap,
+                                      exchange_tail_overlap,
+                                      summarize_stages)
+    degenerate = [
+        [],                                              # empty
+        [{"ph": "M", "pid": 0}],                         # no name at all
+        [{"name": "PS_PULL", "ts": 5, "dur": 2}],        # missing args
+        [{"name": "PS_PULL", "ts": 5, "dur": 2, "args": None}],
+        [{"name": "PS_H2D", "args": {"step": 1}}],       # missing ts/dur
+        [{"name": "PS_BWD_SEG", "ts": 0, "dur": 1,
+          "args": {"name": "g"}}],                       # args w/o step
+    ]
+    for events in degenerate:
+        s = summarize_stages(events)
+        assert all("count" in v for v in s.values())
+        for fn in (exchange_tail_overlap, cross_step_overlap,
+                   exchange_head_overlap):
+            out = fn(events)
+            assert out["overlapped"] is False
+            assert out["overlap_ms"] == 0.0
+    # single-stage trace: PULLs with no tail spans — overlap must be
+    # False, and events missing a step group under step 0 together
+    events = [{"name": "PS_PULL", "ts": 0, "dur": 5},
+              {"name": "PS_PULL", "ts": 5, "dur": 5,
+               "args": {"step": 0}}]
+    assert summarize_stages(events)["PS_PULL"]["count"] == 2
+    assert exchange_tail_overlap(events)["overlapped"] is False
+
+
+# ------------------------------------------------- slow-lane ride-alongs
+
+@pytest.mark.slow
+def test_bench_stats_flag_smoke():
+    """CI slow-lane smoke of ``bench.py --stats``: every A/B variant's
+    JSON carries the registry summary with PS stage histograms."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    old = bench.STATS
+    bench.STATS = True
+    try:
+        out = bench.ps_tail_breakdown(iters=3, warm=1)
+    finally:
+        bench.STATS = old
+    for mode in ("chunked", "fused"):
+        m = out[f"{mode}_metrics"]
+        assert any(k.startswith("stage/PS_") for k in m), m
+        assert m["step/count"] >= 1
+        assert m["stage/PS_PUSH"]["p95_ms"] >= 0
+    assert json.dumps(out)               # still one-line-JSON-able
