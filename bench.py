@@ -557,6 +557,51 @@ def ps_cross_breakdown(iters: int = 10, warm: int = 3,
     return out
 
 
+def ps_plane_breakdown(n_workers: int = 2, nbytes: int = 8 << 20,
+                       rate: float = 4e7, server_rate: float = 4e6,
+                       iters: int = 3, warm: int = 1) -> dict:
+    """Server-plane shard-scaling A/B: the same sync PS round (real
+    transport, ring placement — byteps_tpu.server.plane's byte-weighted
+    consistent hash) with 1 vs 2 server shards, under an ASYMMETRIC
+    ``throttle.Nic``: the server tier's EGRESS is throttled below the
+    workers' line rate (`server_rate` < `rate`), modelling the
+    k-worker pull incast on a server port — the regime where the
+    BytePS rationale says spare server bandwidth is the win. Adding a
+    shard halves each server's egress load, so the throughput curve
+    must MOVE (`shards_1_to_2` > 1.0); on a worker-bound config it
+    would sit at ≈1.0, which is why the bench pins the server side as
+    the bottleneck rather than asserting a win unconditionally
+    (arXiv 2103.00543: measure when the extra machinery pays).
+
+    Rates are deliberately LOW (single-digit MB/s on the server side):
+    the emulated NIC must sit well under what the Python/loopback
+    stack can actually move, or host CPU (not the throttle) is the
+    bottleneck and the extra shard only buys thread contention — the
+    measured-not-assumed point above, which an early cut of this bench
+    demonstrated by losing, and which a 2-core CI box re-demonstrated
+    at 10 MB/s (the 4-process fleet's scheduler noise rivalled the
+    ~1.6 s wire time; at 4 MB/s the wire dominates again).
+    """
+    from byteps_tpu.server.allreduce_emu import ps_exchange
+
+    out: dict = {"nbytes": nbytes, "workers": n_workers,
+                 "worker_rate": rate, "server_egress_rate": server_rate}
+    times: dict = {}
+    for n_servers in (1, 2):
+        if STATS:
+            _reset_metrics()
+        ps_exchange(n_workers, n_servers, nbytes, rate, iters=warm,
+                    server_rate=server_rate, server_rx_rate=rate)
+        times[n_servers] = ps_exchange(
+            n_workers, n_servers, nbytes, rate, iters=iters,
+            server_rate=server_rate, server_rx_rate=rate)
+        out[f"s{n_servers}_round_s"] = round(times[n_servers], 4)
+        if STATS:
+            out[f"s{n_servers}_metrics"] = _metrics_summary()
+    out["shards_1_to_2"] = round(times[1] / times[2], 4)
+    return out
+
+
 def probe_tpu(attempts: int = 3, timeout: float = 150.0,
               backoff: float = 20.0):
     """Bounded TPU-reachability probe. jax.devices() can hang
@@ -808,6 +853,12 @@ def main() -> None:
         line["ps_cross"] = ps_cross_breakdown()
     except Exception as e:       # noqa: BLE001 — recorded, not fatal
         line["ps_cross_error"] = f"{type(e).__name__}: {e}"[:300]
+    # server-plane shard-scaling A/B (1 vs 2 shards under the
+    # server-egress-bound throttle) — same ride-along contract
+    try:
+        line["ps_plane"] = ps_plane_breakdown()
+    except Exception as e:       # noqa: BLE001 — recorded, not fatal
+        line["ps_plane_error"] = f"{type(e).__name__}: {e}"[:300]
     print(json.dumps(line))
 
 

@@ -93,7 +93,20 @@ class Config:
     server_enable_schedule: bool = False # BYTEPS_SERVER_ENABLE_SCHEDULE
 
     # --- key placement (reference: global.cc:158-180) ---
-    key_hash_fn: str = "djb2"            # naive|built_in|djb2|sdbm
+    key_hash_fn: str = "djb2"            # naive|built_in|djb2|sdbm|mixed|ring
+
+    # --- server plane (ours: placement/replication/rebalancing,
+    # docs/server-plane.md) ---
+    plane_replicas: int = 0              # BPS_PLANE_REPLICAS: >0 with
+                                         # multiple BPS_SERVER_ADDRS wraps
+                                         # the shards in the managed plane
+                                         # (primary-backup forward logs,
+                                         # failover = reroute + replay)
+    plane_rebalance_sec: float = 0.0     # BPS_PLANE_REBALANCE_SEC: load-
+                                         # aware rebalancer cadence (0 off)
+    plane_vnodes: int = 0                # BPS_PLANE_VNODES: virtual nodes
+                                         # per shard on the hash ring
+                                         # (0 = default 64)
 
     # --- emulated-NIC throttle for this worker endpoint (perf lab:
     # charges all RemotePSBackend traffic to a throttle.Nic so
@@ -152,6 +165,10 @@ class Config:
             server_engine_threads=_env_int("BPS_SERVER_ENGINE_THREAD", "BYTEPS_SERVER_ENGINE_THREAD", 4),
             server_enable_schedule=_env_bool("BPS_SERVER_ENABLE_SCHEDULE", "BYTEPS_SERVER_ENABLE_SCHEDULE"),
             key_hash_fn=_env("BPS_KEY_HASH_FN", "BYTEPS_KEY_HASH_FN", "djb2"),
+            plane_replicas=int(_env("BPS_PLANE_REPLICAS", None, "0") or 0),
+            plane_rebalance_sec=float(
+                _env("BPS_PLANE_REBALANCE_SEC", None, "0") or 0),
+            plane_vnodes=int(_env("BPS_PLANE_VNODES", None, "0") or 0),
             emu_nic_rate=float(_env("BPS_EMU_NIC_RATE", None, "0") or 0),
             emu_nic_latency=float(_env("BPS_EMU_NIC_LATENCY", None, "0") or 0),
             min_compress_bytes=_env_int("BPS_MIN_COMPRESS_BYTES", "BYTEPS_MIN_COMPRESS_BYTES", 65536),

@@ -105,6 +105,7 @@ class GlobalState:
         self.engine.timeline = self.timeline
         self.engine.debug_sample = config.debug_sample_tensor
         self.ps_backend = None
+        self.plane_rebalancer = None
         if config.enable_ps:
             # PS deployment (reference architecture): workers are
             # independent processes with LOCAL meshes; the cross-worker
@@ -120,9 +121,56 @@ class GlobalState:
                     from ..server.throttle import Nic
                     nic = Nic(config.emu_nic_rate,
                               latency=config.emu_nic_latency)
-                self.ps_backend = RemotePSBackend(
-                    addrs, hash_fn=config.key_hash_fn,
-                    async_mode=config.enable_async, nic=nic)
+                if config.plane_replicas > 0 and len(addrs) > 1:
+                    # managed server plane: one single-address client
+                    # per shard, routed through the byte-weighted ring
+                    # with versioned epochs, each key's rounds forward-
+                    # logged to its backup shard (failover = reroute +
+                    # replay, docs/server-plane.md)
+                    from ..server.plane import PlanePSBackend, Rebalancer
+                    shards = [RemotePSBackend(
+                        [a], async_mode=config.enable_async, nic=nic)
+                        for a in addrs]
+                    self.ps_backend = PlanePSBackend(
+                        shards, num_workers=config.num_worker,
+                        replicas=config.plane_replicas,
+                        vnodes=config.plane_vnodes or 64,
+                        owns_shards=True,
+                        worker_id=config.worker_id)
+                    if config.plane_rebalance_sec > 0:
+                        if config.num_worker > 1:
+                            # each worker holds its own placement view;
+                            # independent rebalancers would migrate
+                            # different keys and the views diverge
+                            # (same key pushed to different shards =
+                            # torn sums). Failover stays safe — its
+                            # reassignment is a deterministic pure
+                            # function of the shared ring. A server-
+                            # side placement controller is the
+                            # multi-worker path (docs/server-plane.md).
+                            get_logger().warning(
+                                "BPS_PLANE_REBALANCE_SEC ignored with "
+                                "%d workers: per-worker rebalancers "
+                                "would diverge the placement views",
+                                config.num_worker)
+                        else:
+                            self.plane_rebalancer = Rebalancer(
+                                self.ps_backend,
+                                interval_sec=config.plane_rebalance_sec
+                            ).start()
+                else:
+                    if config.plane_replicas > 0:
+                        # replication was asked for but there is
+                        # nothing to replicate across — say so, or a
+                        # mistyped BPS_SERVER_ADDRS silently downgrades
+                        # "server death = reroute + replay" to restart
+                        get_logger().warning(
+                            "BPS_PLANE_REPLICAS=%d ignored: %d server "
+                            "address(es) — the plane needs >1 shard",
+                            config.plane_replicas, len(addrs))
+                    self.ps_backend = RemotePSBackend(
+                        addrs, hash_fn=config.key_hash_fn,
+                        async_mode=config.enable_async, nic=nic)
             else:
                 if config.num_worker > 1:
                     raise ValueError(
@@ -203,6 +251,8 @@ class GlobalState:
                     if inst.ps_backend is not None else "")
             if inst.engine.ps_exchange is not None:
                 inst.engine.ps_exchange.close()
+            if getattr(inst, "plane_rebalancer", None) is not None:
+                inst.plane_rebalancer.stop()
             if inst.ps_backend is not None:
                 inst.ps_backend.close()
             cls._instance = None
@@ -219,6 +269,8 @@ class GlobalState:
                      for d in (inst.registry.get(n) for n in inst.registry.declared_names())]
             if inst.engine.ps_exchange is not None:
                 inst.engine.ps_exchange.close()
+            if getattr(inst, "plane_rebalancer", None) is not None:
+                inst.plane_rebalancer.stop()
             if inst.ps_backend is not None:
                 inst.ps_backend.close()
             cls._instance = None

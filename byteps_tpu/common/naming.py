@@ -160,18 +160,44 @@ class NameRegistry:
             self._next_key = 0
 
 
+_RINGS: Dict = {}
+
+
+def _ring_place(key: int, num_servers: int, vnodes: int) -> int:
+    """Stateless consistent-hash ring placement (``hash_fn="ring"``):
+    the successor-walk ring from the server plane
+    (byteps_tpu.server.plane.placement.HashRing). NOTE: this is the
+    key's RING PRIMARY only — the PS backends route through a
+    byte-weighted ``PlacementService`` over the same ring, which
+    regularly assigns a key to a lighter non-primary candidate (and
+    migrations move keys further still), so bare ``place_key`` answers
+    must not be used to locate a live backend's key. It is the right
+    answer for stateless spread (allreduce_emu) and pre-init routing;
+    balance-by-construction lives in the service (the at-the-source
+    fix for the djb2/built_in hot spots the emulation measured)."""
+    from ..server.plane.placement import DEFAULT_VNODES, HashRing
+    vn = int(vnodes) or DEFAULT_VNODES
+    ring = _RINGS.get((num_servers, vn))
+    if ring is None:
+        ring = _RINGS[(num_servers, vn)] = HashRing(num_servers,
+                                                    vnodes=vn)
+    return ring.lookup(key)
+
+
 def place_key(key: int, num_servers: int, hash_fn: str = "djb2",
               num_workers: int = 0, mixed_bound: int = 101,
               built_in_coef: int = 1,
-              reduce_roots: Optional[List[int]] = None) -> int:
+              reduce_roots: Optional[List[int]] = None,
+              vnodes: int = 0) -> int:
     """Which server shard owns a PS key (reference: global.cc:628-677).
 
     ``hash_fn="mixed"`` needs ``num_workers`` (reference:
-    BYTEPS_ENABLE_MIXED_MODE + Hash_Mixed_Mode). ``reduce_roots``
-    restricts placement to the listed shards (reference:
-    BYTEPS_REDUCE_ROOTS steering which device roots own reductions,
-    global.cc:238-251) — keys hash over the root list instead of all
-    servers."""
+    BYTEPS_ENABLE_MIXED_MODE + Hash_Mixed_Mode). ``hash_fn="ring"`` is
+    the server plane's consistent-hash ring (``vnodes`` per shard,
+    BPS_PLANE_VNODES). ``reduce_roots`` restricts placement to the
+    listed shards (reference: BYTEPS_REDUCE_ROOTS steering which device
+    roots own reductions, global.cc:238-251) — keys hash over the root
+    list instead of all servers."""
     if reduce_roots:
         for r in reduce_roots:
             if not 0 <= r < num_servers:
@@ -182,6 +208,8 @@ def place_key(key: int, num_servers: int, hash_fn: str = "djb2",
         return reduce_roots[_raw_djb2(key) % len(reduce_roots)]
     if num_servers <= 1:
         return 0
+    if hash_fn == "ring":
+        return _ring_place(key, num_servers, vnodes)
     if hash_fn == "mixed":
         if num_workers <= 0:
             raise ValueError("BPS_KEY_HASH_FN=mixed needs "
@@ -191,8 +219,8 @@ def place_key(key: int, num_servers: int, hash_fn: str = "djb2",
     try:
         fn = HASH_FNS[hash_fn]
     except KeyError:
-        raise ValueError(f"unknown BPS_KEY_HASH_FN {hash_fn!r}; "
-                         f"choose from {sorted(HASH_FNS) + ['mixed']}"
+        raise ValueError(f"unknown BPS_KEY_HASH_FN {hash_fn!r}; choose "
+                         f"from {sorted(HASH_FNS) + ['mixed', 'ring']}"
                          ) from None
     h = fn(key, built_in_coef) if hash_fn == "built_in" else fn(key)
     return h % num_servers
@@ -216,6 +244,7 @@ def placement_from_env() -> Dict:
         built_in_coef=int(_get("BPS_BUILT_IN_HASH_COEF",
                                "BYTEPS_BUILT_IN_HASH_COEF", "1")),
         reduce_roots=[int(x) for x in roots_s.split(",") if x.strip()],
+        vnodes=int(_get("BPS_PLANE_VNODES", "BPS_PLANE_VNODES", "0") or 0),
     )
 
 

@@ -39,6 +39,15 @@ STAGES: Tuple[str, ...] = (
     "PS_UNPACK", "PS_H2D", "PS_APPLY_CHUNK", "PS_XSTEP_GATE",
 )
 
+# Server-plane control-loop signals (byteps_tpu.server.plane,
+# docs/server-plane.md), pre-registered like the stages so "which plane
+# signals exist" is answerable before any traffic. Per-shard loads ride
+# alongside as dynamic plane/shard_bytes/s<i> / plane/keys_per_shard/s<i>
+# gauges (shard count is a runtime property).
+PLANE_GAUGES: Tuple[str, ...] = ("plane/epoch", "plane/replication_lag")
+PLANE_COUNTERS: Tuple[str, ...] = ("plane/migrations", "plane/failovers",
+                                   "plane/wrong_epoch")
+
 # ONE truthiness rule shared with Config (BPS_STATS must resolve
 # identically whether read here or through Config.stats_on)
 from ..common.config import _TRUE  # noqa: E402
@@ -242,6 +251,10 @@ class MetricsRegistry:
         self._metrics: Dict[str, object] = {}
         for s in STAGES:
             self.histogram(f"stage/{s}")
+        for g in PLANE_GAUGES:
+            self.gauge(g)
+        for c in PLANE_COUNTERS:
+            self.counter(c)
 
     def _get(self, name: str, cls, *args):
         m = self._metrics.get(name)

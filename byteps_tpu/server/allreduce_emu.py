@@ -194,7 +194,9 @@ def ps_exchange(n_workers: int, n_servers: int, nbytes: int, rate: float,
                 latency: float = 0.0, iters: int = 1,
                 partition_bytes: Optional[int] = None,
                 colocated: bool = False, verify: bool = True,
-                compression: Optional[Dict[str, str]] = None) -> float:
+                compression: Optional[Dict[str, str]] = None,
+                server_rate: Optional[float] = None,
+                server_rx_rate: Optional[float] = None) -> float:
     """One PS sync round (push G, pull merged G) per iteration through
     the REAL transport stack, every endpoint throttled.
 
@@ -206,7 +208,11 @@ def ps_exchange(n_workers: int, n_servers: int, nbytes: int, rate: float,
     ``compression`` (reference-format kwargs, e.g. onebit) rides the
     real compressed wire: workers push codec payloads, the (native)
     server codec decompresses/sums/recompresses — LOSSY, so verify is
-    skipped; the point is wire time where bandwidth is the bottleneck."""
+    skipped; the point is wire time where bandwidth is the bottleneck.
+
+    ``server_rate``/``server_rx_rate`` throttle the server tier
+    asymmetrically (egress vs ingress) — the server-egress-bound incast
+    regime ``bench.py ps_plane`` measures shard scaling under."""
     import os
     from ..common.naming import NameRegistry
     from .engine import PSServer
@@ -238,7 +244,9 @@ def ps_exchange(n_workers: int, n_servers: int, nbytes: int, rate: float,
     if colocated:
         server_nics = [worker_nics[j % n_workers] for j in range(n_servers)]
     else:
-        server_nics = [Nic(rate, latency) for _ in range(n_servers)]
+        server_nics = [Nic(server_rate if server_rate is not None
+                           else rate, latency, rx_rate=server_rx_rate)
+                       for _ in range(n_servers)]
 
     try:
         backends = [PSServer(num_workers=n_workers, engine_threads=1)
@@ -257,16 +265,19 @@ def ps_exchange(n_workers: int, n_servers: int, nbytes: int, rate: float,
     want = np.sum(datas, axis=0) if verify else None
 
     reg = NameRegistry()
-    # naive hash == key % n_servers, and bucket keys are decl<<16 | i:
-    # EXACT round-robin placement. djb2 put 5/16 buckets on one server
-    # and built_in 20/64 — every round then gates on the hottest
-    # server's NIC (+25% measured). Placement balance is precisely what
-    # BYTEPS_KEY_HASH_FN exists to tune in the reference
+    # ring placement: the server plane's byte-weighted virtual-node
+    # assignment is balanced BY CONSTRUCTION (max−min assigned bytes
+    # bounded by one bucket), so no hash needs hand-tuning per workload.
+    # History: djb2 put 5/16 buckets on one server and built_in 20/64 —
+    # every round then gated on the hottest server's NIC (+25%
+    # measured) — and a "naive == round-robin" special case papered
+    # over it here until the ring fixed it at the source
+    # (tests/test_server_plane.py asserts the balance bound).
     try:
         if compression:
             reg.declare("lb", **compression)
         remotes = [RemotePSBackend(addrs, nic=worker_nics[i],
-                                   hash_fn="naive")
+                                   hash_fn="ring")
                    for i in range(n_workers)]
         exs = [PSGradientExchange(remotes[i],
                                   partition_bytes=partition_bytes,

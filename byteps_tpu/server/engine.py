@@ -392,9 +392,30 @@ class HostPSBackend:
         from ..common.naming import check_mixed_mode_enabled, placement_from_env
         check_mixed_mode_enabled(hash_fn)
         self._placement = placement_from_env()
+        # hash_fn="ring": placement comes from the server plane's
+        # byte-weighted consistent-hash service instead of the env hash
+        # — balanced by construction (max−min assigned bytes bounded by
+        # one key), deterministic across workers under the exchange's
+        # declaration-order contract. The env hashes stay for
+        # reference-parity deployments.
+        self._ring = None
+        if hash_fn == "ring" and num_servers > 1:
+            from .plane.placement import DEFAULT_VNODES, PlacementService
+            self._ring = PlacementService(
+                num_servers,
+                vnodes=int(self._placement.get("vnodes") or 0)
+                or DEFAULT_VNODES)
         self.async_mode = async_mode
         self._rounds: Dict[int, int] = {}
         self._shard_bytes: Dict[int, int] = {}
+        # key -> shard override from migrate_key (hash placements have
+        # no routing table to rewrite, so moves live here); ring
+        # placements rewrite the PlacementService table instead
+        self._migrated: Dict[int, int] = {}
+        self._key_meta: Dict[int, tuple] = {}    # key -> (nbytes, dtype)
+        # plane round = shard-local round + base after a migration (the
+        # new shard's store counts from 0)
+        self._round_base: Dict[int, int] = {}
         self._placed: set = set()
         self._rs_cols: Dict[int, int] = {}   # row-sparse: pinned cols/key
         from .compressed import CompressedKeyStore
@@ -410,6 +431,21 @@ class HostPSBackend:
             s.close()
 
     def _shard_index(self, key: int) -> int:
+        s = self._migrated.get(key)
+        if s is not None:
+            return s
+        if self._ring is not None:
+            try:
+                return self._ring.shard_of(key)
+            except KeyError:
+                # op before init_key (raw clients' round probes): route
+                # to the ring primary WITHOUT recording an assignment —
+                # place(key, 0) here would pin the key at weight zero
+                # forever (place is idempotent), silently breaking the
+                # byte-weighted balance and, worse, diverging this
+                # worker's placement sequence from peers that never hit
+                # this path. init_key does the real byte-weighted place.
+                return self._ring.ring.lookup(key)
         from ..common.naming import place_key
         return place_key(key, len(self.servers), self.hash_fn,
                          **self._placement)
@@ -426,12 +462,25 @@ class HostPSBackend:
         if compression:
             size = nbytes // np.dtype(dtype).itemsize
             self.compressed.register(key, compression, size, dtype)
+        if self._ring is not None:
+            self._ring.place(key, nbytes)    # byte-weighted, idempotent
         self._shard(key).init_key(key, nbytes, dtype, init)
+        # init copy kept for migrate_key's round-0 replay (a fresh key
+        # moved before any round completes must carry its init, not
+        # zero-fill the destination)
+        self._key_meta.setdefault(
+            key, (int(nbytes), dtype,
+                  None if init is None else np.array(init)))
         if key not in self._placed:      # re-inits are no-ops server-side;
             self._placed.add(key)        # don't double-count the load stats
             from ..common.naming import log_key_placement
             log_key_placement(key, nbytes, self._shard_index(key),
                               self._shard_bytes, self.hash_fn)
+            # one shared publisher with the plane: the rebalancer and
+            # the watchdog read the same plane/shard_bytes gauges
+            # whichever backend is in play
+            from .plane.placement import publish_shard_bytes
+            publish_shard_bytes(dict(self._shard_bytes))
 
     def push(self, key: int, data: np.ndarray) -> None:
         import time
@@ -458,7 +507,18 @@ class HostPSBackend:
              timeout_ms: int = 30000) -> None:
         import time
         t0 = time.time()
-        self._shard(key).pull(key, out, round, timeout_ms)
+        base = self._round_base.get(key, 0)
+        if round and round <= base:
+            # the classic backend keeps no forward log (that is the
+            # plane's job): a pre-migration round cannot be served —
+            # round==base would silently alias to "latest published"
+            # (shard round 0) and smaller rounds go negative
+            raise ValueError(
+                f"pull({key}) round={round}: rounds <= the migration "
+                f"base ({base}) left with the old shard — only the "
+                f"replicated plane retains them")
+        self._shard(key).pull(key, out, (round - base) if round else 0,
+                              timeout_ms)
         # how long the merge took to publish from this worker's view —
         # server sum time plus the wait for the other workers' pushes
         self._m_pull_wait.observe(time.time() - t0)
@@ -468,8 +528,61 @@ class HostPSBackend:
         a restarted worker of a live job resynchronize its round
         counters to the server's instead of stalling on round 1
         (the elastic-rejoin analog of the reference's is_recovery
-        skip-barrier, global.cc:283-297)."""
-        return int(self._shard(key).round(key))
+        skip-barrier, global.cc:283-297). Migrated keys report
+        ``base + shard round`` (the destination store counts from 0)."""
+        return (self._round_base.get(key, 0)
+                + int(self._shard(key).round(key)))
+
+    def migrate_key(self, key: int, dst: int) -> int:
+        """Move ``key``'s store to shard ``dst`` at a round boundary:
+        replay the latest merged state (or nothing, for a round-0 key)
+        to the destination, re-base the round translation, and update
+        the ``_shard_bytes`` accounting + ``plane/shard_bytes`` gauges
+        so the rebalancer and the watchdog keep seeing truth. Callers
+        must be at a round boundary for the key (no pushed-but-unpulled
+        round — the plane backend's ``migrate_key`` enforces this; here
+        the single-process trainer's step edges are the boundary).
+        Returns the destination shard."""
+        if not 0 <= dst < len(self.servers):
+            raise ValueError(f"shard {dst} out of range "
+                             f"0..{len(self.servers) - 1}")
+        if self.compressed.has(key) or key in self._rs_cols:
+            # the byte-path pulls (pull_bytes/onebit/topk) carry raw
+            # plane rounds with no base translation — migrating such a
+            # key would leave them waiting on rounds the destination
+            # never published. Refuse until the byte paths learn the
+            # re-basing the dense path does.
+            raise ValueError(
+                f"key {key} has a compressed/row-sparse codec — "
+                f"migration is dense-path only")
+        src = self._shard_index(key)
+        if src == dst:
+            return dst
+        meta = self._key_meta.get(key)
+        if meta is None:
+            raise KeyError(f"key {key} was never init_key'd — nothing "
+                           f"to migrate")
+        nbytes, dtype, init = meta
+        srv = self.servers[src]
+        cr = int(srv.round(key))
+        state = init                 # round-0 key: replay its init
+        if cr > 0:
+            state = np.empty(nbytes // np.dtype(dtype).itemsize,
+                             dtype=dtype)
+            srv.pull(key, state, round=cr, timeout_ms=5000)
+        self.servers[dst].init_key(key, nbytes, dtype, state)
+        self._round_base[key] = self._round_base.get(key, 0) + cr
+        if self._ring is not None:
+            self._ring.migrate(key, dst)     # epoch bump + its counter
+        else:
+            self._migrated[key] = dst
+            from ..obs.metrics import get_registry
+            get_registry().counter("plane/migrations").inc()
+        self._shard_bytes[src] = self._shard_bytes.get(src, 0) - nbytes
+        self._shard_bytes[dst] = self._shard_bytes.get(dst, 0) + nbytes
+        from .plane.placement import publish_shard_bytes
+        publish_shard_bytes(dict(self._shard_bytes))
+        return dst
 
     def push_onebit(self, key: int, payload) -> None:
         """Native onebit push on the key's shard (see PSServer)."""

@@ -1,0 +1,33 @@
+"""Managed server plane: placement, replication, load-aware rebalancing.
+
+Until this package existed the server side was a flat shard list behind
+a static hash — a hot key saturated one shard and a server death was a
+job restart. The plane gives the server tier its own control loop, the
+operational conclusion of the BytePS rationale-doc claim (spare CPU
+bandwidth on a scaled-out server tier beats allreduce,
+docs/rationale.md):
+
+- ``placement``: consistent-hash ring with byte-weighted virtual-node
+  assignment, versioned placement epochs, and placement-aware striping
+  (stripes of one large bucket land on DIFFERENT shards);
+- ``replica``: forward-log of each key's summed rounds to a backup
+  shard, so a killed server becomes reroute + replay instead of a
+  restart;
+- ``backend``: the worker-facing ``PlanePSBackend`` (same duck
+  interface as ``HostPSBackend``/``RemotePSBackend``) that routes
+  through the placement service and executes failover + migration;
+- ``rebalance``: the load-aware controller that migrates the hottest
+  keys to the coldest shards at round boundaries, driven by the live
+  obs registry signals (``server/merge_wait_s``,
+  ``server/engine_queue_depth``, per-shard push bytes).
+
+See docs/server-plane.md for the protocols and the failure matrix.
+"""
+
+from .backend import PlanePSBackend
+from .placement import HashRing, PlacementService, WrongEpoch
+from .rebalance import Rebalancer
+from .replica import ReplicaStore
+
+__all__ = ["HashRing", "PlacementService", "WrongEpoch",
+           "PlanePSBackend", "Rebalancer", "ReplicaStore"]

@@ -1,0 +1,538 @@
+"""``PlanePSBackend`` — the worker-facing driver of the managed plane.
+
+Same duck interface as ``HostPSBackend``/``RemotePSBackend`` (init_key /
+push / pull / round / push_bytes / pull_bytes), so
+``PSGradientExchange`` runs over it unchanged; underneath, every op is
+
+  1. routed through the ``PlacementService`` (byte-weighted ring
+     assignment, versioned epochs — an op tagged with a stale epoch is
+     refused with ``WrongEpoch`` before it can tear a round),
+  2. replicated (``replicas=1``): the merged bytes of every completed
+     round are forward-logged to the key's backup shard the moment this
+     worker's pull lands, and the one round the admission gate allows
+     in flight is retained worker-side for replay,
+  3. failed over: a shard-unreachable error triggers reroute — the dead
+     shard's keys move to their ring successors (where their replica
+     logs already live), inits are replayed from the plane's meta, round
+     counters are re-based onto the replica log, and the in-flight round
+     is re-pushed. The retried op then completes bit-identically; the
+     job never restarts.
+
+Shard clients are either in-process ``PSServer`` instances (their
+replica logs live in this plane object) or single-address
+``RemotePSBackend`` clients (replica logs live in the remote
+``PSTransportServer``, reached via the OP_REPL_* wire ops).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...common.logging import get_logger
+from ...obs.metrics import get_registry
+from ..engine import ServerClosed
+from .placement import DEFAULT_VNODES, PlacementService
+from .replica import ReplicaStore
+
+
+class _LocalReplica:
+    """Replica-log interface over an in-process ``ReplicaStore`` — the
+    plane holds the store, so it SURVIVES its shard's death (that is
+    the point: the log for a key lives at the key's backup index)."""
+
+    __slots__ = ("_s",)
+
+    def __init__(self, store: ReplicaStore) -> None:
+        self._s = store
+
+    def repl_put(self, key: int, round: int, payload) -> None:
+        self._s.put(key, round, payload)
+
+    def repl_get(self, key: int, round: int) -> Optional[bytes]:
+        return self._s.get(key, round)
+
+    def repl_base(self, key: int) -> int:
+        return self._s.base(key)
+
+
+class PlanePSBackend:
+    """Placement-routed, replicated, migratable PS backend."""
+
+    def __init__(self, shards: List, num_workers: int = 1,
+                 replicas: int = 0, vnodes: int = DEFAULT_VNODES,
+                 fanout: int = 0,
+                 placement: Optional[PlacementService] = None,
+                 owns_shards: bool = False,
+                 worker_id: Optional[int] = None) -> None:
+        if not shards:
+            raise ValueError("the plane needs at least one shard")
+        self._shards = list(shards)
+        self.num_workers = int(num_workers)
+        self.replicas = max(0, min(int(replicas), len(shards) - 1))
+        # replication logs the key's round the moment a pull of it
+        # lands; designated logging (worker_id given) has the (key %
+        # num_workers)-th worker log each key ONCE instead of every
+        # worker uploading the identical merge (W-fold backup ingest on
+        # the pull hot path). None = every worker logs — the safe
+        # default for hand-built planes that never declared their rank.
+        self.worker_id = None if worker_id is None else int(worker_id)
+        self.placement = placement or PlacementService(
+            len(shards), vnodes=vnodes, fanout=fanout)
+        self._owns = owns_shards
+        self.async_mode = any(getattr(s, "async_mode", False)
+                              for s in shards)
+        if self.async_mode and self.replicas > 0:
+            # async pulls are round-less: nothing marks a round
+            # boundary, so the forward log, the in-flight replay copy,
+            # and migration's drain contract all lose their anchor —
+            # failover would "succeed" by replaying the original init
+            # over accumulated async state. Refuse loudly.
+            raise ValueError(
+                "BPS_PLANE_REPLICAS>0 does not compose with async mode "
+                "(round-less pulls leave nothing to forward-log or "
+                "replay) — run the async tier on the flat shard list")
+        # replica-log handles: a remote shard client speaks OP_REPL_*
+        # itself; an in-process shard gets a plane-held store
+        self._repl = [s if hasattr(s, "repl_put")
+                      else _LocalReplica(ReplicaStore())
+                      for s in shards]
+        self._lock = threading.Lock()
+        self._mig_cv = threading.Condition(self._lock)
+        # key -> (nbytes, dtype, init copy, compression) for init
+        # replay on failover / migration
+        self._meta: Dict[int, tuple] = {}
+        # plane round r maps to shard-local round r - base (a promoted
+        # or migration-target shard starts counting from 0)
+        self._round_base: Dict[int, int] = {}
+        # this worker's per-key push round (mirrors the exchange's
+        # counter; seeds from round() like _next_round does) and the
+        # one pushed-but-unpulled round the admission gate allows:
+        # key -> (plane round, data copy | None). The copy is what
+        # failover re-pushes; kept only when replication is on.
+        self._push_round: Dict[int, int] = {}
+        self._inflight: Dict[int, tuple] = {}
+        # key -> round that fail_shard already re-pushed to the new
+        # owner: the push whose failure TRIGGERED the failover is
+        # retried by _run, and without this marker that retry would
+        # push the same round a second time (double-counted in the
+        # new shard's sum)
+        self._replayed: Dict[int, int] = {}
+        self._logged: Dict[int, int] = {}
+        # keys being migrated right now: push must not slip a new round
+        # onto the OLD primary between migrate_key's drain check and
+        # the routing switch (that round would be silently lost)
+        self._migrating: set = set()
+        self._dead: set = set()
+        # rebalancer inputs: pushed bytes per shard / per key since the
+        # last load_window() call
+        self._win_shard: Dict[int, int] = {}
+        self._win_key: Dict[int, int] = {}
+        reg = get_registry()
+        self._m_failovers = reg.counter("plane/failovers")
+        self._g_lag = reg.gauge("plane/replication_lag")
+        # per-key push-vs-logged lag with argmax tracking, so the gauge
+        # stays O(1) per op instead of rescanning every key under the
+        # plane lock on each push/pull
+        self._lag: Dict[int, int] = {}
+        self._lag_argmax: Optional[int] = None
+
+    # ------------------------------------------------------------ admin
+
+    def close(self) -> None:
+        if self._owns:
+            for s in self._shards:
+                try:
+                    s.close()
+                except Exception:   # noqa: BLE001 — best-effort teardown
+                    pass
+
+    def placement_epoch(self) -> int:
+        """The worker's current placement view — captured by the
+        exchange at push time and carried through the round's pull, so
+        a migration racing the round is caught as WrongEpoch instead of
+        a torn assembly."""
+        return self.placement.epoch
+
+    def shard_bytes(self) -> Dict[int, int]:
+        return self.placement.shard_bytes()
+
+    def load_window(self) -> Dict[str, Dict[int, int]]:
+        """Pushed bytes per shard and per key since the last call
+        (reset on read) — the rebalancer's live-load signal."""
+        with self._lock:
+            out = {"shards": dict(self._win_shard),
+                   "keys": dict(self._win_key)}
+            self._win_shard.clear()
+            self._win_key.clear()
+        return out
+
+    def queue_depth(self) -> int:
+        n = 0
+        for i, s in enumerate(self._shards):
+            if i in self._dead or not hasattr(s, "queue_depth"):
+                continue
+            try:
+                n += s.queue_depth()
+            except Exception:   # noqa: BLE001 — a dying shard's gauge
+                pass            # must not fail the caller
+        return n
+
+    # ------------------------------------------------- failover plumbing
+
+    def _run(self, key: int, op):
+        """Run ``op(shard_client)`` on the key's primary; one
+        shard-unreachable error triggers failover and a single retry on
+        the new owner. TimeoutError stays an application answer (the
+        shard is alive, the round just isn't ready) — it must never
+        trigger a failover."""
+        for attempt in (0, 1):
+            s = self.placement.shard_of(key)
+            try:
+                return op(self._shards[s], s)
+            except TimeoutError:
+                raise
+            except (ConnectionError, OSError, ServerClosed) as e:
+                if attempt:
+                    raise
+                self.fail_shard(s, cause=e)
+
+    def fail_shard(self, shard: int, cause: Optional[BaseException] = None
+                   ) -> Dict[int, int]:
+        """Reroute + replay: reassign the dead shard's keys to their
+        ring successors, replay their inits there, re-base round
+        counters onto the replica log, and re-push the in-flight round.
+        Without replication there is nothing to replay — the original
+        error propagates (restart-level failure, loud)."""
+        with self._lock:
+            if shard in self._dead:
+                return {}
+            if self.replicas <= 0:
+                if cause is not None:
+                    raise cause
+                raise RuntimeError(
+                    f"shard {shard} unreachable and BPS_PLANE_REPLICAS=0 "
+                    f"— no replica log to fail over onto")
+            moved = self.placement.fail_shard(shard)
+            self._dead.add(shard)
+            self._m_failovers.inc()
+            get_logger().warning(
+                "plane: shard %d unreachable (%s) — failing over %d "
+                "key(s), placement epoch now %d", shard, cause,
+                len(moved), self.placement.epoch)
+            for key, dst in moved.items():
+                meta = self._meta.get(key)
+                if meta is not None:
+                    nbytes, dtype, init, compression = meta
+                    self._init_on(dst, key, nbytes, dtype, init,
+                                  compression)
+                # the new primary WAS the key's backup (ring successor),
+                # so the forward log is already local to it; its store
+                # counts rounds from 0 → re-base onto the logged round
+                base = self._repl_base_any(key, prefer=dst)
+                self._round_base[key] = base
+                inf = self._inflight.get(key)
+                if inf is not None and inf[0] > base and inf[1] is not None:
+                    # the admission-gate round in flight at death: only
+                    # this worker can replace its own contribution. Mark
+                    # the round replayed so a push retry racing this
+                    # failover (the push that DETECTED the death) does
+                    # not apply it a second time.
+                    self._shards[dst].push(key, inf[1])
+                    self._replayed[key] = inf[0]
+            try:
+                self._shards[shard].close()
+            except Exception:   # noqa: BLE001 — it is already dead
+                pass
+        return moved
+
+    def _init_on(self, shard: int, key: int, nbytes: int, dtype: str,
+                 init, compression) -> None:
+        sh = self._shards[shard]
+        if compression:
+            import inspect
+            if "compression" not in inspect.signature(
+                    sh.init_key).parameters:
+                # in-process PSServer shards take no codec registration
+                # (that lives at the transport/backend layer) — a
+                # compressed key on such a plane must fail at INIT, not
+                # as a TypeError inside a failover replay
+                raise ValueError(
+                    f"shard {shard} ({type(sh).__name__}) cannot "
+                    f"register a compression codec — compressed keys "
+                    f"need transport-backed plane shards")
+            sh.init_key(key, nbytes, dtype, init=init,
+                        compression=compression)
+        else:
+            sh.init_key(key, nbytes, dtype, init=init)
+
+    # ----------------------------------------------------- replica log
+
+    def _repl_base_any(self, key: int, prefer: int) -> int:
+        """Highest logged round across live shards' stores, preferring
+        ``prefer`` (the new primary — normally the only holder)."""
+        best = 0
+        order = [prefer] + [i for i in range(len(self._shards))
+                            if i != prefer and i not in self._dead]
+        for i in order:
+            try:
+                best = max(best, int(self._repl[i].repl_base(key)))
+            except Exception:   # noqa: BLE001 — a dead/din store is
+                continue        # simply not a log source
+        return best
+
+    def _repl_wait(self, key: int, round: int, timeout_ms: int) -> bytes:
+        """Fetch a logged round, waiting out the race where ANOTHER
+        worker's forward-log of it is still in flight."""
+        deadline = time.monotonic() + max(1, timeout_ms) / 1e3
+        while True:
+            prim = self.placement.shard_of(key)
+            order = [prim] + [i for i in range(len(self._shards))
+                              if i != prim and i not in self._dead]
+            for i in order:
+                try:
+                    data = self._repl[i].repl_get(key, round)
+                except Exception:   # noqa: BLE001 — skip dead stores
+                    continue
+                if data is not None:
+                    return data
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"pull({key}) round={round}: not in any replica log "
+                    f"(retention window passed, or the logging worker "
+                    f"died before its pull)")
+            time.sleep(0.01)
+
+    def _logs_key(self, key: int) -> bool:
+        """Is this worker the designated forward-logger for ``key``?
+        Every worker pulls the identical published merge, so ONE
+        logging it suffices (idempotent last-wins makes extras merely
+        redundant) — designated logging cuts the backup shard's ingest
+        and the pull tail's synchronous upload by the worker count.
+        ``worker_id=None`` (hand-built planes): everyone logs."""
+        if self.worker_id is None or self.num_workers <= 1:
+            return True
+        return key % self.num_workers == self.worker_id % self.num_workers
+
+    def _log_round(self, key: int, round: int, out: np.ndarray) -> None:
+        """Forward-log a completed round to the key's backup. The
+        backup dying is a shard death like any other: fail it over
+        (idempotent) and log to the NEW backup — the pull that carried
+        this merge was healthy and must not error."""
+        payload = out.tobytes()
+        for attempt in (0, 1):
+            b = self.placement.backup_of(key)
+            try:
+                self._repl[b].repl_put(key, round, payload)
+                break
+            except TimeoutError:
+                raise   # repl ops never block server-side: surface it
+            except (ConnectionError, OSError, ServerClosed) as e:
+                if attempt:
+                    raise
+                self.fail_shard(b, cause=e)
+        with self._lock:
+            self._logged[key] = max(self._logged.get(key, 0), round)
+            self._update_lag_locked(key)
+
+    def _update_lag_locked(self, key: int) -> None:
+        """O(1) gauge refresh for one key's push/log change; a full
+        rescan only when the current worst key improves."""
+        if not self._logs_key(key):
+            return          # never logged by this worker — not lag
+        lag = self._push_round.get(key, 0) - self._logged.get(key, 0)
+        # read the argmax's PREVIOUS lag before overwriting: when this
+        # very key is the argmax and just improved, the stale read
+        # would make lag >= cur trivially true and the rescan branch
+        # unreachable (gauge stuck low until another key's op)
+        old_argmax = self._lag_argmax
+        cur = (self._lag.get(old_argmax, -1)
+               if old_argmax is not None else -1)
+        self._lag[key] = lag
+        if lag >= cur:
+            self._lag_argmax = key
+            self._g_lag.set(lag)
+        elif key == old_argmax:
+            k2 = max(self._lag, key=self._lag.get)
+            self._lag_argmax = k2
+            self._g_lag.set(self._lag[k2])
+
+    # ------------------------------------------------------- data plane
+
+    def init_key(self, key: int, nbytes: int, dtype: str = "float32",
+                 init: Optional[np.ndarray] = None,
+                 compression: Optional[Dict[str, str]] = None) -> None:
+        self.placement.place(key, nbytes)
+        with self._lock:
+            if key not in self._meta:
+                self._meta[key] = (int(nbytes), dtype,
+                                   None if init is None else np.array(init),
+                                   dict(compression) if compression
+                                   else None)
+        self._run(key, lambda sh, i: self._init_on(
+            i, key, nbytes, dtype, init, compression))
+
+    def push(self, key: int, data: np.ndarray,
+             epoch: Optional[int] = None) -> None:
+        self.placement.check_epoch(key, epoch)
+        with self._lock:
+            seed = self._push_round.get(key)
+        if seed is None:
+            seed = int(self.round(key))  # elastic seed, like _next_round
+        keep = (np.array(data, copy=True) if self.replicas > 0 else None)
+        with self._mig_cv:
+            # wait-and-REGISTER is one critical section, the dual of
+            # migrate_key's drain-and-mark: while _migrating holds the
+            # key no new round can register (a push slipping onto the
+            # OLD primary would be silently absent from the replayed
+            # state), and once _inflight holds this round the migration
+            # drain blocks until its pull lands
+            while key in self._migrating:
+                self._mig_cv.wait(timeout=1.0)
+            lr = self._push_round.get(key, seed) + 1
+            self._push_round[key] = lr
+            self._inflight[key] = (lr, keep)
+            self._update_lag_locked(key)
+
+        def book(i, n=int(getattr(data, "nbytes", 0))):
+            with self._lock:
+                self._win_shard[i] = self._win_shard.get(i, 0) + n
+                self._win_key[key] = self._win_key.get(key, 0) + n
+
+        def do(sh, i):
+            with self._lock:
+                # a failover between the first attempt and this retry
+                # already re-pushed this round to the new owner —
+                # pushing again would double-count it
+                replayed = self._replayed.get(key) == lr
+                if replayed:
+                    del self._replayed[key]
+            if not replayed:
+                sh.push(key, data)
+            book(i)
+
+        self._run(key, do)
+
+    def pull(self, key: int, out: np.ndarray, round: int = 0,
+             timeout_ms: int = 30000,
+             epoch: Optional[int] = None) -> None:
+        self.placement.check_epoch(key, epoch)
+
+        def do(sh, i):
+            base = self._round_base.get(key, 0)
+            if round and round <= base:
+                # a round completed before the failover/migration: the
+                # live store never saw it — serve the forward log,
+                # bit-exact (every worker logged the same merge)
+                data = self._repl_wait(key, round, timeout_ms)
+                flat = np.frombuffer(data, dtype=out.dtype)
+                np.copyto(out.reshape(-1), flat[:out.size])
+                return
+            sh.pull(key, out, round=(round - base) if round else 0,
+                    timeout_ms=timeout_ms)
+
+        self._run(key, do)
+        if round:
+            # re-read base: a failover inside _run may have raised it.
+            # round <= base means the payload CAME from the forward log
+            # — uploading it back would be a redundant full-payload
+            # wire write on the pull tail.
+            if (self.replicas > 0 and self._logs_key(key)
+                    and round > self._round_base.get(key, 0)):
+                self._log_round(key, round, out)
+            with self._mig_cv:
+                inf = self._inflight.get(key)
+                if inf is not None and inf[0] <= round:
+                    del self._inflight[key]
+                    self._mig_cv.notify_all()   # migrate_key's drain
+
+    def round(self, key: int) -> int:
+        base = self._round_base.get(key, 0)
+        return base + int(self._run(key, lambda sh, i: sh.round(key)))
+
+    def push_bytes(self, key: int, payload) -> None:
+        """Compressed push — routed, epoch-checked upstream, but NOT
+        replicated (the codec payload is not the merged round; see
+        docs/server-plane.md failure matrix)."""
+        with self._mig_cv:
+            while key in self._migrating:
+                self._mig_cv.wait(timeout=1.0)
+            lr = self._push_round.get(key, 0) + 1
+            self._push_round[key] = lr
+            n = len(payload)
+            # window accounting only; no replay copy (unreplicated)
+            self._inflight[key] = (lr, None)
+
+        def do(sh, i):
+            sh.push_bytes(key, payload)
+            with self._lock:
+                self._win_shard[i] = self._win_shard.get(i, 0) + n
+                self._win_key[key] = self._win_key.get(key, 0) + n
+
+        self._run(key, do)
+
+    def pull_bytes(self, key: int, round: int = 0,
+                   timeout_ms: int = 30000) -> bytes:
+        base = self._round_base.get(key, 0)
+        data = self._run(key, lambda sh, i: sh.pull_bytes(
+            key, round=(round - base) if round else 0,
+            timeout_ms=timeout_ms))
+        with self._mig_cv:
+            inf = self._inflight.get(key)
+            if inf is not None and round and inf[0] <= round:
+                del self._inflight[key]
+                self._mig_cv.notify_all()   # migrate_key's drain
+        return data
+
+    # -------------------------------------------------------- migration
+
+    def migrate_key(self, key: int, dst: int,
+                    wait_s: float = 5.0) -> int:
+        """Move ``key`` to shard ``dst`` at a round boundary: wait for
+        the in-flight round to drain, replay the key's state (latest
+        merged round + init meta) to the new owner, re-base the round
+        translation, then publish placement epoch N+1. Returns the new
+        epoch. Raises TimeoutError if the key never reaches a round
+        boundary within ``wait_s`` (the rebalancer skips it and retries
+        next cycle)."""
+        deadline = time.monotonic() + wait_s
+        with self._mig_cv:
+            # drain-and-mark is ATOMIC: the instant the in-flight round
+            # clears, the key enters _migrating under the same lock, so
+            # no push can slip a fresh round onto the old primary
+            # between this check and the routing switch below (it would
+            # be silently absent from the replayed state)
+            while key in self._inflight:
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"key {key}: in-flight round never drained in "
+                        f"{wait_s:.1f}s — not at a round boundary")
+                self._mig_cv.wait(timeout=0.05)
+            self._migrating.add(key)
+        try:
+            src = self.placement.shard_of(key)
+            if src == dst:
+                return self.placement.epoch
+            meta = self._meta.get(key)
+            if meta is None:
+                raise KeyError(f"key {key} has no init meta to replay")
+            nbytes, dtype, init, compression = meta
+            sh = self._shards[src]
+            cr = int(sh.round(key))
+            state = init
+            if cr > 0:
+                buf = np.empty(nbytes // np.dtype(dtype).itemsize,
+                               dtype=dtype)
+                sh.pull(key, buf, round=cr, timeout_ms=5000)
+                state = buf
+            self._init_on(dst, key, nbytes, dtype, state, compression)
+            with self._lock:
+                self._round_base[key] = self._round_base.get(key, 0) + cr
+            return self.placement.migrate(key, dst)
+        finally:
+            with self._mig_cv:
+                self._migrating.discard(key)
+                self._mig_cv.notify_all()

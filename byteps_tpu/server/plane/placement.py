@@ -1,0 +1,359 @@
+"""Consistent-hash placement with byte-weighted assignment and epochs.
+
+Two layers:
+
+- ``HashRing``: a classic virtual-node consistent-hash ring. It fixes
+  the DETERMINISTIC ORDER in which shards are considered for a key
+  (the successor walk from the key's ring position) — the property
+  failover and striping need: when a shard dies its keys move to their
+  ring successors and nobody else's placement changes, and the stripes
+  of one large bucket land on consecutive DISTINCT shards.
+
+- ``PlacementService``: the authoritative key→shard table. Assignment
+  is BYTE-WEIGHTED: a new key goes to the lightest (by assigned bytes)
+  of its ring-preferred candidates, so ``place`` is balanced by
+  construction (max/min shard bytes stays within one key of even) —
+  this is the at-the-source fix for the hash hot-spots the djb2/
+  built_in placements measured (server/allreduce_emu.py: 5/16 buckets
+  on one shard, +25% round time). Deterministic given the same
+  ``place`` call order, which the exchange's declaration-order
+  contract already guarantees across workers (naming.py).
+
+Every assignment change (migration, failover) publishes a new
+PLACEMENT EPOCH. Ops tagged with a stale epoch are refused with
+``WrongEpoch`` — an explicit reroute signal — instead of landing on a
+shard that no longer owns the key and tearing the round's assembly.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional
+
+from ...obs.metrics import get_registry
+
+DEFAULT_VNODES = 64
+
+
+def _h64(s: str) -> int:
+    """FNV-1a over the string form — process-independent (placement
+    must agree across worker processes; Python's salted hash() cannot,
+    same reasoning as naming._raw_built_in)."""
+    h = 0xCBF29CE484222325
+    for ch in s:
+        h = ((h ^ ord(ch)) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def _mix64(x: int) -> int:
+    """splitmix64 finalizer for a KEY's ring position. Keys are
+    sequential integers (decl<<16 | bucket); FNV over their decimal
+    string leaves adjacent keys ~one multiply apart on the ring (they
+    differ only in the last digit), which clustered whole key ranges
+    onto one shard and made every key share one successor walk. A full
+    bit-avalanche mix spreads them uniformly; process-independent."""
+    x = (x + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return x ^ (x >> 31)
+
+
+class WrongEpoch(RuntimeError):
+    """An op carried a placement epoch older than the key's current
+    assignment: the key migrated since the caller resolved its route.
+    The op was REFUSED before touching any store — the caller must
+    refresh its placement view and reroute (ps_mode retries once with
+    the fresh epoch)."""
+
+    def __init__(self, key: int, current_epoch: int, owner: int) -> None:
+        super().__init__(
+            f"stale placement epoch for key {key}: key moved at epoch "
+            f"{current_epoch}, now owned by shard {owner} — refresh and "
+            f"reroute")
+        self.key = key
+        self.current_epoch = current_epoch
+        self.owner = owner
+
+
+class HashRing:
+    """Virtual-node consistent-hash ring over ``num_shards`` shards.
+
+    ``weights`` (relative byte capacity per shard, default equal)
+    scale each shard's vnode count, so a bigger server owns a
+    proportionally larger arc — the "byte-weighted virtual nodes" of
+    the placement story applied at the capacity level; the per-key
+    byte weighting lives in ``PlacementService.place``."""
+
+    def __init__(self, num_shards: int, vnodes: int = DEFAULT_VNODES,
+                 weights: Optional[List[float]] = None) -> None:
+        if num_shards <= 0:
+            raise ValueError("need at least one shard")
+        if weights is not None and len(weights) != num_shards:
+            raise ValueError(f"{len(weights)} weights for {num_shards} "
+                             f"shards")
+        self.num_shards = num_shards
+        self.vnodes = max(1, int(vnodes))
+        w = weights or [1.0] * num_shards
+        wmax = max(w)
+        pts: List[tuple] = []
+        for s in range(num_shards):
+            n = max(1, round(self.vnodes * w[s] / wmax))
+            for v in range(n):
+                # _mix64 on top of the label FNV: similar labels
+                # ("shard0#v1"/"shard0#v2") hash one multiply apart,
+                # which clustered each shard's vnodes into a few arcs —
+                # the avalanche spreads them over the whole ring
+                pts.append((_mix64(_h64(f"shard{s}#v{v}")), s))
+        pts.sort()
+        self._points = [p for p, _ in pts]
+        self._owners = [o for _, o in pts]
+
+    def lookup(self, key: int) -> int:
+        """The key's primary shard: first vnode clockwise of its hash."""
+        i = bisect.bisect_right(self._points, _mix64(key))
+        return self._owners[i % len(self._owners)]
+
+    def successors(self, key: int, k: int,
+                   skip: Optional[set] = None) -> List[int]:
+        """First ``k`` DISTINCT shards on the clockwise walk from the
+        key's position, excluding ``skip`` (dead shards). Fewer than
+        ``k`` live shards → all of them, in walk order."""
+        skip = skip or set()
+        i = bisect.bisect_right(self._points, _mix64(key))
+        out: List[int] = []
+        n = len(self._owners)
+        for j in range(n):
+            s = self._owners[(i + j) % n]
+            if s in skip or s in out:
+                continue
+            out.append(s)
+            if len(out) >= k:
+                break
+        return out
+
+
+def publish_shard_bytes(shard_bytes: Dict[int, int],
+                        keys_per_shard: Optional[Dict[int, int]] = None
+                        ) -> None:
+    """Publish per-shard byte (and optionally key-count) loads as
+    ``plane/shard_bytes/s<i>`` / ``plane/keys_per_shard/s<i>`` gauges —
+    ONE publisher shared by the PlacementService and the classic
+    ``HostPSBackend`` accounting, so the rebalancer and the watchdog
+    read the same numbers whichever backend is in play."""
+    reg = get_registry()
+    for s, b in shard_bytes.items():
+        reg.gauge(f"plane/shard_bytes/s{s}").set(b)
+    if keys_per_shard is not None:
+        for s, n in keys_per_shard.items():
+            reg.gauge(f"plane/keys_per_shard/s{s}").set(n)
+
+
+class PlacementService:
+    """Authoritative, epoch-versioned key→shard assignment.
+
+    ``fanout`` bounds the candidate set for a new key to its first
+    ``fanout`` ring successors (locality-preserving bounded-load mode);
+    ``fanout=0`` (default) considers every live shard with the ring
+    walk as the deterministic tie-break — true byte-greedy, balanced
+    by construction (max−min assigned bytes ≤ the largest single key).
+    """
+
+    def __init__(self, num_shards: int, vnodes: int = DEFAULT_VNODES,
+                 fanout: int = 0,
+                 weights: Optional[List[float]] = None) -> None:
+        self.ring = HashRing(num_shards, vnodes=vnodes, weights=weights)
+        self.num_shards = num_shards
+        self.fanout = int(fanout)
+        self.epoch = 1
+        self._lock = threading.Lock()
+        self._assign: Dict[int, int] = {}
+        self._key_bytes: Dict[int, int] = {}
+        self._key_epoch: Dict[int, int] = {}
+        self._shard_bytes: Dict[int, int] = {s: 0
+                                             for s in range(num_shards)}
+        self._dead: set = set()
+        reg = get_registry()
+        self._g_epoch = reg.gauge("plane/epoch")
+        self._g_epoch.set(self.epoch)
+
+    # ------------------------------------------------------- assignment
+
+    def _candidates(self, key: int) -> List[int]:
+        width = self.fanout if self.fanout > 0 else self.num_shards
+        return self.ring.successors(key, width, skip=self._dead)
+
+    def place(self, key: int, nbytes: int) -> int:
+        """Assign (or return the assignment of) ``key``. New keys go to
+        the lightest candidate by assigned bytes; ties break in ring
+        walk order. Idempotent per key — re-placing an assigned key
+        returns its current shard regardless of ``nbytes``."""
+        with self._lock:
+            s = self._assign.get(key)
+            if s is not None:
+                return s
+            cands = self._candidates(key)
+            if not cands:
+                raise RuntimeError("no live shards left in the plane")
+            # min() is first-wins on ties, and cands is already in ring
+            # walk order — the deterministic tie-break comes for free
+            s = min(cands, key=lambda c: self._shard_bytes[c])
+            self._assign[key] = s
+            self._key_bytes[key] = int(nbytes)
+            self._key_epoch[key] = self.epoch
+            self._shard_bytes[s] += int(nbytes)
+            self._publish_locked()
+            return s
+
+    def shard_of(self, key: int) -> int:
+        with self._lock:
+            try:
+                return self._assign[key]
+            except KeyError:
+                raise KeyError(f"key {key} has no placement — place() "
+                               f"runs at init_key") from None
+
+    def key_epoch(self, key: int) -> int:
+        """Epoch at which the key's CURRENT assignment became valid —
+        an op resolved before this epoch is stale (WrongEpoch)."""
+        with self._lock:
+            return self._key_epoch.get(key, 1)
+
+    def check_epoch(self, key: int, epoch: Optional[int]) -> None:
+        """Refuse an op whose placement view predates the key's current
+        assignment (see WrongEpoch). ``epoch=None`` = trust-the-table
+        (single-process callers that share this very service)."""
+        if epoch is None:
+            return
+        with self._lock:
+            cur = self._key_epoch.get(key, 1)
+            owner = self._assign.get(key, -1)
+        if epoch < cur:
+            get_registry().counter("plane/wrong_epoch").inc()
+            raise WrongEpoch(key, cur, owner)
+
+    def place_stripes(self, key: int, nstripes: int) -> List[int]:
+        """Placement-aware striping: the stripes of one large bucket
+        land on DISTINCT shards (the key's ring successors), so a hot
+        key's traffic spreads instead of saturating its primary. Fewer
+        live shards than stripes → shards repeat round-robin in walk
+        order (every stripe still has an owner)."""
+        with self._lock:
+            order = self.ring.successors(key, self.num_shards,
+                                         skip=self._dead)
+        if not order:
+            raise RuntimeError("no live shards left in the plane")
+        return [order[i % len(order)] for i in range(nstripes)]
+
+    # ------------------------------------------------- migration / death
+
+    def migrate(self, key: int, dst: int) -> int:
+        """Move ``key`` to shard ``dst`` and publish a new placement
+        epoch. Returns the new epoch. The DATA move (state replay,
+        round-base bookkeeping) is the backend's job — this is the
+        routing-table half."""
+        with self._lock:
+            if dst in self._dead or not 0 <= dst < self.num_shards:
+                raise ValueError(f"cannot migrate key {key} to shard "
+                                 f"{dst} (dead or out of range)")
+            src = self._assign.get(key)
+            if src is None:
+                raise KeyError(f"key {key} has no placement")
+            if src == dst:
+                return self.epoch
+            nb = self._key_bytes.get(key, 0)
+            self._shard_bytes[src] -= nb
+            self._shard_bytes[dst] += nb
+            self._assign[key] = dst
+            self.epoch += 1
+            self._key_epoch[key] = self.epoch
+            self._g_epoch.set(self.epoch)
+            get_registry().counter("plane/migrations").inc()
+            self._publish_locked()
+            return self.epoch
+
+    def fail_shard(self, shard: int) -> Dict[int, int]:
+        """Mark ``shard`` dead and reassign every key it owned to its
+        next LIVE ring successor — the key's backup, where its forward
+        log already lives. One epoch bump covers the whole failover.
+        Returns {key: new_shard} for the moved keys; idempotent — a
+        second report of the same death moves nothing."""
+        moved: Dict[int, int] = {}
+        with self._lock:
+            if shard in self._dead:
+                return moved
+            self._dead.add(shard)
+            if len(self._dead) >= self.num_shards:
+                raise RuntimeError("every shard in the plane is dead")
+            self.epoch += 1
+            for key, s in list(self._assign.items()):
+                if s != shard:
+                    continue
+                cands = [c for c in self._candidates(key) if c != shard]
+                # promote the FIRST live ring successor, not the
+                # lightest candidate: that is the key's backup
+                # (backup_of), so the forward log is already local to
+                # the new primary — the locality invariant replica.py
+                # and the failure matrix promise. Balance is the
+                # rebalancer's job, after the fire is out.
+                dst = cands[0]
+                nb = self._key_bytes.get(key, 0)
+                self._shard_bytes[shard] -= nb
+                self._shard_bytes[dst] += nb
+                self._assign[key] = dst
+                self._key_epoch[key] = self.epoch
+                moved[key] = dst
+            # publish the dead shard's (now zero) load BEFORE dropping
+            # it from the table — otherwise its gauge would freeze at
+            # the pre-failover value forever
+            self._g_epoch.set(self.epoch)
+            self._publish_locked()
+            self._shard_bytes.pop(shard, None)
+        return moved
+
+    def backup_of(self, key: int) -> int:
+        """The key's replication target: its first live ring successor
+        AFTER the primary — which is exactly the shard ``fail_shard``
+        walks to first, so after a failover the new primary already
+        holds the key's replica log locally."""
+        with self._lock:
+            s = self._assign.get(key)
+            order = self.ring.successors(key, self.num_shards,
+                                         skip=self._dead)
+        if len(order) < 2:
+            return order[0] if order else 0
+        if s in order:
+            return order[(order.index(s) + 1) % len(order)]
+        return order[0]
+
+    # ------------------------------------------------------------- views
+
+    def live_shards(self) -> List[int]:
+        with self._lock:
+            return [s for s in range(self.num_shards)
+                    if s not in self._dead]
+
+    def shard_bytes(self) -> Dict[int, int]:
+        with self._lock:
+            return dict(self._shard_bytes)
+
+    def keys_per_shard(self) -> Dict[int, int]:
+        with self._lock:
+            out = {s: 0 for s in self._shard_bytes}
+            for s in self._assign.values():
+                out[s] = out.get(s, 0) + 1
+            return out
+
+    def key_bytes(self) -> Dict[int, int]:
+        with self._lock:
+            return dict(self._key_bytes)
+
+    def assignment(self) -> Dict[int, int]:
+        with self._lock:
+            return dict(self._assign)
+
+    def _publish_locked(self) -> None:
+        out = {s: 0 for s in self._shard_bytes}
+        for s in self._assign.values():
+            out[s] = out.get(s, 0) + 1
+        publish_shard_bytes(dict(self._shard_bytes), out)

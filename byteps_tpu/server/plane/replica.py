@@ -1,0 +1,75 @@
+"""Primary-backup replication: the forward-log of summed rounds.
+
+A ``ReplicaStore`` lives NEXT TO a shard (attached to the in-process
+``PSServer`` by the plane backend, or hosted inside a
+``PSTransportServer`` and reached over the OP_REPL_* wire ops): it
+holds, per key, the BYTES of the last few completed (merged) rounds.
+Workers forward-log each round the moment its pull lands — the merged
+bytes are identical on every worker by construction (the server
+publishes one merge per round), so concurrent logs of the same
+(key, round) are idempotent last-wins writes.
+
+After a primary dies, the key's ring successor — which is where the
+replica log already lives (``PlacementService.backup_of``) — is
+promoted: pulls of logged rounds are served from the log bit-exact,
+and the one round the admission gate allows in flight is re-pushed by
+the workers (reroute + replay instead of a job restart). Retention is
+bounded to the cross-step in-flight window plus slack; anything a
+straggler could still legally pull is kept.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+# Rounds retained per key. The per-key admission gate bounds the live
+# window to 2 rounds (cross_step.py); 4 leaves slack for a straggler
+# pulling round k while k+1 and the log of k+2 race in.
+DEFAULT_RETAIN = 4
+
+
+class ReplicaStore:
+    """Bounded per-key round→bytes log with last-wins idempotent puts."""
+
+    def __init__(self, retain: int = DEFAULT_RETAIN) -> None:
+        self.retain = max(1, int(retain))
+        self._lock = threading.Lock()
+        self._rounds: Dict[int, Dict[int, bytes]] = {}
+        self._base: Dict[int, int] = {}     # highest logged round per key
+
+    def put(self, key: int, round: int, payload: bytes) -> None:
+        """Log round ``round``'s merged bytes for ``key``. Idempotent:
+        every worker pulled the same published merge, so a re-log (or a
+        concurrent log from another worker) writes identical bytes."""
+        if round <= 0:
+            raise ValueError(f"replica log rounds are 1-based, got {round}")
+        data = bytes(payload)
+        with self._lock:
+            log = self._rounds.setdefault(key, {})
+            log[round] = data
+            if round > self._base.get(key, 0):
+                self._base[key] = round
+            while len(log) > self.retain:
+                del log[min(log)]
+
+    def get(self, key: int, round: int) -> Optional[bytes]:
+        """The logged merged bytes, or None when that round was never
+        logged (or already aged out of the retention window)."""
+        with self._lock:
+            return self._rounds.get(key, {}).get(round)
+
+    def base(self, key: int) -> int:
+        """Highest logged round for ``key`` (0 = nothing logged) — the
+        round-translation base a promoted shard starts counting from."""
+        with self._lock:
+            return self._base.get(key, 0)
+
+    def keys(self):
+        with self._lock:
+            return list(self._rounds)
+
+    def drop_key(self, key: int) -> None:
+        with self._lock:
+            self._rounds.pop(key, None)
+            self._base.pop(key, None)

@@ -145,6 +145,14 @@ class _Round:
         # it, and the overlap aggregates group per step
         self.step_tag = step
         self.decl_name, self.treedef, self.keyed = ex._plan(tree, name)
+        # epoch-tagged routing (server plane): the placement view this
+        # round resolved its routes under. Every push/pull carries it;
+        # a key that migrated since is refused with WrongEpoch (an
+        # explicit reroute, never a torn assembly) and the exchange
+        # refreshes + retries once. None = placement-less backend.
+        self.route_epoch = (ex.backend.placement_epoch()
+                            if hasattr(ex.backend, "placement_epoch")
+                            else None)
         leaves, _ = jax.tree_util.tree_flatten(tree)
         self.shapes = [l.shape for l in leaves]
         # ingest rounds get their sources fed later; the template tree
@@ -258,7 +266,7 @@ class _Round:
         t0 = ex._record(self.decl_name, "PS_PACK", pskey, t0,
                         step=self.step_tag)
         try:
-            ex._push_bucket(pskey, b, buf)
+            ex._push_bucket(pskey, b, buf, rnd=self)
         except Exception:
             # the round counter advanced but the push never landed: drop
             # the entry so a retried exchange() re-seeds from the
@@ -278,7 +286,8 @@ class _Round:
         ex = self.ex
         pskey, b = self.keyed[idx]
         t0 = time.time()
-        merged = ex._pull_bucket(pskey, b, buf, self.rounds[idx])
+        merged = ex._pull_bucket(pskey, b, buf, self.rounds[idx],
+                                 rnd=self)
         t0 = ex._record(self.decl_name, "PS_PULL", pskey, t0,
                         step=self.step_tag)
         if ex._native_pack and merged.flags["C_CONTIGUOUS"]:
@@ -807,7 +816,22 @@ class PSGradientExchange:
                 return
         submit()                     # key stays busy for the successor
 
-    def _push_bucket(self, pskey, b, buf) -> None:
+    def _routed(self, rnd, op) -> None:
+        """Run ``op(epoch)`` under the round's placement-epoch tag.
+        WrongEpoch (the key migrated after the round resolved its
+        routes) is an explicit reroute signal: refresh the view and
+        retry ONCE with the fresh epoch — the plane's routing table is
+        authoritative, so the second attempt lands on the new owner."""
+        if rnd is None or rnd.route_epoch is None:
+            return op(None)
+        from .plane.placement import WrongEpoch
+        try:
+            return op(rnd.route_epoch)
+        except WrongEpoch:
+            rnd.route_epoch = self.backend.placement_epoch()
+            return op(rnd.route_epoch)
+
+    def _push_bucket(self, pskey, b, buf, rnd=None) -> None:
         chain = self._chains.get(pskey)
         if chain is not None:
             # COMPRESS stage right before PUSH (reference:
@@ -818,15 +842,22 @@ class PSGradientExchange:
             self.backend.push_bytes(pskey, payload)
         else:
             self._m_push_bytes.inc(buf.nbytes)
-            self.backend.push(pskey, buf)
+            self._routed(rnd, lambda epoch:
+                         self.backend.push(pskey, buf, epoch=epoch)
+                         if epoch is not None
+                         else self.backend.push(pskey, buf))
 
-    def _pull_bucket(self, pskey, b, buf, rnd):
+    def _pull_bucket(self, pskey, b, buf, rnd_num, rnd=None):
         chain = self._chains.get(pskey)
         if chain is not None:
-            payload = self.backend.pull_bytes(pskey, round=rnd)
+            payload = self.backend.pull_bytes(pskey, round=rnd_num)
             self._m_pull_bytes.inc(len(payload))
             return chain.decompress(payload).astype(b.dtype)
-        self.backend.pull(pskey, buf, round=rnd)
+        self._routed(rnd, lambda epoch:
+                     self.backend.pull(pskey, buf, round=rnd_num,
+                                       epoch=epoch)
+                     if epoch is not None
+                     else self.backend.pull(pskey, buf, round=rnd_num))
         self._m_pull_bytes.inc(buf.nbytes)
         return buf
 

@@ -95,6 +95,18 @@ OP_PULL_SHM = 11   # same; the server PULLs INTO the segment
 #     torn tensor assembled from two different rounds (ADVICE.md).
 OP_PUSH_PART = 12
 OP_PULL_PART = 13
+# Replica-log ops for the server plane's primary-backup replication
+# (byteps_tpu.server.plane): the forward-log of a key's summed rounds
+# lives in a ReplicaStore hosted by the BACKUP shard's transport
+# server, so after the primary dies the promoted shard replays pulls
+# from its local log bit-exact (docs/server-plane.md).
+#   OP_REPL_PUT: ``round`` = plane round, payload = merged bytes
+#     (idempotent last-wins; every worker logs the identical merge).
+#   OP_REPL_GET: ``round`` = plane round; response payload = one
+#     presence byte (0/1) + the logged bytes — a zero-length logged
+#     round stays distinguishable from "never logged".
+#   OP_REPL_BASE: response payload = u64 highest logged round.
+OP_REPL_PUT, OP_REPL_GET, OP_REPL_BASE = 14, 15, 16
 _PART = struct.Struct("!IIHHQ")  # offset, part_len, part_idx, nparts, nonce
 ST_OK, ST_ERR, ST_TIMEOUT, ST_GONE = 0, 1, 2, 3
 
@@ -298,7 +310,8 @@ def _send_req(sock: socket.socket, op: int, key: int, rnd: int, nbytes: int,
 # buffer — a new op that stashes a payload view past its handler return
 # degrades to an allocation instead of silently corrupting frames.
 _REUSE_SAFE_OPS = frozenset(
-    {OP_INIT, OP_PUSH, OP_PUSH_C, OP_PUSH_RS, OP_PUSH_PART})
+    {OP_INIT, OP_PUSH, OP_PUSH_C, OP_PUSH_RS, OP_PUSH_PART,
+     OP_REPL_PUT})   # ReplicaStore.put copies via bytes() synchronously
 
 
 def _recv_req(sock: socket.socket, rholder: Optional[list] = None):
@@ -421,6 +434,11 @@ class PSTransportServer:
         # retry window) of inactivity so elastic worker churn can't grow
         # the table without bound.
         self._push_seen: Dict[Tuple[int, int], _DedupState] = {}
+        # replica log hosted FOR other shards' keys (server plane
+        # primary-backup replication, OP_REPL_*) — created on first use
+        # so plain deployments never pay the import
+        self._replica = None
+        self._replica_lock = threading.Lock()
         self._shm = _ShmCache()
         # striping reassembly/scatter state (OP_PUSH_PART/OP_PULL_PART):
         # parts of one logical op arrive on DIFFERENT connection
@@ -647,6 +665,21 @@ class PSTransportServer:
                 part = st["data"][off:off + plen_]
                 conn.sendall(_RSP.pack(ST_OK, len(part)))
                 conn.sendall(part)
+            elif op == OP_REPL_PUT:
+                self._replica_store().put(key, int(rnd),
+                                          bytes(payload or b""))
+                conn.sendall(_RSP.pack(ST_OK, 0))
+            elif op == OP_REPL_GET:
+                data = self._replica_store().get(key, int(rnd))
+                if data is None:
+                    conn.sendall(_RSP.pack(ST_OK, 1) + b"\x00")
+                else:
+                    conn.sendall(_RSP.pack(ST_OK, 1 + len(data)) + b"\x01")
+                    conn.sendall(data)
+            elif op == OP_REPL_BASE:
+                rv = struct.pack("!Q",
+                                 int(self._replica_store().base(key)))
+                conn.sendall(_RSP.pack(ST_OK, len(rv)) + rv)
             elif op == OP_PULL_C:
                 from .compressed import compressed_pull
                 buf = compressed_pull(self.compressed, self.backend, key,
@@ -673,6 +706,14 @@ class PSTransportServer:
             else:   # backend rejections (bad length, key, …)
                 msg = f"{type(e).__name__}: {e}".encode()[:4096]
                 conn.sendall(_RSP.pack(ST_ERR, len(msg)) + msg)
+
+    def _replica_store(self):
+        if self._replica is None:
+            with self._replica_lock:
+                if self._replica is None:
+                    from .plane.replica import ReplicaStore
+                    self._replica = ReplicaStore()
+        return self._replica
 
     def _pull_dense(self, key, rnd, nbytes, dtype, timeout) -> np.ndarray:
         """Round-blocked engine pull in WIRE dtype — the one transcode
@@ -942,6 +983,17 @@ class RemotePSBackend:
         from ..common.naming import check_mixed_mode_enabled, placement_from_env
         check_mixed_mode_enabled(hash_fn)
         self._placement = placement_from_env()
+        # hash_fn="ring": byte-weighted consistent-hash placement from
+        # the server plane (balanced by construction under the
+        # exchange's declaration-order contract) instead of the env
+        # hash — see HostPSBackend for the full rationale
+        self._ring = None
+        if hash_fn == "ring" and len(addrs) > 1:
+            from .plane.placement import DEFAULT_VNODES, PlacementService
+            self._ring = PlacementService(
+                len(addrs),
+                vnodes=int(self._placement.get("vnodes") or 0)
+                or DEFAULT_VNODES)
         self.async_mode = async_mode
         self.reconnect_secs = (
             float(_os.environ.get("BPS_RECONNECT_SECS", "30"))
@@ -1025,6 +1077,15 @@ class RemotePSBackend:
         return s
 
     def _shard(self, key: int) -> int:
+        if self._ring is not None:
+            try:
+                return self._ring.shard_of(key)
+            except KeyError:
+                # pre-init op: ring-primary routing only — recording a
+                # zero-weight assignment here would poison the byte-
+                # weighted balance and diverge placement across workers
+                # (see HostPSBackend._shard_index)
+                return self._ring.ring.lookup(key)
         return place_key(key, len(self._pools), self.hash_fn,
                          **self._placement)
 
@@ -1170,6 +1231,8 @@ class RemotePSBackend:
     def init_key(self, key: int, nbytes: int, dtype: str = "float32",
                  init: Optional[np.ndarray] = None,
                  compression: Optional[Dict[str, str]] = None) -> None:
+        if self._ring is not None:
+            self._ring.place(key, nbytes)    # byte-weighted, idempotent
         if compression:
             from ..ops.compression.host import serialize_kwargs
             self._rpc(OP_INIT_C, key, 0, nbytes, 0, dtype,
@@ -1391,6 +1454,33 @@ class RemotePSBackend:
         """The server's latest completed round for ``key`` (see
         HostPSBackend.round — the elastic-rejoin resync point)."""
         data = self._rpc(OP_ROUND, key, 0, 0, 0, "uint8", None)
+        return struct.unpack("!Q", data)[0]
+
+    # Replica-log client (server plane primary-backup replication,
+    # docs/server-plane.md): the plane backend wraps SINGLE-address
+    # RemotePSBackend clients as shard handles, so these ops always
+    # target this client's one server — the shard the plane chose as
+    # the key's backup.
+
+    def repl_put(self, key: int, round: int, payload) -> None:
+        """Forward-log a completed round's merged bytes (idempotent
+        last-wins: every worker logs the identical published merge)."""
+        self._rpc(OP_REPL_PUT, key, int(round), 0, 0, "uint8",
+                  memoryview(bytes(payload)))
+
+    def repl_get(self, key: int, round: int) -> Optional[bytes]:
+        """The logged bytes for ``round``, or None when never logged /
+        aged out of the retention window."""
+        data = self._rpc(OP_REPL_GET, key, int(round), 0, 0, "uint8",
+                         None)
+        if not data or data[:1] == b"\x00":
+            return None
+        return data[1:]
+
+    def repl_base(self, key: int) -> int:
+        """Highest logged round (0 = nothing logged) — the round base a
+        promoted shard re-counts from after failover."""
+        data = self._rpc(OP_REPL_BASE, key, 0, 0, 0, "uint8", None)
         return struct.unpack("!Q", data)[0]
 
     def push_bytes(self, key: int, payload) -> None:

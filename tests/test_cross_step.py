@@ -118,6 +118,52 @@ def test_two_round_window_striped_path_exact():
         os.environ.pop("BPS_STRIPE_MIN", None)
 
 
+def test_stale_epoch_rerouted_not_torn():
+    """Server-plane epoch contract alongside the two-round window: a
+    worker whose round resolved its routes BEFORE a key migrated gets
+    an explicit ``WrongEpoch`` reroute from the plane (never a torn
+    assembly), and the exchange refreshes + retries once — the round
+    completes exactly on the new owner."""
+    from byteps_tpu.obs.metrics import get_registry
+    from byteps_tpu.server.engine import PSServer
+    from byteps_tpu.server.plane import PlanePSBackend, WrongEpoch
+    from byteps_tpu.server.ps_mode import _Round
+
+    shards = [PSServer(num_workers=1, engine_threads=1) for _ in range(2)]
+    plane = PlanePSBackend(shards, num_workers=1, replicas=1,
+                           owns_shards=True)
+    try:
+        t1, t2 = _tree(1), _tree(2)
+        ex = PSGradientExchange(plane, partition_bytes=4 << 10)
+        r1 = ex.exchange(t1, name="ep")           # round 1, clean epoch
+        for k in sorted(t1):
+            np.testing.assert_array_equal(np.asarray(r1[k]), t1[k])
+        # round 2 resolves its routes, THEN a key migrates under it
+        rnd = _Round(ex, t2, "ep", stream=False)
+        stale = rnd.route_epoch
+        pskey = rnd.keyed[0][0]
+        dst = 1 - plane.placement.shard_of(pskey)
+        plane.migrate_key(pskey, dst)
+        assert plane.placement_epoch() > stale
+        # the raw stale op is refused loudly...
+        with pytest.raises(WrongEpoch):
+            plane.push(pskey, np.zeros(4, np.float32), epoch=stale)
+        wrong_before = get_registry().counter("plane/wrong_epoch").value
+        # ...and the exchange's routed path retries with a fresh view
+        bufs = [rnd.push_one(i) for i in range(len(rnd.keyed))]
+        for i, buf in enumerate(bufs):
+            rnd.pull_one(i, buf)
+        out = rnd.assemble()
+        for k in sorted(t2):
+            np.testing.assert_array_equal(np.asarray(out[k]), t2[k])
+        assert get_registry().counter("plane/wrong_epoch").value \
+            > wrong_before
+        assert rnd.route_epoch == plane.placement_epoch()
+        ex.close()
+    finally:
+        plane.close()
+
+
 def test_pull_order_follows_next_use_priority():
     """Hold every pull behind a gate until ALL pushes landed, then
     release: the backlog must drain input-side-first (ascending min

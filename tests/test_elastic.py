@@ -119,6 +119,11 @@ def test_per_key_round_seeding_handles_divergent_keys():
         k0 = keys[0]
         sz = 2000 // 4
         w.push(k0, np.full(sz, 7.0, np.float32))
+        # the push ACK precedes the engine's async sum — poll briefly
+        # for the round to publish instead of racing it
+        deadline = time.time() + 5.0
+        while w.round(k0) != 2 and time.time() < deadline:
+            time.sleep(0.01)
         assert w.round(k0) == 2 and w.round(keys[1]) == 1
         w.close()
 

@@ -173,6 +173,110 @@ def test_exchange_survives_random_connection_kills(monkeypatch):
 
 
 @pytest.mark.slow
+def test_plane_failover_tcp_bit_identical(monkeypatch):
+    """Kill one server-plane shard mid-step over the REAL TCP
+    transport: two workers, two transport servers, replicas=1. Round
+    3 is pushed to the victim but not yet pulled when the server dies
+    — each worker's plane must reroute the dead shard's keys to their
+    ring successor (where the replica logs already live, via the
+    OP_REPL_* wire ops), re-push its own in-flight contribution, and
+    finish every round BIT-IDENTICAL to a no-fault run (the
+    test_grad_exactness-style contract, applied to failover). One
+    failover per worker plane lands in the registry."""
+    monkeypatch.delenv("BPS_ENABLE_SHM", raising=False)
+    from byteps_tpu.obs.metrics import get_registry
+    from byteps_tpu.server.plane import PlanePSBackend
+
+    keys = list(range(4))
+    nb = 64 << 10
+
+    def data(w, k, r):
+        return np.random.RandomState(1000 * w + 10 * k + r).randn(
+            nb // 4).astype(np.float32)
+
+    def run(kill: bool):
+        """4 keys x 4 rounds x 2 worker threads; with ``kill``, the
+        shard owning key 0 dies after round 3's pushes land. Returns
+        {(worker, key, round): merged array}."""
+        engines = [PSServer(num_workers=2, engine_threads=1)
+                   for _ in range(2)]
+        servers = [PSTransportServer(e, host="127.0.0.1", port=0)
+                   for e in engines]
+        addrs = [f"127.0.0.1:{s.port}" for s in servers]
+        results, errors = {}, []
+        barrier = threading.Barrier(3)
+        planes = []
+
+        def worker(w: int):
+            try:
+                shards = [RemotePSBackend([a], reconnect_secs=1.0)
+                          for a in addrs]
+                plane = PlanePSBackend(shards, num_workers=2,
+                                       replicas=1, owns_shards=True)
+                planes.append(plane)
+                for k in keys:
+                    plane.init_key(k, nb)
+                for r in (1, 2):
+                    for k in keys:
+                        plane.push(k, data(w, k, r))
+                    for k in keys:
+                        out = np.empty(nb // 4, np.float32)
+                        plane.pull(k, out, round=r)
+                        results[(w, k, r)] = out.copy()
+                for k in keys:
+                    plane.push(k, data(w, k, 3))
+                barrier.wait(timeout=60)    # round-3 pushes landed
+                barrier.wait(timeout=60)    # victim is dead (if kill)
+                for k in keys:
+                    out = np.empty(nb // 4, np.float32)
+                    plane.pull(k, out, round=3)
+                    results[(w, k, 3)] = out.copy()
+                for k in keys:
+                    plane.push(k, data(w, k, 4))
+                for k in keys:
+                    out = np.empty(nb // 4, np.float32)
+                    plane.pull(k, out, round=4)
+                    results[(w, k, 4)] = out.copy()
+                plane.close()
+            except Exception as e:      # noqa: BLE001 — surfaced below
+                errors.append((w, repr(e)))
+                try:
+                    barrier.abort()
+                except Exception:
+                    pass
+
+        ts = [threading.Thread(target=worker, args=(w,))
+              for w in range(2)]
+        try:
+            [t.start() for t in ts]
+            barrier.wait(timeout=120)
+            if kill:
+                victim = planes[0].placement.shard_of(0)
+                servers[victim].close()
+                engines[victim].close()
+            barrier.wait(timeout=60)
+            for t in ts:
+                t.join(timeout=120)
+            assert not any(t.is_alive() for t in ts), "worker hung"
+            assert not errors, errors
+        finally:
+            for s in servers:
+                s.close()
+            for e in engines:
+                e.close()
+        return results
+
+    ref = run(kill=False)
+    get_registry().counter("plane/failovers").reset()
+    got = run(kill=True)
+    # one failover per worker plane (each detects the death itself)
+    assert get_registry().counter("plane/failovers").value == 2
+    assert set(got) == set(ref)
+    for wkr, arr in ref.items():
+        assert np.array_equal(got[wkr], arr), f"{wkr} diverged"
+
+
+@pytest.mark.slow
 def test_watchdog_dumps_on_lost_peer_push(monkeypatch):
     """Watchdog integration over the REAL transport: a 2-worker server
     where the second worker never pushes is exactly the wedge the

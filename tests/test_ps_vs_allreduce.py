@@ -55,6 +55,10 @@ def test_ring_allreduce_matches_bandwidth_model():
     assert t == pytest.approx(pred, rel=0.25), (t, pred)
 
 
+# wall-clock bandwidth races through the full emulated fleet — minutes
+# of wire time, and scheduler-dominated (flaky) on a loaded shared-core
+# box; slow lane keeps them gating merges without starving tier-1
+@pytest.mark.slow
 def test_ps_beats_ring_in_bandwidth_bound_regime():
     """THE claim: with s=n extra server machines behind equal NICs, the
     PS data plane completes a sync round faster than ring allreduce —
@@ -82,6 +86,7 @@ def test_ps_colocated_loses_to_ring():
     assert t_colo > t_ring, (t_colo, t_ring)
 
 
+@pytest.mark.slow
 def test_compressed_ps_crushes_bandwidth_bound_regime():
     """onebit-compressed PS (G/32 wire bytes through the native server
     codec) must beat BOTH dense PS and ring by a wide margin when

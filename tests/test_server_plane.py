@@ -1,0 +1,492 @@
+"""Server plane (byteps_tpu/server/plane): byte-weighted consistent-hash
+placement with versioned epochs, primary-backup replication with
+failover = reroute + replay, and the load-aware rebalancer.
+
+Contracts under test:
+  - placement is BALANCED BY CONSTRUCTION (max/min shard bytes <= 1.3x
+    on the allreduce-emu bucket workload that measured djb2 at 5/16 on
+    one shard) and deterministic across workers under the declaration-
+    order contract;
+  - a stale placement epoch is refused with an explicit ``WrongEpoch``
+    reroute, never a torn assembly;
+  - killing a shard mid-run converges BIT-IDENTICALLY to a no-fault
+    run (replica-log replay + in-flight re-push), with
+    ``plane/failovers == 1`` in the metrics registry — the in-process
+    tier-1 twin of the TCP kill test in test_fault_injection.py;
+  - migration happens at round boundaries, re-bases round counters,
+    and keeps the ``plane/shard_bytes`` gauges truthful (the same
+    numbers the rebalancer and the watchdog read).
+"""
+
+import numpy as np
+import pytest
+
+from byteps_tpu.obs.metrics import get_registry
+from byteps_tpu.server.engine import HostPSBackend, PSServer
+from byteps_tpu.server.plane import (PlanePSBackend, PlacementService,
+                                     Rebalancer, ReplicaStore, WrongEpoch)
+
+KB = 1 << 10
+
+
+def _mk_plane(n_shards=2, replicas=1, num_workers=1):
+    shards = [PSServer(num_workers=num_workers, engine_threads=1)
+              for _ in range(n_shards)]
+    return PlanePSBackend(shards, num_workers=num_workers,
+                          replicas=replicas, owns_shards=True), shards
+
+
+# ---------------------------------------------------------- placement
+
+def test_ring_deterministic_and_successors_distinct():
+    from byteps_tpu.server.plane.placement import HashRing
+    r1, r2 = HashRing(4), HashRing(4)
+    for k in range(100):
+        assert r1.lookup(k) == r2.lookup(k)
+        succ = r1.successors(k, 4)
+        assert sorted(succ) == [0, 1, 2, 3]       # distinct, complete
+        assert succ[0] == r1.lookup(k)            # walk starts at primary
+        assert r1.successors(k, 2, skip={succ[0]})[0] == succ[1]
+
+
+def test_placement_balanced_by_construction():
+    """The at-the-source fix for the allreduce_emu djb2 hot spot
+    (5/16 buckets on one shard, +25% round time): byte-weighted
+    assignment keeps max/min shard bytes within 1.3x on the same
+    bucket-key workload (decl<<16 | i), equal and mixed sizes alike."""
+    ps = PlacementService(4)
+    for i in range(16):                       # the emu's 16 equal buckets
+        ps.place((7 << 16) | i, 1 << 20)
+    loads = ps.shard_bytes()
+    assert max(loads.values()) / min(loads.values()) <= 1.3, loads
+
+    ps = PlacementService(3)
+    rng = np.random.RandomState(0)
+    for i in range(40):                       # mixed sizes, several decls
+        ps.place((int(rng.randint(1, 9)) << 16) | i,
+                 int(rng.choice([64, 256, 1024, 4096])) * KB)
+    loads = ps.shard_bytes()
+    assert max(loads.values()) / min(loads.values()) <= 1.3, loads
+
+
+def test_place_key_ring_spread():
+    """Stateless ``place_key(..., "ring")`` (bare callers) must not
+    cluster sequential bucket keys onto one shard the way the string
+    hashes did."""
+    from collections import Counter
+
+    from byteps_tpu.common.naming import place_key
+    counts = Counter(place_key((5 << 16) | i, 4, "ring")
+                     for i in range(64))
+    assert len(counts) == 4, counts
+    assert max(counts.values()) <= 3 * min(counts.values()), counts
+
+
+def test_place_stripes_land_on_distinct_shards():
+    ps = PlacementService(4)
+    ps.place(1, 8 << 20)
+    stripes = ps.place_stripes(1, 4)
+    assert sorted(stripes) == [0, 1, 2, 3]
+    # more stripes than shards: round-robin in walk order, all owned
+    assert ps.place_stripes(1, 6)[:4] == stripes
+
+
+def test_host_backend_ring_balance_and_migrate_accounting():
+    """HostPSBackend(hash_fn="ring"): balanced init placement, and
+    migrate_key keeps ``_shard_bytes`` + the ``plane/shard_bytes``
+    gauges truthful (the rebalancer and the watchdog read the same
+    numbers) while rounds stay continuous across the move."""
+    get_registry().reset()
+    be = HostPSBackend(num_servers=2, num_workers=1, engine_threads=1,
+                       hash_fn="ring")
+    try:
+        for i in range(8):
+            be.init_key((3 << 16) | i, 64 * KB)
+        loads = dict(be._shard_bytes)
+        assert max(loads.values()) / min(loads.values()) <= 1.3, loads
+        key = (3 << 16) | 0
+        d = np.arange(16 * KB, dtype=np.float32)
+        assert np.array_equal(be.push_pull(key, d), d)
+        src = be._shard_index(key)
+        dst = 1 - src
+        be.migrate_key(key, dst)
+        assert be._shard_index(key) == dst
+        # accounting moved with the key, and the gauges agree
+        assert be._shard_bytes[src] == loads[src] - 64 * KB
+        assert be._shard_bytes[dst] == loads[dst] + 64 * KB
+        for s, b in be._shard_bytes.items():
+            assert get_registry().gauge(
+                f"plane/shard_bytes/s{s}").value == b
+        assert get_registry().counter("plane/migrations").value == 1
+        # rounds continue across the move (base + shard-local round)
+        assert be.round(key) == 1
+        assert np.array_equal(be.push_pull(key, d * 2), d * 2)
+        assert be.round(key) == 2
+    finally:
+        be.close()
+
+
+# ------------------------------------------------------------- epochs
+
+def test_stale_epoch_refused_with_wrong_epoch():
+    plane, _ = _mk_plane()
+    try:
+        plane.init_key(0, 4 * KB)
+        epoch0 = plane.placement_epoch()
+        d = np.ones(KB, np.float32)
+        plane.push(0, d, epoch=epoch0)          # current epoch: accepted
+        out = np.empty_like(d)
+        plane.pull(0, out, round=1, epoch=epoch0)
+        dst = 1 - plane.placement.shard_of(0)
+        plane.migrate_key(0, dst)               # publishes epoch N+1
+        with pytest.raises(WrongEpoch) as ei:
+            plane.push(0, d, epoch=epoch0)
+        assert ei.value.owner == dst            # the reroute answer
+        assert get_registry().counter("plane/wrong_epoch").value >= 1
+        # fresh epoch: routed to the new owner, round base carried
+        plane.push(0, d * 3, epoch=plane.placement_epoch())
+        plane.pull(0, out, round=2, epoch=plane.placement_epoch())
+        np.testing.assert_array_equal(out, d * 3)
+    finally:
+        plane.close()
+
+
+# -------------------------------------------------------- replication
+
+def test_replica_store_retention_and_idempotence():
+    rs = ReplicaStore(retain=2)
+    rs.put(5, 1, b"a" * 8)
+    rs.put(5, 1, b"a" * 8)                      # idempotent last-wins
+    rs.put(5, 2, b"b" * 8)
+    rs.put(5, 3, b"c" * 8)
+    assert rs.get(5, 1) is None                 # aged out (retain=2)
+    assert rs.get(5, 3) == b"c" * 8
+    assert rs.base(5) == 3
+    with pytest.raises(ValueError):
+        rs.put(5, 0, b"")                       # rounds are 1-based
+
+
+def _run_rounds(plane, keys, rounds, data, results, start=1):
+    for r in range(start, start + rounds):
+        for k in keys:
+            plane.push(k, data(k, r))
+        for k in keys:
+            out = np.empty_like(data(k, r))
+            plane.pull(k, out, round=r)
+            results[(k, r)] = out.copy()
+
+
+def test_failover_bit_identical_to_no_fault_run():
+    """Kill one in-process shard mid-step: the plane reroutes the dead
+    shard's keys to their ring successors (where the replica logs
+    live), replays state, re-pushes the in-flight round — and every
+    subsequent pull is BIT-IDENTICAL to a run with no fault, with
+    exactly one failover in the registry. The tier-1 twin of the TCP
+    kill test (test_fault_injection.py, slow lane)."""
+    get_registry().reset()
+    keys = list(range(4))
+    nb = 16 * KB
+
+    def data(k, r):
+        return np.random.RandomState(100 * k + r).randn(
+            nb // 4).astype(np.float32)
+
+    # reference: no fault
+    ref_plane, _ = _mk_plane()
+    ref = {}
+    try:
+        for k in keys:
+            ref_plane.init_key(k, nb)
+        _run_rounds(ref_plane, keys, 4, data, ref)
+    finally:
+        ref_plane.close()
+
+    plane, shards = _mk_plane()
+    got = {}
+    try:
+        for k in keys:
+            plane.init_key(k, nb)
+        _run_rounds(plane, keys, 2, data, got)
+        victim = plane.placement.shard_of(keys[0])
+        epoch_before = plane.placement.epoch
+        # round 3 pushed but NOT yet pulled when the shard dies: the
+        # in-flight round must be re-pushed to the new owner (replay),
+        # rounds 1-2 must come from the forward log
+        for k in keys:
+            plane.push(k, data(k, 3))
+        shards[victim].close()
+        for k in keys:
+            out = np.empty(nb // 4, np.float32)
+            plane.pull(k, out, round=3)
+            got[(k, 3)] = out.copy()
+        _run_rounds(plane, keys, 1, data, got, start=4)
+        assert get_registry().counter("plane/failovers").value == 1
+        assert plane.placement.epoch == epoch_before + 1
+        assert victim not in plane.placement.live_shards()
+        # the dead shard's completed pre-fault rounds replay from the
+        # backup's forward log, bit-exact
+        moved = [k for k in keys
+                 if plane._round_base.get(k, 0) > 0]
+        assert moved, "victim owned no keys — placement degenerate"
+        for k in moved:
+            out = np.empty(nb // 4, np.float32)
+            plane.pull(k, out, round=2)
+            np.testing.assert_array_equal(out.copy(), ref[(k, 2)])
+        for kr, arr in ref.items():
+            assert np.array_equal(got[kr], arr), f"{kr} diverged"
+    finally:
+        plane.close()
+
+
+def test_backup_shard_death_during_log_fails_over_not_errors():
+    """The backup dying must not error a HEALTHY pull: _log_round
+    fails the backup over (idempotent) and logs to the new backup —
+    the plane exists precisely so 'a server death = reroute + replay',
+    whichever role the dead shard played for this key. The death is
+    injected on the replica handle (over the wire it surfaces as a
+    ConnectionError from the dropped TCP connection)."""
+    get_registry().reset()
+    plane, _ = _mk_plane(n_shards=3)
+    try:
+        plane.init_key(0, 4 * KB)
+        d = np.arange(KB, dtype=np.float32)
+        plane.push(0, d)
+        out = np.empty_like(d)
+        plane.pull(0, out, round=1)
+        backup = plane.placement.backup_of(0)
+        assert backup != plane.placement.shard_of(0)
+
+        class _DeadRepl:                        # the backup's store is
+            def repl_put(self, *a, **k):        # unreachable from now on
+                raise ConnectionError("injected backup death")
+
+            def repl_get(self, *a, **k):
+                raise ConnectionError("injected backup death")
+
+            def repl_base(self, *a, **k):
+                raise ConnectionError("injected backup death")
+
+        plane._repl[backup] = _DeadRepl()
+        plane.push(0, d * 2)
+        plane.pull(0, out, round=2)             # pull is healthy...
+        np.testing.assert_array_equal(out, d * 2)
+        # ...and the death was absorbed as a failover, with the round
+        # logged to the NEW backup (readable through the plane's wait)
+        assert get_registry().counter("plane/failovers").value == 1
+        assert backup not in plane.placement.live_shards()
+        assert plane.placement.backup_of(0) != backup
+        assert plane._repl_wait(0, 2, timeout_ms=2000) == (d * 2).tobytes()
+    finally:
+        plane.close()
+
+
+def test_replicas_refuse_async_shards():
+    class _AsyncShard:
+        async_mode = True
+
+        def close(self):
+            pass
+
+    with pytest.raises(ValueError, match="async"):
+        PlanePSBackend([_AsyncShard(), _AsyncShard()], replicas=1)
+
+
+def test_designated_logger_splits_keys_by_rank():
+    """worker_id given: exactly one worker logs each key; None (the
+    hand-built default) logs everything."""
+    p0, _ = _mk_plane(num_workers=1)
+    try:
+        assert all(p0._logs_key(k) for k in range(4))   # default: all
+    finally:
+        p0.close()
+    shards = [PSServer(num_workers=1, engine_threads=1) for _ in range(2)]
+    plane = PlanePSBackend(shards, num_workers=2, replicas=1,
+                           owns_shards=True, worker_id=1)
+    try:
+        mine = [k for k in range(6) if plane._logs_key(k)]
+        assert mine == [1, 3, 5]
+    finally:
+        plane.close()
+
+
+def test_host_backend_refuses_pre_migration_round():
+    """No forward log in the classic backend: a pull of a round at or
+    below the migration base must be refused loudly, not silently
+    served from the destination's fresh rounds."""
+    be = HostPSBackend(num_servers=2, num_workers=1, engine_threads=1,
+                       hash_fn="ring")
+    try:
+        be.init_key(0, 4 * KB)
+        d = np.arange(KB, dtype=np.float32)
+        assert np.array_equal(be.push_pull(0, d), d)
+        be.migrate_key(0, 1 - be._shard_index(0))       # base = 1
+        out = np.empty_like(d)
+        with pytest.raises(ValueError, match="migration base"):
+            be.pull(0, out, round=1)
+        assert np.array_equal(be.push_pull(0, d * 2), d * 2)  # round 2 ok
+    finally:
+        be.close()
+
+
+def test_failover_without_replicas_is_loud():
+    plane, shards = _mk_plane(replicas=0)
+    try:
+        plane.init_key(0, 4 * KB)
+        shards[plane.placement.shard_of(0)].close()
+        with pytest.raises(Exception):
+            plane.push(0, np.ones(KB, np.float32))
+    finally:
+        plane.close()
+
+
+# ---------------------------------------------------------- migration
+
+def test_migration_at_round_boundary_with_log_replay():
+    plane, _ = _mk_plane()
+    try:
+        plane.init_key(0, 4 * KB)
+        d = np.arange(KB, dtype=np.float32)
+        plane.push(0, d)
+        out = np.empty_like(d)
+        plane.pull(0, out, round=1)
+        src = plane.placement.shard_of(0)
+        epoch = plane.migrate_key(0, 1 - src)
+        assert epoch == plane.placement.epoch
+        assert plane.placement.shard_of(0) == 1 - src
+        assert plane.round(0) == 1               # continuity across move
+        plane.push(0, d * 5)
+        plane.pull(0, out, round=2)
+        np.testing.assert_array_equal(out, d * 5)
+        plane.pull(0, out, round=1)              # pre-move round: log
+        np.testing.assert_array_equal(out, d)
+    finally:
+        plane.close()
+
+
+def test_exchange_over_plane_epoch_tagged():
+    """PSGradientExchange runs unchanged over the plane (same duck
+    interface), with every push/pull carrying the round's placement
+    epoch."""
+    from byteps_tpu.server.ps_mode import PSGradientExchange
+    plane, _ = _mk_plane(n_shards=2)
+    try:
+        ex = PSGradientExchange(plane, partition_bytes=4 * KB)
+        tree = {f"k{i}": np.random.RandomState(i).randn(2048)
+                .astype(np.float32) for i in range(3)}
+        for _ in range(2):
+            out = ex.exchange(tree, name="pl")
+            for k in tree:
+                np.testing.assert_array_equal(np.asarray(out[k]), tree[k])
+        ex.close()
+    finally:
+        plane.close()
+
+
+# ---------------------------------------------------------- rebalance
+
+def test_rebalancer_moves_hot_keys_to_cold_shard():
+    plane, _ = _mk_plane(n_shards=2, replicas=1)
+    try:
+        for k in range(6):
+            plane.init_key(k, 8 * KB)
+        # skew the live load: every key on shard `hot` pushes 10x more
+        assign = plane.placement.assignment()
+        hot = max(set(assign.values()),
+                  key=lambda s: sum(1 for v in assign.values() if v == s))
+        d = np.ones(2 * KB, np.float32)
+        out = np.empty_like(d)
+        rounds = {k: 0 for k in range(6)}
+        for k in range(6):
+            reps = 10 if assign[k] == hot else 1
+            for _ in range(reps):
+                plane.push(k, d)
+                rounds[k] += 1
+                plane.pull(k, out, round=rounds[k])
+        rb = Rebalancer(plane, imbalance=1.3, max_moves=2)
+        decision = rb.step()
+        assert decision["hot"] == hot
+        assert decision["moved"], decision
+        moved_keys = [m["key"] for m in decision["moved"] if "to" in m]
+        for k in moved_keys:
+            assert plane.placement.shard_of(k) != hot
+        assert get_registry().counter("plane/migrations").value >= 1
+        # the decision record carries the registry signals it read
+        assert "merge_wait_p95_ms" in decision
+        assert "queue_depth" in decision
+    finally:
+        plane.close()
+
+
+def test_rebalancer_noop_when_balanced():
+    plane, _ = _mk_plane(n_shards=2)
+    try:
+        for k in range(4):
+            plane.init_key(k, 8 * KB)
+        rb = Rebalancer(plane, imbalance=1.3)
+        d1 = rb.step()
+        assert d1.get("skip") == "balanced" or not d1["moved"], d1
+    finally:
+        plane.close()
+
+
+# ----------------------------------------------------- gauges / bench
+
+def test_shard_bytes_gauges_published():
+    get_registry().reset()
+    plane, _ = _mk_plane(n_shards=2)
+    try:
+        plane.init_key(0, 64 * KB)
+        plane.init_key(1, 32 * KB)
+        loads = plane.shard_bytes()
+        for s, b in loads.items():
+            assert get_registry().gauge(
+                f"plane/shard_bytes/s{s}").value == b
+        assert get_registry().gauge("plane/epoch").value >= 1
+    finally:
+        plane.close()
+
+
+def test_global_state_wires_plane_from_env(monkeypatch):
+    """BPS_PLANE_REPLICAS>0 with multiple BPS_SERVER_ADDRS wraps the
+    shards in the managed plane at bps.init(), and the stock exchange
+    runs through it unchanged."""
+    from byteps_tpu.server.transport import PSTransportServer
+    engines = [PSServer(num_workers=1, engine_threads=1)
+               for _ in range(2)]
+    servers = [PSTransportServer(e, host="127.0.0.1", port=0)
+               for e in engines]
+    monkeypatch.delenv("BPS_ENABLE_SHM", raising=False)
+    monkeypatch.setenv("BPS_ENABLE_PS", "1")
+    monkeypatch.setenv("BPS_SERVER_ADDRS",
+                       ",".join(f"127.0.0.1:{s.port}" for s in servers))
+    monkeypatch.setenv("BPS_PLANE_REPLICAS", "1")
+    import byteps_tpu as bps
+    from byteps_tpu.common.global_state import GlobalState
+    try:
+        bps.init(config=bps.Config.from_env())
+        gs = GlobalState.get()
+        assert isinstance(gs.ps_backend, PlanePSBackend)
+        assert gs.ps_backend.replicas == 1
+        tree = {"g": np.arange(1024, dtype=np.float32)}
+        out = gs.engine.ps_exchange.exchange(tree, name="wire")
+        np.testing.assert_array_equal(np.asarray(out["g"]), tree["g"])
+    finally:
+        bps.shutdown()
+        for s in servers:
+            s.close()
+        for e in engines:
+            e.close()
+
+
+@pytest.mark.slow
+def test_bench_ps_plane_smoke():
+    """CI slow-lane smoke of the shard-scaling A/B: on the
+    server-egress-bound config, adding a shard must move the
+    throughput curve (ratio > 1.0 going 1 -> 2 shards)."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+    out = bench.ps_plane_breakdown(iters=2, warm=1)
+    assert out["shards_1_to_2"] > 1.0, out
