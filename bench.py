@@ -557,6 +557,184 @@ def ps_cross_breakdown(iters: int = 10, warm: int = 3,
     return out
 
 
+def ps_comp_breakdown(iters: int = 5, warm: int = 4,
+                      dim: int = 512, depth: int = 6,
+                      batch: int = 128, nic_rate: float = 3.5e8,
+                      server_rate: float = 6e6,
+                      pairs: int = 2,
+                      compute_iters: int = 30) -> dict:
+    """Fused-compression A/B (``byteps_tpu/compress``), run in the TWO
+    regimes the adaptive design is about (arXiv 2103.00543: compression
+    pays only when the wire, not compute, is the bottleneck):
+
+    **wire-bound**: the same MLP-chain PS trainer as ``ps_cross``, over
+    the real transport under the ASYMMETRIC ``throttle.Nic`` — the
+    server's egress (the k-worker pull incast) throttled far below the
+    workers' line rate, so pull wire time dominates the step. Arms:
+    ``BPS_COMPRESS=auto`` (the controller reads the live ``nic/stalls``
+    off the throttle and ratchets the ladder up during warmup) vs
+    ``=none``. Compression shrinks BOTH directions' wire bytes ~4x
+    (int8), so the compressed arm must win by a clearly-resolved
+    margin; its codec decisions are visible in the attached ``--stats``
+    registry summary (``compress/level/*`` gauges,
+    ``compress/decisions``).
+
+    **compute-bound**: the identical trainer with NO throttle (loopback
+    at host speed — the wire is idle). The controller sees quiet
+    signals and auto-disables (every ``compress/level/*`` gauge decays
+    to/stays 0), so the ``auto`` arm must hold ≈ 1.00x against dense —
+    never a regression — which is the half of the claim a static
+    compression config cannot make.
+
+    Same methodology as the sibling benches — alternating-lead init
+    pairs, both arms at identical pipeline settings so the ratio
+    isolates compression — with ps_cross's POOLED per-step-wall
+    medians as the headline ratios: the compute-bound arms execute
+    identical code (levels pinned at none), so a short window's median
+    is pure scheduler noise on a shared box; pooling pairs x iters
+    walls per arm is what makes ~1.00x resolvable (per-pair ratios
+    ride along as the drift cross-check)."""
+    import statistics
+
+    import byteps_tpu as bps
+    from byteps_tpu.models.mlp import mlp_init, mlp_loss
+    from byteps_tpu.obs.metrics import get_registry
+    from byteps_tpu.parallel.mesh import make_mesh
+    from byteps_tpu.server.engine import PSServer
+    from byteps_tpu.server.throttle import Nic
+    from byteps_tpu.server.transport import PSTransportServer
+    from byteps_tpu.training import DistributedTrainer
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, dim).astype(np.float32)
+    data = (x, np.tanh(x))
+    params = mlp_init(jax.random.PRNGKey(0), dim, depth)
+    saved = {k: os.environ.get(k) for k in
+             ("BPS_ENABLE_PS", "BPS_COMPRESS", "BPS_MIN_COMPRESS_BYTES",
+              "BPS_SERVER_ADDRS", "BPS_EMU_NIC_RATE", "BPS_PS_CONNS",
+              "BPS_PS_PIPELINE")}
+    out: dict = {}
+
+    def run_arm(mode: str, n_iters: int, tag: str, stats: bool):
+        os.environ["BPS_COMPRESS"] = mode
+        # ALWAYS reset (the sibling benches reset only under --stats):
+        # the adaptive controller READS the process-wide registry, so a
+        # stale gauge from whatever ran before this bench — e.g. an
+        # engine_queue_depth a previous in-process backend published
+        # and nothing updates anymore — would masquerade as permanent
+        # wire pressure and ratchet the compute-bound arm
+        _reset_metrics()
+        bps.init(config=bps.Config.from_env())
+        mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
+        trainer = DistributedTrainer(
+            mlp_loss, params, optax.adamw(1e-4), mesh=mesh,
+            partition_bytes=dim * dim * 4, name=f"ps-comp-{tag}")
+        for _ in range(warm):
+            float(trainer.step(data))
+        trainer.drain()
+        walls = []
+        for _ in range(n_iters):
+            t0 = time.perf_counter()
+            trainer.step(data)
+            walls.append(time.perf_counter() - t0)
+        trainer.drain()
+        reg = get_registry()
+        # THIS arm's layers only (layer = <trainer name>.<bucket>; the
+        # registry outlives arms, so earlier arms' gauges persist)
+        levels = {n: reg.gauge(n).value for n in reg.names()
+                  if n.startswith(f"compress/level/ps-comp-{tag}.")}
+        summary = _metrics_summary() if stats else None
+        trainer.close()
+        bps.shutdown()
+        return walls, levels, summary
+
+    try:
+        # ---- wire-bound phase: server egress is the bottleneck ----
+        engine = PSServer(num_workers=1, engine_threads=2)
+        server = PSTransportServer(engine, host="127.0.0.1", port=0,
+                                   nic=Nic(server_rate,
+                                           rx_rate=nic_rate))
+        os.environ.update(BPS_ENABLE_PS="1",
+                          BPS_MIN_COMPRESS_BYTES="65536",
+                          BPS_SERVER_ADDRS=f"127.0.0.1:{server.port}",
+                          BPS_EMU_NIC_RATE=str(nic_rate),
+                          BPS_PS_CONNS=str(2 * depth + 4),
+                          BPS_PS_PIPELINE=str(2 * depth + 4))
+        try:
+            walls: dict = {"auto": [], "none": []}
+            pair_rates: dict = {"auto": [], "none": []}
+            for rep in range(pairs):
+                arms = (("auto",), ("none",)) if rep % 2 == 0 \
+                    else (("none",), ("auto",))
+                for (mode,) in arms:
+                    w, levels, summary = run_arm(
+                        mode, iters, f"wire-{mode}-{rep}",
+                        STATS and rep == 0)
+                    walls[mode].extend(w)
+                    pair_rates[mode].append(batch / statistics.median(w))
+                    if rep == 0 and mode == "auto":
+                        out["wire_bound_levels"] = levels
+                        out["wire_bound_decisions"] = get_registry() \
+                            .counter("compress/decisions").value
+                    if summary is not None:
+                        out[f"wire_{mode}_metrics"] = summary
+            out["wire_auto_sps"] = round(
+                batch / statistics.median(walls["auto"]), 2)
+            out["wire_none_sps"] = round(
+                batch / statistics.median(walls["none"]), 2)
+            out["wire_pair_ratios"] = [
+                round(a / n, 4) for a, n in zip(pair_rates["auto"],
+                                                pair_rates["none"])]
+            out["comp_vs_dense_wire_bound"] = round(
+                statistics.median(walls["none"])
+                / statistics.median(walls["auto"]), 4)
+        finally:
+            server.close()
+            engine.close()
+
+        # ---- compute-bound phase: no throttle, wire is idle ----
+        engine = PSServer(num_workers=1, engine_threads=2)
+        server = PSTransportServer(engine, host="127.0.0.1", port=0)
+        os.environ["BPS_SERVER_ADDRS"] = f"127.0.0.1:{server.port}"
+        os.environ.pop("BPS_EMU_NIC_RATE", None)
+        try:
+            walls = {"auto": [], "none": []}
+            pair_rates = {"auto": [], "none": []}
+            for rep in range(pairs):
+                arms = (("auto",), ("none",)) if rep % 2 == 0 \
+                    else (("none",), ("auto",))
+                for (mode,) in arms:
+                    w, levels, summary = run_arm(
+                        mode, compute_iters, f"cpu-{mode}-{rep}",
+                        STATS and rep == 0)
+                    walls[mode].extend(w)
+                    pair_rates[mode].append(batch / statistics.median(w))
+                    if rep == 0 and mode == "auto":
+                        out["compute_bound_levels"] = levels
+                    if summary is not None:
+                        out[f"compute_{mode}_metrics"] = summary
+            out["compute_auto_sps"] = round(
+                batch / statistics.median(walls["auto"]), 2)
+            out["compute_none_sps"] = round(
+                batch / statistics.median(walls["none"]), 2)
+            out["compute_pair_ratios"] = [
+                round(a / n, 4) for a, n in zip(pair_rates["auto"],
+                                                pair_rates["none"])]
+            out["auto_vs_dense_compute_bound"] = round(
+                statistics.median(walls["none"])
+                / statistics.median(walls["auto"]), 4)
+        finally:
+            server.close()
+            engine.close()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
 def ps_plane_breakdown(n_workers: int = 2, nbytes: int = 8 << 20,
                        rate: float = 4e7, server_rate: float = 4e6,
                        iters: int = 3, warm: int = 1) -> dict:
@@ -632,7 +810,23 @@ def probe_tpu(attempts: int = 3, timeout: float = 150.0,
     return False, err
 
 
+_BREAKDOWNS = {
+    "ps_tail": lambda: ps_tail_breakdown(),
+    "ps_head": lambda: ps_head_breakdown(),
+    "ps_cross": lambda: ps_cross_breakdown(),
+    "ps_plane": lambda: ps_plane_breakdown(),
+    "ps_comp": lambda: ps_comp_breakdown(),
+}
+
+
 def main() -> None:
+    # standalone breakdown dispatch: `bench.py ps_comp [--stats]` runs
+    # ONE A/B and prints its JSON line, skipping the flagship run (the
+    # form the CI smoke lanes and the ISSUE win conditions invoke)
+    for name, fn in _BREAKDOWNS.items():
+        if name in sys.argv[1:]:
+            print(json.dumps({name: fn()}))
+            return
     tunnel_err = None
     if os.environ.get("JAX_PLATFORMS", "").lower() != "cpu":
         ok, err = probe_tpu()
@@ -859,6 +1053,12 @@ def main() -> None:
         line["ps_plane"] = ps_plane_breakdown()
     except Exception as e:       # noqa: BLE001 — recorded, not fatal
         line["ps_plane_error"] = f"{type(e).__name__}: {e}"[:300]
+    # fused-compression A/B (wire-bound win + compute-bound ≈1.00
+    # auto-disable) — same ride-along contract
+    try:
+        line["ps_comp"] = ps_comp_breakdown()
+    except Exception as e:       # noqa: BLE001 — recorded, not fatal
+        line["ps_comp_error"] = f"{type(e).__name__}: {e}"[:300]
     print(json.dumps(line))
 
 

@@ -118,6 +118,23 @@ class Config:
     # --- compression (reference: global.cc:137-139) ---
     min_compress_bytes: int = 65536      # BYTEPS_MIN_COMPRESS_BYTES default 64KiB
 
+    # --- fused adaptive compression plane (ours: byteps_tpu/compress,
+    # docs/gradient-compression.md) ---
+    compress: str = "none"               # BPS_COMPRESS: none | auto |
+                                         # fp16 | int8 | topk — per-
+                                         # bucket codecs fused into the
+                                         # streamed PS pipeline; "auto"
+                                         # = runtime controller driven
+                                         # by the live congestion
+                                         # signals; a codec name pins
+                                         # the decision trace (determi-
+                                         # nistic compressed training)
+    # BPS_COMPRESS_EF (error-feedback residuals, default on),
+    # BPS_COMPRESS_MAX (auto ladder cap, default int8),
+    # BPS_COMPRESS_INTERVAL (decision cadence in rounds) and
+    # BPS_COMPRESS_TOPK_DIV (k = elems/div) are read by the plane
+    # itself (compress/plane.py) — they tune a mode, not select one
+
     # --- tracing / telemetry (reference: global.cc:113-124, 697-752) ---
     trace_on: bool = False
     trace_start_step: int = 10
@@ -172,6 +189,7 @@ class Config:
             emu_nic_rate=float(_env("BPS_EMU_NIC_RATE", None, "0") or 0),
             emu_nic_latency=float(_env("BPS_EMU_NIC_LATENCY", None, "0") or 0),
             min_compress_bytes=_env_int("BPS_MIN_COMPRESS_BYTES", "BYTEPS_MIN_COMPRESS_BYTES", 65536),
+            compress=(_env("BPS_COMPRESS", None, "none") or "none").lower(),
             trace_on=_env_bool("BPS_TRACE_ON", "BYTEPS_TRACE_ON"),
             trace_start_step=_env_int("BPS_TRACE_START_STEP", "BYTEPS_TRACE_START_STEP", 10),
             trace_end_step=_env_int("BPS_TRACE_END_STEP", "BYTEPS_TRACE_END_STEP", 20),

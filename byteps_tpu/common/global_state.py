@@ -193,7 +193,8 @@ class GlobalState:
                     self.ps_backend, partition_bytes=config.partition_bytes,
                     registry=self.registry,
                     min_compress_bytes=config.min_compress_bytes,
-                    watchdog_sec=config.watchdog_sec)
+                    watchdog_sec=config.watchdog_sec,
+                    compress=config.compress)
                 self.engine.ps_exchange.timeline = self.timeline
                 self.engine.ps_world = config.num_worker
         if self.mesh is None:

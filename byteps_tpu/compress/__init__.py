@@ -1,0 +1,37 @@
+"""Fused adaptive gradient compression for the streamed PS pipeline.
+
+The compression PLANE: per-bucket codecs composed into the pipeline
+(compress on the pack worker right before PUSH, decompress on the
+pull → H2D path feeding ``ChunkedApply``), self-describing wire
+payloads any shard can decode without out-of-band codec registration,
+and a runtime controller that reads the live congestion signals from
+the metrics registry and assigns each layer a codec level — ratcheting
+up when the wire is the bottleneck, decaying to ``none`` when it isn't
+(arXiv 2105.07829, 2103.00543). ``BPS_COMPRESS=auto|none|<codec>``;
+docs/gradient-compression.md.
+
+Modules:
+  ``wire``        codec header + deterministic host codecs + pull cache
+  ``controller``  the adaptive (and the pinned) decision logic
+  ``plane``       per-exchange state: eligibility, EF residuals, levels
+
+The legacy per-key server-codec path (``server/compressed.py``, the
+reference's INIT_C/PUSH_C/PULL_C protocol) stays available behind its
+explicit opt-in — declaring a tensor with ``compressor_type`` kwargs —
+and takes precedence for keys that declare it.
+"""
+
+from .controller import CompressController, FixedController
+from .plane import CompressionPlane
+from .wire import (CODEC_FP16, CODEC_INT8, CODEC_NONE, CODEC_TOPK,
+                   CodecError, FusedPullCache, LEVELS, codec_id,
+                   codec_name, decode, encode, peek, pull_encoded,
+                   wire_nbytes)
+
+__all__ = [
+    "CompressController", "CompressionPlane", "CodecError",
+    "FixedController", "FusedPullCache", "LEVELS",
+    "CODEC_NONE", "CODEC_FP16", "CODEC_INT8", "CODEC_TOPK",
+    "codec_id", "codec_name", "decode", "encode", "peek",
+    "pull_encoded", "wire_nbytes",
+]

@@ -1,0 +1,226 @@
+"""Adaptive per-layer codec controller for the fused compression plane.
+
+Compression only pays when the WIRE, not compute, is the bottleneck
+(arXiv 2103.00543) — on an idle link the extra quantize/dequantize work
+is pure loss, and the right codec strength tracks how congested the
+link actually is (arXiv 2105.07829's adaptive compressed communication).
+This controller closes that loop against the live PR-4 metrics registry
+instead of a static config:
+
+  signals (``bps.get_metrics()``):
+    ``nic/stalls``                token-bucket pacing stalls (counter;
+                                  a delta > 0 means senders waited on
+                                  the wire since the last decision)
+    ``server/engine_queue_depth`` enqueued-but-unsummed pushes (gauge;
+                                  the server-side backlog)
+    ``transport/resends``         reconnect-and-resend events (counter;
+                                  a flapping wire)
+    per-layer ``ps/push_bytes/<layer>``  who is actually loading the
+                                  wire: the three global signals set
+                                  the DIRECTION, the per-layer byte
+                                  deltas pick which layers an
+                                  up-ratchet applies to (a layer that
+                                  moved no bytes since the last
+                                  decision holds its level)
+
+  decision ladder (``wire.LEVELS``): none -> fp16 -> int8 -> topk
+
+Decisions happen at ROUND boundaries (the exchange calls ``on_round``
+when it opens a round) with HYSTERESIS: a level moves only after
+``hold`` CONSECUTIVE congested (or idle) verdicts, and a mixed/boundary
+verdict resets both streaks — so a signal sitting on the threshold can
+never flap the codec every round (each flap would invalidate the
+server's per-(round, codec) pull cache and wiggle convergence behavior
+for nothing). The hard fallback is built into the verdict: an IDLE wire
+(all three signals quiet) decays every layer back toward ``none``, so
+compression auto-disables where it would lose.
+
+Every decision is observable: ``compress/level/<layer>`` gauges hold
+the current ladder index per layer and ``compress/decisions`` counts
+level CHANGES — when the bench's byte counters move, the registry says
+why.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..obs.metrics import MetricsRegistry, get_registry
+from . import wire
+
+
+class CompressController:
+    """Maps live congestion signals to a per-layer codec level."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 max_level: str = "int8",
+                 hold: int = 2,
+                 queue_depth_min: float = 2.0,
+                 interval: int = 1) -> None:
+        self.reg = registry if registry is not None else get_registry()
+        self.max_level = wire.codec_id(max_level)
+        self.hold = max(1, int(hold))
+        self.queue_depth_min = float(queue_depth_min)
+        self.interval = max(1, int(interval))
+        self._lock = threading.Lock()
+        self._layers: Dict[str, int] = {}        # layer -> ladder index
+        self._gauges: Dict[str, object] = {}
+        self._bytes: Dict[str, object] = {}      # ps/push_bytes/<layer>
+        self._bytes_snap: Dict[str, int] = {}    # value at last decision
+        self._up = 0                              # consecutive verdicts
+        self._down = 0
+        self._last_stalls = self.reg.counter("nic/stalls").value
+        self._last_resends = self.reg.counter("transport/resends").value
+        self._rounds_seen = 0
+        self._m_decisions = self.reg.counter("compress/decisions")
+
+    # ------------------------------------------------------------ layers
+
+    def register_layer(self, layer: str) -> None:
+        with self._lock:
+            if layer in self._layers:
+                return
+            self._layers[layer] = wire.CODEC_NONE
+            g = self.reg.gauge(f"compress/level/{layer}")
+            g.set(wire.CODEC_NONE)
+            self._gauges[layer] = g
+            # per-layer wire-load signal (the exchange incs it on every
+            # push of the layer's bucket, dense or fused): who is
+            # actually loading the wire — see _shift
+            self._bytes[layer] = self.reg.counter(
+                f"ps/push_bytes/{layer}")
+            self._bytes_snap[layer] = self._bytes[layer].value
+
+    def level_of(self, layer: str) -> int:
+        return self._layers.get(layer, wire.CODEC_NONE)
+
+    def levels(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._layers)
+
+    # ---------------------------------------------------------- decision
+
+    def _verdict(self) -> Optional[bool]:
+        """True = wire-bound, False = idle, None = boundary (no vote).
+
+        Deltas of the two counters since the LAST decision plus the
+        backlog gauge's current value. All-quiet is the idle verdict —
+        the hard auto-disable path; any stall/resend or a real backlog
+        is wire-bound; a backlog below the floor with no stalls is the
+        boundary case that must not flap the ladder."""
+        stalls = self.reg.counter("nic/stalls").value
+        resends = self.reg.counter("transport/resends").value
+        depth = self.reg.gauge("server/engine_queue_depth").value
+        d_stalls = stalls - self._last_stalls
+        d_resends = resends - self._last_resends
+        self._last_stalls, self._last_resends = stalls, resends
+        if d_stalls > 0 or d_resends > 0 or depth >= self.queue_depth_min:
+            return True
+        if d_stalls == 0 and d_resends == 0 and depth <= 0:
+            return False
+        return None
+
+    def on_round(self) -> None:
+        """One round boundary passed; every ``interval`` rounds, read
+        the signals and (maybe) move the ladder."""
+        with self._lock:
+            self._rounds_seen += 1
+            if self._rounds_seen % self.interval:
+                return
+            self.decide_locked()
+
+    def decide(self) -> Dict[str, int]:
+        """Force one decision pass (tests, explicit callers); returns
+        the post-decision per-layer levels."""
+        with self._lock:
+            self.decide_locked()
+            return dict(self._layers)
+
+    def decide_locked(self) -> None:
+        v = self._verdict()
+        try:
+            if v is None:
+                # boundary signal: reset both streaks — hysteresis
+                # means a threshold-riding signal holds levels steady
+                self._up = self._down = 0
+                return
+            if v:
+                self._up += 1
+                self._down = 0
+                if self._up >= self.hold:
+                    self._up = 0
+                    self._shift(+1)
+            else:
+                self._down += 1
+                self._up = 0
+                if self._down >= self.hold:
+                    self._down = 0
+                    self._shift(-1)
+        finally:
+            # "bytes since the last decision" is the _shift signal:
+            # re-snapshot every pass, verdict or not
+            for l, c in self._bytes.items():
+                self._bytes_snap[l] = c.value
+
+    def _shift(self, direction: int) -> None:
+        """Move layers one ladder step (clamped to [none, max_level]);
+        record changed levels in the gauges/counter.
+
+        The per-layer ``ps/push_bytes/<layer>`` counters pick WHICH
+        layers an up-ratchet applies to: only layers that actually
+        moved bytes since the last decision — an idle layer (a second
+        trainer between steps, an accumulation window) has nothing on
+        the wire to compress, so ratcheting it buys codec work for
+        free. Cold start (no layer has recorded bytes yet) falls back
+        to all layers.
+        Decays apply to every layer — an idle layer should shed its
+        level, not hold it. Size/dtype eligibility is enforced by the
+        plane at encode time — the controller only expresses wire
+        pressure."""
+        targets = self._layers
+        if direction > 0:
+            deltas = {l: self._bytes[l].value - self._bytes_snap[l]
+                      for l in self._layers}
+            loaded = {l for l, d in deltas.items() if d > 0}
+            if loaded:
+                targets = loaded
+        for layer in list(targets):
+            lvl = self._layers[layer]
+            new = min(max(lvl + direction, wire.CODEC_NONE),
+                      self.max_level)
+            if new != lvl:
+                self._layers[layer] = new
+                self._gauges[layer].set(new)
+                self._m_decisions.inc()
+
+
+class FixedController:
+    """Pinned decision trace: every registered layer runs ONE codec,
+    forever. ``BPS_COMPRESS=<codec>`` — the determinism contract's
+    anchor (a fixed trace + deterministic codecs = bit-reproducible
+    compressed training) and the bench's non-adaptive arm."""
+
+    def __init__(self, level: str,
+                 registry: Optional[MetricsRegistry] = None) -> None:
+        self.reg = registry if registry is not None else get_registry()
+        self.level = wire.codec_id(level)
+        self._layers: List[str] = []
+        self._m_decisions = self.reg.counter("compress/decisions")
+
+    def register_layer(self, layer: str) -> None:
+        if layer in self._layers:
+            return
+        self._layers.append(layer)
+        self.reg.gauge(f"compress/level/{layer}").set(self.level)
+        if self.level != wire.CODEC_NONE:
+            self._m_decisions.inc()
+
+    def level_of(self, layer: str) -> int:
+        return self.level
+
+    def levels(self) -> Dict[str, int]:
+        return {l: self.level for l in self._layers}
+
+    def on_round(self) -> None:
+        pass

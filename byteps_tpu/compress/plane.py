@@ -1,0 +1,209 @@
+"""Worker-side state of the fused compression plane.
+
+``CompressionPlane`` is what ``PSGradientExchange`` talks to: one per
+exchange, holding
+
+  - per-PS-key codec eligibility (size floor, fp32-only lossy math) and
+    the LAYER identity the controller decides on (``<decl>.<bucket>``),
+  - the controller (adaptive or pinned — ``BPS_COMPRESS=auto|<codec>``),
+  - per-key ERROR-FEEDBACK residual state with a commit-on-pull
+    protocol: ``encode`` stages the round's new residual as PENDING and
+    ``commit`` (called when that round's pull lands) installs it. The
+    per-key admission gate already serializes round k's pull before
+    round k+1's push of the same key, so with two rounds in flight the
+    residual each compress reads is exactly the previous committed
+    round's — and a round that DIES between push and pull never
+    commits, leaving the EF state consistent for the retry instead of
+    double-counting the dead round's error.
+
+Levels are PINNED PER ROUND: the exchange snapshots ``level_of`` for
+every bucket when the round opens, and both the push and the pull of
+that round use the snapshot — the controller re-deciding mid-round can
+never make a worker pull a codec the server didn't encode.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..obs.metrics import MetricsRegistry, get_registry, metrics_enabled
+from . import wire
+from .controller import CompressController, FixedController
+
+#: BPS_COMPRESS values that mean "plane off" (dense path, bit-identical
+#: to a build without the plane)
+OFF_VALUES = ("", "0", "none", "off", "false")
+
+
+class _KeyState:
+    __slots__ = ("size", "dtype", "layer", "residual", "pending",
+                 "m_bytes")
+
+    def __init__(self, size: int, dtype, layer: str, m_bytes) -> None:
+        self.size = int(size)
+        self.dtype = np.dtype(dtype)
+        self.layer = layer
+        self.residual: Optional[np.ndarray] = None   # committed EF state
+        self.pending: Optional[tuple] = None         # (round, residual)
+        self.m_bytes = m_bytes                       # per-layer counter
+
+
+class CompressionPlane:
+    """Per-exchange fused-compression state + controller front."""
+
+    def __init__(self, mode: str, min_bytes: int = 65536,
+                 ef: bool = True, interval: int = 1,
+                 max_level: str = "int8", topk_div: int = wire.TOPK_DIV,
+                 registry: Optional[MetricsRegistry] = None) -> None:
+        mode = (mode or "none").strip().lower()
+        if mode in OFF_VALUES:
+            raise ValueError("CompressionPlane constructed with mode off "
+                             "— callers must skip construction instead")
+        self.mode = mode
+        self.min_bytes = int(min_bytes)
+        self.ef = bool(ef)
+        self.topk_div = int(topk_div)
+        self.reg = registry if registry is not None else get_registry()
+        if mode == "auto":
+            self.controller = CompressController(
+                registry=self.reg, max_level=max_level, interval=interval)
+        else:
+            self.controller = FixedController(mode, registry=self.reg)
+        self._keys: Dict[int, _KeyState] = {}
+        self._lock = threading.Lock()
+        self._m_raw = self.reg.counter("compress/raw_bytes")
+        self._m_wire = self.reg.counter("compress/wire_bytes")
+
+    @staticmethod
+    def from_config(mode: Optional[str], min_bytes: int,
+                    registry: Optional[MetricsRegistry] = None
+                    ) -> Optional["CompressionPlane"]:
+        """The one construction recipe (exchange + tests): env-resolved
+        knobs, None when the plane is off."""
+        import os
+        # the repo's ONE env-parsing rule (common/config.py): a user
+        # writing BPS_COMPRESS_EF=off must not silently keep EF on
+        from ..common.config import _env, _env_bool, _env_int
+        mode = (mode if mode is not None
+                else _env("BPS_COMPRESS", None, "none"))
+        if (mode or "none").strip().lower() in OFF_VALUES:
+            return None
+        if mode.strip().lower() == "auto" and not metrics_enabled():
+            # the controller's verdict signals are metrics-registry
+            # counters, and BPS_STATS=0 freezes every one of them at
+            # zero: auto would be a silent permanent no-op. Say so.
+            from ..common.logging import get_logger
+            get_logger().warning(
+                "BPS_COMPRESS=auto with BPS_STATS=0: the congestion "
+                "signals the controller reads are frozen, so every "
+                "layer will stay at `none` — enable BPS_STATS or pin "
+                "a codec (BPS_COMPRESS=int8)")
+        ef = _env_bool("BPS_COMPRESS_EF", None, True)
+        interval = _env_int("BPS_COMPRESS_INTERVAL", None, 1)
+        max_level = _env("BPS_COMPRESS_MAX", None, "int8")
+        topk_div = _env_int("BPS_COMPRESS_TOPK_DIV", None,
+                            wire.TOPK_DIV)
+        return CompressionPlane(mode, min_bytes=min_bytes, ef=ef,
+                                interval=interval, max_level=max_level,
+                                topk_div=topk_div, registry=registry)
+
+    # ------------------------------------------------------ registration
+
+    def register(self, pskey: int, size: int, dtype, layer: str) -> bool:
+        """Declare a bucket to the plane; returns eligibility. Lossy
+        codec math runs in fp32, so only fp32 buckets at or above the
+        compression floor are eligible — everything else stays on the
+        dense path (same floor rule as the legacy
+        BYTEPS_MIN_COMPRESS_BYTES)."""
+        dt = np.dtype(dtype)
+        nbytes = int(size) * dt.itemsize
+        if dt != np.float32 or nbytes < self.min_bytes:
+            return False
+        with self._lock:
+            if pskey not in self._keys:
+                self._keys[pskey] = _KeyState(
+                    size, dt, layer,
+                    self.reg.counter(f"ps/push_bytes/{layer}"))
+            self.controller.register_layer(layer)
+        return True
+
+    def active(self, pskey: int) -> bool:
+        return pskey in self._keys
+
+    # --------------------------------------------------------- decisions
+
+    def on_round(self) -> None:
+        self.controller.on_round()
+
+    def level_of(self, pskey: int) -> int:
+        st = self._keys.get(pskey)
+        if st is None:
+            return wire.CODEC_NONE
+        return self.controller.level_of(st.layer)
+
+    # --------------------------------------------------------- data path
+
+    def encode(self, pskey: int, buf: np.ndarray, level: int,
+               round_tag: int) -> bytes:
+        """Compress ``buf`` for the wire at ``level`` (> none), with the
+        committed EF residual folded in and the round's NEW residual
+        staged as pending (installed by ``commit`` when the pull
+        lands)."""
+        st = self._keys[pskey]
+        x = np.asarray(buf, np.float32).reshape(-1)
+        if self.ef and st.residual is not None:
+            x = x + st.residual
+        payload = wire.encode(level, x.astype(st.dtype, copy=False),
+                              div=self.topk_div)
+        if self.ef:
+            st.pending = (round_tag,
+                          x - wire.decode(payload, st.size, np.float32))
+        st.m_bytes.inc(len(payload))
+        self._m_raw.inc(st.size * st.dtype.itemsize)
+        self._m_wire.inc(len(payload))
+        return payload
+
+    def note_dense_push(self, pskey: int, nbytes: int) -> None:
+        """Account a DENSE push of a plane-managed key into its
+        per-layer ``ps/push_bytes/<layer>`` counter — the controller's
+        which-layers-are-loading-the-wire signal must see the layer's
+        traffic even while its level sits at ``none`` (that is exactly
+        when an up-ratchet decision needs it)."""
+        st = self._keys.get(pskey)
+        if st is not None:
+            st.m_bytes.inc(nbytes)
+
+    def fold_residual(self, pskey: int, buf: np.ndarray,
+                      round_tag: int) -> np.ndarray:
+        """Dense-path sibling of ``encode`` for a key whose level
+        decayed back to ``none`` while it still carries a residual:
+        flush the residual into this round's push ONCE (pending a zero
+        state, committed like any round) so the accumulated error isn't
+        silently dropped when the controller disables compression."""
+        st = self._keys.get(pskey)
+        if st is None or not self.ef or st.residual is None:
+            return buf
+        out = (np.asarray(buf, np.float32).reshape(-1)
+               + st.residual).astype(np.dtype(buf.dtype), copy=False)
+        st.pending = (round_tag, None)      # commit clears the residual
+        return out
+
+    def decode(self, pskey: int, payload, round_tag: int) -> np.ndarray:
+        """Decompress a pulled merged payload to the key's dense dtype
+        and COMMIT the round's pending residual (see class docstring)."""
+        st = self._keys[pskey]
+        out = wire.decode(payload, st.size, st.dtype)
+        self.commit(pskey, round_tag)
+        return out
+
+    def commit(self, pskey: int, round_tag: int) -> None:
+        st = self._keys.get(pskey)
+        if st is None or st.pending is None:
+            return
+        tag, resid = st.pending
+        if tag == round_tag:
+            st.residual = resid
+            st.pending = None

@@ -1,0 +1,293 @@
+"""Self-describing wire format for the fused compression plane.
+
+The legacy compressed path (``server/compressed.py``) registers ONE
+immutable codec per key at INIT time — the right shape for a static,
+user-declared compression config, and the wrong one for the adaptive
+controller, which re-decides each layer's codec at round boundaries
+(arXiv 2105.07829). Here every compressed payload carries its own codec
+HEADER, so a shard can decode any round's push without out-of-band
+state, and two rounds of the same key in flight (cross-step) can carry
+different codec decisions.
+
+Header (little-endian, ``_HDR``)::
+
+    magic:u16 | version:u8 | codec:u8 | dtype:char[8] | elems:u64
+
+``magic``/``version`` are checked LOUDLY on decode: a torn frame, a
+stale-version peer, or plain-dense bytes routed onto the fused path
+raise :class:`CodecError` instead of scattering garbage into the store
+— the codec analogue of the server plane's ``WrongEpoch`` refusal.
+
+Codecs (the controller's ladder, cheapest first):
+
+    ``none``  raw bytes (self-describing dense — used by replay paths)
+    ``fp16``  float16 cast, 2x on fp32 buckets
+    ``int8``  symmetric max-abs linear quantization, one fp32 scale
+              per bucket, round-half-even — deterministic, 4x
+    ``topk``  largest-k magnitudes as (int32 idx | fp32 val), k =
+              elems/topk_div — sparse, ~4x over int8 at div=32
+
+All codecs are DETERMINISTIC functions of the dense input (no RNG), so
+a fixed codec decision trace makes compressed training reproducible
+bit-for-bit, and a server re-encoding a merged round serves
+byte-identical payloads to every puller without a cache being load-
+bearing (the cache in :class:`FusedPullCache` is for throughput only).
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+MAGIC = 0xB5C1
+VERSION = 1
+
+CODEC_NONE, CODEC_FP16, CODEC_INT8, CODEC_TOPK = 0, 1, 2, 3
+
+#: controller ladder order — index = aggressiveness level
+LEVELS = ("none", "fp16", "int8", "topk")
+_NAME_TO_ID = {n: i for i, n in enumerate(LEVELS)}
+
+_HDR = struct.Struct("<HBB8sQ")
+
+#: default top-k keep fraction denominator (k = elems // TOPK_DIV)
+TOPK_DIV = 32
+
+
+class CodecError(RuntimeError):
+    """A payload that cannot be decoded safely: bad magic (dense bytes
+    or a torn frame on the fused path), codec-version mismatch between
+    peers, an unknown codec id, or a body whose length disagrees with
+    its header. Always LOUD — decoding a torn payload into plausible
+    garbage and summing it would corrupt the round silently."""
+
+
+def codec_id(name: str) -> int:
+    try:
+        return _NAME_TO_ID[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown fused codec {name!r}; expected one of {LEVELS}")
+
+
+def codec_name(cid: int) -> str:
+    if not 0 <= cid < len(LEVELS):
+        raise ValueError(f"unknown fused codec id {cid}")
+    return LEVELS[cid]
+
+
+def lossy(cid: int) -> bool:
+    return cid != CODEC_NONE
+
+
+def topk_k(elems: int, div: int = TOPK_DIV) -> int:
+    return max(1, int(elems) // int(div))
+
+
+def wire_nbytes(cid: int, elems: int, dtype, div: int = TOPK_DIV) -> int:
+    """Exact payload size (header included) for ``elems`` elements."""
+    dt = np.dtype(dtype)
+    if cid == CODEC_NONE:
+        body = elems * dt.itemsize
+    elif cid == CODEC_FP16:
+        body = elems * 2
+    elif cid == CODEC_INT8:
+        body = 4 + elems
+    elif cid == CODEC_TOPK:
+        body = 4 + topk_k(elems, div) * 8
+    else:
+        raise ValueError(f"unknown fused codec id {cid}")
+    return _HDR.size + body
+
+
+def encode(cid: int, arr: np.ndarray, div: int = TOPK_DIV) -> bytes:
+    """Compress a flat dense array into a self-describing payload.
+
+    Lossy codecs run their math in fp32 regardless of the wire dtype
+    recorded in the header (the decode target); ``none`` ships the raw
+    bytes. Deterministic for every codec (see module docstring)."""
+    arr = np.ascontiguousarray(np.asarray(arr).reshape(-1))
+    dt = arr.dtype
+    hdr = _HDR.pack(MAGIC, VERSION, cid,
+                    dt.name.encode()[:8].ljust(8, b"\0"), arr.size)
+    if cid == CODEC_NONE:
+        return hdr + arr.tobytes()
+    x = arr.astype(np.float32, copy=False)
+    if cid == CODEC_FP16:
+        return hdr + x.astype(np.float16).tobytes()
+    if cid == CODEC_INT8:
+        amax = float(np.max(np.abs(x))) if x.size else 0.0
+        scale = np.float32(amax / 127.0 if amax > 0 else 1.0)
+        # rint = round-half-even, matching jnp.round → the Pallas
+        # int8 kernel pair produces byte-identical q for the same scale
+        q = np.clip(np.rint(x / scale), -127, 127).astype(np.int8)
+        return hdr + struct.pack("<f", scale) + q.tobytes()
+    if cid == CODEC_TOPK:
+        k = topk_k(x.size, div)
+        # ties to the lower index (stable argsort of -|x|), matching
+        # the legacy HostTopk selection rule
+        idx = np.argsort(-np.abs(x), kind="stable")[:k].astype(np.int32)
+        return (hdr + struct.pack("<I", k) + idx.tobytes()
+                + x[idx].astype(np.float32).tobytes())
+    raise ValueError(f"unknown fused codec id {cid}")
+
+
+def decode_for_store(payload, meta) -> np.ndarray:
+    """The one decode recipe the server-side push paths share
+    (``HostPSBackend.push_fused`` and the transport's OP_PUSH_F
+    handler): validate the payload against the key's registered store
+    meta — ``(nbytes, dtype, ...)`` or None for an unregistered key —
+    and return the dense array in store dtype, ready for the engine."""
+    if meta is None:
+        return decode(payload)
+    nbytes, dtype = meta[0], meta[1]
+    return decode(payload,
+                  expect_elems=int(nbytes) // np.dtype(dtype).itemsize,
+                  expect_dtype=dtype)
+
+
+def peek(payload) -> tuple:
+    """(codec_id, dtype_name, elems) of a payload's header, validated.
+    Raises :class:`CodecError` on anything that is not a well-formed
+    fused payload of THIS version."""
+    buf = bytes(payload[:_HDR.size]) if len(payload) >= _HDR.size else \
+        bytes(payload)
+    if len(buf) < _HDR.size:
+        raise CodecError(
+            f"fused payload truncated: {len(payload)} bytes is shorter "
+            f"than the {_HDR.size}-byte codec header")
+    magic, ver, cid, dt, elems = _HDR.unpack(buf)
+    if magic != MAGIC:
+        raise CodecError(
+            f"bad codec magic 0x{magic:04x} (expected 0x{MAGIC:04x}) — "
+            f"not a fused compression payload; refusing a torn decode")
+    if ver != VERSION:
+        raise CodecError(
+            f"codec-version mismatch: payload v{ver}, this build speaks "
+            f"v{VERSION} — refusing to decode across codec versions")
+    if cid >= len(LEVELS):
+        raise CodecError(f"unknown codec id {cid} in payload header")
+    return cid, dt.rstrip(b"\0").decode(), int(elems)
+
+
+def decode(payload, expect_elems: Optional[int] = None,
+           expect_dtype=None) -> np.ndarray:
+    """Decompress a payload to its dense flat array (header dtype, or
+    ``expect_dtype`` when given). Every structural inconsistency —
+    element-count mismatch with the caller's bucket plan, body length
+    disagreeing with the header — is a :class:`CodecError`."""
+    payload = bytes(payload)
+    cid, dt_name, elems = peek(payload)
+    if expect_elems is not None and elems != expect_elems:
+        raise CodecError(
+            f"fused payload declares {elems} elements, bucket plan "
+            f"expects {expect_elems} — key/plan mismatch")
+    dt = np.dtype(dt_name)
+    body = payload[_HDR.size:]
+    if cid == CODEC_NONE:
+        if len(body) != elems * dt.itemsize:
+            raise CodecError(
+                f"dense body is {len(body)} bytes, header says "
+                f"{elems}x{dt.itemsize}")
+        out = np.frombuffer(body, dt).copy()
+    elif cid == CODEC_FP16:
+        if len(body) != elems * 2:
+            raise CodecError(
+                f"fp16 body is {len(body)} bytes for {elems} elements")
+        out = np.frombuffer(body, np.float16).astype(np.float32)
+    elif cid == CODEC_INT8:
+        if len(body) != 4 + elems:
+            raise CodecError(
+                f"int8 body is {len(body)} bytes for {elems} elements")
+        (scale,) = struct.unpack("<f", body[:4])
+        out = np.frombuffer(body[4:], np.int8).astype(np.float32) * scale
+    elif cid == CODEC_TOPK:
+        if len(body) < 4:
+            raise CodecError("topk body missing its k prefix")
+        (k,) = struct.unpack("<I", body[:4])
+        if len(body) != 4 + k * 8:
+            raise CodecError(
+                f"topk body is {len(body)} bytes for k={k}")
+        idx = np.frombuffer(body[4:4 + k * 4], np.int32)
+        vals = np.frombuffer(body[4 + k * 4:], np.float32)
+        if k and (idx.min() < 0 or idx.max() >= elems):
+            raise CodecError(
+                f"topk index out of range 0..{elems} — torn payload")
+        out = np.zeros(elems, np.float32)
+        out[idx] = vals
+    else:  # pragma: no cover — peek() already refused
+        raise CodecError(f"unknown codec id {cid}")
+    want = np.dtype(expect_dtype) if expect_dtype is not None else dt
+    return out.astype(want, copy=False)
+
+
+# how many recompressed rounds each (key, codec) keeps: all workers pull
+# round r before r+2 can complete (admission gate: they must pull r
+# before pushing r+1), so 4 is comfortably past the in-flight window
+_CACHE_ROUNDS = 4
+
+
+class FusedPullCache:
+    """Per-backend cache of encoded merged rounds for the fused pull
+    path. Purely a THROUGHPUT cache — every fused codec is
+    deterministic, so a miss re-encodes byte-identical payloads; what
+    the cache buys is skipping the dense copy out of the engine and the
+    encode for every puller after the first (the same lesson the
+    native legacy path learned, server/compressed.py)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # key -> {(round, codec): payload}, insertion-ordered eviction
+        self._cache: Dict[int, Dict[tuple, bytes]] = {}
+
+    def get(self, key: int, rnd: int, cid: int,
+            div: int = TOPK_DIV) -> Optional[bytes]:
+        if rnd == 0:
+            return None          # round 0 = "latest": mutates, never cache
+        with self._lock:
+            return self._cache.get(key, {}).get((rnd, cid, div))
+
+    def put(self, key: int, rnd: int, cid: int, payload: bytes,
+            div: int = TOPK_DIV) -> None:
+        if rnd == 0:
+            return
+        with self._lock:
+            rounds = self._cache.setdefault(key, {})
+            rounds.setdefault((rnd, cid, div), payload)
+            while len(rounds) > _CACHE_ROUNDS:
+                rounds.pop(next(iter(rounds)))
+
+    def drop(self, key: int) -> None:
+        """Invalidate a key's cached rounds. Called on (re-)INIT: a
+        re-initialized store restarts its shard-local rounds, so a key
+        migrated away and later BACK to this shard would otherwise be
+        served its first tenancy's cached payloads for the recurring
+        round numbers — silently stale gradients."""
+        with self._lock:
+            self._cache.pop(key, None)
+
+
+def pull_encoded(backend, cache: Optional[FusedPullCache], key: int,
+                 nbytes: int, dtype: str, cid: int, rnd: int,
+                 timeout_ms: int = 30000, div: int = TOPK_DIV) -> bytes:
+    """The one fused-pull recipe shared by ``HostPSBackend`` and the
+    transport server: cache hit, else round-blocked dense pull out of
+    the engine → ``encode`` at the requested codec → cache → bytes.
+    ``div`` rides in from the puller's request so the topk keep
+    fraction honors the worker's BPS_COMPRESS_TOPK_DIV in BOTH wire
+    directions (it is part of the cache key — two workers configured
+    differently must not be served each other's k)."""
+    if cache is not None:
+        hit = cache.get(key, rnd, cid, div)
+        if hit is not None:
+            return hit
+    dense = np.empty(int(nbytes) // np.dtype(dtype).itemsize,
+                     dtype=np.dtype(dtype))
+    backend.pull(key, dense, round=rnd, timeout_ms=timeout_ms)
+    payload = encode(cid, dense, div=div)
+    if cache is not None:
+        cache.put(key, rnd, cid, payload, div)
+    return payload

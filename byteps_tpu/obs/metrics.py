@@ -35,8 +35,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 STAGES: Tuple[str, ...] = (
     "DISPATCH", "REDUCE", "CREDIT_BLOCK", "PUSH_PULL", "PS_PUSH_PULL",
     "REDUCE_WAIT", "COPYD2H",
-    "PS_BWD_SEG", "PS_D2H", "PS_PACK", "PS_PUSH", "PS_PULL",
-    "PS_UNPACK", "PS_H2D", "PS_APPLY_CHUNK", "PS_XSTEP_GATE",
+    "PS_BWD_SEG", "PS_D2H", "PS_PACK", "PS_COMPRESS", "PS_PUSH",
+    "PS_PULL", "PS_DECOMPRESS", "PS_UNPACK", "PS_H2D",
+    "PS_APPLY_CHUNK", "PS_XSTEP_GATE",
 )
 
 # Server-plane control-loop signals (byteps_tpu.server.plane,
@@ -47,6 +48,16 @@ STAGES: Tuple[str, ...] = (
 PLANE_GAUGES: Tuple[str, ...] = ("plane/epoch", "plane/replication_lag")
 PLANE_COUNTERS: Tuple[str, ...] = ("plane/migrations", "plane/failovers",
                                    "plane/wrong_epoch")
+
+# Fused compression plane (byteps_tpu.compress, docs/gradient-
+# compression.md): decision/byte counters pre-registered so "is the
+# controller doing anything" is answerable before any traffic; the
+# per-layer ``compress/level/<layer>`` gauges and
+# ``ps/push_bytes/<layer>`` counters ride alongside dynamically (layer
+# set is a runtime property of the bucket plan).
+COMPRESS_COUNTERS: Tuple[str, ...] = ("compress/decisions",
+                                      "compress/raw_bytes",
+                                      "compress/wire_bytes")
 
 # ONE truthiness rule shared with Config (BPS_STATS must resolve
 # identically whether read here or through Config.stats_on)
@@ -254,6 +265,8 @@ class MetricsRegistry:
         for g in PLANE_GAUGES:
             self.gauge(g)
         for c in PLANE_COUNTERS:
+            self.counter(c)
+        for c in COMPRESS_COUNTERS:
             self.counter(c)
 
     def _get(self, name: str, cls, *args):

@@ -100,3 +100,84 @@ def onebit_unpack(packed: jnp.ndarray, n: int) -> jnp.ndarray:
         interpret=_interpret(),
     )(wp)
     return signs.reshape(-1)[:n]
+
+
+# ----------------------------------------------------- int8 quantization
+#
+# The fused compression plane's int8 hot path (byteps_tpu/compress):
+# symmetric max-abs linear quantization with ONE fp32 scale per bucket,
+# round-half-even — byte-identical to the host codec
+# (compress.wire.encode CODEC_INT8), so a device-side quantize can feed
+# the same wire format the numpy pack workers produce. Lanes are the
+# full 128-wide vreg (unlike the onebit kernels' 32-wide packing
+# geometry); the int8 output tile minimum is (32, 128), so the block
+# row count stays a multiple of 32.
+
+_LANES = 128
+_Q_ROWS = 256      # 256×128 f32 in + int8 out ≈ 160 KiB VMEM per step
+
+
+def _int8_q_kernel(x_ref, scale_ref, out_ref):
+    # DIVIDE, exactly like the host codec's rint(x / scale): a
+    # reciprocal-multiply is ~1 ulp off and flips round-half-even ties
+    # on ~4e-7 of elements — enough to break byte-identity with the
+    # wire codec on large buckets. scale <= 0 is substituted with 1.0
+    # host-side (matching wire.encode's zero-amax rule).
+    q = jnp.clip(jnp.round(x_ref[:] / scale_ref[0]), -127.0, 127.0)
+    out_ref[:] = q.astype(jnp.int8)
+
+
+def _int8_dq_kernel(q_ref, scale_ref, out_ref):
+    out_ref[:] = q_ref[:].astype(jnp.float32) * scale_ref[0]
+
+
+def _q_grid(n: int):
+    rows = _cdiv(_cdiv(n, _LANES), _Q_ROWS) * _Q_ROWS
+    return rows, rows // _Q_ROWS
+
+
+def int8_quantize(x: jnp.ndarray, scale) -> jnp.ndarray:
+    """Quantize a flat float buffer to int8 at ``scale`` (fp32 scalar;
+    elements map to ``clip(round(x/scale), -127, 127)``). Zero-padded
+    internally; the padding quantizes to 0 and is sliced off."""
+    n = x.shape[0]
+    rows, grid = _q_grid(n)
+    xp = jnp.pad(x.astype(jnp.float32), (0, rows * _LANES - n))
+    scale = jnp.asarray(scale, jnp.float32).reshape(1)
+    # the host codec never divides by a non-positive scale (wire.encode
+    # substitutes 1.0 for a zero amax) — mirror that rule here so the
+    # kernel stays byte-identical AND total on degenerate inputs
+    scale = jnp.where(scale > 0, scale, 1.0)
+    q = pl.pallas_call(
+        _int8_q_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.int8),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((_Q_ROWS, _LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec((_Q_ROWS, _LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(xp.reshape(rows, _LANES), scale)
+    return q.reshape(-1)[:n]
+
+
+def int8_dequantize(q: jnp.ndarray, scale, n: int = None) -> jnp.ndarray:
+    """Expand int8 values back to fp32 (``q * scale``)."""
+    m = q.shape[0]
+    n = m if n is None else n
+    rows, grid = _q_grid(m)
+    qp = jnp.pad(q.astype(jnp.int8), (0, rows * _LANES - m))
+    scale = jnp.asarray(scale, jnp.float32).reshape(1)
+    out = pl.pallas_call(
+        _int8_dq_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((_Q_ROWS, _LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec((_Q_ROWS, _LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(qp.reshape(rows, _LANES), scale)
+    return out.reshape(-1)[:n]

@@ -420,6 +420,9 @@ class HostPSBackend:
         self._rs_cols: Dict[int, int] = {}   # row-sparse: pinned cols/key
         from .compressed import CompressedKeyStore
         self.compressed = CompressedKeyStore()
+        # fused-plane pull cache (byteps_tpu.compress), created on first
+        # fused pull so plain deployments never pay the import
+        self._fused_cache = None
         from ..obs.metrics import get_registry
         self._m_pull_wait = get_registry().histogram("server/pull_wait_s")
         self._m_queue_depth = get_registry().gauge(
@@ -608,6 +611,31 @@ class HostPSBackend:
         engine (reference: decompress before SUM_RECV, server.cc:86-113)."""
         from .compressed import compressed_push
         compressed_push(self.compressed, self._shard(key), key, payload)
+
+    def push_fused(self, key: int, payload) -> None:
+        """Fused-plane push (byteps_tpu.compress): the payload is
+        SELF-DESCRIBING (codec header), so no per-key codec
+        registration exists to drift — decode on arrival, dense-sum in
+        the engine. A torn/mismatched payload raises CodecError loudly
+        before any bytes reach the store."""
+        from ..compress import wire
+        dense = wire.decode_for_store(payload, self._key_meta.get(key))
+        self.push(key, dense)
+
+    def pull_fused(self, key: int, nbytes: int, dtype: str, codec: int,
+                   round: int = 0, timeout_ms: int = 30000,
+                   div: Optional[int] = None) -> bytes:
+        """Fused-plane pull: the merged round encoded at the codec the
+        caller's decision trace pinned for it (deterministic codecs —
+        every puller of (round, codec, div) gets byte-identical
+        payloads; the cache only skips repeat encodes)."""
+        from ..compress import wire
+        if self._fused_cache is None:
+            self._fused_cache = wire.FusedPullCache()
+        return wire.pull_encoded(self, self._fused_cache, key, nbytes,
+                                 dtype, codec, round,
+                                 timeout_ms=timeout_ms,
+                                 div=div if div else wire.TOPK_DIV)
 
     def pull_bytes(self, key: int, round: int = 0,
                    timeout_ms: int = 30000) -> bytes:

@@ -127,6 +127,7 @@ class PlanePSBackend:
         # the routing switch (that round would be silently lost)
         self._migrating: set = set()
         self._dead: set = set()
+        self._fused_ok = False      # _check_fused_shards verdict cache
         # rebalancer inputs: pushed bytes per shard / per key since the
         # last load_window() call
         self._win_shard: Dict[int, int] = {}
@@ -240,8 +241,15 @@ class PlanePSBackend:
                     # this worker can replace its own contribution. Mark
                     # the round replayed so a push retry racing this
                     # failover (the push that DETECTED the death) does
-                    # not apply it a second time.
-                    self._shards[dst].push(key, inf[1])
+                    # not apply it a second time. A fused-plane copy is
+                    # re-pushed as its PAYLOAD — the new shard decodes
+                    # it exactly like the dead one did (deterministic
+                    # codecs), so the replayed sum stays bit-identical.
+                    if (isinstance(inf[1], tuple)
+                            and inf[1][0] == "fused"):
+                        self._shards[dst].push_fused(key, inf[1][1])
+                    else:
+                        self._shards[dst].push(key, inf[1])
                     self._replayed[key] = inf[0]
             try:
                 self._shards[shard].close()
@@ -317,12 +325,14 @@ class PlanePSBackend:
             return True
         return key % self.num_workers == self.worker_id % self.num_workers
 
-    def _log_round(self, key: int, round: int, out: np.ndarray) -> None:
+    def _log_round_bytes(self, key: int, round: int, payload) -> None:
         """Forward-log a completed round to the key's backup. The
         backup dying is a shard death like any other: fail it over
         (idempotent) and log to the NEW backup — the pull that carried
-        this merge was healthy and must not error."""
-        payload = out.tobytes()
+        this merge was healthy and must not error. The log stores the
+        exact BYTES the pull returned (dense for plain rounds, the
+        encoded payload for fused ones), so a replayed pull of the
+        round decodes bit-identically to the original."""
         for attempt in (0, 1):
             b = self.placement.backup_of(key)
             try:
@@ -375,32 +385,28 @@ class PlanePSBackend:
         self._run(key, lambda sh, i: self._init_on(
             i, key, nbytes, dtype, init, compression))
 
-    def push(self, key: int, data: np.ndarray,
-             epoch: Optional[int] = None) -> None:
-        self.placement.check_epoch(key, epoch)
+    def _push_registered(self, key: int, keep, nbytes: int,
+                         send) -> None:
+        """The ONE push critical section (dense and fused): elastic
+        round seeding, wait-and-REGISTER against migration (the dual of
+        migrate_key's drain-and-mark: while ``_migrating`` holds the
+        key no new round can register — a push slipping onto the OLD
+        primary would be silently absent from the replayed state — and
+        once ``_inflight`` holds this round the migration drain blocks
+        until its pull lands), the failover replay-dedup guard, and the
+        rebalancer's load-window booking. ``send(shard_client)`` does
+        the actual wire op."""
         with self._lock:
             seed = self._push_round.get(key)
         if seed is None:
             seed = int(self.round(key))  # elastic seed, like _next_round
-        keep = (np.array(data, copy=True) if self.replicas > 0 else None)
         with self._mig_cv:
-            # wait-and-REGISTER is one critical section, the dual of
-            # migrate_key's drain-and-mark: while _migrating holds the
-            # key no new round can register (a push slipping onto the
-            # OLD primary would be silently absent from the replayed
-            # state), and once _inflight holds this round the migration
-            # drain blocks until its pull lands
             while key in self._migrating:
                 self._mig_cv.wait(timeout=1.0)
             lr = self._push_round.get(key, seed) + 1
             self._push_round[key] = lr
             self._inflight[key] = (lr, keep)
             self._update_lag_locked(key)
-
-        def book(i, n=int(getattr(data, "nbytes", 0))):
-            with self._lock:
-                self._win_shard[i] = self._win_shard.get(i, 0) + n
-                self._win_key[key] = self._win_key.get(key, 0) + n
 
         def do(sh, i):
             with self._lock:
@@ -411,10 +417,19 @@ class PlanePSBackend:
                 if replayed:
                     del self._replayed[key]
             if not replayed:
-                sh.push(key, data)
-            book(i)
+                send(sh)
+            with self._lock:
+                self._win_shard[i] = self._win_shard.get(i, 0) + nbytes
+                self._win_key[key] = self._win_key.get(key, 0) + nbytes
 
         self._run(key, do)
+
+    def push(self, key: int, data: np.ndarray,
+             epoch: Optional[int] = None) -> None:
+        self.placement.check_epoch(key, epoch)
+        keep = (np.array(data, copy=True) if self.replicas > 0 else None)
+        self._push_registered(key, keep, int(getattr(data, "nbytes", 0)),
+                              lambda sh: sh.push(key, data))
 
     def pull(self, key: int, out: np.ndarray, round: int = 0,
              timeout_ms: int = 30000,
@@ -426,32 +441,133 @@ class PlanePSBackend:
             if round and round <= base:
                 # a round completed before the failover/migration: the
                 # live store never saw it — serve the forward log,
-                # bit-exact (every worker logged the same merge)
+                # bit-exact (every worker logged the same merge). The
+                # log stores whatever bytes the DESIGNATED worker's
+                # pull returned — with BPS_COMPRESS=auto and divergent
+                # per-worker decision traces that may be a fused
+                # payload while THIS worker's trace pinned dense.
+                # Disambiguate by SIZE first (a dense log is exactly
+                # out.nbytes; random gradient bytes matching the codec
+                # magic must not shunt a healthy dense replay into the
+                # decoder), header second; a log entry that is neither
+                # refuses loudly inside decode.
+                from ...compress import wire as cwire
                 data = self._repl_wait(key, round, timeout_ms)
-                flat = np.frombuffer(data, dtype=out.dtype)
+                if len(data) == out.nbytes:
+                    flat = np.frombuffer(data, dtype=out.dtype)
+                else:
+                    flat = cwire.decode(data, expect_elems=out.size,
+                                        expect_dtype=out.dtype)
                 np.copyto(out.reshape(-1), flat[:out.size])
                 return
             sh.pull(key, out, round=(round - base) if round else 0,
                     timeout_ms=timeout_ms)
 
         self._run(key, do)
-        if round:
-            # re-read base: a failover inside _run may have raised it.
-            # round <= base means the payload CAME from the forward log
-            # — uploading it back would be a redundant full-payload
-            # wire write on the pull tail.
-            if (self.replicas > 0 and self._logs_key(key)
-                    and round > self._round_base.get(key, 0)):
-                self._log_round(key, round, out)
-            with self._mig_cv:
-                inf = self._inflight.get(key)
-                if inf is not None and inf[0] <= round:
-                    del self._inflight[key]
-                    self._mig_cv.notify_all()   # migrate_key's drain
+        self._finish_pull(key, round, lambda: out.tobytes())
+
+    def _finish_pull(self, key: int, round: int, payload_fn) -> None:
+        """The ONE pull tail (dense and fused): forward-log the
+        completed round when this worker is its designated logger —
+        re-reading the base first, since a failover inside ``_run`` may
+        have raised it, and a round at or below base CAME from the log
+        (re-uploading it would be a redundant full-payload write on the
+        pull tail) — then release the admission-gate in-flight entry
+        for migrate_key's drain. ``payload_fn`` supplies the exact
+        bytes this pull returned, lazily (non-logging workers never pay
+        the copy)."""
+        if not round:
+            return
+        if (self.replicas > 0 and self._logs_key(key)
+                and round > self._round_base.get(key, 0)):
+            self._log_round_bytes(key, round, payload_fn())
+        with self._mig_cv:
+            inf = self._inflight.get(key)
+            if inf is not None and inf[0] <= round:
+                del self._inflight[key]
+                self._mig_cv.notify_all()   # migrate_key's drain
 
     def round(self, key: int) -> int:
         base = self._round_base.get(key, 0)
         return base + int(self._run(key, lambda sh, i: sh.round(key)))
+
+    def _check_fused_shards(self) -> None:
+        """Refuse fused ops EARLY on a plane with any shard that cannot
+        speak them (in-process ``PSServer`` shards take raw dense
+        buffers only) — the same convention ``_init_on`` sets for
+        legacy compressed keys: a capability mismatch must fail at the
+        first call (or, via the exchange's construction-time probe,
+        before any training), never as an AttributeError inside a
+        failover replay that would leave the plane half-migrated.
+        EVERY shard is checked — a fused round can land on any of them
+        after enough failovers/migrations. The verdict is invariant
+        (the shard list never changes), so it is computed once and
+        cached off the per-bucket hot path."""
+        if self._fused_ok:
+            return
+        for sh in self._shards:
+            if not hasattr(sh, "push_fused"):
+                raise ValueError(
+                    f"fused compression needs transport-backed plane "
+                    f"shards (shard type {type(sh).__name__} has no "
+                    f"push_fused/pull_fused) — run the fused plane "
+                    f"over RemotePSBackend shards, or set "
+                    f"BPS_COMPRESS=none")
+        self._fused_ok = True
+
+    def push_fused(self, key: int, payload,
+                   epoch: Optional[int] = None) -> None:
+        """Fused-plane push: routed, epoch-checked, and REPLICATED like
+        a dense push — the in-flight copy kept for failover replay is
+        the encoded payload itself, re-pushed through ``push_fused`` so
+        the promoted shard's decode (deterministic) reproduces exactly
+        what the dead shard summed."""
+        self._check_fused_shards()
+        self.placement.check_epoch(key, epoch)
+        keep = (("fused", bytes(payload)) if self.replicas > 0 else None)
+        self._push_registered(key, keep, len(payload),
+                              lambda sh: sh.push_fused(key, payload))
+
+    def pull_fused(self, key: int, nbytes: int, dtype: str, codec: int,
+                   round: int = 0, timeout_ms: int = 30000,
+                   epoch: Optional[int] = None,
+                   div: Optional[int] = None) -> bytes:
+        """Fused-plane pull. A round at or below the failover/migration
+        base is served from the forward log — the log holds the exact
+        payload bytes the original pull returned, so the replayed round
+        decodes bit-identically (the fused analogue of the dense log
+        replay) whenever the workers' decision traces agree (pinned
+        codecs / single worker); under ``auto`` with divergent
+        per-worker traces the replay is the designated LOGGER's view,
+        normalized below so this worker's decode stays well-formed."""
+        from ...compress import wire as cwire
+        self._check_fused_shards()
+        self.placement.check_epoch(key, epoch)
+
+        def do(sh, i):
+            base = self._round_base.get(key, 0)
+            if round and round <= base:
+                data = self._repl_wait(key, round, timeout_ms)
+                if len(data) == int(nbytes):
+                    # the designated logger's trace pinned DENSE for
+                    # this round while ours pinned a codec: wrap the
+                    # logged dense bytes in a self-describing `none`
+                    # payload so our decode stays well-formed (the
+                    # header, not the requested codec, drives decode).
+                    # Size disambiguates deterministically — a fused
+                    # payload is never exactly the dense length for
+                    # any bucket past the compression floor.
+                    data = cwire.encode(
+                        cwire.CODEC_NONE,
+                        np.frombuffer(data, dtype=np.dtype(dtype)))
+                return data
+            return sh.pull_fused(key, nbytes, dtype, codec,
+                                 round=(round - base) if round else 0,
+                                 timeout_ms=timeout_ms, div=div)
+
+        data = self._run(key, do)
+        self._finish_pull(key, round, lambda: data)
+        return data
 
     def push_bytes(self, key: int, payload) -> None:
         """Compressed push — routed, epoch-checked upstream, but NOT

@@ -145,6 +145,18 @@ class _Round:
         # it, and the overlap aggregates group per step
         self.step_tag = step
         self.decl_name, self.treedef, self.keyed = ex._plan(tree, name)
+        # fused-compression decision trace, PINNED per round: the
+        # controller (re)decides at this round boundary and the
+        # snapshot below is what BOTH the push and the pull of every
+        # bucket in this round use — with two rounds in flight
+        # (cross-step) each carries its own trace, so a mid-round
+        # re-decision can never make a worker pull a codec the server
+        # didn't encode
+        self.clevels = None
+        if ex._cplane is not None:
+            ex._cplane.on_round()
+            self.clevels = [ex._cplane.level_of(pskey)
+                            for pskey, _ in self.keyed]
         # epoch-tagged routing (server plane): the placement view this
         # round resolved its routes under. Every push/pull carries it;
         # a key that migrated since is refused with WrongEpoch (an
@@ -266,7 +278,7 @@ class _Round:
         t0 = ex._record(self.decl_name, "PS_PACK", pskey, t0,
                         step=self.step_tag)
         try:
-            ex._push_bucket(pskey, b, buf, rnd=self)
+            ex._push_bucket(pskey, b, buf, rnd=self, idx=idx)
         except Exception:
             # the round counter advanced but the push never landed: drop
             # the entry so a retried exchange() re-seeds from the
@@ -287,7 +299,7 @@ class _Round:
         pskey, b = self.keyed[idx]
         t0 = time.time()
         merged = ex._pull_bucket(pskey, b, buf, self.rounds[idx],
-                                 rnd=self)
+                                 rnd=self, idx=idx)
         t0 = ex._record(self.decl_name, "PS_PULL", pskey, t0,
                         step=self.step_tag)
         if ex._native_pack and merged.flags["C_CONTIGUOUS"]:
@@ -476,11 +488,37 @@ class PSGradientExchange:
                  registry: Optional[NameRegistry] = None,
                  min_compress_bytes: int = 65536,
                  pipeline_depth: Optional[int] = None,
-                 watchdog_sec: Optional[float] = None) -> None:
+                 watchdog_sec: Optional[float] = None,
+                 compress: Optional[str] = None) -> None:
         self.backend = backend
         self.partition_bytes = partition_bytes
         self.registry = registry or NameRegistry()
         self.min_compress_bytes = min_compress_bytes
+        # fused compression plane (byteps_tpu.compress): per-bucket
+        # codecs composed into THIS pipeline — compress on the pack
+        # worker right before PUSH, decompress on the pull path feeding
+        # the H2D/apply tail — with the codec level decided per layer
+        # at round boundaries (BPS_COMPRESS=auto) or pinned
+        # (=fp16|int8|topk). None (=none, the default) keeps the dense
+        # path bit-identical to a plane-less build. The explicit arg
+        # (Config.compress, wired by GlobalState and the trainer) wins;
+        # the env fallback covers directly-constructed exchanges.
+        from ..compress.plane import CompressionPlane
+        self._cplane = CompressionPlane.from_config(
+            compress, min_bytes=min_compress_bytes)
+        if self._cplane is not None:
+            # capability check at CONFIG time, not mid-training: with
+            # auto mode an incapable backend would otherwise train fine
+            # on an idle wire for hours and crash the moment the
+            # controller first ratchets a layer up
+            if not hasattr(backend, "push_fused"):
+                raise ValueError(
+                    f"BPS_COMPRESS={self._cplane.mode!r} needs a "
+                    f"backend with push_fused/pull_fused; "
+                    f"{type(backend).__name__} has neither")
+            chk = getattr(backend, "_check_fused_shards", None)
+            if chk is not None:
+                chk()    # a plane backend also vets its shard list
         self.pipeline_depth = (int(os.environ.get("BPS_PS_PIPELINE", "4"))
                                if pipeline_depth is None else pipeline_depth)
         self.timeline = None            # set by GlobalState when tracing
@@ -667,6 +705,13 @@ class PSGradientExchange:
                                       compression=ckw)
             else:
                 self.backend.init_key(pskey, nbytes, b.dtype)
+        if self._cplane is not None:
+            for pskey, b in keyed:
+                if pskey in self._chains:
+                    continue    # legacy kwargs chain: explicit opt-in,
+                    #             takes precedence over the fused plane
+                self._cplane.register(pskey, b.size, b.dtype,
+                                      layer=f"{decl_name}.{b.index}")
         plan = (decl_name, treedef, keyed)
         self._plans[key] = plan
         return plan
@@ -831,34 +876,103 @@ class PSGradientExchange:
             rnd.route_epoch = self.backend.placement_epoch()
             return op(rnd.route_epoch)
 
-    def _push_bucket(self, pskey, b, buf, rnd=None) -> None:
+    def _round_level(self, rnd, idx: int) -> int:
+        """The codec level this round's decision trace pinned for
+        bucket ``idx`` (0 = none/dense)."""
+        if (rnd is None or idx is None
+                or getattr(rnd, "clevels", None) is None):
+            return 0
+        return rnd.clevels[idx]
+
+    def _push_bucket(self, pskey, b, buf, rnd=None, idx=None) -> None:
         chain = self._chains.get(pskey)
         if chain is not None:
-            # COMPRESS stage right before PUSH (reference:
+            # legacy COMPRESS stage right before PUSH (reference:
             # core_loops.cc:498-536): wire bytes are compressed; the
             # server decompresses, dense-sums, recompresses the merge
             payload = chain.compress(buf)
             self._m_push_bytes.inc(len(payload))
             self.backend.push_bytes(pskey, payload)
-        else:
-            self._m_push_bytes.inc(buf.nbytes)
-            self._routed(rnd, lambda epoch:
-                         self.backend.push(pskey, buf, epoch=epoch)
-                         if epoch is not None
-                         else self.backend.push(pskey, buf))
+            return
+        plane = self._cplane
+        if plane is not None and plane.active(pskey):
+            import time
+            round_tag = (rnd.rounds[idx]
+                         if rnd is not None and idx is not None else 0)
+            level = self._round_level(rnd, idx)
+            if level:
+                # fused PS_COMPRESS stage, on the pack worker the
+                # moment the bucket's last leaf landed — EF residual
+                # folded in, new residual staged for commit-on-pull.
+                # (level > 0 implies a live rnd: levels come from the
+                # round's pinned trace, so _record is always valid.)
+                t0 = time.time()
+                payload = plane.encode(pskey, buf, level, round_tag)
+                self._record(rnd.decl_name, "PS_COMPRESS", pskey,
+                             t0, step=rnd.step_tag)
+                self._m_push_bytes.inc(len(payload))
+                self._routed(rnd, lambda epoch:
+                             self.backend.push_fused(pskey, payload,
+                                                     epoch=epoch)
+                             if epoch is not None
+                             else self.backend.push_fused(pskey,
+                                                          payload))
+                return
+            # dense round of a plane-managed key: per-layer byte
+            # accounting keeps the controller's wire-load signal live
+            # at level none (which is when up-ratchets consult it),
+            # and any accumulated EF residual from a decayed level is
+            # flushed into this dense round once
+            plane.note_dense_push(pskey, buf.nbytes)
+            buf = plane.fold_residual(pskey, buf, round_tag)
+        self._m_push_bytes.inc(buf.nbytes)
+        self._routed(rnd, lambda epoch:
+                     self.backend.push(pskey, buf, epoch=epoch)
+                     if epoch is not None
+                     else self.backend.push(pskey, buf))
 
-    def _pull_bucket(self, pskey, b, buf, rnd_num, rnd=None):
+    def _pull_bucket(self, pskey, b, buf, rnd_num, rnd=None, idx=None):
         chain = self._chains.get(pskey)
         if chain is not None:
             payload = self.backend.pull_bytes(pskey, round=rnd_num)
             self._m_pull_bytes.inc(len(payload))
             return chain.decompress(payload).astype(b.dtype)
+        plane = self._cplane
+        if plane is not None and plane.active(pskey):
+            level = self._round_level(rnd, idx)
+            if level:
+                import time
+                nbytes = b.size * np.dtype(b.dtype).itemsize
+                div = plane.topk_div
+                payload = self._routed(rnd, lambda epoch:
+                                       self.backend.pull_fused(
+                                           pskey, nbytes, str(b.dtype),
+                                           level, round=rnd_num,
+                                           epoch=epoch, div=div)
+                                       if epoch is not None
+                                       else self.backend.pull_fused(
+                                           pskey, nbytes, str(b.dtype),
+                                           level, round=rnd_num,
+                                           div=div))
+                self._m_pull_bytes.inc(len(payload))
+                # PS_DECOMPRESS on the pull → H2D path feeding the
+                # chunked apply; commits the round's EF residual.
+                # (level > 0 implies a live rnd, as in _push_bucket.)
+                t0 = time.time()
+                merged = plane.decode(pskey, payload, rnd_num)
+                self._record(rnd.decl_name, "PS_DECOMPRESS", pskey,
+                             t0, step=rnd.step_tag)
+                return merged
         self._routed(rnd, lambda epoch:
                      self.backend.pull(pskey, buf, round=rnd_num,
                                        epoch=epoch)
                      if epoch is not None
                      else self.backend.pull(pskey, buf, round=rnd_num))
         self._m_pull_bytes.inc(buf.nbytes)
+        if plane is not None:
+            # dense round of a plane-managed key: still commit (a
+            # residual flush pinned to this round clears on its pull)
+            plane.commit(pskey, rnd_num)
         return buf
 
     def exchange(self, tree, name: Optional[str] = None):

@@ -107,6 +107,22 @@ OP_PULL_PART = 13
 #     round stays distinguishable from "never logged".
 #   OP_REPL_BASE: response payload = u64 highest logged round.
 OP_REPL_PUT, OP_REPL_GET, OP_REPL_BASE = 14, 15, 16
+# Fused compression plane (byteps_tpu.compress): unlike INIT_C/PUSH_C/
+# PULL_C (one immutable codec registered per key), the payload is
+# SELF-DESCRIBING — a codec header rides every frame, so the adaptive
+# controller can re-decide a layer's codec at any round boundary and
+# the server decodes whatever arrives (or refuses LOUDLY on a codec-
+# version mismatch / torn header, compress.wire.CodecError).
+#   OP_PUSH_F: ``round`` = dedup token (like OP_PUSH); payload =
+#     header + codec body. Server decodes → dense-sums in the engine.
+#   OP_PULL_F: ``round`` = sync round, ``nbytes`` = DENSE size, dtype =
+#     dense dtype; payload = codec:u8 | topk-div:u16le (the level the
+#     worker's decision trace pinned for this round + its configured
+#     keep fraction). Server pulls the merged round dense, encodes it
+#     at that codec (cached per (key, round, codec, div) —
+#     deterministic codecs, so the cache is throughput-only), responds
+#     with the payload.
+OP_PUSH_F, OP_PULL_F = 17, 18
 _PART = struct.Struct("!IIHHQ")  # offset, part_len, part_idx, nparts, nonce
 ST_OK, ST_ERR, ST_TIMEOUT, ST_GONE = 0, 1, 2, 3
 
@@ -311,7 +327,9 @@ def _send_req(sock: socket.socket, op: int, key: int, rnd: int, nbytes: int,
 # degrades to an allocation instead of silently corrupting frames.
 _REUSE_SAFE_OPS = frozenset(
     {OP_INIT, OP_PUSH, OP_PUSH_C, OP_PUSH_RS, OP_PUSH_PART,
-     OP_REPL_PUT})   # ReplicaStore.put copies via bytes() synchronously
+     OP_REPL_PUT,    # ReplicaStore.put copies via bytes() synchronously
+     OP_PUSH_F})     # wire.decode materializes (or the engine copies
+                     # the dense view) before the handler returns
 
 
 def _recv_req(sock: socket.socket, rholder: Optional[list] = None):
@@ -440,6 +458,11 @@ class PSTransportServer:
         self._replica = None
         self._replica_lock = threading.Lock()
         self._shm = _ShmCache()
+        # fused-plane pull cache (OP_PULL_F): one encoded payload per
+        # (key, round, codec), throughput-only — the codecs are
+        # deterministic, so a miss re-encodes identical bytes
+        from ..compress.wire import FusedPullCache
+        self._fused_cache = FusedPullCache()
         # striping reassembly/scatter state (OP_PUSH_PART/OP_PULL_PART):
         # parts of one logical op arrive on DIFFERENT connection
         # threads. Stages carry a last-activity stamp and are swept
@@ -523,6 +546,11 @@ class PSTransportServer:
                         if payload is not None else None)
                 self.backend.init_key(key, nbytes, dtype, init=init)
                 self._key_meta[key] = (int(nbytes), dtype)
+                # a (re-)init marks a new tenancy of the key on this
+                # shard (migration replay): shard-local rounds restart,
+                # so cached fused pulls from a previous tenancy would
+                # alias the recurring round numbers
+                self._fused_cache.drop(key)
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PUSH:
                 # wire transcode: a frame dtype narrower than the store
@@ -555,6 +583,35 @@ class PSTransportServer:
                     lambda: compressed_push(self.compressed, self.backend,
                                             key, payload))
                 conn.sendall(_RSP.pack(ST_OK, 0))
+            elif op == OP_PUSH_F:
+                from ..compress import wire as cwire
+                arr = cwire.decode_for_store(payload,
+                                             self._key_meta.get(key))
+                self._apply_push_once(
+                    key, rnd, lambda: self.backend.push(key, arr))
+                conn.sendall(_RSP.pack(ST_OK, 0))
+            elif op == OP_PULL_F:
+                from ..compress import wire as cwire
+                pb = bytes(payload or b"\0")
+                cid = int(pb[0])
+                div = (struct.unpack("<H", pb[1:3])[0]
+                       if len(pb) >= 3 else cwire.TOPK_DIV)
+                t0 = time.time()
+                buf = cwire.pull_encoded(
+                    self.backend, self._fused_cache, key, int(nbytes),
+                    dtype, cid, int(rnd), int(timeout) or 30000,
+                    div=div or cwire.TOPK_DIV)
+                # same bottleneck signal OP_PULL feeds (_pull_dense):
+                # merge wait + the slowest worker's push lag; cache
+                # hits observe ~0 and don't skew the histogram
+                self._m_merge_wait.observe(time.time() - t0)
+                if self._key_log:
+                    from ..common.logging import get_logger
+                    get_logger().info(
+                        "PS_KEY_LOG op=%d key=%d bytes=%d rnd=%d",
+                        op, key, len(buf), rnd)
+                conn.sendall(_RSP.pack(ST_OK, len(buf)))
+                conn.sendall(buf)
             elif op == OP_PUSH_RS:
                 from .rowsparse import rowsparse_push, unpack_rows
                 idx, rows = unpack_rows(payload, dtype)
@@ -1489,6 +1546,30 @@ class RemotePSBackend:
         bandwidth win the reference's inter-node compression is for)."""
         self._rpc(OP_PUSH_C, key, self._push_token(key), 0, 0, "uint8",
                   memoryview(payload))
+
+    def push_fused(self, key: int, payload) -> None:
+        """Fused-plane push (byteps_tpu.compress): self-describing codec
+        payload, decoded on arrival by the server; dedup-tokenized like
+        any push so a retried frame is applied exactly once."""
+        self._rpc(OP_PUSH_F, key, self._push_token(key), 0, 0, "uint8",
+                  memoryview(payload))
+
+    def pull_fused(self, key: int, nbytes: int, dtype: str, codec: int,
+                   round: int = 0, timeout_ms: int = 30000,
+                   div: Optional[int] = None) -> bytes:
+        """Fused-plane pull: the merged round encoded server-side at
+        ``codec`` (the level this worker's decision trace pinned for
+        the round) — wire bytes stay compressed in BOTH directions.
+        The frame's payload carries (codec:u8 | topk div:u16le) so the
+        server's re-encode honors this worker's keep fraction."""
+        from ..compress.wire import TOPK_DIV
+        payload = bytes((int(codec),)) + struct.pack(
+            "<H", int(div) if div else TOPK_DIV)
+        return self._sliced_pull(
+            lambda slice_ms: self._rpc(
+                OP_PULL_F, key, round, int(nbytes), slice_ms, dtype,
+                payload),
+            timeout_ms, f"pull_fused({key}) round={round}")
 
     def push_rowsparse(self, key: int, idx, rows, dense_nbytes: int,
                       dtype=None) -> None:

@@ -237,7 +237,8 @@ class DistributedTrainer:
             self._ps_exchange = PSGradientExchange(
                 gs.ps_backend, partition_bytes=partition_bytes,
                 registry=gs.registry, min_compress_bytes=min_compress_bytes,
-                watchdog_sec=gs.config.watchdog_sec)
+                watchdog_sec=gs.config.watchdog_sec,
+                compress=gs.config.compress)
             self._ps_exchange.timeline = gs.timeline
             self._ps_world = eng.ps_world
             # streamed step tail (pull → H2D → chunked apply pipelined

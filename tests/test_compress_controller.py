@@ -1,0 +1,185 @@
+"""Adaptive compression controller against a FAKE metrics registry
+(a private MetricsRegistry instance the test mutates directly): the
+decision logic — ratchet under wire pressure, decay to ``none`` on an
+idle wire, hysteresis on boundary signals — without any real traffic.
+The signals are the ones the controller reads in production
+(``nic/stalls``, ``server/engine_queue_depth``, ``transport/resends``;
+docs/gradient-compression.md "The controller")."""
+
+import numpy as np
+import pytest
+
+from byteps_tpu.compress import wire
+from byteps_tpu.compress.controller import (CompressController,
+                                            FixedController)
+from byteps_tpu.compress.plane import CompressionPlane
+from byteps_tpu.obs.metrics import MetricsRegistry
+
+
+def make(max_level="topk", hold=2, **kw):
+    reg = MetricsRegistry()
+    c = CompressController(registry=reg, max_level=max_level, hold=hold,
+                           **kw)
+    c.register_layer("l0")
+    c.register_layer("l1")
+    return reg, c
+
+
+def test_wire_bound_ratchets_up():
+    """Sustained stalls walk every layer up the ladder one step per
+    ``hold`` consecutive congested verdicts, stopping at max_level."""
+    reg, c = make()
+    stalls = reg.counter("nic/stalls")
+    seen = []
+    for _ in range(8):
+        stalls.inc(5)
+        c.decide()
+        seen.append(c.level_of("l0"))
+    assert seen == [0, 1, 1, 2, 2, 3, 3, 3]     # none→fp16→int8→topk, capped
+    assert c.level_of("l1") == wire.CODEC_TOPK
+
+
+def test_resends_and_queue_depth_also_count_as_pressure():
+    reg, c = make(max_level="fp16")
+    reg.counter("transport/resends").inc()
+    c.decide()
+    reg.counter("transport/resends").inc()
+    c.decide()
+    assert c.level_of("l0") == wire.CODEC_FP16
+    reg2, c2 = make(max_level="fp16")
+    reg2.gauge("server/engine_queue_depth").set(5)
+    c2.decide()
+    c2.decide()
+    assert c2.level_of("l0") == wire.CODEC_FP16
+
+
+def test_idle_wire_decays_to_none():
+    """The hard fallback: an idle wire (all signals quiet) walks the
+    ladder back down to none — compression auto-disables where it
+    would lose (arXiv 2103.00543)."""
+    reg, c = make()
+    stalls = reg.counter("nic/stalls")
+    for _ in range(6):
+        stalls.inc(1)
+        c.decide()
+    assert c.level_of("l0") == wire.CODEC_TOPK
+    for _ in range(6):
+        c.decide()                               # no new stalls: idle
+    assert c.level_of("l0") == wire.CODEC_NONE
+    assert c.level_of("l1") == wire.CODEC_NONE
+
+
+def test_hysteresis_no_flap_on_boundary_signal():
+    """A signal sitting on the decision boundary — alternating one
+    stall / none, or a sub-threshold queue depth — must never move the
+    ladder: each opposing or boundary verdict resets the streak."""
+    reg, c = make()
+    stalls = reg.counter("nic/stalls")
+    levels = []
+    for i in range(12):
+        if i % 2 == 0:
+            stalls.inc(1)                        # congested this round
+        levels.append(c.decide()["l0"])          # idle next round
+    assert levels == [0] * 12, f"flapped: {levels}"
+    # queue depth below the floor with zero stalls = boundary verdict:
+    # holds whatever level is current (here none), votes reset
+    reg2, c2 = make()
+    reg2.gauge("server/engine_queue_depth").set(1.0)   # < default 2.0
+    for _ in range(6):
+        c2.decide()
+    assert c2.level_of("l0") == wire.CODEC_NONE
+
+
+def test_decisions_visible_in_gauges_and_counter():
+    """Every level change lands in the per-layer gauge and the
+    decisions counter — the bench/watchdog view of why bytes moved."""
+    reg, c = make(max_level="int8")
+    stalls = reg.counter("nic/stalls")
+    for _ in range(4):
+        stalls.inc(1)
+        c.decide()
+    assert reg.gauge("compress/level/l0").value == wire.CODEC_INT8
+    assert reg.gauge("compress/level/l1").value == wire.CODEC_INT8
+    # 2 layers x 2 level changes
+    assert reg.counter("compress/decisions").value == 4
+
+
+def test_fixed_controller_pins_the_trace():
+    reg = MetricsRegistry()
+    c = FixedController("fp16", registry=reg)
+    c.register_layer("a")
+    reg.counter("nic/stalls").inc(100)
+    c.on_round()
+    assert c.level_of("a") == wire.CODEC_FP16
+    assert reg.gauge("compress/level/a").value == wire.CODEC_FP16
+
+
+def test_plane_auto_mode_uses_live_registry_signals():
+    """End-to-end through the plane: a round boundary with stall
+    pressure ratchets the level the exchange will snapshot next round;
+    quiet rounds decay it back."""
+    reg = MetricsRegistry()
+    plane = CompressionPlane("auto", min_bytes=0, registry=reg)
+    assert plane.register(11, 512, "float32", "m.0")
+    assert plane.level_of(11) == wire.CODEC_NONE
+    stalls = reg.counter("nic/stalls")
+    for _ in range(4):
+        stalls.inc(2)
+        plane.on_round()
+    assert plane.level_of(11) == wire.CODEC_INT8    # default max cap
+    for _ in range(6):
+        plane.on_round()
+    assert plane.level_of(11) == wire.CODEC_NONE
+    # per-layer wire-byte counter exists for the controller's ranking
+    payload = plane.encode(11, np.ones(512, np.float32),
+                           wire.CODEC_INT8, 1)
+    assert reg.counter("ps/push_bytes/m.0").value == len(payload)
+
+
+def test_decision_interval_cadence():
+    """``interval`` spaces the decisions: with interval=3, only every
+    third round boundary reads the signals."""
+    reg = MetricsRegistry()
+    c = CompressController(registry=reg, max_level="int8", hold=1,
+                           interval=3)
+    c.register_layer("x")
+    stalls = reg.counter("nic/stalls")
+    for i in range(5):
+        stalls.inc(1)
+        c.on_round()
+    # rounds 3 only (rounds 1,2,4,5 skipped; round 3 decided once)
+    assert c.level_of("x") == wire.CODEC_FP16
+
+
+def test_up_ratchet_targets_only_wire_loading_layers():
+    """The per-layer ps/push_bytes counters pick WHICH layers ratchet:
+    under pressure, a layer that moved bytes since the last decision
+    climbs; an idle layer holds (nothing on the wire to compress).
+    Cold start — no layer has recorded bytes — falls back to all."""
+    reg, c = make(max_level="int8", hold=1)
+    stalls = reg.counter("nic/stalls")
+    # cold start: neither layer has bytes -> both ratchet
+    stalls.inc(1)
+    c.decide()
+    assert c.level_of("l0") == c.level_of("l1") == wire.CODEC_FP16
+    # only l0 pushes from here on: l1 holds while l0 climbs
+    reg.counter("ps/push_bytes/l0").inc(1 << 20)
+    stalls.inc(1)
+    c.decide()
+    assert c.level_of("l0") == wire.CODEC_INT8
+    assert c.level_of("l1") == wire.CODEC_FP16
+    # decay applies to every layer (an idle layer sheds its level too)
+    c.decide()
+    assert c.level_of("l0") == wire.CODEC_FP16
+    assert c.level_of("l1") == wire.CODEC_NONE
+
+
+def test_plane_dense_pushes_feed_the_per_layer_signal():
+    """A plane-managed key pushed DENSE (level none) still accounts
+    into ps/push_bytes/<layer> — exactly the state an up-ratchet
+    decision consults."""
+    reg = MetricsRegistry()
+    plane = CompressionPlane("auto", min_bytes=0, registry=reg)
+    plane.register(5, 256, "float32", "d.0")
+    plane.note_dense_push(5, 1024)
+    assert reg.counter("ps/push_bytes/d.0").value == 1024

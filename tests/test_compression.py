@@ -247,3 +247,136 @@ def test_nesterov_momentum_state():
     np.testing.assert_allclose(np.asarray(st["m"]), np.asarray(x) * 1.0)  # m = 0.5*0 + x
     _, st2 = comp.compress(x, st)
     np.testing.assert_allclose(np.asarray(st2["m"]), 0.5 * np.asarray(st["m"]) + np.asarray(x))
+
+
+# ------------------------------------------------- fused wire codecs
+#
+# The FUSED compression plane (byteps_tpu/compress, BPS_COMPRESS) is
+# the pipeline-integrated successor of the kwargs-declared chains
+# above: self-describing payloads, deterministic codecs, adaptive
+# per-layer levels. These tests pin the wire format and the
+# error-feedback plane; the end-to-end exchange coverage lives in
+# test_ps_compression.py.
+
+from byteps_tpu.compress import wire as cwire
+from byteps_tpu.compress.plane import CompressionPlane
+
+
+@pytest.mark.parametrize("name", cwire.LEVELS)
+def test_fused_codec_roundtrip_and_size(name):
+    cid = cwire.codec_id(name)
+    x = np.random.RandomState(10).randn(1000).astype(np.float32)
+    payload = cwire.encode(cid, x)
+    assert len(payload) == cwire.wire_nbytes(cid, 1000, "float32")
+    out = cwire.decode(payload, expect_elems=1000, expect_dtype="float32")
+    if cid == cwire.CODEC_NONE:
+        np.testing.assert_array_equal(out, x)
+    else:
+        assert len(payload) < 1000 * 4          # it actually compresses
+        # every codec is value-bounded: reconstruction error within the
+        # codec's resolution on the unit-normal input
+        tol = {cwire.CODEC_FP16: 1e-3, cwire.CODEC_INT8: 0.05,
+               cwire.CODEC_TOPK: 5.0}[cid]
+        assert float(np.abs(out - x).max()) <= tol
+
+
+def test_fused_codec_deterministic():
+    """No RNG anywhere: encode is a pure function of the dense input —
+    the property the pinned-decision-trace reproducibility contract
+    and the server's cacheless byte-identity both rest on."""
+    x = np.random.RandomState(11).randn(777).astype(np.float32)
+    for cid in (cwire.CODEC_FP16, cwire.CODEC_INT8, cwire.CODEC_TOPK):
+        assert cwire.encode(cid, x) == cwire.encode(cid, x.copy())
+
+
+def test_fused_header_refuses_loudly():
+    """Torn/foreign/mismatched payloads raise CodecError instead of
+    decoding garbage — the WrongEpoch-style refusal on the codec axis."""
+    x = np.arange(100, dtype=np.float32)
+    good = cwire.encode(cwire.CODEC_INT8, x)
+    with pytest.raises(cwire.CodecError, match="magic"):
+        cwire.decode(x.tobytes())               # dense bytes, no header
+    with pytest.raises(cwire.CodecError, match="truncated"):
+        cwire.decode(good[:8])
+    bad_ver = bytearray(good)
+    bad_ver[2] = 99
+    with pytest.raises(cwire.CodecError, match="version"):
+        cwire.decode(bytes(bad_ver))
+    with pytest.raises(cwire.CodecError, match="expects"):
+        cwire.decode(good, expect_elems=99)     # plan mismatch
+    with pytest.raises(cwire.CodecError, match="body"):
+        cwire.decode(good + b"\x00")            # length disagreement
+
+
+def test_fused_int8_matches_pallas_kernels():
+    """The host int8 codec and the Pallas quantize/dequantize pair
+    produce byte-identical q for the same scale (round-half-even both
+    sides) — a device-side quantize stage can feed the same wire."""
+    import jax.numpy as jnp
+
+    from byteps_tpu.ops.compression.pallas_kernels import (
+        int8_dequantize, int8_quantize)
+    x = np.random.RandomState(12).randn(1000).astype(np.float32)
+    payload = cwire.encode(cwire.CODEC_INT8, x)
+    import struct as _struct
+    body = payload[cwire._HDR.size:]
+    (scale,) = _struct.unpack("<f", body[:4])
+    q_host = np.frombuffer(body[4:], np.int8)
+    q_dev = np.asarray(int8_quantize(jnp.asarray(x), scale))
+    np.testing.assert_array_equal(q_host, q_dev)
+    np.testing.assert_allclose(
+        np.asarray(int8_dequantize(jnp.asarray(q_dev), scale, 1000)),
+        cwire.decode(payload, 1000, "float32"), rtol=1e-6)
+
+
+def test_fused_plane_error_feedback_recovers_signal():
+    """EF through the plane: residuals carry quantization error across
+    rounds, so the averaged decoded stream approaches the true input
+    (the same telescoping argument as the legacy HostErrorFeedback)."""
+    n = 256
+    g = np.random.RandomState(13).randn(n).astype(np.float32)
+    # div=8: k = n/8 coordinates per round, so every coordinate's turn
+    # comes around every ~8 rounds and the telescoped residual term
+    # (e_0 - e_N)/N stays well inside the tolerance
+    plane = CompressionPlane("topk", min_bytes=0, topk_div=8)
+    assert plane.register(7, n, "float32", "l.0")
+    acc = np.zeros(n)
+    rounds = 300
+    for r in range(1, rounds + 1):
+        payload = plane.encode(7, g, cwire.CODEC_TOPK, r)
+        acc += plane.decode(7, payload, r)      # decode commits EF
+    np.testing.assert_allclose(acc / rounds, g, atol=0.05)
+    # without EF, topk would NEVER ship the small coordinates
+    plain = cwire.decode(cwire.encode(cwire.CODEC_TOPK, g), n, "float32")
+    dropped = (plain == 0) & (np.abs(g) > 0.05)
+    assert dropped.any() and np.all(acc[dropped] != 0)
+
+
+def test_fused_plane_residual_commits_only_on_pull():
+    """A round that dies between push and pull must NOT advance the EF
+    state: the pending residual is installed only by the matching
+    commit, so the retry re-reads the last committed residual."""
+    n = 64
+    plane = CompressionPlane("int8", min_bytes=0)
+    plane.register(3, n, "float32", "l.0")
+    g = np.random.RandomState(14).randn(n).astype(np.float32)
+    p1 = plane.encode(3, g, cwire.CODEC_INT8, 1)
+    plane.decode(3, p1, 1)                      # round 1 lands
+    committed = plane._keys[3].residual.copy()
+    p2 = plane.encode(3, g, cwire.CODEC_INT8, 2)   # round 2 pushed...
+    # ...but its pull never lands: the committed state is unchanged
+    np.testing.assert_array_equal(plane._keys[3].residual, committed)
+    # the retry compresses against the same committed residual
+    p2_retry = plane.encode(3, g, cwire.CODEC_INT8, 2)
+    assert p2 == p2_retry
+
+
+def test_fused_plane_eligibility_floor():
+    """Sub-floor and non-fp32 buckets stay dense (same rule as the
+    legacy BYTEPS_MIN_COMPRESS_BYTES floor)."""
+    plane = CompressionPlane("int8", min_bytes=1024)
+    assert not plane.register(1, 8, "float32", "small")     # < floor
+    assert not plane.register(2, 4096, "int32", "ints")     # not fp32
+    assert plane.register(3, 4096, "float32", "big")
+    assert not plane.active(1) and not plane.active(2)
+    assert plane.active(3)

@@ -312,3 +312,186 @@ def test_watchdog_dumps_on_lost_peer_push(monkeypatch):
         srv.close()
         be.close()
         w.close()
+
+
+def test_fused_codec_version_mismatch_is_loud_not_torn():
+    """A payload carrying a FOREIGN codec version (a stale peer, a torn
+    frame that still parses a header) is refused with the CodecError
+    message over the wire — never decoded into plausible garbage — and
+    the connection survives for the next good round (the WrongEpoch
+    refusal pattern, applied to the codec axis)."""
+    from byteps_tpu.compress import wire as cwire
+
+    be = PSServer(num_workers=1, engine_threads=1)
+    srv = PSTransportServer(be, host="127.0.0.1")
+    try:
+        w = RemotePSBackend([f"127.0.0.1:{srv.port}"])
+        n = 512
+        w.init_key(41, n * 4, "float32")
+        x = np.arange(n, dtype=np.float32)
+        good = cwire.encode(cwire.CODEC_INT8, x)
+        bad = bytearray(good)
+        bad[2] = 99                      # version byte
+        with pytest.raises(RuntimeError, match="codec-version"):
+            w.push_fused(41, bytes(bad))
+        # dense bytes routed onto the fused path: refused on magic
+        with pytest.raises(RuntimeError, match="magic"):
+            w.push_fused(41, x.tobytes())
+        # the connection is still usable and the store untouched: the
+        # next good round is round 1, not 3
+        w.push_fused(41, good)
+        out = cwire.decode(
+            w.pull_fused(41, n * 4, "float32", cwire.CODEC_INT8,
+                         round=1), n, "float32")
+        np.testing.assert_allclose(out, cwire.decode(good, n, "float32"),
+                                   atol=0.02 * n / 127)
+        w.close()
+    finally:
+        srv.close()
+        be.close()
+
+
+@pytest.mark.slow
+def test_plane_failover_fused_compression_bit_identical():
+    """Kill a server-plane shard mid-round WITH fused compression on:
+    the failover must (a) re-push the in-flight round's retained
+    PAYLOAD so the promoted shard's decode reproduces exactly what the
+    dead shard summed, and (b) serve pre-fault rounds from the forward
+    log — which stores the encoded payload the original pull returned —
+    so replayed rounds decode BIT-identically. Whole run compared
+    against a no-fault run (the test_plane_failover_tcp_bit_identical
+    contract, compressed)."""
+    from byteps_tpu.compress import wire as cwire
+    from byteps_tpu.server.plane import PlanePSBackend
+
+    keys = list(range(3))
+    n = 4096
+
+    def data(k, r):
+        return np.random.RandomState(100 * k + r).randn(n).astype(
+            np.float32)
+
+    def run(kill: bool):
+        engines = [PSServer(num_workers=1, engine_threads=1)
+                   for _ in range(2)]
+        servers = [PSTransportServer(e, host="127.0.0.1", port=0)
+                   for e in engines]
+        results = {}
+        try:
+            shards = [RemotePSBackend([f"127.0.0.1:{s.port}"],
+                                      reconnect_secs=1.0)
+                      for s in servers]
+            plane = PlanePSBackend(shards, num_workers=1, replicas=1,
+                                   owns_shards=True)
+            for k in keys:
+                plane.init_key(k, n * 4)
+            for r in (1, 2):
+                for k in keys:
+                    plane.push_fused(
+                        k, cwire.encode(cwire.CODEC_INT8, data(k, r)))
+                for k in keys:
+                    results[(k, r)] = plane.pull_fused(
+                        k, n * 4, "float32", cwire.CODEC_INT8, round=r)
+            # round 3 pushed but not pulled — then the shard owning
+            # key 0 dies (the admission-gate in-flight window)
+            for k in keys:
+                plane.push_fused(
+                    k, cwire.encode(cwire.CODEC_INT8, data(k, 3)))
+            if kill:
+                victim = plane.placement.shard_of(0)
+                servers[victim].close()
+                engines[victim].close()
+            for k in keys:
+                results[(k, 3)] = plane.pull_fused(
+                    k, n * 4, "float32", cwire.CODEC_INT8, round=3)
+            if kill:
+                # pre-fault rounds now live only in the forward log:
+                # the replay serves the exact logged payload bytes
+                for k in keys:
+                    assert plane.pull_fused(
+                        k, n * 4, "float32", cwire.CODEC_INT8,
+                        round=2) == results[(k, 2)], (
+                        f"key {k} round 2 log replay diverged")
+            # one more full round through the post-failover plane
+            for k in keys:
+                plane.push_fused(
+                    k, cwire.encode(cwire.CODEC_INT8, data(k, 4)))
+            for k in keys:
+                results[(k, 4)] = plane.pull_fused(
+                    k, n * 4, "float32", cwire.CODEC_INT8, round=4)
+            plane.close()
+        finally:
+            for s in servers:
+                try:
+                    s.close()
+                except Exception:
+                    pass
+            for e in engines:
+                try:
+                    e.close()
+                except Exception:
+                    pass
+        return results
+
+    from byteps_tpu.obs.metrics import get_registry
+    ref = run(kill=False)
+    get_registry().counter("plane/failovers").reset()
+    got = run(kill=True)
+    assert get_registry().counter("plane/failovers").value >= 1
+    assert set(got) == set(ref)
+    for kr in ref:
+        assert got[kr] == ref[kr], f"{kr} diverged after fused failover"
+
+
+def test_plane_log_replay_normalizes_cross_codec_formats():
+    """Under BPS_COMPRESS=auto, per-worker decision traces may diverge
+    (documented), so the forward log — written by the designated
+    logging worker — can hold a FUSED payload while the replaying
+    worker's trace pinned dense for that round, or vice versa. Both
+    replay paths must normalize on the self-describing header instead
+    of misreading codec bytes as fp32 (shape explosion) or dense bytes
+    as a payload (CodecError on a healthy pull)."""
+    from byteps_tpu.compress import wire as cwire
+    from byteps_tpu.server.plane import PlanePSBackend
+
+    engines = [PSServer(num_workers=1, engine_threads=1)
+               for _ in range(2)]
+    servers = [PSTransportServer(e, host="127.0.0.1", port=0)
+               for e in engines]
+    try:
+        shards = [RemotePSBackend([f"127.0.0.1:{s.port}"],
+                                  reconnect_secs=1.0) for s in servers]
+        plane = PlanePSBackend(shards, num_workers=1, replicas=1,
+                               owns_shards=True)
+        n = 256
+        dense = np.random.RandomState(30).randn(n).astype(np.float32)
+        fused = cwire.encode(cwire.CODEC_INT8, dense)
+        for key, logged in ((1, fused), (2, dense.tobytes())):
+            plane.init_key(key, n * 4)
+            b = plane.placement.backup_of(key)
+            plane._repl[b].repl_put(key, 1, logged)
+            plane._round_base[key] = 1      # round 1 = log-served
+        # fused-logged round pulled DENSE: decoded via the header
+        out = np.empty(n, np.float32)
+        plane.pull(1, out, round=1)
+        np.testing.assert_array_equal(
+            out, cwire.decode(fused, n, "float32"))
+        # fused-logged round pulled FUSED: payload served as-is
+        assert plane.pull_fused(1, n * 4, "float32", cwire.CODEC_INT8,
+                                round=1) == fused
+        # dense-logged round pulled DENSE: raw bytes as before
+        out2 = np.empty(n, np.float32)
+        plane.pull(2, out2, round=1)
+        np.testing.assert_array_equal(out2, dense)
+        # dense-logged round pulled FUSED: wrapped in a `none` payload,
+        # decodes to the exact dense merge
+        payload = plane.pull_fused(2, n * 4, "float32",
+                                   cwire.CODEC_INT8, round=1)
+        np.testing.assert_array_equal(
+            cwire.decode(payload, n, "float32"), dense)
+        plane.close()
+    finally:
+        for s in servers:
+            s.close()
+        for e in engines:
+            e.close()

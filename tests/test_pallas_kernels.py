@@ -52,3 +52,60 @@ def test_pack_unpack_primitives_jit():
 
     signs = np.asarray(roundtrip(x))
     np.testing.assert_array_equal(signs, np.where(np.asarray(x) < 0, -1.0, 1.0))
+
+
+# ------------------------------------------------ int8 quantize pair
+#
+# The fused compression plane's int8 hot path (byteps_tpu/compress):
+# the Pallas kernel pair must match the host codec's math exactly
+# (same scale convention, round-half-even), so device-quantized bytes
+# are interchangeable with pack-worker-quantized ones on the wire.
+
+from byteps_tpu.ops.compression.pallas_kernels import (int8_dequantize,
+                                                       int8_quantize)
+
+
+@pytest.mark.parametrize("n", [128, 1000, 4096, 32768 + 13])
+def test_int8_quantize_matches_host_codec(n):
+    from byteps_tpu.compress import wire as cwire
+    rng = np.random.RandomState(n)
+    x = rng.randn(n).astype(np.float32)
+    payload = cwire.encode(cwire.CODEC_INT8, x)
+    import struct
+    body = payload[cwire._HDR.size:]
+    (scale,) = struct.unpack("<f", body[:4])
+    q_host = np.frombuffer(body[4:], np.int8)
+    q_dev = np.asarray(int8_quantize(jnp.asarray(x), scale))
+    np.testing.assert_array_equal(q_dev, q_host)
+
+
+@pytest.mark.parametrize("n", [128, 1000, 4096])
+def test_int8_roundtrip_and_bounds(n):
+    rng = np.random.RandomState(n + 1)
+    x = rng.randn(n).astype(np.float32) * 3.0
+    scale = np.float32(np.abs(x).max() / 127.0)
+    q = np.asarray(int8_quantize(jnp.asarray(x), scale))
+    assert q.min() >= -127 and q.max() <= 127
+    out = np.asarray(int8_dequantize(jnp.asarray(q), scale, n))
+    # reconstruction error bounded by half a quantization step
+    assert float(np.abs(out - x).max()) <= 0.5 * float(scale) + 1e-6
+
+
+def test_int8_quantize_pair_jit():
+    n = 5000
+    x = jnp.asarray(np.random.RandomState(3).randn(n).astype(np.float32))
+    scale = jnp.float32(0.02)
+
+    @jax.jit
+    def roundtrip(x):
+        return int8_dequantize(int8_quantize(x, scale), scale, n)
+
+    out = np.asarray(roundtrip(x))
+    want = np.clip(np.rint(np.asarray(x) / 0.02), -127, 127) * 0.02
+    np.testing.assert_allclose(out, want.astype(np.float32), rtol=1e-6)
+
+
+def test_int8_zero_scale_quantizes_to_zero():
+    """amax == 0 (all-zero bucket): inv-scale 0 → all-zero q, no NaNs."""
+    q = np.asarray(int8_quantize(jnp.zeros(256, jnp.float32), 0.0))
+    assert not q.any()

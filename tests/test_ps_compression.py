@@ -241,3 +241,289 @@ def test_ps_mode_end_to_end_compressed():
         bps.shutdown()
         os.environ.pop("BPS_ENABLE_PS", None)
         os.environ.pop("BPS_MIN_COMPRESS_BYTES", None)
+
+
+# ----------------------------------------------------- fused PS path
+#
+# The FUSED compression plane (byteps_tpu/compress, BPS_COMPRESS via
+# Config) composed into the streamed exchange — the pipeline-native
+# successor of the kwargs-declared path above, which stays available
+# behind its explicit opt-in (declare_tensor compression kwargs) and
+# takes precedence for keys that declare it.
+
+from byteps_tpu.compress import wire as cwire
+from byteps_tpu.server.ps_mode import PSGradientExchange
+
+FSIZE = 1500
+
+
+def test_fused_backend_two_worker_sum():
+    """Two self-describing int8 pushes: the shard decodes each on
+    arrival, dense-sums in the engine, and both pulls of the merged
+    round are byte-identical (deterministic codec + cache)."""
+    be = HostPSBackend(num_servers=1, num_workers=2, engine_threads=1)
+    try:
+        be.init_key(21, FSIZE * 4, "float32")
+        xa = np.random.RandomState(20).randn(FSIZE).astype(np.float32)
+        xb = np.random.RandomState(21).randn(FSIZE).astype(np.float32)
+        be.push_fused(21, cwire.encode(cwire.CODEC_INT8, xa))
+        be.push_fused(21, cwire.encode(cwire.CODEC_INT8, xb))
+        p1 = be.pull_fused(21, FSIZE * 4, "float32", cwire.CODEC_INT8,
+                           round=1)
+        p2 = be.pull_fused(21, FSIZE * 4, "float32", cwire.CODEC_INT8,
+                           round=1)
+        assert p1 == p2
+        merged = (cwire.decode(cwire.encode(cwire.CODEC_INT8, xa),
+                               FSIZE, "float32")
+                  + cwire.decode(cwire.encode(cwire.CODEC_INT8, xb),
+                                 FSIZE, "float32"))
+        np.testing.assert_allclose(
+            cwire.decode(p1, FSIZE, "float32"),
+            cwire.decode(cwire.encode(cwire.CODEC_INT8, merged),
+                         FSIZE, "float32"), rtol=1e-6)
+    finally:
+        be.close()
+
+
+def test_fused_transport_roundtrip():
+    """OP_PUSH_F/OP_PULL_F over TCP: wire bytes stay compressed in BOTH
+    directions; a codec-version mismatch is refused loudly server-side
+    (ST_ERR with the CodecError message), never a torn decode."""
+    from byteps_tpu.server.engine import PSServer
+
+    be = PSServer(num_workers=1, engine_threads=1)
+    srv = PSTransportServer(be, host="127.0.0.1")
+    try:
+        w = RemotePSBackend([f"127.0.0.1:{srv.port}"])
+        w.init_key(23, FSIZE * 4, "float32")
+        x = np.random.RandomState(22).randn(FSIZE).astype(np.float32)
+        payload = cwire.encode(cwire.CODEC_FP16, x)
+        assert len(payload) < FSIZE * 4
+        w.push_fused(23, payload)
+        out = w.pull_fused(23, FSIZE * 4, "float32", cwire.CODEC_FP16,
+                           round=1)
+        assert len(out) < FSIZE * 4
+        # world 1: merge == the decoded push, re-encoded fp16 (lossless
+        # on already-fp16-grid values)
+        np.testing.assert_allclose(
+            cwire.decode(out, FSIZE, "float32"),
+            cwire.decode(payload, FSIZE, "float32"))
+        bad = bytearray(payload)
+        bad[2] = 99                              # foreign codec version
+        with pytest.raises(RuntimeError, match="codec-version"):
+            w.push_fused(23, bytes(bad))
+        w.close()
+    finally:
+        srv.close()
+        be.close()
+
+
+def test_fused_exchange_levels_and_bytes():
+    """A pinned-codec exchange (Config-style ``compress=`` knob)
+    compresses every eligible bucket: wire byte counters drop ~4x at
+    int8, per-layer level gauges are visible, and the summed tree is
+    within quantization tolerance."""
+    from byteps_tpu.obs.metrics import get_registry
+
+    be = HostPSBackend(num_servers=1, num_workers=1, engine_threads=1)
+    try:
+        reg = get_registry()
+        reg.counter("compress/raw_bytes").reset()
+        reg.counter("compress/wire_bytes").reset()
+        ex = PSGradientExchange(be, partition_bytes=8 << 10,
+                                min_compress_bytes=0, compress="int8")
+        tree = {"g": np.linspace(-1, 1, 6000).astype(np.float32),
+                "h": np.ones(500, np.float32)}
+        out = ex.exchange(tree, name="fx")
+        for k in tree:
+            np.testing.assert_allclose(out[k], tree[k], atol=0.02)
+        raw = reg.counter("compress/raw_bytes").value
+        wirev = reg.counter("compress/wire_bytes").value
+        assert raw > 0 and wirev < raw / 3      # int8 ≈ 4x minus headers
+        levels = [n for n in reg.names()
+                  if n.startswith("compress/level/fx.")]
+        assert levels and all(
+            reg.gauge(n).value == cwire.CODEC_INT8 for n in levels)
+        ex.close()
+    finally:
+        be.close()
+
+
+def test_fused_exchange_none_is_bit_identical_to_dense():
+    """BPS_COMPRESS=none (the default) takes the EXACT dense path: the
+    plane is never constructed and the summed tree is bit-identical to
+    a plane-less exchange."""
+    def run(compress):
+        be = HostPSBackend(num_servers=1, num_workers=1,
+                           engine_threads=1)
+        try:
+            ex = PSGradientExchange(be, partition_bytes=8 << 10,
+                                    min_compress_bytes=0,
+                                    compress=compress)
+            tree = {"g": np.random.RandomState(5).randn(4000)
+                    .astype(np.float32)}
+            out = ex.exchange(tree, name="dn")
+            ex.close()
+            return ex._cplane, out["g"].copy()
+        finally:
+            be.close()
+
+    plane_none, out_none = run("none")
+    plane_off, out_off = run(None)      # env default (unset) = none
+    assert plane_none is None and plane_off is None
+    np.testing.assert_array_equal(out_none, out_off)
+
+
+def test_fused_exchange_deterministic_with_pinned_trace():
+    """Pinned codec decision trace + deterministic codecs: two
+    identical exchanges produce bit-identical summed trees."""
+    def run():
+        be = HostPSBackend(num_servers=1, num_workers=1,
+                           engine_threads=1)
+        try:
+            ex = PSGradientExchange(be, partition_bytes=4 << 10,
+                                    min_compress_bytes=0,
+                                    compress="int8")
+            tree = {"g": np.random.RandomState(6).randn(5000)
+                    .astype(np.float32)}
+            outs = [ex.exchange(
+                {"g": tree["g"] * (r + 1)}, name="dt")["g"].copy()
+                for r in range(3)]
+            ex.close()
+            return outs
+        finally:
+            be.close()
+
+    a, b = run(), run()
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_fused_skips_legacy_chain_keys():
+    """A tensor declared with legacy compression kwargs keeps its
+    kwargs chain (explicit opt-in wins); the fused plane never touches
+    those keys."""
+    from byteps_tpu.common.naming import NameRegistry
+
+    be = HostPSBackend(num_servers=1, num_workers=1, engine_threads=1)
+    try:
+        reg = NameRegistry()
+        reg.declare("legacy", compressor_type="onebit")
+        ex = PSGradientExchange(be, partition_bytes=8 << 10,
+                                registry=reg, min_compress_bytes=0,
+                                compress="int8")
+        tree = {"g": np.random.RandomState(7).randn(3000)
+                .astype(np.float32)}
+        ex.exchange(tree, name="legacy")
+        assert ex._chains, "legacy chain was not engaged"
+        for pskey in ex._chains:
+            assert not ex._cplane.active(pskey)
+        ex.close()
+    finally:
+        be.close()
+
+
+def test_fused_ps_mode_end_to_end(monkeypatch):
+    """BPS_ENABLE_PS + BPS_COMPRESS=int8 through Config: the eager
+    push_pull ships fused payloads (BPS_MIN_COMPRESS_BYTES=0 forces
+    even the small test tensor through, the reference's test knob)."""
+    import byteps_tpu as bps
+    from byteps_tpu.common.global_state import GlobalState
+
+    monkeypatch.setenv("BPS_ENABLE_PS", "1")
+    monkeypatch.setenv("BPS_MIN_COMPRESS_BYTES", "0")
+    monkeypatch.setenv("BPS_COMPRESS", "int8")
+    try:
+        bps.init(config=bps.Config.from_env())
+        dp = len(jax.devices())
+        val = np.linspace(-1.0, 1.0, 64).astype(np.float32)
+        x = np.stack([val] * dp)
+        out = np.asarray(bps.push_pull(x, average=False, name="fgrads"))
+        ex = GlobalState.get().engine.ps_exchange
+        assert ex._cplane is not None
+        assert any(ex._cplane.active(k)
+                   for _, _, keyed in ex._plans.values()
+                   for k, _ in keyed), "fused path was not taken"
+        # world-1 model: local sum (dp*val) → int8 encode → server
+        # decode (the only push) → int8 re-encode on pull → decode
+        expect = cwire.decode(
+            cwire.encode(cwire.CODEC_INT8, cwire.decode(
+                cwire.encode(cwire.CODEC_INT8, dp * val), 64,
+                "float32")), 64, "float32")
+        np.testing.assert_allclose(out[0], expect, rtol=1e-5)
+    finally:
+        bps.shutdown()
+
+
+@pytest.mark.slow
+def test_bench_ps_comp_smoke():
+    """CI slow-lane smoke of the fused-compression A/B: on the
+    server-egress-bound config the compressed (auto) arm must win
+    clearly; on the unthrottled config the controller must keep every
+    level at none and hold ≈1.0x (never a hard regression — the 0.85
+    floor absorbs shared-core scheduler noise, the real bench runs
+    longer windows)."""
+    import os as _os
+    import sys as _sys
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+    import bench
+    out = bench.ps_comp_breakdown(iters=3, warm=4, pairs=1,
+                                  compute_iters=20)
+    assert out["comp_vs_dense_wire_bound"] > 1.3, out
+    # non-empty guards: a drift in the bench's layer-gauge naming must
+    # fail here, not vacuously pass the all()-over-empty below
+    assert out["wire_bound_levels"], out
+    assert out["compute_bound_levels"], out
+    assert all(v == 0 for v in out["compute_bound_levels"].values()), out
+    assert out["auto_vs_dense_compute_bound"] > 0.85, out
+
+
+def test_fused_topk_div_honored_on_pull():
+    """BPS_COMPRESS_TOPK_DIV applies to BOTH wire directions: the pull
+    request carries the worker's keep fraction, so the server's
+    re-encode of the merged round keeps k = n/div coordinates (and two
+    different divs get distinct cached payloads), in-process and TCP."""
+    from byteps_tpu.server.engine import PSServer
+
+    n = 4096
+    x = np.random.RandomState(25).randn(n).astype(np.float32)
+
+    be = HostPSBackend(num_servers=1, num_workers=1, engine_threads=1)
+    try:
+        be.init_key(31, n * 4, "float32")
+        be.push_fused(31, cwire.encode(cwire.CODEC_INT8, x))
+        for div in (8, 32):
+            p = be.pull_fused(31, n * 4, "float32", cwire.CODEC_TOPK,
+                              round=1, div=div)
+            assert len(p) == cwire.wire_nbytes(
+                cwire.CODEC_TOPK, n, "float32", div=div), (div, len(p))
+    finally:
+        be.close()
+
+    eng = PSServer(num_workers=1, engine_threads=1)
+    srv = PSTransportServer(eng, host="127.0.0.1")
+    try:
+        w = RemotePSBackend([f"127.0.0.1:{srv.port}"])
+        w.init_key(32, n * 4, "float32")
+        w.push_fused(32, cwire.encode(cwire.CODEC_INT8, x))
+        p = w.pull_fused(32, n * 4, "float32", cwire.CODEC_TOPK,
+                         round=1, div=8)
+        assert len(p) == cwire.wire_nbytes(cwire.CODEC_TOPK, n,
+                                           "float32", div=8)
+        w.close()
+    finally:
+        srv.close()
+        eng.close()
+
+
+def test_fused_refused_at_construction_on_incapable_backend():
+    """A BPS_COMPRESS mode over a backend without the fused ops fails
+    when the exchange is BUILT — under auto it would otherwise train
+    fine on an idle wire and crash at the first congested round."""
+    class DenseOnly:
+        def init_key(self, *a, **k):
+            pass
+
+    with pytest.raises(ValueError, match="push_fused"):
+        PSGradientExchange(DenseOnly(), compress="auto")
