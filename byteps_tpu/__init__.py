@@ -47,6 +47,36 @@ if not hasattr(jax.lax, "axis_size"):
 
     jax.lax.axis_size = _axis_size
 
+# ``jax.sharding.AbstractMesh`` drift: modern jax takes
+# ``AbstractMesh(axis_sizes, axis_names)``; jax 0.4.37 takes one
+# ``((name, size), ...)`` pairs tuple. Adapt the modern spelling (what
+# parallel/scaling_model.py uses) onto the old constructor so the
+# AOT-lowering scaling model runs on both.
+import inspect as _inspect  # noqa: E402
+import jax.sharding as _jsharding  # noqa: E402
+
+if "axis_names" not in _inspect.signature(
+        _jsharding.AbstractMesh.__init__).parameters:
+    _RealAbstractMesh = _jsharding.AbstractMesh
+
+    class _AbstractMesh(_RealAbstractMesh):
+        def __init__(self, axis_sizes, axis_names=None, axis_types=None):
+            if axis_names is None:     # caller already speaks 0.4.37
+                super().__init__(tuple(axis_sizes), axis_types)
+            else:
+                if axis_types is not None:
+                    # modern per-axis axis_types and 0.4.37's dict form
+                    # are not interconvertible — refuse loudly rather
+                    # than silently building a differently-typed mesh
+                    raise NotImplementedError(
+                        "axis_types is not supported by the jax-0.4.37 "
+                        "AbstractMesh compatibility shim")
+                super().__init__(tuple(zip(tuple(axis_names),
+                                           tuple(axis_sizes))))
+
+    _jsharding.AbstractMesh = _AbstractMesh
+del _inspect, _jsharding
+
 from .common.config import Config
 from .common.global_state import GlobalState
 from .common import naming

@@ -108,6 +108,16 @@ class Config:
                                          # per shard on the hash ring
                                          # (0 = default 64)
 
+    # --- pipeline parallelism (ours: byteps_tpu/pipeline,
+    # docs/pipeline-parallelism.md) ---
+    pp_stages: int = 1                   # BPS_PP_STAGES: pipeline depth
+                                         # (1 = no pipeline parallelism)
+    pp_rank: int = 0                     # BPS_PP_RANK: this worker's
+                                         # stage index in [0, pp_stages)
+    pp_microbatch: int = 1               # BPS_PP_MICROBATCH: microbatches
+                                         # per step driving the 1F1B
+                                         # schedule
+
     # --- emulated-NIC throttle for this worker endpoint (perf lab:
     # charges all RemotePSBackend traffic to a throttle.Nic so
     # multi-process training A/Bs run under a bandwidth constraint;
@@ -186,6 +196,9 @@ class Config:
             plane_rebalance_sec=float(
                 _env("BPS_PLANE_REBALANCE_SEC", None, "0") or 0),
             plane_vnodes=int(_env("BPS_PLANE_VNODES", None, "0") or 0),
+            pp_stages=_env_int("BPS_PP_STAGES", None, 1),
+            pp_rank=_env_int("BPS_PP_RANK", None, 0),
+            pp_microbatch=_env_int("BPS_PP_MICROBATCH", None, 1),
             emu_nic_rate=float(_env("BPS_EMU_NIC_RATE", None, "0") or 0),
             emu_nic_latency=float(_env("BPS_EMU_NIC_LATENCY", None, "0") or 0),
             min_compress_bytes=_env_int("BPS_MIN_COMPRESS_BYTES", "BYTEPS_MIN_COMPRESS_BYTES", 65536),

@@ -77,6 +77,11 @@ class GlobalState:
         # variants) and stand up the per-step StepStats emitter
         from ..obs import metrics as obs_metrics
         obs_metrics.configure(config.stats_on)
+        # two-class wire send scheduler (server/sched.py): resolve the
+        # byte credit for THIS init, before any backend is constructed,
+        # so every transport client sees the same gate
+        from ..server import sched as wire_sched
+        wire_sched.configure(config.scheduling_credit)
         self.stats = None
         if config.stats_on:
             from ..obs.stats import StepStatsEmitter

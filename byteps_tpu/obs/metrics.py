@@ -38,6 +38,7 @@ STAGES: Tuple[str, ...] = (
     "PS_BWD_SEG", "PS_D2H", "PS_PACK", "PS_COMPRESS", "PS_PUSH",
     "PS_PULL", "PS_DECOMPRESS", "PS_UNPACK", "PS_H2D",
     "PS_APPLY_CHUNK", "PS_XSTEP_GATE",
+    "PP_FWD_SEG", "PP_BWD_SEG", "PP_ACT_SEND", "PP_ACT_RECV",
 )
 
 # Server-plane control-loop signals (byteps_tpu.server.plane,
@@ -58,6 +59,17 @@ PLANE_COUNTERS: Tuple[str, ...] = ("plane/migrations", "plane/failovers",
 COMPRESS_COUNTERS: Tuple[str, ...] = ("compress/decisions",
                                       "compress/raw_bytes",
                                       "compress/wire_bytes")
+
+# Pipeline-parallel plane (byteps_tpu.pipeline, docs/pipeline-
+# parallelism.md) + the two-class wire scheduler (server/sched.py):
+# pre-registered so "is the pipeline / scheduler doing anything" is
+# answerable before any traffic.
+PP_COUNTERS: Tuple[str, ...] = (
+    "pp/microbatches", "pp/act_send_bytes", "pp/act_recv_bytes",
+    "pp/builds", "pp/build_fallback",
+    "sched/admitted_act", "sched/admitted_grad", "sched/overtakes")
+PP_GAUGES: Tuple[str, ...] = ("pp/stage", "pp/stages",
+                              "sched/inflight_bytes")
 
 # ONE truthiness rule shared with Config (BPS_STATS must resolve
 # identically whether read here or through Config.stats_on)
@@ -268,6 +280,10 @@ class MetricsRegistry:
             self.counter(c)
         for c in COMPRESS_COUNTERS:
             self.counter(c)
+        for c in PP_COUNTERS:
+            self.counter(c)
+        for g in PP_GAUGES:
+            self.gauge(g)
 
     def _get(self, name: str, cls, *args):
         m = self._metrics.get(name)

@@ -48,6 +48,16 @@ def format_dump(state: dict, stalled_s: float) -> str:
             lines.append(
                 f"    key={b.get('pskey')} round={b.get('round')} "
                 f"state={st}{mark}")
+    for w in state.get("pp_waits", ()):
+        # pipeline plane (byteps_tpu.pipeline): a stage blocked on an
+        # activation that never arrives IS the dead-stage-peer failure
+        # mode — name the hop and the wedged microbatch, per key
+        lines.append(
+            f"  stage {w.get('stage')} blocked on {w.get('kind')} "
+            f"(boundary {w.get('boundary')}, microbatch "
+            f"{w.get('microbatch')}, seq {w.get('seq')}) from stage "
+            f"{w.get('from_stage')} for {w.get('waited_s')}s — stage "
+            f"peer dead or wedged")
     adm = state.get("admission", {})
     busy = adm.get("busy", ())
     if busy:
@@ -63,6 +73,8 @@ def format_dump(state: dict, stalled_s: float) -> str:
             "pull was lost (server death past the reconnect budget, or a "
             "peer that never pushed its share) and the per-key admission "
             "gate cannot release without it")
+    elif state.get("pp_waits"):
+        pass    # the per-stage lines above already name the wedge
     else:
         lines.append(
             "  no bucket reached the wire yet: the stall is upstream of "
@@ -131,7 +143,10 @@ class StallWatchdog:
         rounds = state.get("rounds", ())
         wired = any(b.get("state") in ("pushed", "pulled", "failed")
                     for r in rounds for b in r.get("buckets", ()))
-        if not wired and not state.get("admission", {}).get("waiters"):
+        if not wired and not state.get("admission", {}).get("waiters") \
+                and not state.get("pp_waits"):
+            # (a pipeline stage blocked on an activation IS wire-
+            # involved: the missing frame is a peer's send)
             return
         # progress may have landed between the two reads — re-check so
         # a racing completion can't produce a spurious dump

@@ -1,0 +1,43 @@
+"""MPMD pipeline parallelism over the PS fabric.
+
+The second parallelism axis (ROADMAP item 4, PAPERS.md arXiv
+2412.14374): the model is CUT into P stages placed on different worker
+processes, activations and activation-gradients flow point-to-point
+between neighbor stages over the same transport / timeline / watchdog
+stack the gradients use, and each stage's parameter gradients keep
+flowing through the existing PS path — so PP composes with
+data-parallel replication unchanged.
+
+Pieces:
+
+- ``StagePartitioner`` (partitioner.py): generalizes the
+  ``staged_grad`` jaxpr-cutting machinery from "K backward segments on
+  one worker" to "P (fwd, bwd) segment pairs on P workers", with
+  explicit activation / activation-grad boundary tensors and the same
+  bitwise probe-or-drop exactness contract.
+- ``ActivationExchange`` (exchange.py): the point-to-point activation
+  plane — ``OP_ACT_PUSH``/``OP_ACT_PULL`` wire ops on the existing
+  transport (framing, resend, dedup reuse), latency-class frames
+  (``sched.CLASS_ACT``) that overtake gradient bursts under
+  ``BPS_SCHEDULING_CREDIT``.
+- ``one_f_one_b`` (schedule.py): the per-stage 1F1B schedule driving
+  ``BPS_PP_MICROBATCH`` microbatches so stage k's backward overlaps
+  stage k+1's forward.
+- ``PipelineStageDriver`` (driver.py): one stage worker's step loop —
+  recv → segment → send per microbatch, deterministic gradient
+  accumulation, per-stage optimizer, optional per-stage DP exchange.
+
+Env contract: ``BPS_PP_STAGES`` / ``BPS_PP_RANK`` /
+``BPS_PP_MICROBATCH`` (docs/pipeline-parallelism.md, docs/env.md).
+"""
+
+from .driver import PipelineStageDriver, split_microbatches
+from .exchange import ActivationExchange, LocalActPeer
+from .partitioner import PipelineProgram, StagePartitioner
+from .schedule import one_f_one_b, sequential_schedule
+
+__all__ = [
+    "StagePartitioner", "PipelineProgram", "ActivationExchange",
+    "LocalActPeer", "PipelineStageDriver", "split_microbatches",
+    "one_f_one_b", "sequential_schedule",
+]

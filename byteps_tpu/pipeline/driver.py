@@ -1,0 +1,258 @@
+"""One pipeline stage's training loop.
+
+``PipelineStageDriver`` owns stage s of a ``PipelineProgram``: its
+param leaves, their optimizer state, the activation endpoints, and the
+per-step schedule. ``step(batch)`` splits the global batch into
+``n_micro`` microbatches and walks the stage's 1F1B schedule — recv
+boundary → run segment → send boundary per op — timing every segment
+as ``PP_FWD_SEG`` / ``PP_BWD_SEG`` (pid = stage, so the merged trace
+shows stage k's backward running while stage k+1 forwards: the
+pipeline's existence proof).
+
+Determinism contract: backwards run in microbatch order on every
+stage (both schedules guarantee it), gradients accumulate in that
+order with plain adds and one final ``/ n_micro``, and the loss is the
+same running mean — so a P-stage, M-microbatch run is BITWISE equal to
+a single-process run of the same fused program over the same
+microbatches (the parity tests in tests/test_pipeline.py), and within
+the ``test_grad_exactness`` tolerance of the full-batch fused step.
+
+PP × DP: pass ``exchange`` (a ``PSGradientExchange``) and the stage's
+accumulated grads take one ordinary sync round through the PS path —
+same buckets, admission gates, compression hooks — under a per-stage
+declaration name, so replicas of the same stage sum while different
+stages stay disjoint in the keyspace. Nothing in the PS plane knows
+pipelining exists; that is the composition claim.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..common.logging import get_logger
+from ..obs.metrics import get_registry, observe_stage
+from .exchange import ActivationExchange  # noqa: F401 — typed surface
+from .schedule import one_f_one_b, sequential_schedule
+
+log = get_logger()
+
+
+def split_microbatches(batch, n_micro: int):
+    """Split a global batch into ``n_micro`` equal microbatches along
+    every leaf's leading axis. Unequal splits are refused: they would
+    silently re-weight the mean-of-means loss."""
+    leaves, treedef = jax.tree_util.tree_flatten(batch)
+    outs = []
+    for m in range(n_micro):
+        parts = []
+        for l in leaves:
+            n = l.shape[0]
+            if n % n_micro:
+                raise ValueError(
+                    f"batch leading axis {n} not divisible by "
+                    f"BPS_PP_MICROBATCH={n_micro}")
+            k = n // n_micro
+            parts.append(l[m * k:(m + 1) * k])
+        outs.append(jax.tree_util.tree_unflatten(treedef, parts))
+    return outs
+
+
+class PipelineStageDriver:
+    """Stage ``stage``'s worker loop over a shared ``PipelineProgram``.
+
+    Every stage worker builds the SAME program from the same
+    (loss_fn, params, microbatch template) — the declaration-order
+    determinism the PS plane already relies on — and compiles only the
+    two segments it runs. ``params`` is the full initial tree
+    (replicated init); only this stage's leaves are read or updated.
+    """
+
+    def __init__(self, program, stage: Optional[int], params, tx,
+                 act: ActivationExchange, n_micro: Optional[int] = None,
+                 exchange=None, world: int = 1,
+                 name: str = "pp", timeline=None,
+                 schedule: str = "1f1b") -> None:
+        import optax  # noqa: F401 — tx is an optax transformation
+
+        self.program = program
+        if stage is None or n_micro is None:
+            # env contract: BPS_PP_RANK / BPS_PP_MICROBATCH (via the
+            # live Config when bps.init ran) — the deployment path
+            # where each stage worker is launched with its rank
+            from ..common.config import Config
+            from ..common.global_state import GlobalState
+            cfg = (GlobalState.get().config
+                   if GlobalState.initialized() else Config.from_env())
+            if stage is None:
+                stage = cfg.pp_rank
+            if n_micro is None:
+                n_micro = cfg.pp_microbatch
+        self.stage = int(stage)
+        self.n_micro = int(n_micro)
+        self.act = act
+        self.name = name
+        self.timeline = timeline
+        self._exchange = exchange
+        self._world = int(world)
+        self.tx = tx
+        if schedule not in ("1f1b", "sequential"):
+            raise ValueError(f"unknown schedule {schedule!r}")
+        self._sched_fn = (one_f_one_b if schedule == "1f1b"
+                          else sequential_schedule)
+        self._schedule = self._sched_fn(program.num_stages, self.stage,
+                                        self.n_micro)
+
+        if exchange is not None:
+            # the PS keyspace contract is DECLARATION ORDER — but stage
+            # workers would each declare only their own stage's name,
+            # colliding every stage onto declared-key 0. Pre-declare
+            # every stage's name in stage order so all workers' (and
+            # all stages') registries agree, wherever they run.
+            for s in range(program.num_stages):
+                nm = f"{name}-s{s}"
+                if nm not in exchange.registry.declared_names():
+                    exchange.registry.declare(nm)
+
+        self.own_leaves = list(program.stage_param_leaves[self.stage])
+        flat = jax.tree_util.tree_leaves(params)
+        import jax.numpy as jnp
+        # copy, never alias: the apply step donates these buffers, and
+        # donation must not invalidate the caller's (or another
+        # in-process stage's) view of the initial tree
+        self.params: List = [jnp.array(np.asarray(flat[li]))
+                             for li in self.own_leaves]
+        self.opt_state = tx.init(self.params)
+        self._apply = jax.jit(self._apply_impl, donate_argnums=(0, 1))
+        self._fwd_idx = program.stage_segment(self.stage, "fwd")
+        self._bwd_idx = program.stage_segment(self.stage, "bwd")
+        self._seq_base = 0
+        self.step_count = 0
+        self.last_loss = None
+        reg = get_registry()
+        self._m_micro = reg.counter("pp/microbatches")
+        reg.gauge("pp/stage").set(self.stage)
+        reg.gauge("pp/stages").set(program.num_stages)
+
+    def _apply_impl(self, params, opt_state, grads):
+        import optax
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    # ------------------------------------------------------------- step
+
+    def step(self, batch):
+        """One training step. Returns the mean microbatch loss on the
+        LAST stage, None elsewhere. Raises ``PeerDead`` (loud, naming
+        stage/boundary/microbatch) when a neighbor dies mid-step."""
+        prog = self.program
+        P = prog.num_stages
+        micro = split_microbatches(batch, self.n_micro)
+        batch_invars = prog.invars[prog.n_params:]
+        n_batch_leaves = len(jax.tree_util.tree_leaves(micro[0]))
+        if len(batch_invars) != n_batch_leaves:
+            raise ValueError(
+                f"batch has {n_batch_leaves} leaves, program was "
+                f"traced with {len(batch_invars)}")
+
+        envs: Dict[int, Dict] = {}
+        own_pvars = [prog.param_var_of[li] for li in self.own_leaves]
+        fwd_seg = prog.segments[self._fwd_idx]
+        bwd_seg = prog.segments[self._bwd_idx]
+        b_in_fwd = (prog.boundaries[self._fwd_idx - 1]
+                    if self._fwd_idx > 0 else None)
+        b_out_fwd = (prog.boundaries[self._fwd_idx]
+                     if self._fwd_idx < 2 * P - 1 else None)
+        b_in_bwd = (prog.boundaries[self._bwd_idx - 1]
+                    if self._bwd_idx > 0 else None)
+        b_out_bwd = (prog.boundaries[self._bwd_idx]
+                     if self._bwd_idx < 2 * P - 1 else None)
+
+        acc: Optional[List] = None
+        loss_sum = None
+        base = self._seq_base
+        t_step = time.time()
+        for op, mb in self._schedule:
+            seq = base + mb
+            if op == "F":
+                env = envs[mb] = dict(prog.const_env)
+                for v, p in zip(own_pvars, self.params):
+                    env[v] = p
+                env.update(zip(batch_invars,
+                               jax.tree_util.tree_leaves(micro[mb])))
+                if b_in_fwd is not None and not b_in_fwd.local:
+                    self.act.recv(b_in_fwd, mb, seq, env)
+                loss_here = self._run_segment(fwd_seg, env, mb,
+                                              "PP_FWD_SEG")
+                if loss_here is not None:
+                    loss_sum = (loss_here if loss_sum is None
+                                else loss_sum + loss_here)
+                if b_out_fwd is not None and not b_out_fwd.local:
+                    self.act.send(b_out_fwd, mb, seq, env)
+            else:
+                env = envs[mb]
+                if b_in_bwd is not None and not b_in_bwd.local:
+                    self.act.recv(b_in_bwd, mb, seq, env)
+                loss_here = self._run_segment(bwd_seg, env, mb,
+                                              "PP_BWD_SEG")
+                if loss_here is not None:
+                    loss_sum = (loss_here if loss_sum is None
+                                else loss_sum + loss_here)
+                if b_out_bwd is not None and not b_out_bwd.local:
+                    self.act.send(b_out_bwd, mb, seq, env)
+                grads = [prog.grad_value(env, li)
+                         for li in self.own_leaves]
+                acc = (grads if acc is None else
+                       [a + g for a, g in zip(acc, grads)])
+                del envs[mb]          # residuals dead past the backward
+                self._m_micro.inc()
+        self._seq_base = base + self.n_micro
+        self.step_count += 1
+
+        grads = [g / self.n_micro for g in acc]
+        if self._exchange is not None:
+            # per-stage data-parallel sum through the UNCHANGED PS
+            # path: replicas of this stage share the declaration name,
+            # so bucket plans / keys / admission all match
+            t0 = time.time()
+            grads = self._exchange.exchange(
+                grads, name=f"{self.name}-s{self.stage}")
+            observe_stage("PS_PUSH_PULL", time.time() - t0)
+            if self._world > 1:
+                grads = [g / self._world for g in grads]
+        self.params, self.opt_state = self._apply(self.params,
+                                                  self.opt_state, grads)
+        observe_stage("PUSH_PULL", time.time() - t_step)
+        if loss_sum is None:
+            self.last_loss = None
+            return None
+        self.last_loss = loss_sum / self.n_micro
+        return self.last_loss
+
+    def _run_segment(self, seg, env: Dict, mb: int, stage_name: str):
+        t0 = time.time()
+        missing = [v for v in seg.invars if v not in env]
+        if missing:
+            raise RuntimeError(
+                f"stage {self.stage} segment is missing {len(missing)} "
+                f"env vars for microbatch {mb} — boundary plan bug")
+        outs = seg.fn(*[env[v] for v in seg.invars])
+        jax.block_until_ready(outs)
+        env.update(zip(seg.outvars, outs))
+        dur = time.time() - t0
+        observe_stage(stage_name, dur)
+        if self.timeline is not None:
+            self.timeline.record(f"{self.name}/s{self.stage}/mb{mb}",
+                                 stage_name, t0, dur, self.stage)
+        return env[self.program.loss_var] if seg.emits_loss else None
+
+    # ------------------------------------------------------------ views
+
+    def stage_params_tree(self) -> Dict[int, np.ndarray]:
+        """{flat leaf index: current value} for this stage's leaves —
+        the checkpoint/parity surface."""
+        return {li: np.asarray(p)
+                for li, p in zip(self.own_leaves, self.params)}

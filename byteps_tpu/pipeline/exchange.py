@@ -1,0 +1,270 @@
+"""Point-to-point activation plane over the PS transport.
+
+Activations and activation-gradients flow STAGE→STAGE, never through
+the server sum: the sender pushes the boundary payload into the
+RECEIVER's mailbox (``OP_ACT_PUSH`` on the receiver's transport
+server) and the receiver takes it locally — one wire hop, one frame
+per (boundary, microbatch). The frames reuse the transport's entire
+framing / reconnect / resend machinery; a frame retried after a lost
+ACK is idempotent because the mailbox is last-wins per (key, seq).
+``OP_ACT_PULL`` is the remote-take form (a puller blocks server-side
+until the seq arrives) — the fault-injection tests drive it, and it
+gives a pull-model deployment the same mailbox.
+
+Wire identity: channel key ``ACT_KEY_BASE | boundary_index`` (disjoint
+from the gradient keyspace ``decl<<16|bucket``), ``round`` = absolute
+microbatch sequence number. Both sides compute the sequence from the
+same deterministic schedule, so there is no handshake: seq ``step*M +
+mb``. The payload is the boundary's vars' raw bytes concatenated in
+var order — the (shape, dtype) split recipe is derived from the shared
+``PipelineProgram`` on both sides, never shipped.
+
+Class tagging: activation frames are ``sched.CLASS_ACT`` — under
+``BPS_SCHEDULING_CREDIT`` they overtake queued gradient bursts in the
+send scheduler (the latency class the wire scheduler exists for).
+
+Observability: ``PP_ACT_SEND`` / ``PP_ACT_RECV`` timeline stages +
+always-on stage histograms, ``pp/act_send_bytes`` /
+``pp/act_recv_bytes`` / ``pp/microbatches`` counters, and the
+watchdog contract (``progress_state`` / ``debug_state``): a recv
+blocked on a dead peer shows up as a per-stage diagnostic naming the
+boundary and the wedged microbatch, not a silent hang.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..common.logging import get_logger
+from ..obs.metrics import get_registry, observe_stage
+
+log = get_logger()
+
+# activation channel keyspace: bit 40 set, boundary index in the low
+# bits — disjoint from gradient keys (decl<<16 | bucket, decl keys are
+# small) and from the ring-striping subkey space (bits 48+)
+ACT_KEY_BASE = 1 << 40
+
+
+def act_key(boundary_index: int) -> int:
+    return ACT_KEY_BASE | int(boundary_index)
+
+
+class PeerDead(RuntimeError):
+    """A stage neighbor stopped answering: the send/recv names the
+    stage, boundary, and microbatch so the operator sees WHICH hop of
+    the pipeline died (the loud-partial-state contract — a dead peer
+    must never be a silent hang)."""
+
+
+class ActStore:
+    """Per-process activation mailbox: ``put`` is last-wins per
+    (key, seq) — a resend after a lost ACK re-stores identical bytes —
+    and ``take`` blocks until the seq arrives. Entries are pruned
+    ``retain`` seqs behind the newest taken seq per key, so a retried
+    take (connection died mid-response) still finds its payload while
+    memory stays bounded by the schedule's in-flight window."""
+
+    def __init__(self, retain: int = 64) -> None:
+        self.retain = int(retain)
+        self._cv = threading.Condition()
+        self._data: Dict[int, Dict[int, bytes]] = {}
+        self._taken: Dict[int, int] = {}
+
+    def put(self, key: int, seq: int, payload: bytes) -> None:
+        with self._cv:
+            self._data.setdefault(int(key), {})[int(seq)] = bytes(payload)
+            self._cv.notify_all()
+
+    def take(self, key: int, seq: int, timeout_ms: int = 30000) -> bytes:
+        key, seq = int(key), int(seq)
+        deadline = time.monotonic() + timeout_ms / 1e3
+        with self._cv:
+            while True:
+                d = self._data.get(key)
+                if d is not None and seq in d:
+                    break
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"act take(key={key:#x}, seq={seq}) timed out "
+                        f"after {timeout_ms}ms — peer never pushed")
+                self._cv.wait(min(left, 0.5))
+            out = d[seq]
+            floor = max(self._taken.get(key, -1), seq)
+            self._taken[key] = floor
+            for s in [s for s in d if s <= floor - self.retain]:
+                del d[s]
+            return out
+
+    def pending(self) -> List[Tuple[int, int]]:
+        """(key, newest stored seq) per channel — debug visibility."""
+        with self._cv:
+            return [(k, max(d)) for k, d in self._data.items() if d]
+
+
+class LocalActPeer:
+    """In-process peer handle: same ``act_push`` surface as the
+    transport client, writing straight into the neighbor's ActStore —
+    the tier-1 single-process rig (and the degenerate colocated
+    deployment)."""
+
+    def __init__(self, store: ActStore) -> None:
+        self.store = store
+
+    def act_push(self, key: int, seq: int, payload) -> None:
+        self.store.put(key, seq, bytes(payload))
+
+
+class _Flight:
+    """One boundary crossing's lifecycle for the watchdog: recv-side
+    state is 'waiting' until the take returns."""
+
+    __slots__ = ("boundary", "mb", "seq", "dir", "src", "since")
+
+    def __init__(self, boundary: int, mb: int, seq: int, dir: str,
+                 src: int) -> None:
+        self.boundary = boundary
+        self.mb = mb
+        self.seq = seq
+        self.dir = dir
+        self.src = src
+        self.since = time.monotonic()
+
+
+class ActivationExchange:
+    """One stage's activation endpoints.
+
+    ``store`` is this stage's local mailbox (fed by neighbors — over
+    the wire via its transport server's OP_ACT_PUSH, or in-process via
+    ``LocalActPeer``); ``peer_prev`` / ``peer_next`` are handles with
+    ``act_push`` targeting the neighbors' mailboxes. ``send``/``recv``
+    serialize one boundary's var set per microbatch.
+    """
+
+    def __init__(self, stage: int, store: ActStore,
+                 peer_prev=None, peer_next=None,
+                 timeline=None, name: str = "pp",
+                 timeout_ms: int = 30000) -> None:
+        self.stage = int(stage)
+        self.store = store
+        self.peer_prev = peer_prev
+        self.peer_next = peer_next
+        self.timeline = timeline
+        self.name = name
+        self.timeout_ms = int(timeout_ms)
+        reg = get_registry()
+        self._m_send = reg.counter("pp/act_send_bytes")
+        self._m_recv = reg.counter("pp/act_recv_bytes")
+        self._lock = threading.Lock()
+        self._waits: Dict[int, _Flight] = {}     # boundary -> flight
+        self._progress_t = time.monotonic()
+        self._n = 0
+
+    # -------------------------------------------------------- data path
+
+    def _peer_for(self, boundary) -> object:
+        peer = (self.peer_next if boundary.dst_stage > self.stage
+                else self.peer_prev)
+        if peer is None:
+            raise RuntimeError(
+                f"stage {self.stage} has no peer toward stage "
+                f"{boundary.dst_stage} (boundary {boundary.index})")
+        return peer
+
+    def send(self, boundary, mb: int, seq: int, env: Dict) -> None:
+        """Ship boundary ``boundary``'s vars (read from ``env``) to the
+        neighbor as one CLASS_ACT frame."""
+        t0 = time.time()
+        parts = []
+        for v in boundary.vars:
+            a = np.ascontiguousarray(np.asarray(env[v]))
+            parts.append(a.view(np.uint8).reshape(-1))
+        payload = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        try:
+            self._peer_for(boundary).act_push(act_key(boundary.index),
+                                              seq, payload)
+        except (ConnectionError, OSError, RuntimeError) as e:
+            raise PeerDead(
+                f"stage {self.stage} could not deliver "
+                f"{boundary.kind} (boundary {boundary.index}, "
+                f"microbatch {mb}) to stage {boundary.dst_stage}: "
+                f"{e}") from e
+        self._mark_progress()
+        self._m_send.inc(int(payload.nbytes))
+        dur = time.time() - t0
+        observe_stage("PP_ACT_SEND", dur)
+        if self.timeline is not None:
+            self.timeline.record(f"{self.name}/s{self.stage}/mb{mb}",
+                                 "PP_ACT_SEND", t0, dur, self.stage)
+
+    def recv(self, boundary, mb: int, seq: int, env: Dict) -> None:
+        """Block until boundary ``boundary``'s frame for ``seq``
+        arrives in the local mailbox; bind its vars into ``env``."""
+        t0 = time.time()
+        fl = _Flight(boundary.index, mb, seq, boundary.kind,
+                     boundary.src_stage)
+        with self._lock:
+            self._waits[boundary.index] = fl
+        try:
+            data = self.store.take(act_key(boundary.index), seq,
+                                   timeout_ms=self.timeout_ms)
+        except TimeoutError as e:
+            raise PeerDead(
+                f"stage {self.stage} never received {boundary.kind} "
+                f"(boundary {boundary.index}, microbatch {mb}, seq "
+                f"{seq}) from stage {boundary.src_stage} — peer dead "
+                f"or wedged: {e}") from e
+        finally:
+            with self._lock:
+                self._waits.pop(boundary.index, None)
+        off = 0
+        for v, (shape, dtype) in zip(boundary.vars, boundary.specs()):
+            n = int(np.prod(shape)) * np.dtype(dtype).itemsize
+            arr = np.frombuffer(data, dtype=np.dtype(dtype),
+                                count=n // np.dtype(dtype).itemsize,
+                                offset=off).reshape(shape)
+            env[v] = arr
+            off += n
+        if off != len(data):
+            raise RuntimeError(
+                f"stage {self.stage}: boundary {boundary.index} frame "
+                f"for microbatch {mb} is {len(data)}B, the shared "
+                f"program expects {off}B — peers are running different "
+                f"programs")
+        self._mark_progress()
+        self._n += 1
+        self._m_recv.inc(off)
+        dur = time.time() - t0
+        observe_stage("PP_ACT_RECV", dur)
+        if self.timeline is not None:
+            self.timeline.record(f"{self.name}/s{self.stage}/mb{mb}",
+                                 "PP_ACT_RECV", t0, dur, self.stage)
+
+    # ------------------------------------------------ watchdog contract
+
+    def _mark_progress(self) -> None:
+        self._progress_t = time.monotonic()
+
+    def progress_state(self):
+        """(last progress MONOTONIC ts, in-flight count) — the
+        StallWatchdog poll target, same shape as the PS exchange's."""
+        with self._lock:
+            return self._progress_t, len(self._waits)
+
+    def debug_state(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            waits = [{
+                "stage": self.stage, "boundary": f.boundary,
+                "kind": f.dir, "microbatch": f.mb, "seq": f.seq,
+                "from_stage": f.src,
+                "waited_s": round(now - f.since, 3),
+            } for f in self._waits.values()]
+        return {"in_flight": len(waits), "rounds": [],
+                "admission": {}, "pp_waits": waits,
+                "pp_stage": self.stage, "pp_recvs": self._n}

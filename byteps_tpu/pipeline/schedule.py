@@ -1,0 +1,63 @@
+"""Per-stage microbatch schedules for the MPMD pipeline.
+
+``one_f_one_b`` is the classic 1F1B order (PipeDream-flush /
+Megatron): stage s runs ``P-1-s`` warmup forwards, then alternates
+F/B in steady state, then drains the remaining backwards. The property
+the bench asserts: once warm, stage k's backward of microbatch m runs
+WHILE stage k+1 forwards microbatch m+1 — ``PP_BWD_SEG(stage k)``
+overlaps ``PP_FWD_SEG(stage k+1)`` in the merged trace.
+
+``sequential_schedule`` is the no-overlap A/B arm (``bench.py pp``):
+each microbatch travels all the way down and back before the next one
+enters, so stage k idles while any other stage works — the same
+segments, transport, and framing, with only the schedule changed.
+
+Both schedules are deterministic pure functions of (stages, stage,
+n_micro): every worker derives its own list locally and the blocking
+activation recv/send edges enforce the cross-stage dependencies.
+Backwards run in microbatch order on every stage, which is what makes
+the gradient accumulation order — and therefore training numerics —
+schedule-independent and bitwise-stable.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+Op = Tuple[str, int]    # ("F" | "B", microbatch index)
+
+
+def one_f_one_b(num_stages: int, stage: int, n_micro: int) -> List[Op]:
+    """1F1B order for ``stage`` of ``num_stages`` over ``n_micro``
+    microbatches."""
+    if not 0 <= stage < num_stages:
+        raise ValueError(f"stage {stage} out of range for "
+                         f"{num_stages} stages")
+    if n_micro < 1:
+        raise ValueError("need at least one microbatch")
+    warmup = min(num_stages - 1 - stage, n_micro)
+    sched: List[Op] = [("F", m) for m in range(warmup)]
+    nf, nb = warmup, 0
+    while nf < n_micro:
+        sched.append(("F", nf))
+        nf += 1
+        sched.append(("B", nb))
+        nb += 1
+    while nb < n_micro:
+        sched.append(("B", nb))
+        nb += 1
+    return sched
+
+
+def sequential_schedule(num_stages: int, stage: int,
+                        n_micro: int) -> List[Op]:
+    """Fully serialized schedule (the A/B baseline): F(m) then B(m),
+    one microbatch in flight across the whole pipeline."""
+    if not 0 <= stage < num_stages:
+        raise ValueError(f"stage {stage} out of range for "
+                         f"{num_stages} stages")
+    sched: List[Op] = []
+    for m in range(n_micro):
+        sched.append(("F", m))
+        sched.append(("B", m))
+    return sched

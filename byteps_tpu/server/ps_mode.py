@@ -712,6 +712,18 @@ class PSGradientExchange:
                     #             takes precedence over the fused plane
                 self._cplane.register(pskey, b.size, b.dtype,
                                       layer=f"{decl_name}.{b.index}")
+        if hasattr(self.backend, "set_send_priority"):
+            # two-class wire scheduler (server/sched.py): gradient
+            # frames carry reverse-FIRST-USE priority — the bucket
+            # holding the earliest-declared (input-side) leaves sends
+            # first under BPS_SCHEDULING_CREDIT, the same order the
+            # cross-step pull heap drains (pull_prio), so the send and
+            # pull sides agree on who gates the next forward
+            nleaves = len(leaves)
+            for pskey, b in keyed:
+                first = min((s.leaf_index for s in b.segments),
+                            default=0)
+                self.backend.set_send_priority(pskey, nleaves - first)
         plan = (decl_name, treedef, keyed)
         self._plans[key] = plan
         return plan

@@ -123,6 +123,19 @@ OP_REPL_PUT, OP_REPL_GET, OP_REPL_BASE = 14, 15, 16
 #     deterministic codecs, so the cache is throughput-only), responds
 #     with the payload.
 OP_PUSH_F, OP_PULL_F = 17, 18
+# Point-to-point activation plane (byteps_tpu.pipeline, MPMD pipeline
+# parallelism): activations / activation-grads hop stage→stage through
+# the RECEIVER's mailbox, never through the server sum.
+#   OP_ACT_PUSH: key = activation channel (pipeline.exchange.act_key),
+#     ``round`` = absolute microbatch seq; payload = the boundary's
+#     concatenated var bytes. Last-wins per (key, seq), so the
+#     transport's resend path is idempotent for free.
+#   OP_ACT_PULL: remote take — blocks server-side (sliced, like
+#     OP_PULL) until the (key, seq) frame arrives; response = payload.
+# ACT frames are the transport's LATENCY class: the client tags them
+# ``sched.CLASS_ACT`` so they overtake queued gradient bursts in the
+# send scheduler (BPS_SCHEDULING_CREDIT).
+OP_ACT_PUSH, OP_ACT_PULL = 19, 20
 _PART = struct.Struct("!IIHHQ")  # offset, part_len, part_idx, nparts, nonce
 ST_OK, ST_ERR, ST_TIMEOUT, ST_GONE = 0, 1, 2, 3
 
@@ -328,8 +341,9 @@ def _send_req(sock: socket.socket, op: int, key: int, rnd: int, nbytes: int,
 _REUSE_SAFE_OPS = frozenset(
     {OP_INIT, OP_PUSH, OP_PUSH_C, OP_PUSH_RS, OP_PUSH_PART,
      OP_REPL_PUT,    # ReplicaStore.put copies via bytes() synchronously
-     OP_PUSH_F})     # wire.decode materializes (or the engine copies
+     OP_PUSH_F,      # wire.decode materializes (or the engine copies
                      # the dense view) before the handler returns
+     OP_ACT_PUSH})   # ActStore.put copies via bytes() synchronously
 
 
 def _recv_req(sock: socket.socket, rholder: Optional[list] = None):
@@ -457,6 +471,10 @@ class PSTransportServer:
         # so plain deployments never pay the import
         self._replica = None
         self._replica_lock = threading.Lock()
+        # activation mailbox (pipeline stage→stage plane, OP_ACT_*) —
+        # likewise lazy; plain PS deployments never allocate it
+        self._acts = None
+        self._acts_lock = threading.Lock()
         self._shm = _ShmCache()
         # fused-plane pull cache (OP_PULL_F): one encoded payload per
         # (key, round, codec), throughput-only — the codecs are
@@ -722,6 +740,16 @@ class PSTransportServer:
                 part = st["data"][off:off + plen_]
                 conn.sendall(_RSP.pack(ST_OK, len(part)))
                 conn.sendall(part)
+            elif op == OP_ACT_PUSH:
+                self.act_store().put(key, int(rnd),
+                                     bytes(payload or b""))
+                conn.sendall(_RSP.pack(ST_OK, 0))
+            elif op == OP_ACT_PULL:
+                data = self.act_store().take(
+                    key, int(rnd), timeout_ms=int(timeout) or 30000)
+                conn.sendall(_RSP.pack(ST_OK, len(data)))
+                if data:
+                    conn.sendall(data)
             elif op == OP_REPL_PUT:
                 self._replica_store().put(key, int(rnd),
                                           bytes(payload or b""))
@@ -771,6 +799,17 @@ class PSTransportServer:
                     from .plane.replica import ReplicaStore
                     self._replica = ReplicaStore()
         return self._replica
+
+    def act_store(self):
+        """This server's activation mailbox (pipeline plane) — also the
+        LOCAL take endpoint for a colocated stage driver, so a received
+        activation never makes a second hop."""
+        if self._acts is None:
+            with self._acts_lock:
+                if self._acts is None:
+                    from ..pipeline.exchange import ActStore
+                    self._acts = ActStore()
+        return self._acts
 
     def _pull_dense(self, key, rnd, nbytes, dtype, timeout) -> np.ndarray:
         """Round-blocked engine pull in WIRE dtype — the one transcode
@@ -1075,6 +1114,18 @@ class RemotePSBackend:
         self._stripe_min = int(_os.environ.get("BPS_STRIPE_MIN", "0"))
         self._stripe_exec = None
         self._stripe_exec_lock = threading.Lock()
+        # placement-aware striping (ring mode): one large bucket's
+        # stripes live as independent sub-keys on DISTINCT ring
+        # successors (PlacementService.place_stripes), so a hot key's
+        # traffic spreads across servers instead of saturating its
+        # primary's NIC. key -> [(byte off, byte len, subkey)];
+        # subkey -> shard index (consulted by _shard before any hash)
+        self._stripe_plans: Dict[int, list] = {}
+        self._stripe_shards: Dict[int, int] = {}
+        # per-key send priority for the two-class wire scheduler
+        # (sched.SendScheduler): the exchange assigns reverse-first-use
+        # priorities at plan time via set_send_priority
+        self._send_prio: Dict[int, int] = {}
         self._rounds: Dict[int, int] = {}
         # push dedup: fresh nonzero 32-bit incarnation id + per-key seq
         # (seq lives in the frame's ``round`` field, unused by pushes)
@@ -1134,6 +1185,9 @@ class RemotePSBackend:
         return s
 
     def _shard(self, key: int) -> int:
+        s = self._stripe_shards.get(key)
+        if s is not None:            # striping sub-key: pinned at init
+            return s
         if self._ring is not None:
             try:
                 return self._ring.shard_of(key)
@@ -1257,9 +1311,49 @@ class RemotePSBackend:
                         raise
                     _time.sleep(0.2)
 
+    # payload-bearing ops the wire scheduler gates (the bandwidth
+    # class; OP_ACT_PUSH is the latency class — see server/sched.py).
+    # OP_REPL_PUT is included: a replication forward-log upload is a
+    # merged-round-sized payload — unscheduled it would saturate the
+    # NIC outside the credit and nothing could overtake it
+    _SCHED_GRAD_OPS = frozenset({OP_PUSH, OP_PUSH_C, OP_PUSH_RS,
+                                 OP_PUSH_PART, OP_PUSH_F, OP_REPL_PUT})
+
     def _rpc(self, op: int, key: int, rnd: int, nbytes: int,
              timeout_ms: int, dtype: str, payload: Optional[memoryview],
              pull_into: Optional[np.ndarray] = None) -> bytes:
+        # two-class wire admission (BPS_SCHEDULING_CREDIT): payload
+        # frames queue in (priority desc, key asc) order behind the
+        # byte credit, so a small CLASS_ACT frame overtakes a queued
+        # gradient burst. Credit is held across the frame's roundtrip
+        # (send + ack) — the host-side analogue of the reference's
+        # ack-released scheduling credit. Disabled (credit 0) this is
+        # two dict lookups.
+        ticket = scheduler = None
+        if payload is not None:
+            from . import sched as _sched
+            scheduler = _sched.current()
+            if scheduler is not None:
+                plen = (sum(len(p) for p in payload)
+                        if isinstance(payload, (tuple, list))
+                        else len(payload))
+                if op == OP_ACT_PUSH:
+                    ticket = scheduler.acquire(_sched.CLASS_ACT, 0, key,
+                                               plen)
+                elif op in self._SCHED_GRAD_OPS:
+                    ticket = scheduler.acquire(
+                        _sched.CLASS_GRAD, self._send_prio.get(key, 0),
+                        key, plen)
+        try:
+            return self._rpc_unscheduled(op, key, rnd, nbytes,
+                                         timeout_ms, dtype, payload,
+                                         pull_into=pull_into)
+        finally:
+            if ticket is not None:
+                scheduler.release(ticket)
+
+    def _rpc_unscheduled(self, op, key, rnd, nbytes, timeout_ms, dtype,
+                         payload, pull_into=None) -> bytes:
         i = self._shard(key)
         ch = self._pools[i].get()        # blocks while all channels busy
         try:
@@ -1312,6 +1406,72 @@ class RemotePSBackend:
             from ..common.naming import log_key_placement
             log_key_placement(key, nbytes, i, self._shard_bytes,
                               self.hash_fn)
+        self._plan_stripes(key, nbytes, dtype, init, compression)
+
+    # striping sub-keys ride bits 48+ of the u64 wire key — disjoint
+    # from gradient keys (decl<<16|bucket) and the activation channel
+    # space (bit 40)
+    @staticmethod
+    def _stripe_subkey(key: int, part: int) -> int:
+        return key | ((part + 1) << 48)
+
+    def _plan_stripes(self, key: int, nbytes: int, dtype: str,
+                      init, compression) -> None:
+        """Placement-aware striping (ring mode): init each stripe of a
+        large key as its own sub-key on a DISTINCT ring successor
+        (``PlacementService.place_stripes``), so later push/pull of the
+        key fans its bytes over several servers' NICs instead of one
+        shard's connection pool. Dense ops of the key (round queries,
+        fused/compressed frames — whose payloads are not
+        range-separable) keep routing to the primary, so the plan only
+        engages for plain dense keys."""
+        if (self._ring is None or compression or key in self._stripe_plans
+                or key >= (1 << 40)):    # never re-stripe sub/act keys
+            return
+        # the fused compression plane is level-per-ROUND: level-0 rounds
+        # take the plain push/pull path, level>0 rounds push_fused to
+        # the key's primary — striping only the dense rounds would
+        # split one key's round counters across two stores and wedge
+        # the next pull. A compress-managed deployment keeps
+        # single-shard routing (codec payloads are not range-separable).
+        import os as _os
+
+        from ..common.global_state import GlobalState
+        comp = (GlobalState.get().config.compress
+                if GlobalState.initialized()
+                else (_os.environ.get("BPS_COMPRESS", "none")
+                      or "none").lower())
+        if comp not in ("", "none"):
+            return
+        ranges = self._stripe_ranges(int(nbytes))
+        if not ranges:
+            return
+        shards = self._ring.place_stripes(key, len(ranges))
+        item = np.dtype(dtype).itemsize
+        flat = (None if init is None
+                else np.ascontiguousarray(init).reshape(-1))
+        plan = []
+        for j, (off, ln) in enumerate(ranges):
+            skey = self._stripe_subkey(key, j)
+            self._stripe_shards[skey] = shards[j]
+            part_init = (None if flat is None
+                         else flat[off // item:(off + ln) // item])
+            payload = None if part_init is None else _as_bytes(part_init)
+            self._rpc(OP_INIT, skey, 0, ln, 0, dtype, payload)
+            self._inits[shards[j]][skey] = (
+                skey, ln, dtype,
+                None if part_init is None else np.array(part_init), None)
+            plan.append((off, ln, skey))
+        self._stripe_plans[key] = plan
+
+    def set_send_priority(self, key: int, prio: int) -> None:
+        """Send-scheduler priority for ``key``'s frames (higher = sent
+        earlier under BPS_SCHEDULING_CREDIT). The exchange assigns
+        reverse-first-use bucket priorities here at plan time; stripes
+        of the key inherit it."""
+        self._send_prio[key] = int(prio)
+        for _, _, skey in self._stripe_plans.get(key, ()):
+            self._send_prio[skey] = int(prio)
 
     def _push_token(self, key: int) -> int:
         with self._push_seq_lock:
@@ -1416,6 +1576,21 @@ class RemotePSBackend:
             raise first
 
     def push(self, key: int, data: np.ndarray) -> None:
+        plan = self._stripe_plans.get(key)
+        if plan is not None:
+            # placement-aware stripes: each part is an ordinary dense
+            # push of its own sub-key on its own shard — full framing,
+            # dedup, and reconnect per part, flying concurrently
+            view = _as_bytes(data)
+            dtype = str(data.dtype)
+
+            def push_part(args):
+                off, ln, skey = args
+                self._rpc(OP_PUSH, skey, self._push_token(skey), 0, 0,
+                          dtype, view[off:off + ln])
+
+            self._stripe_run(push_part, plan)
+            return
         tok = self._push_token(key)
         i = self._shard(key)
         if self._shm_shards[i]:
@@ -1468,6 +1643,37 @@ class RemotePSBackend:
 
     def pull(self, key: int, out: np.ndarray, round: int = 0,
              timeout_ms: int = 30000) -> None:
+        plan = self._stripe_plans.get(key)
+        if plan is not None and not out.flags["C_CONTIGUOUS"]:
+            # a striped key's data lives ONLY in the sub-keys — falling
+            # through to the dense base key (which never sees a push)
+            # would round-block forever. Stage through a contiguous
+            # buffer instead; the extra copy is the price of a strided
+            # caller, not a wrong answer.
+            staged = np.empty(out.shape, out.dtype)
+            self.pull(key, staged, round=round, timeout_ms=timeout_ms)
+            np.copyto(out, staged)
+            return
+        if plan is not None:
+            # placement-aware stripes: one dense pull per sub-key on
+            # its own shard, each landing straight in out's byte range
+            # (zero-copy scatter). Every worker pushes every stripe
+            # every round, so the sub-keys' server rounds advance in
+            # lockstep with the logical key's round
+            flat = out.view(np.uint8).reshape(-1)
+            dtype = str(out.dtype)
+
+            def pull_part(args):
+                def one(slice_ms):
+                    off, ln, skey = args
+                    self._rpc(OP_PULL, skey, round, ln, slice_ms, dtype,
+                              None, pull_into=flat[off:off + ln])
+                self._sliced_pull(one, timeout_ms,
+                                  f"pull({key}) stripe round={round}")
+
+            self._stripe_run(pull_part, plan)
+            return
+
         def attempt(slice_ms: int) -> None:
             i = self._shard(key)
             if self._shm_shards[i]:
@@ -1509,7 +1715,15 @@ class RemotePSBackend:
 
     def round(self, key: int) -> int:
         """The server's latest completed round for ``key`` (see
-        HostPSBackend.round — the elastic-rejoin resync point)."""
+        HostPSBackend.round — the elastic-rejoin resync point). A
+        striped key reports the slowest stripe's round — the only
+        round every stripe is guaranteed to have completed."""
+        plan = self._stripe_plans.get(key)
+        if plan is not None:
+            return min(
+                struct.unpack("!Q", self._rpc(OP_ROUND, skey, 0, 0, 0,
+                                              "uint8", None))[0]
+                for _, _, skey in plan)
         data = self._rpc(OP_ROUND, key, 0, 0, 0, "uint8", None)
         return struct.unpack("!Q", data)[0]
 
@@ -1570,6 +1784,27 @@ class RemotePSBackend:
                 OP_PULL_F, key, round, int(nbytes), slice_ms, dtype,
                 payload),
             timeout_ms, f"pull_fused({key}) round={round}")
+
+    # Activation-plane client (byteps_tpu.pipeline): point-to-point
+    # stage→stage frames into the PEER's mailbox. CLASS_ACT in the send
+    # scheduler — the latency class that overtakes gradient bursts.
+
+    def act_push(self, key: int, seq: int, payload) -> None:
+        """Deliver one boundary frame (activations or activation-grads)
+        into the receiving stage's mailbox; last-wins per (key, seq) so
+        the transport's resend path is idempotent."""
+        self._rpc(OP_ACT_PUSH, key, int(seq), 0, 0, "uint8",
+                  _as_bytes(np.asarray(payload).view(np.uint8)))
+
+    def act_pull(self, key: int, seq: int,
+                 timeout_ms: int = 30000) -> bytes:
+        """Remote take: block until the (key, seq) frame arrives in the
+        peer's mailbox, then fetch it — the pull-model form (the local
+        take via ``PSTransportServer.act_store`` is the fast path)."""
+        return self._sliced_pull(
+            lambda slice_ms: self._rpc(OP_ACT_PULL, key, int(seq), 0,
+                                       slice_ms, "uint8", None),
+            timeout_ms, f"act_pull({key:#x}) seq={seq}")
 
     def push_rowsparse(self, key: int, idx, rows, dense_nbytes: int,
                       dtype=None) -> None:

@@ -27,6 +27,12 @@ carries the param leaves it is the first to read
 leaf list behind a readiness gate — the per-parameter unblocking of
 the reference's cross-barrier, at bucket-group granularity.
 
+The same jaxpr-cutting machinery generalized ACROSS WORKERS — P
+(forward, backward) segment pairs on P processes with explicit
+chain-relayed boundary tensors — is the MPMD pipeline-parallel stage
+partitioner (byteps_tpu.pipeline.partitioner), which reuses this
+module's bitwise-probe contract and cut-signal analysis.
+
 Exactness contract: a cut point survives only if the segmented program
 reproduces the fused head BIT-FOR-BIT on a real (params, batch) probe.
 Splitting a program at an arbitrary boundary can perturb XLA's fusion

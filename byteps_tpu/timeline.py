@@ -26,6 +26,11 @@ events with the TRUE owning step via record()'s explicit ``step`` —
 step k's straggler tail records while the ambient step is already k+1,
 and telemetry.cross_step_overlap groups per step
 (BPS_CROSS_STEP=0 disables it).
+The MPMD pipeline plane (byteps_tpu.pipeline) adds PP_FWD_SEG /
+PP_BWD_SEG (one span per stage segment per microbatch; pid = stage
+index — PP_BWD_SEG(stage k) overlapping PP_FWD_SEG(stage k+1) is the
+1F1B schedule's existence proof) and PP_ACT_SEND / PP_ACT_RECV (one
+span per boundary frame crossing to/from a neighbor stage's mailbox).
 With ``BPS_TRACE_PROFILER=1`` the same step window also
 captures a ``jax.profiler`` device trace into
 ``<trace_dir>/<local_rank>/profile`` — host spans land in comm.json

@@ -160,6 +160,17 @@ class DistributedTrainer:
                         "restarted worker cannot alias another trainer's "
                         "keys", name)
         self._name = name
+        if (gs is not None and gs.config.pp_stages > 1):
+            # MPMD pipeline parallelism has its own driver: the model
+            # is cut across WORKERS and this trainer's whole-model
+            # step would silently train only replicas. Refuse loudly.
+            raise ValueError(
+                f"BPS_PP_STAGES={gs.config.pp_stages}: DistributedTrainer "
+                f"is the data-parallel step — pipeline-parallel jobs "
+                f"run byteps_tpu.pipeline.PipelineStageDriver (one per "
+                f"stage worker, docs/pipeline-parallelism.md); PP × DP "
+                f"composes by giving each stage's driver this trainer's "
+                f"PS exchange for its per-stage gradient sum")
         eng = gs.engine if gs is not None else None
         self._ps_engine = (eng if eng is not None and
                            getattr(eng, "ps_exchange", None) is not None

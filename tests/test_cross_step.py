@@ -191,6 +191,18 @@ def test_pull_order_follows_next_use_priority():
     try:
         ex = PSGradientExchange(_GatedPulls(be), partition_bytes=8 << 10,
                                 pipeline_depth=2)
+        # ONE pull worker: with >=2, workers pop the heap in priority
+        # order under the lock but reach the gated observer racily, so
+        # any adjacent pair could append inverted and the assertion
+        # below flaked on loaded boxes. A single worker serializes
+        # pop -> observe, making the drain order a deterministic
+        # statement of the heap's priority (the thing under test);
+        # pushes keep the full pipeline width.
+        ex._ensure_executors()
+        ex._pull_ex.shutdown(wait=False)
+        from concurrent.futures import ThreadPoolExecutor
+        ex._pull_ex = ThreadPoolExecutor(1,
+                                         thread_name_prefix="bps-t-pull")
         handle = ex.exchange_stream(tree, name="prio")
         _, _, keyed = ex._plan(tree, "prio")
         assert len(keyed) == nbuckets
@@ -206,10 +218,9 @@ def test_pull_order_follows_next_use_priority():
         prio = {pskey: min(s.leaf_index for s in b.segments)
                 for pskey, b in keyed}
         got = [prio[k] for k in order]
-        # the first two pulls were already claimed by the 2 pipeline
-        # workers before the backlog formed; the REST must drain in
-        # forward-priority order
-        assert got[2:] == sorted(got[2:]), (got, order)
+        # the first pull was claimed by the worker before the backlog
+        # formed; the REST must drain in forward-priority order
+        assert got[1:] == sorted(got[1:]), (got, order)
         ex.close()
     finally:
         be.close()

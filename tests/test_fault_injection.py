@@ -495,3 +495,181 @@ def test_plane_log_replay_normalizes_cross_codec_formats():
             s.close()
         for e in engines:
             e.close()
+
+
+# ===================================================================
+# Pipeline-parallel fault injection (byteps_tpu.pipeline): a dead
+# stage peer must be a LOUD per-stage error on both neighbors (never a
+# silent hang), and the watchdog's diagnostic must name the wedged
+# microbatch. Slow lane: the same death over real TCP transports.
+# ===================================================================
+
+def _pp_case(dim=32, depth=6, batch=8, micro=2, stages=3):
+    import jax
+    import jax.numpy as jnp
+
+    from byteps_tpu.models.mlp import mlp_init, mlp_loss
+    from byteps_tpu.pipeline import StagePartitioner
+    params = mlp_init(jax.random.PRNGKey(0), dim, depth)
+    xs = np.random.RandomState(0).randn(batch, dim).astype(np.float32)
+    full = (jnp.asarray(xs), jnp.asarray(np.tanh(xs)))
+    mb = jax.tree_util.tree_map(lambda l: l[:batch // micro], full)
+    prog = StagePartitioner(stages).build(mlp_loss, params, mb,
+                                          name="pp-fault")
+    assert prog is not None
+    return prog, params, full
+
+
+def test_dead_stage_peer_is_loud_on_both_neighbors():
+    """3-stage pipeline, the MIDDLE stage never comes up: stage 0
+    (blocked on its activation-grad) and stage 2 (blocked on its
+    activation) must BOTH raise PeerDead naming the boundary and the
+    wedged microbatch — a partial pipeline never hangs silently."""
+    import optax
+
+    from byteps_tpu.pipeline import (ActivationExchange, LocalActPeer,
+                                     PipelineStageDriver)
+    from byteps_tpu.pipeline.exchange import ActStore, PeerDead
+
+    prog, params, full = _pp_case(stages=3)
+    stores = [ActStore() for _ in range(3)]
+    acts = {
+        0: ActivationExchange(0, stores[0],
+                              peer_next=LocalActPeer(stores[1]),
+                              timeout_ms=600),
+        2: ActivationExchange(2, stores[2],
+                              peer_prev=LocalActPeer(stores[1]),
+                              timeout_ms=600),
+    }
+    tx = optax.adam(1e-2)
+    drv = {s: PipelineStageDriver(prog, s, params, tx, acts[s], 2)
+           for s in (0, 2)}
+    errs = {}
+
+    def loop(s):
+        try:
+            drv[s].step(full)
+        except BaseException as e:  # noqa: BLE001 — asserted below
+            errs[s] = e
+
+    ts = [threading.Thread(target=loop, args=(s,)) for s in (0, 2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert all(not t.is_alive() for t in ts), "neighbor hung silently"
+    assert set(errs) == {0, 2}
+    for s, e in errs.items():
+        assert isinstance(e, PeerDead)
+        msg = str(e)
+        assert f"stage {s}" in msg and "microbatch" in msg
+        assert "stage 1" in msg          # the dead peer is NAMED
+
+
+def test_watchdog_diagnostic_names_wedged_microbatch():
+    """The stall watchdog over an ActivationExchange: a recv blocked on
+    a dead peer produces a per-stage diagnostic naming the boundary,
+    direction, and microbatch — the pipeline twin of the lost-pull
+    dump."""
+    from byteps_tpu.obs.watchdog import StallWatchdog, format_dump
+    from byteps_tpu.pipeline.exchange import (ActivationExchange,
+                                              ActStore, PeerDead)
+    from byteps_tpu.pipeline.partitioner import Boundary
+
+    act = ActivationExchange(1, ActStore(), timeout_ms=1500)
+    b = Boundary(index=0, src_stage=0, dst_stage=1, vars=(),
+                 local=False, kind="act")
+    dumps = []
+    wd = StallWatchdog(act, stall_sec=0.2, poll_sec=0.05,
+                       on_dump=lambda st, s: dumps.append(st))
+    try:
+        with pytest.raises(PeerDead):
+            act.recv(b, 3, 7, {})
+        deadline = time.time() + 3
+        while not dumps and time.time() < deadline:
+            time.sleep(0.05)
+    finally:
+        wd.stop()
+    assert dumps, "watchdog never fired on the blocked recv"
+    st = dumps[0]
+    w = st["pp_waits"][0]
+    assert (w["stage"], w["boundary"], w["microbatch"], w["seq"]) \
+        == (1, 0, 3, 7)
+    text = format_dump(st, 1.0)
+    assert "microbatch 3" in text and "stage 1 blocked" in text
+    assert "peer dead or wedged" in text
+
+
+@pytest.mark.slow
+def test_dead_stage_peer_over_tcp_is_loud():
+    """Slow-lane TCP variant: the stage peers exchange activations over
+    real sockets; stage 1's transport server dies mid-run. Stage 0's
+    next SEND must fail loudly (reconnect budget exhausted → PeerDead
+    naming the hop), never hang."""
+    import jax
+    import optax
+
+    from byteps_tpu.pipeline import (ActivationExchange,
+                                     PipelineStageDriver)
+    from byteps_tpu.pipeline.exchange import PeerDead
+
+    prog, params, full = _pp_case(stages=2)
+    engines = [PSServer(num_workers=1, engine_threads=1)
+               for _ in range(2)]
+    servers = [PSTransportServer(e, host="127.0.0.1", port=0)
+               for e in engines]
+    # stage 0 reaches stage 1 through a severable proxy: a transport
+    # server's close() only stops the ACCEPT loop (live connections
+    # keep serving), but a dead peer PROCESS severs its established
+    # connections too — the proxy models exactly that
+    proxy = ChaosProxy(servers[1].port, kill_every=(9999, 10000))
+    clients = [RemotePSBackend([f"127.0.0.1:{proxy.port}"],
+                               reconnect_secs=1.0),
+               RemotePSBackend([f"127.0.0.1:{servers[0].port}"],
+                               reconnect_secs=1.0)]
+    tx = optax.adam(1e-2)
+    acts = [ActivationExchange(0, servers[0].act_store(),
+                               peer_next=clients[0], timeout_ms=3000),
+            ActivationExchange(1, servers[1].act_store(),
+                               peer_prev=clients[1], timeout_ms=3000)]
+    drv = [PipelineStageDriver(prog, s, params, tx, acts[s], 2)
+           for s in (0, 1)]
+    errs, oks = {}, {}
+
+    def loop(s):
+        try:
+            for i in range(2000):   # far more than fit before the kill
+                drv[s].step(full)
+                oks[s] = i
+        except BaseException as e:  # noqa: BLE001 — asserted below
+            errs[s] = e
+
+    try:
+        ts = [threading.Thread(target=loop, args=(s,)) for s in (0, 1)]
+        for t in ts:
+            t.start()
+        time.sleep(0.5)            # let a couple of steps land
+        proxy.close()              # stage 1's endpoint dies mid-run:
+        servers[1].close()         # listener gone AND live
+        engines[1].close()         # connections severed
+        for t in ts:
+            t.join(60)
+        assert all(not t.is_alive() for t in ts), "TCP peer death hung"
+        assert 0 in errs, "stage 0 never noticed its peer died"
+        e = errs[0]
+        assert isinstance(e, PeerDead)
+        assert "stage 0" in str(e) and "stage 1" in str(e)
+        assert "microbatch" in str(e)
+    finally:
+        for c in clients:
+            c.close()
+        for s in servers:
+            try:
+                s.close()
+            except Exception:
+                pass
+        for e in engines:
+            try:
+                e.close()
+            except Exception:
+                pass

@@ -490,3 +490,77 @@ def test_bench_ps_plane_smoke():
     import bench
     out = bench.ps_plane_breakdown(iters=2, warm=1)
     assert out["shards_1_to_2"] > 1.0, out
+
+
+def test_ring_striping_lands_on_distinct_successors(monkeypatch):
+    """SATELLITE (ROADMAP item 2 leftover): with BPS_STRIPE_MIN and
+    ring placement, one large key's stripes become independent
+    sub-keys on DISTINCT ring successors — the bytes genuinely fan out
+    over several servers' NICs (asserted on per-server rx counters)
+    instead of one shard's connection pool — and a two-worker
+    push_pull through the striped path stays BIT-EXACT."""
+    import threading
+
+    from byteps_tpu.server.throttle import Nic
+    from byteps_tpu.server.transport import (PSTransportServer,
+                                             RemotePSBackend)
+
+    monkeypatch.setenv("BPS_STRIPE_MIN", str(512 << 10))
+    monkeypatch.delenv("BPS_ENABLE_SHM", raising=False)
+    nics = [Nic(1e9), Nic(1e9)]
+    engines = [PSServer(num_workers=2, engine_threads=1)
+               for _ in range(2)]
+    servers = [PSTransportServer(e, host="127.0.0.1", port=0, nic=n)
+               for e, n in zip(engines, nics)]
+    addrs = [f"127.0.0.1:{s.port}" for s in servers]
+    clis = [RemotePSBackend(addrs, hash_fn="ring") for _ in range(2)]
+    try:
+        key, elems = 77 << 16, 512 << 10        # 2 MiB fp32 tensor
+        data = [np.random.RandomState(i).randn(elems).astype(np.float32)
+                for i in range(2)]
+        for c in clis:
+            c.init_key(key, elems * 4)
+        plan = clis[0]._stripe_plans.get(key)
+        assert plan, "striping never engaged"
+        shards = [clis[0]._stripe_shards[sk] for _, _, sk in plan]
+        # distinct ring successors, exactly as place_stripes assigns
+        assert set(shards) == {0, 1}
+        assert shards == clis[0]._ring.place_stripes(key, len(plan))
+        # both workers derive the identical plan (declaration-order
+        # determinism — a disagreement would tear every round)
+        assert plan == clis[1]._stripe_plans.get(key)
+
+        rx0 = [n.rx_bytes for n in nics]
+        outs = [None, None]
+
+        def roundtrip(i):
+            outs[i] = clis[i].push_pull(key, data[i])
+
+        ts = [threading.Thread(target=roundtrip, args=(i,))
+              for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        want = data[0] + data[1]
+        for i in range(2):
+            assert np.array_equal(outs[i], want)
+        grew = [n.rx_bytes - b for n, b in zip(nics, rx0)]
+        # each server ingested roughly half the pushed bytes (2 workers
+        # x 1 MiB each per server) — the fan-out is real, not routing
+        # theater
+        assert all(g > 1 << 20 for g in grew), grew
+        # a NON-contiguous out must still read the stripes (the base
+        # key never receives pushes — a silent dense fallback would
+        # round-block forever): staged through a contiguous buffer
+        strided = np.empty(elems * 2, np.float32)[::2]
+        assert not strided.flags["C_CONTIGUOUS"]
+        clis[0].pull(key, strided, round=1, timeout_ms=10000)
+        assert np.array_equal(strided, want)
+    finally:
+        for c in clis:
+            c.close()
+        for s in servers:
+            s.close()
+        for e in engines:
+            e.close()
