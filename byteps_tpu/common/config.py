@@ -118,6 +118,25 @@ class Config:
                                          # per step driving the 1F1B
                                          # schedule
 
+    # --- sharded weight update (ours: byteps_tpu/sharded_update,
+    # docs/sharded-update.md) ---
+    sharded_update: bool = False         # BPS_SHARDED_UPDATE: partition
+                                         # the bucket groups across the
+                                         # dp replicas — pull/apply only
+                                         # your shard, publish params,
+                                         # fetch the rest (ZeRO-style);
+                                         # probe-or-fallback to the full
+                                         # apply (dp=1, async, legacy-
+                                         # compressed keys, coupled tx)
+    shard_rank: int = -1                 # BPS_SHARD_RANK: this
+                                         # replica's ownership rank
+                                         # (-1 = worker_id)
+    shard_world: int = 0                 # BPS_SHARD_WORLD: ownership
+                                         # degree (0 = num_worker)
+    # BPS_PARAM_TIMEOUT_MS (owner-death diagnostic threshold for param
+    # fetches, default 30000) is read by sharded_update itself — it
+    # tunes the mode, not selects it
+
     # --- emulated-NIC throttle for this worker endpoint (perf lab:
     # charges all RemotePSBackend traffic to a throttle.Nic so
     # multi-process training A/Bs run under a bandwidth constraint;
@@ -199,6 +218,9 @@ class Config:
             pp_stages=_env_int("BPS_PP_STAGES", None, 1),
             pp_rank=_env_int("BPS_PP_RANK", None, 0),
             pp_microbatch=_env_int("BPS_PP_MICROBATCH", None, 1),
+            sharded_update=_env_bool("BPS_SHARDED_UPDATE", None),
+            shard_rank=_env_int("BPS_SHARD_RANK", None, -1),
+            shard_world=_env_int("BPS_SHARD_WORLD", None, 0),
             emu_nic_rate=float(_env("BPS_EMU_NIC_RATE", None, "0") or 0),
             emu_nic_latency=float(_env("BPS_EMU_NIC_LATENCY", None, "0") or 0),
             min_compress_bytes=_env_int("BPS_MIN_COMPRESS_BYTES", "BYTEPS_MIN_COMPRESS_BYTES", 65536),

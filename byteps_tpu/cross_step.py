@@ -76,7 +76,12 @@ class CrossStepDriver:
         self._shapes = [l.shape for l in flat]
         self._n = len(flat)
         self._rep = NamedSharding(trainer.mesh, P())
-        self._epoch = 0              # steps whose segments have run
+        # sharded weight update: the driver runs the sharded tail for
+        # its background steps; the epoch counter CONTINUES the
+        # trainer's (a draining sharded step already marked epochs —
+        # restarting at 0 would let later gates pass against stale
+        # installs). Unsharded trainers start at 0, unchanged.
+        self._epoch = getattr(trainer, "_sharded_epoch", 0)
         self._tails: List[threading.Thread] = []
         self._err = None             # (exc, applied_groups, epoch)
         self._err_lock = threading.Lock()
@@ -185,8 +190,14 @@ class CrossStepDriver:
             if chunked.ready_epoch else 0)
         t_ex = time.time()
         template = jax.tree_util.tree_unflatten(self._treedef, self._flat)
-        handle = self._ex.exchange_ingest(template, name=self._name,
-                                          step=e)
+        # re-resolve the sharded state (the trainer may have disabled
+        # it between steps); a None view = classic full-pull round
+        st = self._tr._sharded_active()
+        handle = self._ex.exchange_ingest(
+            template, name=self._name, step=e,
+            sharded=st.plan.round_view() if st is not None else None)
+        if st is not None:
+            self._tr._sharded_epoch = e
 
         def gate(si: int, leaf_ids) -> None:
             if not leaf_ids:
@@ -220,9 +231,15 @@ class CrossStepDriver:
             # params are untouched) — roll the counter back or every
             # later step's gate waits forever on marks that can't come
             self._epoch = e - 1
+            if st is not None:
+                self._tr._sharded_epoch = e - 1
             handle.abort(exc)        # unblock any tail consumer
             raise
-        t = threading.Thread(target=self._tail, args=(handle, e, t_ex, tl),
+        # param-frame seq assigned at tail LAUNCH in step order — every
+        # replica runs the same step sequence, so equal seq = same step
+        seq = st.next_seq() if st is not None else None
+        t = threading.Thread(target=self._tail,
+                             args=(handle, e, t_ex, tl, st, seq),
                              name=f"bps-xstep-tail-{e}", daemon=True)
         self._tails.append(t)
         t.start()
@@ -242,16 +259,39 @@ class CrossStepDriver:
                       step=e)
         return d
 
-    def _tail(self, handle, e: int, t_ex: float, tl) -> None:
+    def _tail(self, handle, e: int, t_ex: float, tl, st=None,
+              seq=None) -> None:
         """Step ``e``'s straggler consumer: iterate leaf completions,
         H2D each, apply the optimizer per group the moment the group's
         leaves land AND its step-``e-1`` apply has been dispatched
         (two tails can be alive at once; per-group epoch order is what
-        keeps momentum-style state exact)."""
+        keeps momentum-style state exact).
+
+        ``st``/``seq``: sharded-update state + param-frame seq — the
+        tail then runs ``ShardedUpdateState.run_tail`` (owned groups
+        pull+apply+publish, the rest install from the owners' frames),
+        with the same epoch gating and error poisoning."""
         import heapq
         chunked = self._chunked
         flat = self._flat
         applied = 0
+        if st is not None:
+            try:
+                applied = st.run_tail(
+                    handle, chunked, flat, e, seq,
+                    lambda li, arr: self._h2d(li, arr, tl, e),
+                    st.param_installer(self._rep), self._tr._h2d_ex, tl,
+                    should_abort=lambda: self._err is not None,
+                    step_tag=e)
+                observe_stage("PS_PUSH_PULL", time.time() - t_ex)
+                if tl is not None:
+                    tl.record(self._name, "PS_PUSH_PULL", t_ex,
+                              time.time() - t_ex, 0, step=e)
+            except BaseException as exc:   # noqa: BLE001 — surfaced on
+                with self._err_lock:       # the next step()/drain()
+                    if self._err is None:
+                        self._err = (exc, applied, e)
+            return
         # arrival is decoupled from apply: a reader thread consumes the
         # leaf-completion stream (H2D fires per leaf immediately) and
         # accumulates COMPLETE groups in a next-use priority heap; this

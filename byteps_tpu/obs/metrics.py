@@ -38,6 +38,7 @@ STAGES: Tuple[str, ...] = (
     "PS_BWD_SEG", "PS_D2H", "PS_PACK", "PS_COMPRESS", "PS_PUSH",
     "PS_PULL", "PS_DECOMPRESS", "PS_UNPACK", "PS_H2D",
     "PS_APPLY_CHUNK", "PS_XSTEP_GATE",
+    "PS_PARAM_PUT", "PS_PARAM_GET",
     "PP_FWD_SEG", "PP_BWD_SEG", "PP_ACT_SEND", "PP_ACT_RECV",
 )
 
@@ -54,11 +55,21 @@ PLANE_COUNTERS: Tuple[str, ...] = ("plane/migrations", "plane/failovers",
 # compression.md): decision/byte counters pre-registered so "is the
 # controller doing anything" is answerable before any traffic; the
 # per-layer ``compress/level/<layer>`` gauges and
-# ``ps/push_bytes/<layer>`` counters ride alongside dynamically (layer
-# set is a runtime property of the bucket plan).
+# ``ps/push_bytes/<layer>`` / ``ps/pull_bytes/<layer>`` counters ride
+# alongside dynamically (layer set is a runtime property of the bucket
+# plan — the pull side registers at exchange plan time, the push side
+# at compress-plane registration).
 COMPRESS_COUNTERS: Tuple[str, ...] = ("compress/decisions",
                                       "compress/raw_bytes",
                                       "compress/wire_bytes")
+
+# Sharded weight update (byteps_tpu.sharded_update,
+# docs/sharded-update.md): param-frame byte counters pre-registered so
+# "is the sharded update doing anything" is answerable before any
+# traffic; grad-pull reduction shows in ps/pull_bytes (global and
+# per-layer).
+SHARD_COUNTERS: Tuple[str, ...] = ("ps/param_put_bytes",
+                                   "ps/param_fetch_bytes")
 
 # Pipeline-parallel plane (byteps_tpu.pipeline, docs/pipeline-
 # parallelism.md) + the two-class wire scheduler (server/sched.py):
@@ -279,6 +290,8 @@ class MetricsRegistry:
         for c in PLANE_COUNTERS:
             self.counter(c)
         for c in COMPRESS_COUNTERS:
+            self.counter(c)
+        for c in SHARD_COUNTERS:
             self.counter(c)
         for c in PP_COUNTERS:
             self.counter(c)

@@ -43,6 +43,12 @@ def format_dump(state: dict, stalled_s: float) -> str:
             mark = ""
             if st == "pushed":
                 mark = "  <-- pushed, pull never completed (wedge)"
+            elif st == "await_param":
+                # sharded update: this replica does not pull the
+                # bucket — it waits for the OWNER's param publish
+                mark = (f"  <-- pushed, awaiting param publish from "
+                        f"owner replica {b.get('owner', '?')} "
+                        f"(sharded update)")
             elif st == "failed":
                 mark = "  <-- failed"
             lines.append(
@@ -73,6 +79,16 @@ def format_dump(state: dict, stalled_s: float) -> str:
             "pull was lost (server death past the reconnect budget, or a "
             "peer that never pushed its share) and the per-key admission "
             "gate cannot release without it")
+    elif any(b.get("state") == "await_param"
+             for r in state.get("rounds", ())
+             for b in r.get("buckets", ())):
+        lines.append(
+            "  an await_param bucket above is the wedge: the named "
+            "owner replica never published its param frame (it died "
+            "between its grad pull and its param publish, or its "
+            "publisher is stalled) — non-owners cannot release the "
+            "bucket's admission key without the frame "
+            "(docs/sharded-update.md failure matrix)")
     elif state.get("pp_waits"):
         pass    # the per-stage lines above already name the wedge
     else:
@@ -141,7 +157,8 @@ class StallWatchdog:
         # gated backward segment even runs, and a long first segment
         # must not read as a per-step false-positive wedge dump
         rounds = state.get("rounds", ())
-        wired = any(b.get("state") in ("pushed", "pulled", "failed")
+        wired = any(b.get("state") in ("pushed", "pulled", "failed",
+                                       "await_param", "param_done")
                     for r in rounds for b in r.get("buckets", ()))
         if not wired and not state.get("admission", {}).get("waiters") \
                 and not state.get("pp_waits"):

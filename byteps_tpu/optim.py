@@ -212,7 +212,7 @@ class ChunkedApply:
     """
 
     def __init__(self, inner: optax.GradientTransformation, params,
-                 groups, donate: bool = True) -> None:
+                 groups, donate: bool = True, owned=None) -> None:
         import jax
         import threading
         self.inner = inner
@@ -225,6 +225,12 @@ class ChunkedApply:
         covered = sorted(self.leaf_group) == list(range(len(leaves)))
         self.decomposable = covered and leafwise_decomposable(
             inner, leaves, self.groups)
+        # sharded weight update (byteps_tpu.sharded_update): optimizer
+        # state is allocated ONLY for this replica's owned groups — the
+        # ~1/dp optimizer-state memory reduction is exactly this line.
+        # Applying a non-owned group is a contract violation (its state
+        # lives on the owner), refused loudly in apply_group.
+        self.owned = None if owned is None else frozenset(owned)
         # per-leaf readiness EPOCH table (cross-step gating): entry li
         # is the last step whose optimizer apply for leaf li has been
         # dispatched. The cross-step driver launches step k+1's staged
@@ -238,7 +244,9 @@ class ChunkedApply:
         if not self.decomposable:
             return
         self.states = [inner.init([leaves[i] for i in g])
-                       for g in self.groups]
+                       if self.owned is None or gi in self.owned
+                       else None
+                       for gi, g in enumerate(self.groups)]
 
         def _apply(plist, state, glist):
             updates, state = inner.update(glist, state, plist)
@@ -259,6 +267,12 @@ class ChunkedApply:
         gate observes the epoch but still reads the pre-apply array."""
         import time
         from .obs.metrics import observe_stage
+        if self.owned is not None and gi not in self.owned:
+            raise RuntimeError(
+                f"apply_group({gi}) on a non-owned group: this replica "
+                f"holds no optimizer state for it (sharded update) — "
+                f"non-owned groups are installed from the owner's "
+                f"param frames, never applied locally")
         t0 = time.time()
         new, self.states[gi] = self._apply(params_list, self.states[gi],
                                            grads_list)

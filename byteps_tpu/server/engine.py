@@ -423,6 +423,13 @@ class HostPSBackend:
         # fused-plane pull cache (byteps_tpu.compress), created on first
         # fused pull so plain deployments never pay the import
         self._fused_cache = None
+        # param mailbox (sharded weight update): one in-process store —
+        # worker threads sharing this backend share it, mirroring the
+        # transport server's param_store(); lazy, plain deployments
+        # never allocate it
+        import threading
+        self._param_store = None
+        self._param_lock = threading.Lock()
         from ..obs.metrics import get_registry
         self._m_pull_wait = get_registry().histogram("server/pull_wait_s")
         self._m_queue_depth = get_registry().gauge(
@@ -636,6 +643,24 @@ class HostPSBackend:
                                  dtype, codec, round,
                                  timeout_ms=timeout_ms,
                                  div=div if div else wire.TOPK_DIV)
+
+    def param_store(self):
+        if self._param_store is None:
+            with self._param_lock:
+                if self._param_store is None:
+                    from ..sharded_update import ParamStore
+                    self._param_store = ParamStore()
+        return self._param_store
+
+    def param_put(self, key: int, seq: int, payload) -> None:
+        """Sharded-update param publish (in-process mailbox; last-wins
+        per (key, seq) — see sharded_update.ParamStore)."""
+        self.param_store().put(key, seq, payload)
+
+    def param_get(self, key: int, seq: int,
+                  timeout_ms: int = 30000) -> bytes:
+        """Blocking non-destructive fetch of a (key, seq) param frame."""
+        return self.param_store().get(key, seq, timeout_ms=timeout_ms)
 
     def pull_bytes(self, key: int, round: int = 0,
                    timeout_ms: int = 30000) -> bytes:

@@ -100,6 +100,17 @@ class PlanePSBackend:
         self._repl = [s if hasattr(s, "repl_put")
                       else _LocalReplica(ReplicaStore())
                       for s in shards]
+        # param-mailbox handles (sharded weight update, OP_PARAM_*):
+        # same split — remote clients speak the wire ops, in-process
+        # shards get plane-held stores. Param keys are routed by PURE
+        # ring successor order (never placed/migrated): every worker
+        # resolves the same shard with no table to diverge, and a shard
+        # death moves them to the next successor — where the owner's
+        # put RETRY lands too (frames are recomputable, not replicated;
+        # docs/sharded-update.md failure matrix).
+        self._params = [s if hasattr(s, "param_put") else None
+                        for s in shards]
+        self._params_local: Dict[int, object] = {}
         self._lock = threading.Lock()
         self._mig_cv = threading.Condition(self._lock)
         # key -> (nbytes, dtype, init copy, compression) for init
@@ -486,6 +497,63 @@ class PlanePSBackend:
             if inf is not None and inf[0] <= round:
                 del self._inflight[key]
                 self._mig_cv.notify_all()   # migrate_key's drain
+
+    # -------------------------------------------- sharded-update params
+
+    def _param_client(self, key: int):
+        """(client, shard index) of ``key``'s param mailbox: its first
+        LIVE ring successor (stateless, identical on every worker). The
+        shard index is captured WITH the client — a failover must blame
+        the shard the op actually ran on, not whatever the ring resolves
+        to after a concurrent thread already marked it dead (that next
+        successor is healthy)."""
+        order = self.placement.ring.successors(key, len(self._shards),
+                                               skip=self._dead)
+        if not order:
+            raise RuntimeError("no live shards left in the plane")
+        s = order[0]
+        client = self._params[s]
+        if client is None:
+            client = self._params_local.get(s)
+            if client is None:
+                from ...sharded_update import ParamStore
+                client = self._params_local[s] = ParamStore()
+        return client, s
+
+    def param_put(self, key: int, seq: int, payload) -> None:
+        for attempt in (0, 1):
+            c, s = self._param_client(key)
+            try:
+                if hasattr(c, "param_put"):
+                    return c.param_put(key, seq, payload)
+                return c.put(key, seq, payload)
+            except (ConnectionError, OSError, ServerClosed) as e:
+                if attempt:
+                    raise
+                self.fail_shard(s, cause=e)   # idempotent per shard
+
+    def param_get(self, key: int, seq: int,
+                  timeout_ms: int = 30000) -> bytes:
+        for attempt in (0, 1):
+            c, s = self._param_client(key)
+            try:
+                if hasattr(c, "param_get"):
+                    return c.param_get(key, seq, timeout_ms=timeout_ms)
+                return c.get(key, seq, timeout_ms=timeout_ms)
+            except TimeoutError:
+                raise          # application answer: owner never put
+            except (ConnectionError, OSError, ServerClosed) as e:
+                if attempt:
+                    raise
+                self.fail_shard(s, cause=e)   # idempotent per shard
+
+    def set_send_priority(self, key: int, prio: int) -> None:
+        """Fan the per-key wire-scheduler priority out to every shard
+        client that gates sends (grad buckets route by placement, param
+        keys by ring successor — the shard owning the key will have it)."""
+        for s in self._shards:
+            if hasattr(s, "set_send_priority"):
+                s.set_send_priority(key, prio)
 
     def round(self, key: int) -> int:
         base = self._round_base.get(key, 0)

@@ -60,27 +60,24 @@ class _StreamingExchange:
     push or pull surfaces as an exception from the iterator (and from
     ``result()``)."""
 
-    __slots__ = ("_n", "_q", "_drain")
+    __slots__ = ("_r",)
 
-    def __init__(self, n_leaves: int, q, drain) -> None:
-        self._n = n_leaves
-        self._q = q
-        self._drain = drain
+    def __init__(self, round_) -> None:
+        self._r = round_
+
+    @property
+    def round_state(self):
+        """The underlying ``_Round`` (sharded-update tail plumbing)."""
+        return self._r
 
     def ready(self):
         """Iterate (leaf_index, flat host array) as leaves complete."""
-        yielded = 0
-        while yielded < self._n:
-            item = self._q.get()
-            if isinstance(item, BaseException):
-                raise item
-            yield item
-            yielded += 1
+        return self._r.ready_iter()
 
     def result(self):
         """Drain every pull and return the assembled summed tree (usable
         with or without consuming ``ready()``)."""
-        return self._drain()
+        return self._r.drain()
 
 
 class _IngestExchange:
@@ -100,6 +97,11 @@ class _IngestExchange:
 
     def __init__(self, round_) -> None:
         self._r = round_
+
+    @property
+    def round_state(self):
+        """The underlying ``_Round`` (sharded-update tail plumbing)."""
+        return self._r
 
     def feed(self, leaf_ids, values) -> None:
         """Hand over device (or host) arrays for ``leaf_ids`` (flat
@@ -137,9 +139,18 @@ class _Round:
     def __init__(self, ex: "PSGradientExchange", tree,
                  name: Optional[str], stream: bool,
                  ingest: bool = False,
-                 step: Optional[int] = None) -> None:
+                 step: Optional[int] = None,
+                 sharded=None) -> None:
         import queue as _queue
         self.ex = ex
+        # sharded weight update (byteps_tpu.sharded_update): push EVERY
+        # bucket (the server sum needs all contributions) but pull only
+        # the buckets covering this replica's OWNED groups; the rest
+        # sit in ``await_param`` until the owner's param frames land
+        # and ``release_skipped`` frees their admission keys. None =
+        # classic full-pull round.
+        self.sharded = sharded
+        self.skip_buckets = frozenset()     # filled once keyed is known
         # cross-step rounds tag their timeline spans with the TRUE
         # owning step: the round's spans outlive the step that started
         # it, and the overlap aggregates group per step
@@ -185,19 +196,34 @@ class _Round:
         self.pull_prio = [min((s.leaf_index for s in b.segments),
                               default=0) for _, b in self.keyed]
         self.round_seq = ex._next_round_seq()
-        self._pulls_left = len(self.keyed)
+        if self.sharded is not None:
+            self.skip_buckets = frozenset(
+                i for i in range(len(self.keyed))
+                if i not in self.sharded.pull_buckets)
+        self._pulls_left = len(self.keyed) - len(self.skip_buckets)
+        self._skips_left = len(self.skip_buckets)
+        self._skip_lock = threading.Lock()
+        # skipped buckets whose release arrived BEFORE their own push
+        # landed (the owner can publish the moment every worker's push
+        # reached the server, racing this worker's push bookkeeping)
+        self._skip_release_pending: set = set()
         self._pull_lock = threading.Lock()
         self._pull_err: Optional[BaseException] = None
         self._pull_done = threading.Event()
         # per-bucket lifecycle for the watchdog's per-key diagnostic:
-        # pending -> pushed -> pulled (or failed). "pushed" forever is
-        # the wedge signature (a lost pull holding the admission gate).
+        # pending -> pushed -> pulled (or failed); sharded rounds add
+        # pending -> await_param -> param_done for non-pulled buckets.
+        # "pushed"/"await_param" forever is the wedge signature (a lost
+        # pull — or a dead owner's missing param frame — holding the
+        # admission gate).
         self.bucket_state = ["pending"] * len(self.keyed)
         self._finished = False
         if not self.keyed:
             self._pull_done.set()
         else:
             ex._register_round(self)
+            if self._pulls_left <= 0:
+                self._pull_done.set()
         self.aborted: Optional[BaseException] = None
         self.readyq = None
         if stream or ingest:
@@ -206,6 +232,15 @@ class _Round:
             for _, b in self.keyed:
                 for s in b.segments:
                     self.seg_left[s.leaf_index] += 1
+            # sharded rounds stream only the OWNED groups' leaves —
+            # the rest complete via the param-fetch path, and their
+            # partial grad data (shared boundary buckets) must never
+            # reach the consumer as if it were a finished leaf
+            if self.sharded is not None:
+                for li in range(len(leaves)):
+                    if li not in self.sharded.stream_leaves:
+                        self.seg_left[li] = -1      # never enqueued
+            self._stream_n = sum(1 for n in self.seg_left if n >= 0)
             self.seg_lock = threading.Lock()
             for li, n in enumerate(self.seg_left):
                 if n == 0:          # zero-size leaf: no covering bucket,
@@ -327,6 +362,8 @@ class _Round:
 
     def _segment_done(self, li: int) -> None:
         with self.seg_lock:
+            if self.seg_left[li] < 0:    # sharded: non-streamed leaf
+                return                   # (completes via param fetch)
             self.seg_left[li] -= 1
             done = self.seg_left[li] == 0
         if done:
@@ -351,14 +388,75 @@ class _Round:
 
     def _push_task(self, idx: int) -> None:
         pskey, _ = self.keyed[idx]
+        skip = idx in self.skip_buckets
         try:
             buf = self.push_one(idx)
         except BaseException as e:   # noqa: BLE001 — relayed to consumers
             self.bucket_state[idx] = "failed"
             self.ex._release_key(pskey)
-            self._pull_finished(e)
+            if skip:
+                self._skip_finished(e)
+            else:
+                self._pull_finished(e)
             return
-        self.ex._enqueue_pull(self, idx, buf)
+        if not skip:
+            self.ex._enqueue_pull(self, idx, buf)
+            return
+        # sharded round, non-owned bucket: no pull — the admission key
+        # stays held until the owner's param frames for every group this
+        # bucket covers have landed (release_skipped). If the release
+        # raced ahead of this push's bookkeeping, complete it inline.
+        with self._skip_lock:
+            self.bucket_state[idx] = "await_param"
+            fire = idx in self._skip_release_pending
+            if fire:
+                self._skip_release_pending.discard(idx)
+        if fire:
+            self._finish_skip_release(idx)
+
+    def release_skipped(self, idx: int) -> None:
+        """Param frames for every group bucket ``idx`` covers have
+        landed (sharded update): release the bucket's admission key so
+        the next round's push can go, and COMMIT the compression
+        plane's pending EF residual — the frame's arrival proves the
+        owner consumed this round's merge, the same signal a pull gives
+        the unsharded path."""
+        if idx not in self.skip_buckets:
+            raise ValueError(f"bucket {idx} is not a skipped bucket of "
+                             f"this round")
+        with self._skip_lock:
+            if self.bucket_state[idx] == "param_done":
+                return
+            if self.bucket_state[idx] != "await_param":
+                # the owner published before OUR push bookkeeping
+                # finished (its publish only needs the push to have
+                # REACHED the server): defer to the push task
+                self._skip_release_pending.add(idx)
+                return
+        self._finish_skip_release(idx)
+
+    def _finish_skip_release(self, idx: int) -> None:
+        ex = self.ex
+        pskey, _ = self.keyed[idx]
+        plane = ex._cplane
+        if plane is not None and plane.active(pskey):
+            plane.commit(pskey, self.rounds[idx])
+        self.bucket_state[idx] = "param_done"
+        ex._mark_progress()
+        ex._release_key(pskey)
+        self._skip_finished(None)
+
+    def _skip_finished(self, exc: Optional[BaseException]) -> None:
+        if exc is not None:
+            if self._pull_err is None:
+                self._pull_err = exc
+            if self.readyq is not None:
+                self.readyq.put(exc)
+        with self._pull_lock:
+            self._skips_left -= 1
+            done = self._pulls_left <= 0 and self._skips_left <= 0
+        if done:
+            self._mark_finished()
 
     def _pull_finished(self, exc: Optional[BaseException]) -> None:
         """Bucket-terminal accounting (pull done, or push/pull failed):
@@ -371,9 +469,11 @@ class _Round:
                 self.readyq.put(exc)
         with self._pull_lock:
             self._pulls_left -= 1
-            done = self._pulls_left <= 0
-        if done:
+            grads_done = self._pulls_left <= 0
+            all_done = grads_done and self._skips_left <= 0
+        if all_done:
             self._mark_finished()
+        if grads_done:
             self._pull_done.set()
 
     def _mark_finished(self) -> None:
@@ -410,7 +510,7 @@ class _Round:
 
     def ready_iter(self):
         yielded = 0
-        n = len(self.out)
+        n = getattr(self, "_stream_n", len(self.out))
         while yielded < n:
             item = self.readyq.get()
             if isinstance(item, BaseException):
@@ -523,6 +623,9 @@ class PSGradientExchange:
                                if pipeline_depth is None else pipeline_depth)
         self.timeline = None            # set by GlobalState when tracing
         self._plans: Dict = {}
+        # pskey -> per-layer ps/pull_bytes/<decl>.<bucket> counter,
+        # registered at plan time (see _plan)
+        self._pull_layer: Dict[int, object] = {}
         self._key_rounds: Dict[int, int] = {}
         self._key_rounds_lock = threading.Lock()
         self._push_ex: Optional[ThreadPoolExecutor] = None
@@ -621,7 +724,11 @@ class PSGradientExchange:
             for ref in self._live_rounds:
                 r = ref()
                 if r is not None and not r._finished:
-                    n += max(0, r._pulls_left)
+                    # sharded rounds: buckets awaiting the owner's param
+                    # publish are in flight too (their admission keys
+                    # are held) — the watchdog must see a dead owner's
+                    # wedge, not an idle exchange
+                    n += max(0, r._pulls_left) + max(0, r._skips_left)
         return n
 
     def progress_state(self):
@@ -640,15 +747,26 @@ class PSGradientExchange:
         for r in live:
             if r is None or r._finished:
                 continue
+            buckets = []
+            for i, (pskey, _) in enumerate(r.keyed):
+                b = {"pskey": pskey, "round": r.rounds[i],
+                     "state": r.bucket_state[i]}
+                if r.sharded is not None and i in r.skip_buckets:
+                    # param-publish state (sharded update): name EVERY
+                    # owner replica a frame must come from (boundary
+                    # buckets can wait on two), so a dead-owner wedge
+                    # is attributable from the dump
+                    owners = r.sharded.skip_owner.get(i, ())
+                    b["owner"] = (owners[0] if len(owners) == 1
+                                  else list(owners))
+                buckets.append(b)
             rounds.append({
                 "name": r.decl_name,
                 "step": r.step_tag,
                 "seq": r.round_seq,
                 "pulls_left": r._pulls_left,
-                "buckets": [
-                    {"pskey": pskey, "round": r.rounds[i],
-                     "state": r.bucket_state[i]}
-                    for i, (pskey, _) in enumerate(r.keyed)],
+                "skips_left": r._skips_left,
+                "buckets": buckets,
             })
         with self._key_lock:
             adm = {"busy": sorted(self._key_busy),
@@ -712,6 +830,15 @@ class PSGradientExchange:
                     #             takes precedence over the fused plane
                 self._cplane.register(pskey, b.size, b.dtype,
                                       layer=f"{decl_name}.{b.index}")
+        # per-layer pull-byte counters, dynamically registered at plan
+        # time exactly like the compress plane's ps/push_bytes/<layer>
+        # — the 1/dp pull reduction of the sharded update is directly
+        # observable per layer, and the compress controller can later
+        # read pull-side pressure from the same names
+        for pskey, b in keyed:
+            if pskey not in self._pull_layer:
+                self._pull_layer[pskey] = get_registry().counter(
+                    f"ps/pull_bytes/{decl_name}.{b.index}")
         if hasattr(self.backend, "set_send_priority"):
             # two-class wire scheduler (server/sched.py): gradient
             # frames carry reverse-FIRST-USE priority — the bucket
@@ -943,11 +1070,17 @@ class PSGradientExchange:
                      if epoch is not None
                      else self.backend.push(pskey, buf))
 
+    def _pull_layer_inc(self, pskey: int, n: int) -> None:
+        m = self._pull_layer.get(pskey)
+        if m is not None:
+            m.inc(n)
+
     def _pull_bucket(self, pskey, b, buf, rnd_num, rnd=None, idx=None):
         chain = self._chains.get(pskey)
         if chain is not None:
             payload = self.backend.pull_bytes(pskey, round=rnd_num)
             self._m_pull_bytes.inc(len(payload))
+            self._pull_layer_inc(pskey, len(payload))
             return chain.decompress(payload).astype(b.dtype)
         plane = self._cplane
         if plane is not None and plane.active(pskey):
@@ -967,6 +1100,7 @@ class PSGradientExchange:
                                            level, round=rnd_num,
                                            div=div))
                 self._m_pull_bytes.inc(len(payload))
+                self._pull_layer_inc(pskey, len(payload))
                 # PS_DECOMPRESS on the pull → H2D path feeding the
                 # chunked apply; commits the round's EF residual.
                 # (level > 0 implies a live rnd, as in _push_bucket.)
@@ -981,6 +1115,7 @@ class PSGradientExchange:
                      if epoch is not None
                      else self.backend.pull(pskey, buf, round=rnd_num))
         self._m_pull_bytes.inc(buf.nbytes)
+        self._pull_layer_inc(pskey, buf.nbytes)
         if plane is not None:
             # dense round of a plane-managed key: still commit (a
             # residual flush pinned to this round clears on its pull)
@@ -1009,18 +1144,23 @@ class PSGradientExchange:
         core_loops.cc:538-618)."""
         return self._exchange_impl(tree, name, detach=True)
 
-    def exchange_stream(self, tree, name: Optional[str] = None):
+    def exchange_stream(self, tree, name: Optional[str] = None,
+                        sharded=None):
         """Streaming sync round: returns a ``_StreamingExchange`` whose
         ``ready()`` iterator yields each leaf the moment its last
         covering bucket's pull unpacks. This makes leaf completion
         first-class: the trainer overlaps H2D upload and the chunked
         optimizer apply with still-in-flight pulls of later buckets —
         the step-tail analogue of the reference's free-running pull loop
-        feeding the framework as partitions land (operations.cc:140-180)."""
-        return self._exchange_impl(tree, name, detach=True, stream=True)
+        feeding the framework as partitions land (operations.cc:140-180).
+
+        ``sharded``: a ``sharded_update`` round view — push every
+        bucket, pull only the owned ones, stream only owned leaves."""
+        return self._exchange_impl(tree, name, detach=True, stream=True,
+                                   sharded=sharded)
 
     def exchange_ingest(self, template, name: Optional[str] = None,
-                        step: Optional[int] = None):
+                        step: Optional[int] = None, sharded=None):
         """Incremental-ingest sync round — the step-HEAD mirror of
         ``exchange_stream``. ``template`` is any tree with the grads'
         structure/shapes/dtypes (typically the param tree; no values
@@ -1037,7 +1177,7 @@ class PSGradientExchange:
         self._ensure_watchdog()
         return _IngestExchange(_Round(self, template, name,
                                       stream=True, ingest=True,
-                                      step=step))
+                                      step=step, sharded=sharded))
 
     def _ensure_executors(self) -> None:
         # Creation is locked: the multi-channel torch dispatcher reaches
@@ -1052,9 +1192,9 @@ class PSGradientExchange:
                     width, thread_name_prefix="bps-ps-pull")
 
     def _exchange_impl(self, tree, name: Optional[str], detach: bool,
-                       stream: bool = False):
+                       stream: bool = False, sharded=None):
         self._ensure_watchdog()
-        rnd = _Round(self, tree, name, stream=stream)
+        rnd = _Round(self, tree, name, stream=stream, sharded=sharded)
         for l in rnd.sources:            # start ALL D2H copies first so the
             if hasattr(l, "copy_to_host_async"):   # transfers overlap instead
                 l.copy_to_host_async()             # of serializing per leaf
@@ -1073,7 +1213,7 @@ class PSGradientExchange:
         for i in range(len(rnd.keyed)):
             rnd.submit_bucket(i)
         if stream:
-            return _StreamingExchange(len(rnd.out), rnd.readyq, rnd.drain)
+            return _StreamingExchange(rnd)
         if not detach:
             return rnd.drain()
         return _PendingExchange(rnd.drain)

@@ -136,6 +136,21 @@ OP_PUSH_F, OP_PULL_F = 17, 18
 # ``sched.CLASS_ACT`` so they overtake queued gradient bursts in the
 # send scheduler (BPS_SCHEDULING_CREDIT).
 OP_ACT_PUSH, OP_ACT_PULL = 19, 20
+# Sharded weight update (byteps_tpu.sharded_update): the group OWNER
+# publishes post-apply parameter bytes, non-owners fetch them instead
+# of gradients. A versioned last-wins mailbox like the act store, but
+# NON-destructive reads (dp-1 replicas read each frame) with bounded
+# retention (the two-round cross-step window + slack).
+#   OP_PARAM_PUT: key = param-class key (bit 41 | decl<<16 | group),
+#     ``round`` = the sharded step seq; payload = the group's
+#     concatenated leaf bytes. Idempotent last-wins per (key, seq).
+#     PUT frames ride the wire scheduler's LATENCY class with
+#     next-step first-use priority — they gate the next forward like
+#     activations do.
+#   OP_PARAM_GET: ``round`` = seq; blocks server-side (sliced, like
+#     OP_PULL) until the frame arrives; response = payload. A timeout
+#     is the owner-death diagnostic's trigger, never a silent hang.
+OP_PARAM_PUT, OP_PARAM_GET = 21, 22
 _PART = struct.Struct("!IIHHQ")  # offset, part_len, part_idx, nparts, nonce
 ST_OK, ST_ERR, ST_TIMEOUT, ST_GONE = 0, 1, 2, 3
 
@@ -343,7 +358,8 @@ _REUSE_SAFE_OPS = frozenset(
      OP_REPL_PUT,    # ReplicaStore.put copies via bytes() synchronously
      OP_PUSH_F,      # wire.decode materializes (or the engine copies
                      # the dense view) before the handler returns
-     OP_ACT_PUSH})   # ActStore.put copies via bytes() synchronously
+     OP_ACT_PUSH,    # ActStore.put copies via bytes() synchronously
+     OP_PARAM_PUT})  # ParamStore.put copies via bytes() synchronously
 
 
 def _recv_req(sock: socket.socket, rholder: Optional[list] = None):
@@ -475,6 +491,8 @@ class PSTransportServer:
         # likewise lazy; plain PS deployments never allocate it
         self._acts = None
         self._acts_lock = threading.Lock()
+        # param mailbox (sharded weight update, OP_PARAM_*) — lazy too
+        self._params = None
         self._shm = _ShmCache()
         # fused-plane pull cache (OP_PULL_F): one encoded payload per
         # (key, round, codec), throughput-only — the codecs are
@@ -740,6 +758,16 @@ class PSTransportServer:
                 part = st["data"][off:off + plen_]
                 conn.sendall(_RSP.pack(ST_OK, len(part)))
                 conn.sendall(part)
+            elif op == OP_PARAM_PUT:
+                self.param_store().put(key, int(rnd),
+                                       bytes(payload or b""))
+                conn.sendall(_RSP.pack(ST_OK, 0))
+            elif op == OP_PARAM_GET:
+                data = self.param_store().get(
+                    key, int(rnd), timeout_ms=int(timeout) or 30000)
+                conn.sendall(_RSP.pack(ST_OK, len(data)))
+                if data:
+                    conn.sendall(data)
             elif op == OP_ACT_PUSH:
                 self.act_store().put(key, int(rnd),
                                      bytes(payload or b""))
@@ -810,6 +838,17 @@ class PSTransportServer:
                     from ..pipeline.exchange import ActStore
                     self._acts = ActStore()
         return self._acts
+
+    def param_store(self):
+        """This server's param mailbox (sharded weight update,
+        OP_PARAM_*) — lazy like the act store, so plain deployments
+        never allocate it."""
+        if self._params is None:
+            with self._acts_lock:
+                if self._params is None:
+                    from ..sharded_update import ParamStore
+                    self._params = ParamStore()
+        return self._params
 
     def _pull_dense(self, key, rnd, nbytes, dtype, timeout) -> np.ndarray:
         """Round-blocked engine pull in WIRE dtype — the one transcode
@@ -1340,6 +1379,14 @@ class RemotePSBackend:
                 if op == OP_ACT_PUSH:
                     ticket = scheduler.acquire(_sched.CLASS_ACT, 0, key,
                                                plen)
+                elif op == OP_PARAM_PUT:
+                    # sharded-update param frames are the latency class
+                    # too — they gate the next step's forward — with
+                    # next-step first-use priority among themselves
+                    # (set_send_priority at sharded-plan time)
+                    ticket = scheduler.acquire(
+                        _sched.CLASS_ACT, self._send_prio.get(key, 0),
+                        key, plen)
                 elif op in self._SCHED_GRAD_OPS:
                     ticket = scheduler.acquire(
                         _sched.CLASS_GRAD, self._send_prio.get(key, 0),
@@ -1805,6 +1852,27 @@ class RemotePSBackend:
             lambda slice_ms: self._rpc(OP_ACT_PULL, key, int(seq), 0,
                                        slice_ms, "uint8", None),
             timeout_ms, f"act_pull({key:#x}) seq={seq}")
+
+    # Sharded-update param plane (byteps_tpu.sharded_update): the group
+    # owner's post-apply param bytes into the server's param mailbox;
+    # non-owners block-fetch them instead of pulling gradients.
+
+    def param_put(self, key: int, seq: int, payload) -> None:
+        """Publish one param frame; idempotent last-wins per (key, seq)
+        so the transport's resend path re-stores identical bytes."""
+        self._rpc(OP_PARAM_PUT, key, int(seq), 0, 0, "uint8",
+                  memoryview(bytes(payload)))
+
+    def param_get(self, key: int, seq: int,
+                  timeout_ms: int = 30000) -> bytes:
+        """Blocking NON-destructive fetch of the (key, seq) param frame
+        (dp-1 replicas read each frame). A timeout here is the
+        owner-death signal the sharded tail turns into its loud per-key
+        diagnostic."""
+        return self._sliced_pull(
+            lambda slice_ms: self._rpc(OP_PARAM_GET, key, int(seq), 0,
+                                       slice_ms, "uint8", None),
+            timeout_ms, f"param_get({key:#x}) seq={seq}")
 
     def push_rowsparse(self, key: int, idx, rows, dense_nbytes: int,
                       dtype=None) -> None:

@@ -1,0 +1,687 @@
+"""ZeRO-style cross-replica sharded weight update on the PS path.
+
+Every sync-PS worker used to pull EVERY summed gradient and run the
+full optimizer step — pull bytes, apply FLOPs, and optimizer-state
+memory all O(model) per replica regardless of the data-parallel
+degree. That redundancy is exactly what arXiv 2004.13336 ("Automatic
+Cross-Replica Sharding of Weight Update in Data-Parallel Training")
+eliminates and what ZeRO (arXiv 1910.02054) targets for memory. This
+module brings the same split to the PS pipeline (``BPS_SHARDED_UPDATE=1``):
+
+  - the exchange's bucket groups (``PSGradientExchange.leaf_groups``)
+    are partitioned across the ``dp`` replicas by BYTE-BALANCED
+    ownership, with the server plane's ``HashRing`` successor walk as
+    the deterministic tie-break — every worker computes the identical
+    assignment from the shared bucket plan, no coordination round;
+  - every worker still PUSHES every gradient bucket (the server sum
+    needs all contributions) but PULLS only the buckets covering its
+    owned groups (~1/dp of the grad bytes) and runs
+    ``ChunkedApply.apply_group`` only on those groups — optimizer
+    state is allocated for owned leaves only (the ZeRO memory win);
+  - the owner then PUBLISHES the updated parameter bytes back through
+    the PS store (``OP_PARAM_PUT``/``OP_PARAM_GET`` — a versioned
+    last-wins mailbox, one frame per (group, step)), and non-owners
+    fetch params instead of gradients. Param frames ride the two-class
+    wire scheduler in the LATENCY class with next-step first-use
+    priority, so a small input-side param frame overtakes a queued
+    gradient burst exactly like an activation does.
+
+Cross-step composition: a FETCHED param marks the same per-leaf epoch
+(``ChunkedApply.mark_epoch``) an applied one does, so ``BPS_CROSS_STEP``
+gating, the staged head, and the per-key admission gate work unchanged.
+The admission gate's release for a non-pulled bucket moves from "my
+pull landed" to "the param frames of every group this bucket covers
+landed" — which implies the owner pulled the bucket's round, so the
+server's single-published-round invariant still holds with two rounds
+in flight.
+
+EF composition: compress-plane keys keep error-feedback semantics by
+committing a round's pending residual on the signal that the round
+completed — the owner commits on its grad pull (unchanged), a
+non-owner commits when the round's param frames land (the moment it
+KNOWS the merge was consumed). A round that dies in between never
+commits, exactly like the unsharded contract.
+
+Failure contract: an owner dying between its grad pull and its param
+publish must never become a silent hang of non-owners blocked in
+``wait_epoch``. The param fetch carries a timeout
+(``BPS_PARAM_TIMEOUT_MS``) and raises a loud per-key diagnostic naming
+the group, owner rank, step, and param key; until then the watchdog's
+``debug_state`` shows the skipped buckets as ``await_param`` with the
+owner rank, so a wedge is attributable from the dump alone.
+
+Probe-or-fallback: dp=1, async mode, non-leafwise-decomposable
+optimizers, legacy ``compressor_type`` keys, and backends without the
+param mailbox all fall back to the full apply (one INFO line names the
+reason). ``docs/sharded-update.md`` has the ownership contract, the
+param-publish state machine, and the failure matrix.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .obs.metrics import get_registry, observe_stage
+
+#: param-class key space: bit 41 set on ``decl_key<<16 | group_index``.
+#: Disjoint from gradient keys (decl<<16|bucket, < 2^40), activation
+#: channels (bit 40), and striping sub-keys (bits 48+; param keys are
+#: >= 2^40 so the transport never re-stripes them).
+PARAM_KEY_BASE = 1 << 41
+
+#: bounded mailbox retention (seqs per key): two rounds in flight
+#: (cross-step) + slack for a straggling fetcher's retry.
+PARAM_RETAIN = 4
+
+
+def param_timeout_ms() -> int:
+    """How long a non-owner waits for an owner's param frame before
+    raising the loud owner-death diagnostic."""
+    return int(os.environ.get("BPS_PARAM_TIMEOUT_MS", "30000") or 30000)
+
+
+class ParamStore:
+    """Server-side param mailbox: ``put`` is last-wins per (key, seq)
+    — a resend after a lost ACK re-stores identical bytes — and ``get``
+    blocks until the seq arrives WITHOUT consuming it (dp-1 non-owners
+    read each frame). Entries are pruned ``retain`` seqs behind the
+    newest put per key, bounding memory to the in-flight window."""
+
+    def __init__(self, retain: int = PARAM_RETAIN) -> None:
+        self.retain = int(retain)
+        self._cv = threading.Condition()
+        self._data: Dict[int, Dict[int, bytes]] = {}
+
+    def put(self, key: int, seq: int, payload: bytes) -> None:
+        key, seq = int(key), int(seq)
+        with self._cv:
+            d = self._data.setdefault(key, {})
+            d[seq] = bytes(payload)
+            for s in [s for s in d if s <= seq - self.retain]:
+                del d[s]
+            self._cv.notify_all()
+
+    def get(self, key: int, seq: int, timeout_ms: int = 30000) -> bytes:
+        key, seq = int(key), int(seq)
+        deadline = time.monotonic() + timeout_ms / 1e3
+        with self._cv:
+            while True:
+                d = self._data.get(key)
+                if d is not None and seq in d:
+                    return d[seq]
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"param get(key={key:#x}, seq={seq}) timed out "
+                        f"after {timeout_ms}ms — owner never published")
+                self._cv.wait(min(left, 0.5))
+
+    def pending(self) -> List[Tuple[int, int]]:
+        """(key, newest stored seq) per channel — debug visibility."""
+        with self._cv:
+            return [(k, max(d)) for k, d in self._data.items() if d]
+
+
+class _RoundView:
+    """What ``ps_mode._Round`` needs to run a sharded round: which
+    buckets to pull, which leaves stream on the grad readyq, and the
+    owner rank per skipped bucket (for the watchdog's diagnostic)."""
+
+    __slots__ = ("pull_buckets", "stream_leaves", "skip_owner")
+
+    def __init__(self, pull_buckets, stream_leaves, skip_owner) -> None:
+        self.pull_buckets = frozenset(pull_buckets)
+        self.stream_leaves = frozenset(stream_leaves)
+        self.skip_owner = dict(skip_owner)
+
+
+class ShardedUpdatePlan:
+    """Deterministic byte-balanced ownership of the exchange's bucket
+    groups across ``world`` data-parallel replicas.
+
+    Assignment reuses the server plane's placement machinery: each
+    group's candidate order is the ``HashRing`` successor walk from its
+    defining bucket's PS key, and the group goes to the LIGHTEST
+    candidate by already-assigned bytes (walk order breaks ties) — the
+    exact ``PlacementService.place`` rule, applied to replicas instead
+    of server shards. Deterministic given the shared bucket plan, which
+    the exchange's declaration-order contract already guarantees.
+    """
+
+    def __init__(self, keyed, groups, leaf_meta, rank: int, world: int,
+                 vnodes: int = 0) -> None:
+        from .server.plane.placement import DEFAULT_VNODES, HashRing
+        if world <= 1:
+            raise ValueError("sharded update needs dp > 1")
+        if not 0 <= rank < world:
+            raise ValueError(f"shard rank {rank} outside [0, {world})")
+        self.rank, self.world = int(rank), int(world)
+        self.groups = [tuple(g) for g in groups]
+        # leaf_meta: per flat leaf (shape, dtype, nbytes)
+        self.leaf_meta = list(leaf_meta)
+        leaf_group: Dict[int, int] = {}
+        for gi, g in enumerate(self.groups):
+            for li in g:
+                leaf_group[li] = gi
+        # buckets each group's leaves touch: the owner must pull every
+        # one of them (a leaf larger than partition_bytes spans buckets)
+        needed: List[set] = [set() for _ in self.groups]
+        for bi, (_, b) in enumerate(keyed):
+            for s in b.segments:
+                gi = leaf_group.get(s.leaf_index)
+                if gi is not None:
+                    needed[gi].add(bi)
+        self.needed = [frozenset(n) for n in needed]
+        self.group_bytes = [sum(self.leaf_meta[li][2] for li in g)
+                            for g in self.groups]
+        # defining bucket = the LAST bucket covering the group (the one
+        # whose pull completes it); groups of only zero-size leaves
+        # have no bucket and key off their index
+        self.group_bucket = [max(n) if n else None for n in needed]
+        ring = HashRing(world, vnodes=vnodes or DEFAULT_VNODES)
+        load = [0] * world
+        owner: List[int] = []
+        for gi in range(len(self.groups)):
+            bi = self.group_bucket[gi]
+            ring_key = keyed[bi][0] if bi is not None else gi
+            cands = ring.successors(ring_key, world)
+            r = min(cands, key=lambda c: load[c])   # first-wins tie-break
+            owner.append(r)
+            load[r] += self.group_bytes[gi]
+        self.owner = owner
+        self.load = load
+        self.owned = tuple(gi for gi, o in enumerate(owner) if o == rank)
+        self.owned_set = frozenset(self.owned)
+        self.stream_leaves = frozenset(
+            li for gi in self.owned for li in self.groups[gi])
+        self.pull_buckets = frozenset(
+            bi for gi in self.owned for bi in needed[gi])
+        all_buckets = frozenset(range(len(keyed)))
+        covered = frozenset(bi for n in needed for bi in n)
+        # every bucket's leaves belong to some group, so every bucket
+        # is either pulled here or released by param fetches
+        assert covered == all_buckets, (covered, all_buckets)
+        # skipped bucket -> the (all non-owned) groups whose param
+        # frames release it, and EVERY owner to name in diagnostics (a
+        # boundary bucket shared by two groups can wait on two distinct
+        # owners — blaming only the first could point at a live replica
+        # while the other one is the dead publisher)
+        self.skip_groups: Dict[int, Tuple[int, ...]] = {}
+        self.skip_owner: Dict[int, Tuple[int, ...]] = {}
+        for bi in sorted(all_buckets - self.pull_buckets):
+            gs = tuple(gi for gi in range(len(self.groups))
+                       if bi in needed[gi])
+            self.skip_groups[bi] = gs
+            self.skip_owner[bi] = tuple(sorted({owner[gi] for gi in gs}))
+        # fetch non-owned groups in next-step FIRST-USE order (min leaf
+        # ascending — the same priority the pull heap and the staged
+        # forward gates use), so the input-side params land first
+        self.fetch_order = tuple(sorted(
+            (gi for gi in range(len(self.groups)) if owner[gi] != rank),
+            key=lambda gi: min(self.groups[gi], default=0)))
+        decl_key = (keyed[0][0] >> 16) if keyed else 0
+        self.param_keys = {
+            gi: PARAM_KEY_BASE | (decl_key << 16) | gi
+            for gi in range(len(self.groups))}
+
+    def round_view(self) -> _RoundView:
+        return _RoundView(self.pull_buckets, self.stream_leaves,
+                          self.skip_owner)
+
+    def balance_ratio(self) -> float:
+        """max/min owned bytes across replicas (1.0 = perfectly even);
+        the largest single group bounds the imbalance."""
+        lo = min(self.load)
+        return float(max(self.load)) / float(lo) if lo else float("inf")
+
+    # ------------------------------------------------------ param frames
+
+    def pack_group(self, gi: int, host_leaves: Sequence[np.ndarray]
+                   ) -> bytes:
+        """Concatenate a group's updated param bytes in group order.
+        The split recipe is derived from the shared bucket plan on both
+        sides — a size mismatch means the peers run different programs
+        and is raised loudly at unpack."""
+        parts = []
+        for li, arr in zip(self.groups[gi], host_leaves):
+            shape, dtype, nbytes = self.leaf_meta[li]
+            a = np.ascontiguousarray(arr)
+            if a.nbytes != nbytes or np.dtype(a.dtype) != np.dtype(dtype):
+                raise ValueError(
+                    f"param publish of leaf {li}: got {a.nbytes}B "
+                    f"{a.dtype}, plan expects {nbytes}B {dtype}")
+            parts.append(a.tobytes())
+        return b"".join(parts)
+
+    def unpack_group(self, gi: int, payload: bytes) -> List[np.ndarray]:
+        want = sum(self.leaf_meta[li][2] for li in self.groups[gi])
+        if len(payload) != want:
+            raise ValueError(
+                f"param frame for group {gi} is {len(payload)}B, plan "
+                f"expects {want}B — peers are running different bucket "
+                f"plans")
+        out, off = [], 0
+        for li in self.groups[gi]:
+            shape, dtype, nbytes = self.leaf_meta[li]
+            n = nbytes // max(1, np.dtype(dtype).itemsize)
+            a = np.frombuffer(payload, dtype=dtype, count=n, offset=off)
+            out.append(a.reshape(shape))
+            off += nbytes
+        return out
+
+    @staticmethod
+    def leaf_meta_of(tree) -> List[Tuple[tuple, str, int]]:
+        import jax
+        metas = []
+        for l in jax.tree_util.tree_leaves(tree):
+            shape = tuple(getattr(l, "shape", ()))
+            dtype = str(np.dtype(l.dtype))
+            nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+            metas.append((shape, dtype, nbytes))
+        return metas
+
+
+def _fallback(reason: str) -> None:
+    from .common.logging import get_logger
+    get_logger().info("BPS_SHARDED_UPDATE falls back to the full "
+                      "weight update: %s", reason)
+
+
+def build_sharded_state(exchange, params, tx, name: str,
+                        rank: int, world: int,
+                        timeline=None) -> Optional["ShardedUpdateState"]:
+    """Probe-or-fallback construction (called by the trainer once the
+    exchange exists). Returns None — with one INFO line naming the
+    reason — whenever the sharded contract cannot hold."""
+    import jax
+    if world <= 1:
+        _fallback("dp=1 (nothing to shard across)")
+        return None
+    backend = exchange.backend
+    if not hasattr(backend, "param_put") or not hasattr(backend,
+                                                       "param_get"):
+        _fallback(f"backend {type(backend).__name__} has no param "
+                  f"mailbox (param_put/param_get)")
+        return None
+    if getattr(backend, "async_mode", False):
+        _fallback("async PS mode (round-less pulls leave no ownership "
+                  "anchor)")
+        return None
+    decl_name, _, keyed = exchange._plan(params, name)
+    if any(pskey in exchange._chains for pskey, _ in keyed):
+        _fallback("legacy compressor_type keys on this declaration "
+                  "(their byte-path pulls carry codec state per worker)")
+        return None
+    groups = exchange.leaf_groups(params, name=name)
+    if len(groups) < 2:
+        _fallback(f"{len(groups)} bucket group(s) — nothing to partition")
+        return None
+    leaves = jax.tree_util.tree_leaves(params)
+    from .optim import leafwise_decomposable
+    if not leafwise_decomposable(tx, leaves, [tuple(g) for g in groups]):
+        _fallback("optimizer is not leafwise-decomposable (owned-shard "
+                  "apply would change the math)")
+        return None
+    plan = ShardedUpdatePlan(keyed, groups,
+                             ShardedUpdatePlan.leaf_meta_of(params),
+                             rank, world)
+    return ShardedUpdateState(exchange, plan, decl_name,
+                              timeline=timeline)
+
+
+class ShardedUpdateState:
+    """Per-trainer sharded-update machinery: the ownership plan, the
+    monotonic param-frame seq counter (all replicas step in lockstep,
+    so equal seq = same step), and the publisher thread that ships
+    owned groups' updated params without blocking the apply loop."""
+
+    def __init__(self, exchange, plan: ShardedUpdatePlan, name: str,
+                 timeline=None) -> None:
+        self.exchange = exchange
+        self.plan = plan
+        self.name = name
+        self.timeline = timeline
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self.timeout_ms = param_timeout_ms()
+        reg = get_registry()
+        self._m_put = reg.counter("ps/param_put_bytes")
+        self._m_fetch = reg.counter("ps/param_fetch_bytes")
+        self._pub_q: "List" = []
+        self._pub_cv = threading.Condition()
+        self._pub_stop = False
+        self._pub_err: Optional[BaseException] = None
+        self._pub_thread: Optional[threading.Thread] = None
+        # param frames are the LATENCY class on the wire scheduler —
+        # they gate the next step's forward exactly like activations —
+        # with next-step first-use priority among themselves
+        be = exchange.backend
+        if hasattr(be, "set_send_priority"):
+            nleaves = len(plan.leaf_meta)
+            for gi, key in plan.param_keys.items():
+                first = min(plan.groups[gi], default=0)
+                be.set_send_priority(key, nleaves - first)
+
+    # ------------------------------------------------------------ admin
+
+    def next_seq(self) -> int:
+        """Seq for the NEXT sharded round — called once per step at
+        tail launch, in step order, on every replica identically."""
+        with self._seq_lock:
+            self._seq += 1
+            return self._seq
+
+    def check_publisher(self) -> None:
+        """Raise if the background publisher died — called at the
+        trainer's sync points (drain, close) so a final-step publish
+        failure can never exit as silent success while the non-owners
+        blame a 'dead' owner that actually ran to completion."""
+        with self._pub_cv:
+            err = self._pub_err
+        if err is not None:
+            raise RuntimeError(
+                "sharded-update param publisher died — some owned "
+                "groups' param frames never reached the store; "
+                "non-owners of those groups will time out"
+            ) from err
+
+    def close(self) -> None:
+        with self._pub_cv:
+            self._pub_stop = True
+            self._pub_cv.notify_all()
+        t = self._pub_thread
+        if t is not None:
+            # the publisher drains its queue before honoring stop, so
+            # a final step's frames still flush here — and a flush that
+            # does NOT finish must not read as success (the daemon
+            # thread would die with the process while peers time out)
+            t.join(timeout=5.0)
+            alive = t.is_alive()
+            self._pub_thread = None
+            if alive:
+                raise RuntimeError(
+                    "sharded-update param publisher did not flush its "
+                    "queue within 5s at close — param frames owed to "
+                    "peer replicas may never have shipped (non-owners "
+                    "of this replica's groups will time out)")
+        self.check_publisher()
+
+    # -------------------------------------------------------- publishing
+
+    def _ensure_publisher(self) -> None:
+        if self._pub_thread is None or not self._pub_thread.is_alive():
+            self._pub_thread = threading.Thread(
+                target=self._pub_run, name="bps-param-pub", daemon=True)
+            self._pub_stop = False
+            self._pub_thread.start()
+
+    def _pub_run(self) -> None:
+        while True:
+            with self._pub_cv:
+                while not self._pub_q and not self._pub_stop:
+                    self._pub_cv.wait(0.5)
+                if self._pub_stop and not self._pub_q:
+                    return
+                gi, seq, host_leaves, step_tag = self._pub_q.pop(0)
+            try:
+                t0 = time.time()
+                payload = self.plan.pack_group(gi, host_leaves)
+                self.exchange.backend.param_put(
+                    self.plan.param_keys[gi], seq, payload)
+                self._m_put.inc(len(payload))
+                observe_stage("PS_PARAM_PUT", time.time() - t0)
+                tl = self.timeline
+                if tl is not None:
+                    tl.record(self.name, "PS_PARAM_PUT", t0,
+                              time.time() - t0, gi, step=step_tag)
+                self.exchange._mark_progress()
+            except BaseException as e:   # noqa: BLE001 — surfaced to the
+                with self._pub_cv:       # next publish() caller / tail
+                    if self._pub_err is None:
+                        self._pub_err = e
+
+    def publish(self, gi: int, seq: int, host_leaves, step_tag=None
+                ) -> None:
+        """Queue group ``gi``'s post-apply param bytes for the wire.
+        ``host_leaves`` must already be host arrays — the apply loop
+        snapshots BEFORE marking the epoch, because the next step's
+        apply donates the device buffers the moment its gate opens."""
+        with self._pub_cv:
+            if self._pub_err is not None:
+                raise RuntimeError(
+                    f"param publisher died — non-owners of this "
+                    f"replica's groups will time out waiting"
+                ) from self._pub_err
+            self._pub_q.append((gi, seq, list(host_leaves), step_tag))
+            self._pub_cv.notify_all()
+        self._ensure_publisher()
+
+    # ------------------------------------------------------------- tail
+
+    def param_installer(self, rep):
+        """The non-owned install H2D (plain device_put — params carry
+        the owner's final bytes, so NO /world divide, unlike the grad
+        h2d). One shared recipe for the draining and cross tails."""
+        import jax
+
+        def put_param(li: int, arr: np.ndarray):
+            t0 = time.time()
+            d = jax.device_put(arr, rep)
+            observe_stage("PS_H2D", time.time() - t0)
+            return d
+
+        return put_param
+
+    def run_tail(self, handle, chunked, flat, e: int, seq: int,
+                 h2d_grad, put_param, h2d_ex, tl,
+                 should_abort=None, step_tag=None) -> int:
+        """Consume one sharded round end to end. Returns the number of
+        optimizer groups applied locally (the caller's partial-state
+        accounting).
+
+          - a reader thread drains the grad readyq (OWNED leaves only —
+            the round's pull mask keeps non-owned leaves off it),
+            firing H2D per leaf and heaping complete owned groups by
+            next-use priority;
+          - a fetcher thread pulls non-owned groups' param frames in
+            first-use order, installs them (epoch-ordered via
+            ``wait_epoch``), marks their epoch, and releases the
+            skipped buckets' admission keys (committing EF residuals);
+          - the calling thread pops owned groups, gates on the previous
+            epoch, applies, SNAPSHOTS the new leaves to host, enqueues
+            the publish, installs, and marks the epoch.
+        """
+        import heapq
+        rnd = handle.round_state
+        plan = self.plan
+        cv = threading.Condition()
+        ready_groups: List = []
+        futs: dict = {}
+        state = {"done": False, "exc": None}
+
+        def fail(exc: BaseException) -> None:
+            with cv:
+                if state["exc"] is None:
+                    state["exc"] = exc
+                cv.notify_all()
+
+        def aborted() -> bool:
+            return (state["exc"] is not None
+                    or (should_abort is not None and should_abort()))
+
+        def reader() -> None:
+            remaining = {gi: len(plan.groups[gi]) for gi in plan.owned}
+            try:
+                for li, arr in handle.ready():
+                    fut = h2d_ex.submit(h2d_grad, li, arr)
+                    gi = chunked.leaf_group.get(li)
+                    with cv:
+                        futs[li] = fut
+                        if gi in remaining:
+                            remaining[gi] -= 1
+                            if remaining[gi] == 0:
+                                heapq.heappush(
+                                    ready_groups,
+                                    (min(plan.groups[gi], default=0), gi))
+                                cv.notify_all()
+            except BaseException as exc:   # noqa: BLE001 — relayed
+                fail(exc)
+            finally:
+                with cv:
+                    state["done"] = True
+                    cv.notify_all()
+
+        # param fetches run in a SMALL POOL, issued in first-use order:
+        # one sequential fetcher pays a server round trip per group and
+        # lets the throttled egress pipe idle between frames, while
+        # parallel blocking gets stream back-to-back as owners publish
+        # (the server blocks each get until its frame lands, so the
+        # pool doubles as the wait)
+        skip_lock = threading.Lock()
+        skip_left = {bi: set(gs) for bi, gs in plan.skip_groups.items()}
+        fetch_iter = iter(plan.fetch_order)
+        fetch_lock = threading.Lock()
+
+        def fetch_one(gi: int) -> None:
+            key = plan.param_keys[gi]
+            t0 = time.time()
+            try:
+                payload = self.exchange.backend.param_get(
+                    key, seq, timeout_ms=self.timeout_ms)
+            except TimeoutError as te:
+                if rnd._pull_err is not None:
+                    # OUR OWN push/pull failed in this round — the
+                    # server round never completed with this worker's
+                    # contribution, so the owner could not publish.
+                    # Blame the real root cause, not a healthy owner.
+                    raise RuntimeError(
+                        f"sharded update: this replica's gradient "
+                        f"push/pull failed in the round (step {e}), so "
+                        f"the server round never completed and no "
+                        f"owner could publish group {gi}'s params"
+                    ) from rnd._pull_err
+                raise RuntimeError(
+                    f"sharded update: param frame for group "
+                    f"{gi} (key {key:#x}, step {e}, seq {seq}) "
+                    f"never arrived from owner replica "
+                    f"{plan.owner[gi]} within "
+                    f"{self.timeout_ms}ms — owner died between "
+                    f"its grad pull and its param publish? "
+                    f"Non-owners cannot apply this group; see "
+                    f"docs/sharded-update.md failure matrix"
+                ) from te
+            self._m_fetch.inc(len(payload))
+            observe_stage("PS_PARAM_GET", time.time() - t0)
+            if tl is not None:
+                tl.record(self.name, "PS_PARAM_GET", t0,
+                          time.time() - t0, gi, step=step_tag)
+            host = plan.unpack_group(gi, payload)
+            group = plan.groups[gi]
+            chunked.wait_epoch(group, e - 1, should_abort=aborted)
+            if aborted():
+                return
+            dev = [put_param(li, a) for li, a in zip(group, host)]
+            for li, leaf in zip(group, dev):
+                flat[li] = leaf
+            # mark only AFTER install (same ordering contract as the
+            # apply loop: a gate waking between mark and install would
+            # read stale step k-1 weights)
+            chunked.mark_epoch(group, e)
+            self.exchange._mark_progress()
+            with skip_lock:
+                fire = []
+                for bi, left in skip_left.items():
+                    if gi in left:
+                        left.discard(gi)
+                        if not left:
+                            fire.append(bi)
+            for bi in sorted(fire):
+                rnd.release_skipped(bi)
+
+        def fetcher() -> None:
+            try:
+                while not aborted():
+                    with fetch_lock:
+                        gi = next(fetch_iter, None)
+                    if gi is None:
+                        return
+                    fetch_one(gi)
+            except BaseException as exc:   # noqa: BLE001 — relayed
+                fail(exc)
+
+        rt = threading.Thread(target=reader, daemon=True,
+                              name=f"bps-shard-ready-{e}")
+        rt.start()
+        fts = [threading.Thread(target=fetcher, daemon=True,
+                                name=f"bps-shard-fetch-{e}-{i}")
+               for i in range(min(4, max(1, len(plan.fetch_order))))]
+        for ft in fts:
+            ft.start()
+        applied = 0
+        try:
+            while True:
+                with cv:
+                    while not ready_groups and not state["done"] \
+                            and state["exc"] is None:
+                        cv.wait()
+                    if state["exc"] is not None:
+                        raise state["exc"]
+                    if not ready_groups and state["done"]:
+                        break
+                    _, gi = heapq.heappop(ready_groups)
+                group = plan.groups[gi]
+                chunked.wait_epoch(group, e - 1, should_abort=aborted)
+                with cv:
+                    if state["exc"] is not None:
+                        raise state["exc"]
+                    gfuts = [futs.pop(i) for i in group]
+                gdev = [f.result() for f in gfuts]
+                t0 = time.time()
+                new = chunked.apply_group(gi, [flat[i] for i in group],
+                                          gdev)
+                if tl is not None:
+                    tl.record(self.name, "PS_APPLY_CHUNK", t0,
+                              time.time() - t0, gi, step=step_tag)
+                # host snapshot BEFORE install+mark: once the epoch is
+                # marked, the next step's apply may donate these buffers
+                for leaf in new:
+                    if hasattr(leaf, "copy_to_host_async"):
+                        leaf.copy_to_host_async()
+                host = [np.asarray(leaf) for leaf in new]
+                self.publish(gi, seq, host, step_tag=step_tag)
+                for i, leaf in zip(group, new):
+                    flat[i] = leaf
+                chunked.mark_epoch(group, e)
+                applied += 1
+            # the apply loop finishing does not mean the round is done:
+            # non-owned installs gate later steps too
+            for ft in fts:
+                ft.join()
+            with cv:
+                if state["exc"] is not None:
+                    raise state["exc"]
+            # a SKIPPED bucket's failed push streams no leaf and feeds
+            # no fetch on this worker's side — its error lands only in
+            # the round's _pull_err. Surface it: the server round is
+            # missing this worker's contribution and every peer is
+            # about to wedge on it.
+            if rnd._pull_err is not None:
+                raise RuntimeError(
+                    f"sharded round (step {e}) has a failed bucket "
+                    f"push/pull on this replica — the server round is "
+                    f"incomplete and peers cannot finish it"
+                ) from rnd._pull_err
+        except BaseException:
+            # wake the other threads' gates; the caller poisons the
+            # trainer (partial state) exactly like the unsharded tail
+            with cv:
+                if state["exc"] is None:
+                    state["exc"] = RuntimeError("sharded tail aborted")
+                cv.notify_all()
+            raise
+        return applied

@@ -117,7 +117,8 @@ class DistributedTrainer:
                  reducer: Reducer = psum_reducer,
                  compression: Optional[dict] = None,
                  min_compress_bytes: Optional[int] = None,
-                 donate: bool = True, name: Optional[str] = None) -> None:
+                 donate: bool = True, name: Optional[str] = None,
+                 shard_rank: Optional[int] = None) -> None:
         if mesh is None:
             # a MirroredStrategy scope takes precedence over the global mesh
             from .strategy import current_strategy
@@ -301,6 +302,32 @@ class DistributedTrainer:
             self._grad_fn, self._apply_fn = self._build_ps_step(donate)
             self._accum = None
             self.step_count = 0
+            # ZeRO-style sharded weight update (BPS_SHARDED_UPDATE,
+            # byteps_tpu.sharded_update): partition the bucket groups
+            # across the dp replicas — pull/apply only the owned shard
+            # (optimizer state allocated for it alone), publish the
+            # updated params, fetch the rest. Probe-or-fallback. Built
+            # at the FIRST step (not here): tests and the bench swap
+            # the exchange's backend right after construction, and the
+            # probe's plan/init_key must land on the final backend —
+            # but before the first round is created, so even step 1
+            # restricts its pulls.
+            self._sharded = None
+            self._sharded_epoch = 0
+            cfg = gs.config
+            self._sharded_cfg = None
+            if cfg.sharded_update and self._apply_chunked \
+                    and backward_passes_per_step == 1:
+                world = cfg.shard_world or self._ps_world
+                rank = (shard_rank if shard_rank is not None
+                        else (cfg.shard_rank if cfg.shard_rank >= 0
+                              else cfg.worker_id))
+                self._sharded_cfg = (rank, world)
+            elif cfg.sharded_update:
+                from .sharded_update import _fallback
+                _fallback("BPS_APPLY_CHUNKED=0 or "
+                          "backward_passes_per_step>1 (the sharded "
+                          "tail is the chunked tail)")
             return
         # Size-1 data axes reduce to identity psums; dropping them skips the
         # whole bucket pack/unpack (pure HBM overhead on a single chip).
@@ -430,8 +457,54 @@ class DistributedTrainer:
         out, self._accum = self._accum, None
         return out
 
+    def _next_shard_epoch(self) -> int:
+        """One shared, monotonic epoch counter for the sharded tail's
+        ``mark_epoch``/``wait_epoch`` bookkeeping, whichever path runs
+        the step — a draining step amid cross steps must not mark an
+        epoch below what the cross tails already published."""
+        d = getattr(self, "_cross_driver", None)
+        base = max(self._sharded_epoch,
+                   d._epoch if d is not None else 0)
+        self._sharded_epoch = base + 1
+        return self._sharded_epoch
+
+    def _sharded_active(self):
+        """The live ShardedUpdateState, or None — re-checked at every
+        round creation so a disable (externally restored opt_state, a
+        failed probe) can never leave a round with restricted pulls and
+        an unsharded tail."""
+        st = getattr(self, "_sharded", None)
+        if st is None:
+            return None
+        if (self._chunked is None
+                and self._opt_state_at_init is not None
+                and self.opt_state is not self._opt_state_at_init):
+            # opt_state was replaced before the first step: the tail
+            # will keep the fused apply (see _ensure_streamed_tail) —
+            # owned-shard state cannot honor the restored full tree
+            from .sharded_update import _fallback
+            _fallback("opt_state was replaced before the first step "
+                      "(restored full-tree state needs the fused apply)")
+            st.close()
+            self._sharded = None
+            return None
+        if self._chunked is not None and not self._chunked.decomposable:
+            st.close()
+            self._sharded = None
+            return None
+        return st
+
     def _ps_step(self, batch) -> jnp.ndarray:
         batch = self.shard_batch(batch)
+        if self._sharded_cfg is not None:
+            rank, world = self._sharded_cfg
+            self._sharded_cfg = None
+            gs0 = GlobalState._instance
+            from .sharded_update import build_sharded_state
+            self._sharded = build_sharded_state(
+                self._ps_exchange, self.params, self.tx, self._name,
+                rank, world,
+                timeline=gs0.timeline if gs0 is not None else None)
         if (self._bwd_staged and self._apply_chunked
                 and self.backward_passes_per_step == 1):
             # the staged program is shape-specialized; each new batch
@@ -525,8 +598,10 @@ class DistributedTrainer:
             return
         from .optim import ChunkedApply
         groups = self._ps_exchange.leaf_groups(grads, name=self._name)
-        self._chunked = ChunkedApply(self.tx, self.params, groups,
-                                     donate=self._ps_donate)
+        st = getattr(self, "_sharded", None)
+        self._chunked = ChunkedApply(
+            self.tx, self.params, groups, donate=self._ps_donate,
+            owned=st.plan.owned_set if st is not None else None)
         if (self._chunked.decomposable
                 and self.opt_state is not self._opt_state_at_init):
             # the caller installed its own state (checkpoint restore)
@@ -624,8 +699,10 @@ class DistributedTrainer:
         tl = gs.timeline if gs is not None else None
         self.step_count += 1
         t_ex = time.time()
-        handle = self._ps_exchange.exchange_ingest(self.params,
-                                                   name=self._name)
+        st = self._sharded_active()
+        handle = self._ps_exchange.exchange_ingest(
+            self.params, name=self._name,
+            sharded=st.plan.round_view() if st is not None else None)
         loss = None
         try:
             for seg in self._staged.run(self.params, batch):
@@ -655,6 +732,11 @@ class DistributedTrainer:
         d = getattr(self, "_cross_driver", None)
         if d is not None and (d.pending or d.failed):
             d.drain()
+        st = getattr(self, "_sharded", None)
+        if st is not None:
+            # a dead publisher means frames this trainer OWED its peers
+            # never shipped — surface it at the sync point, loudly
+            st.check_publisher()
 
     def close(self) -> None:
         """Release the trainer's PS-tail resources (H2D dispatch thread,
@@ -666,13 +748,20 @@ class DistributedTrainer:
         try:
             self.drain()
         finally:
-            h2d = getattr(self, "_h2d_ex", None)
-            if h2d is not None:
-                h2d.shutdown(wait=False)
-                self._h2d_ex = None
-            ex = getattr(self, "_ps_exchange", None)
-            if ex is not None:
-                ex.close()
+            st = getattr(self, "_sharded", None)
+            try:
+                if st is not None:
+                    self._sharded = None
+                    st.close()    # flushes queued frames; raises on a
+                    #               dead publisher (loud, after flush)
+            finally:
+                h2d = getattr(self, "_h2d_ex", None)
+                if h2d is not None:
+                    h2d.shutdown(wait=False)
+                    self._h2d_ex = None
+                ex = getattr(self, "_ps_exchange", None)
+                if ex is not None:
+                    ex.close()
 
     def _ps_step_streamed(self, grads, loss, tl, handle=None,
                           t_ex: Optional[float] = None) -> jnp.ndarray:
@@ -689,12 +778,17 @@ class DistributedTrainer:
         ``grads`` then only serves as the structure template for the
         first-step group derivation. None = start an
         ``exchange_stream`` round from the full ``grads`` tree."""
-        self._ensure_streamed_tail(grads)
+        if handle is None:
+            st0 = self._sharded_active()
+            self._ensure_streamed_tail(grads)
+            handle = self._ps_exchange.exchange_stream(
+                grads, name=self._name,
+                sharded=(st0.plan.round_view()
+                         if st0 is not None else None))
+        else:
+            self._ensure_streamed_tail(grads)
         if t_ex is None:
             t_ex = time.time()
-        if handle is None:
-            handle = self._ps_exchange.exchange_stream(grads,
-                                                       name=self._name)
         rep = NamedSharding(self.mesh, P())
         flat, treedef = jax.tree_util.tree_flatten(self.params)
         shapes = [l.shape for l in flat]
@@ -713,6 +807,37 @@ class DistributedTrainer:
             return d
 
         chunked = self._chunked
+        rnd_state = getattr(handle, "round_state", None)
+        if rnd_state is not None and rnd_state.sharded is not None:
+            # sharded weight update: owned groups pull+apply+publish,
+            # the rest install from the owners' param frames. The
+            # draining step stays fully synchronous — run_tail returns
+            # only once every group (owned or fetched) is installed.
+            st = self._sharded
+            if st is None:
+                raise RuntimeError(
+                    "sharded round created but the sharded state is "
+                    "gone — this is a bug in the enable/disable path")
+            e = self._next_shard_epoch()
+            seq = st.next_seq()
+            try:
+                st.run_tail(handle, chunked, flat, e, seq, h2d,
+                            st.param_installer(rep), self._h2d_ex, tl)
+            except BaseException as exc:
+                raise RuntimeError(
+                    f"sharded PS step failed — params and optimizer "
+                    f"state may be PARTIALLY stepped (owned groups "
+                    f"apply and fetched groups install independently); "
+                    f"do not retry this step on the same trainer "
+                    f"(restore a checkpoint, or run with "
+                    f"BPS_SHARDED_UPDATE=0)") from exc
+            finally:
+                self.params = jax.tree_util.tree_unflatten(treedef, flat)
+                observe_stage("PS_PUSH_PULL", time.time() - t_ex)
+                if tl is not None:
+                    tl.record(name, "PS_PUSH_PULL", t_ex,
+                              time.time() - t_ex)
+            return loss
         futs: dict = {}
         remaining = [len(g) for g in chunked.groups]
         applied = 0

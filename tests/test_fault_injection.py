@@ -673,3 +673,85 @@ def test_dead_stage_peer_over_tcp_is_loud():
                 e.close()
             except Exception:
                 pass
+
+
+# --------------------------------------------- sharded update (ISSUE 10)
+
+@pytest.mark.slow
+def test_sharded_owner_death_over_tcp_is_loud():
+    """Slow-lane TCP variant of the owner-death contract
+    (docs/sharded-update.md failure matrix): two replicas run the
+    ZeRO-style sharded update over real sockets; the OWNER of some
+    groups dies between its grad pull and its param publish. The
+    surviving non-owner's param fetch must time out into the loud
+    per-key diagnostic naming the group, owner rank, and step — never
+    a silent wait_epoch hang."""
+    import jax
+    import optax
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    from byteps_tpu.common.naming import NameRegistry
+    from byteps_tpu.optim import ChunkedApply
+    from byteps_tpu.server.ps_mode import PSGradientExchange
+    from byteps_tpu.sharded_update import build_sharded_state
+
+    os.environ["BPS_PARAM_TIMEOUT_MS"] = "3000"
+    eng = PSServer(num_workers=2, engine_threads=2)
+    srv = PSTransportServer(eng, host="127.0.0.1", port=0)
+    clients = [RemotePSBackend([f"127.0.0.1:{srv.port}"],
+                               reconnect_secs=1.0) for _ in range(2)]
+    reg = NameRegistry()
+    exs = [PSGradientExchange(clients[w], partition_bytes=4 << 10,
+                              registry=reg) for w in range(2)]
+    rng = np.random.RandomState(0)
+    params = {f"k{i}": np.zeros(2048, np.float32) for i in range(4)}
+    grads = [{f"k{i}": rng.randn(2048).astype(np.float32)
+              for i in range(4)} for _ in range(2)]
+    tx = optax.adam(1e-3)
+    states = [build_sharded_state(exs[w], params, tx, "odt", w, 2)
+              for w in range(2)]
+    try:
+        assert all(s is not None for s in states)
+        plan0 = states[0].plan
+
+        # the owner (worker 1): pushes its grads — its own grad pulls
+        # run automatically, completing the server round — then DIES
+        # (no tail, no publish). Modeled by feeding the round and
+        # closing its client after the pushes land.
+        h1 = exs[1].exchange_ingest(params, name="odt",
+                                    sharded=states[1].plan.round_view())
+        h1.feed(range(4), [grads[1][f"k{i}"] for i in range(4)])
+        h1.finish()
+
+        chunked = ChunkedApply(tx, params,
+                               [list(g) for g in plan0.groups],
+                               donate=False, owned=plan0.owned_set)
+        h2d_ex = ThreadPoolExecutor(1)
+        flat = [jax.numpy.asarray(params[f"k{i}"]) for i in range(4)]
+        h0 = exs[0].exchange_ingest(params, name="odt",
+                                    sharded=plan0.round_view())
+        h0.feed(range(4), [grads[0][f"k{i}"] for i in range(4)])
+        h0.finish()
+        t0 = time.time()
+        with pytest.raises(RuntimeError) as ei:
+            states[0].run_tail(
+                h0, chunked, flat, 1, states[0].next_seq(),
+                lambda li, arr: jax.device_put(arr / 2.0),
+                lambda li, a: jax.device_put(a), h2d_ex, None)
+        msg = str(ei.value)
+        assert "param frame for group" in msg
+        assert "owner replica 1" in msg and "never arrived" in msg
+        assert time.time() - t0 < 30, "diagnostic took too long"
+        h2d_ex.shutdown(wait=False)
+    finally:
+        os.environ.pop("BPS_PARAM_TIMEOUT_MS", None)
+        for ex in exs:
+            ex.close()
+        for s in states:
+            if s is not None:
+                s.close()
+        for c in clients:
+            c.close()
+        srv.close()
+        eng.close()

@@ -27,9 +27,17 @@ class _FlakySock:
 
 
 def test_mid_frame_failure_plus_resend_counts_once():
-    # burst far below the frame size forces the chunked path; the high
-    # rate keeps pacing sleeps negligible
-    nic = Nic(rate=4e9, burst=64 << 10)
+    # Root cause of the long-standing failure here (not load-dependent,
+    # and not a product bug): chunk_size() became RATE-SCALED
+    # (~2 ms of link time, clamped to [64 KB, 4 MB]) when the fixed
+    # 64 KB chunking measured as the bottleneck at 10 Gbps-class rates.
+    # At this test's original rate=4e9 a 1 MB frame fits in ONE 4 MB
+    # chunk, so the injected 3rd-write failure never fired and the
+    # raises-block failed deterministically. The rate below keeps the
+    # pacing fast but yields 128 KB chunks — 8 writes per frame, the
+    # genuinely chunked path the invariant is about.
+    nic = Nic(rate=64e6, burst=64 << 10)
+    assert nic.chunk_size() < (1 << 20) // 3, nic.chunk_size()
     frame = bytes(1 << 20)
     sock = _FlakySock(ok_writes=2)     # fail on the 3rd chunk
     ts = ThrottledSocket(sock, nic)
