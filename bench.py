@@ -788,7 +788,7 @@ def ps_zero_breakdown(iters: int = 8, warm: int = 2,
 def ps_comp_breakdown(iters: int = 5, warm: int = 4,
                       dim: int = 512, depth: int = 6,
                       batch: int = 128, nic_rate: float = 3.5e8,
-                      server_rate: float = 6e6,
+                      server_rate: float = 3e6,
                       pairs: int = 2,
                       compute_iters: int = 30) -> dict:
     """Fused-compression A/B (``byteps_tpu/compress``), run in the TWO
@@ -799,13 +799,18 @@ def ps_comp_breakdown(iters: int = 5, warm: int = 4,
     the real transport under the ASYMMETRIC ``throttle.Nic`` — the
     server's egress (the k-worker pull incast) throttled far below the
     workers' line rate, so pull wire time dominates the step. Arms:
-    ``BPS_COMPRESS=auto`` (the controller reads the live ``nic/stalls``
-    off the throttle and ratchets the ladder up during warmup) vs
-    ``=none``. Compression shrinks BOTH directions' wire bytes ~4x
-    (int8), so the compressed arm must win by a clearly-resolved
-    margin; its codec decisions are visible in the attached ``--stats``
-    registry summary (``compress/level/*`` gauges,
-    ``compress/decisions``).
+    ``BPS_COMPRESS=auto`` at the FULL ladder (BPS_COMPRESS_MAX=topk —
+    the controller reads the live ``nic/stalls`` off the throttle and
+    walks none→fp16→int8→fp8→topk to its congestion equilibrium during
+    the longer warmup) vs ``=none``; codec decisions are visible in the
+    attached ``--stats`` registry summary (``compress/level/*`` gauges,
+    ``compress/decisions``). A third ``fp8_e4m3`` arm pins the fp8 rung
+    with the device-side Pallas encode forced on and reports the
+    machine-readable win columns: ``fp8_d2h_vs_dense`` (measured
+    ``ps/d2h_bytes``, target ≤0.55x — the encode-before-D2H halving),
+    ``fp8_homog_rounds``/``fp8_dense_decodes`` (the homogeneous server
+    merge: decode-free, so dense decodes must be ZERO), and the
+    ``server/fused_merge_cpu_s`` server-CPU column.
 
     **compute-bound**: the identical trainer with NO throttle (loopback
     at host speed — the wire is idle). The controller sees quiet
@@ -840,11 +845,17 @@ def ps_comp_breakdown(iters: int = 5, warm: int = 4,
     saved = {k: os.environ.get(k) for k in
              ("BPS_ENABLE_PS", "BPS_COMPRESS", "BPS_MIN_COMPRESS_BYTES",
               "BPS_SERVER_ADDRS", "BPS_EMU_NIC_RATE", "BPS_PS_CONNS",
-              "BPS_PS_PIPELINE")}
+              "BPS_PS_PIPELINE", "BPS_COMPRESS_MAX",
+              "BPS_COMPRESS_DEVICE")}
     out: dict = {}
 
-    def run_arm(mode: str, n_iters: int, tag: str, stats: bool):
+    def run_arm(mode: str, n_iters: int, tag: str, stats: bool,
+                n_warm=None, env=None):
         os.environ["BPS_COMPRESS"] = mode
+        os.environ.pop("BPS_COMPRESS_MAX", None)
+        os.environ.pop("BPS_COMPRESS_DEVICE", None)
+        if env:
+            os.environ.update(env)
         # ALWAYS reset (the sibling benches reset only under --stats):
         # the adaptive controller READS the process-wide registry, so a
         # stale gauge from whatever ran before this bench — e.g. an
@@ -857,16 +868,24 @@ def ps_comp_breakdown(iters: int = 5, warm: int = 4,
         trainer = DistributedTrainer(
             mlp_loss, params, optax.adamw(1e-4), mesh=mesh,
             partition_bytes=dim * dim * 4, name=f"ps-comp-{tag}")
-        for _ in range(warm):
+        for _ in range(warm if n_warm is None else n_warm):
             float(trainer.step(data))
         trainer.drain()
+        reg = get_registry()
+        # measured-window deltas for the byte/CPU columns (warmup's
+        # ratcheting rounds would otherwise pollute the ratio)
+        base = {n: reg.counter(n).value for n in (
+            "ps/d2h_bytes", "ps/push_bytes",
+            "server/fused_rounds_homog", "server/fused_rounds_fallback",
+            "server/fused_dense_decodes", "server/fused_merge_cpu_s")}
         walls = []
         for _ in range(n_iters):
             t0 = time.perf_counter()
             trainer.step(data)
             walls.append(time.perf_counter() - t0)
         trainer.drain()
-        reg = get_registry()
+        counters = {n.rsplit("/", 1)[-1]: reg.counter(n).value - v
+                    for n, v in base.items()}
         # THIS arm's layers only (layer = <trainer name>.<bucket>; the
         # registry outlives arms, so earlier arms' gauges persist)
         levels = {n: reg.gauge(n).value for n in reg.names()
@@ -874,7 +893,7 @@ def ps_comp_breakdown(iters: int = 5, warm: int = 4,
         summary = _metrics_summary() if stats else None
         trainer.close()
         bps.shutdown()
-        return walls, levels, summary
+        return walls, levels, summary, counters
 
     try:
         # ---- wire-bound phase: server egress is the bottleneck ----
@@ -891,15 +910,29 @@ def ps_comp_breakdown(iters: int = 5, warm: int = 4,
         try:
             walls: dict = {"auto": [], "none": []}
             pair_rates: dict = {"auto": [], "none": []}
+            arm_counters: dict = {}
+            # the auto arm runs the FULL ladder (BPS_COMPRESS_MAX=topk
+            # — "push compression to the physical limits"): the
+            # sustained throttle walks none→fp16→int8→fp8→topk during
+            # the longer warmup (one rung per 2 congested rounds). The
+            # warm window exists to reach each arm's steady state — the
+            # ladder equilibrium for auto (10+ rounds), jit+transport
+            # warmup for none (4 is plenty, and each of its warm steps
+            # costs a full dense wire round).
+            wire_warm = max(warm, 14)
             for rep in range(pairs):
                 arms = (("auto",), ("none",)) if rep % 2 == 0 \
                     else (("none",), ("auto",))
                 for (mode,) in arms:
-                    w, levels, summary = run_arm(
+                    w, levels, summary, ctr = run_arm(
                         mode, iters, f"wire-{mode}-{rep}",
-                        STATS and rep == 0)
+                        STATS and rep == 0,
+                        n_warm=wire_warm if mode == "auto" else warm,
+                        env=({"BPS_COMPRESS_MAX": "topk"}
+                             if mode == "auto" else None))
                     walls[mode].extend(w)
                     pair_rates[mode].append(batch / statistics.median(w))
+                    arm_counters.setdefault(mode, ctr)
                     if rep == 0 and mode == "auto":
                         out["wire_bound_levels"] = levels
                         out["wire_bound_decisions"] = get_registry() \
@@ -916,6 +949,31 @@ def ps_comp_breakdown(iters: int = 5, warm: int = 4,
             out["comp_vs_dense_wire_bound"] = round(
                 statistics.median(walls["none"])
                 / statistics.median(walls["auto"]), 4)
+
+            # ---- fp8 device-encode arm: the D2H + server-CPU column.
+            # Pinned fp8_e4m3 with the Pallas encode BEFORE D2H forced
+            # on (interpret-mode kernels on CPU rigs — correctness-
+            # equivalent, and the wire stays the bottleneck here), so
+            # the measured d2h_bytes ratio and the homogeneous merge
+            # counters are the machine-readable win condition:
+            # d2h ≤ 0.55x dense, fused_dense_decodes == 0.
+            w, _, _, fp8c = run_arm(
+                "fp8_e4m3", iters, "wire-fp8-0", False, n_warm=warm,
+                env={"BPS_COMPRESS_DEVICE": "1"})
+            dense_ctr = arm_counters.get("none", {})
+            out["fp8_wire_sps"] = round(batch / statistics.median(w), 2)
+            out["fp8_d2h_bytes"] = fp8c.get("d2h_bytes", 0)
+            out["none_d2h_bytes"] = dense_ctr.get("d2h_bytes", 0)
+            if dense_ctr.get("d2h_bytes"):
+                out["fp8_d2h_vs_dense"] = round(
+                    fp8c["d2h_bytes"] / dense_ctr["d2h_bytes"], 4)
+            out["fp8_homog_rounds"] = fp8c.get("fused_rounds_homog", 0)
+            out["fp8_dense_decodes"] = fp8c.get("fused_dense_decodes", 0)
+            out["fp8_server_merge_cpu_s"] = round(
+                fp8c.get("fused_merge_cpu_s", 0.0), 4)
+            out["auto_server_merge_cpu_s"] = round(
+                arm_counters.get("auto", {}).get("fused_merge_cpu_s",
+                                                 0.0), 4)
         finally:
             server.close()
             engine.close()
@@ -932,7 +990,7 @@ def ps_comp_breakdown(iters: int = 5, warm: int = 4,
                 arms = (("auto",), ("none",)) if rep % 2 == 0 \
                     else (("none",), ("auto",))
                 for (mode,) in arms:
-                    w, levels, summary = run_arm(
+                    w, levels, summary, _ = run_arm(
                         mode, compute_iters, f"cpu-{mode}-{rep}",
                         STATS and rep == 0)
                     walls[mode].extend(w)

@@ -11,9 +11,12 @@ up when the wire is the bottleneck, decaying to ``none`` when it isn't
 docs/gradient-compression.md.
 
 Modules:
-  ``wire``        codec header + deterministic host codecs + pull cache
+  ``wire``        codec header + deterministic host codecs (incl. the
+                  counter-based-SR fp8 rungs) + pull cache
   ``controller``  the adaptive (and the pinned) decision logic
   ``plane``       per-exchange state: eligibility, EF residuals, levels
+  ``device``      device-side PS_COMPRESS: Pallas encode before D2H,
+                  bitwise probe-or-fallback
 
 The legacy per-key server-codec path (``server/compressed.py``, the
 reference's INIT_C/PUSH_C/PULL_C protocol) stays available behind its
@@ -23,15 +26,17 @@ and takes precedence for keys that declare it.
 
 from .controller import CompressController, FixedController
 from .plane import CompressionPlane
-from .wire import (CODEC_FP16, CODEC_INT8, CODEC_NONE, CODEC_TOPK,
-                   CodecError, FusedPullCache, LEVELS, codec_id,
-                   codec_name, decode, encode, peek, pull_encoded,
+from .wire import (CODEC_FP16, CODEC_FP8_E4M3, CODEC_FP8_E5M2,
+                   CODEC_INT8, CODEC_NONE, CODEC_TOPK, CodecError,
+                   FusedPullCache, LEVELS, codec_id, codec_name,
+                   decode, encode, peek, pull_encoded, sr_seed,
                    wire_nbytes)
 
 __all__ = [
     "CompressController", "CompressionPlane", "CodecError",
     "FixedController", "FusedPullCache", "LEVELS",
-    "CODEC_NONE", "CODEC_FP16", "CODEC_INT8", "CODEC_TOPK",
+    "CODEC_NONE", "CODEC_FP16", "CODEC_INT8", "CODEC_FP8_E4M3",
+    "CODEC_FP8_E5M2", "CODEC_TOPK",
     "codec_id", "codec_name", "decode", "encode", "peek",
-    "pull_encoded", "wire_nbytes",
+    "pull_encoded", "sr_seed", "wire_nbytes",
 ]

@@ -40,15 +40,25 @@ OFF_VALUES = ("", "0", "none", "off", "false")
 
 class _KeyState:
     __slots__ = ("size", "dtype", "layer", "residual", "pending",
-                 "m_bytes")
+                 "m_bytes", "sr_seq")
 
     def __init__(self, size: int, dtype, layer: str, m_bytes) -> None:
         self.size = int(size)
         self.dtype = np.dtype(dtype)
         self.layer = layer
-        self.residual: Optional[np.ndarray] = None   # committed EF state
+        # committed EF state — a numpy array on the host-codec path, a
+        # DEVICE array while the bucket rides the Pallas device encode
+        # (residuals then never cross PCIe); consumers coerce
+        self.residual = None
         self.pending: Optional[tuple] = None         # (round, residual)
         self.m_bytes = m_bytes                       # per-layer counter
+        # fp8 stochastic-rounding sequence: advances per fp8 encode of
+        # this key (decorrelates SR noise across EF iterations beyond
+        # what the round tag gives) and is RESET by the idle-decay
+        # flush — a `none`-decayed layer re-entering the ladder starts
+        # from a clean SR trace, reproducible from the decision trace
+        # alone
+        self.sr_seq = 0
 
 
 class CompressionPlane:
@@ -146,6 +156,19 @@ class CompressionPlane:
 
     # --------------------------------------------------------- data path
 
+    def _sr_seed(self, pskey: int, st: "_KeyState",
+                 round_tag: int, level: int) -> int:
+        """Worker-side fp8 SR seed: (key, round) folded with the key's
+        SR sequence. Only fp8 levels take noise. Does NOT advance the
+        sequence — callers bump ``st.sr_seq`` only after the encode
+        SUCCEEDS, so a device-encode failure falling back to the host
+        codec consumes exactly one sequence value and the run stays
+        bitwise-equal to a pure-host run."""
+        if level not in wire.FP8_CODECS:
+            return 0
+        return wire.sr_seed(pskey, round_tag) \
+            ^ ((st.sr_seq * 0x9E3779B9) & 0xFFFFFFFF)
+
     def encode(self, pskey: int, buf: np.ndarray, level: int,
                round_tag: int) -> bytes:
         """Compress ``buf`` for the wire at ``level`` (> none), with the
@@ -155,9 +178,16 @@ class CompressionPlane:
         st = self._keys[pskey]
         x = np.asarray(buf, np.float32).reshape(-1)
         if self.ef and st.residual is not None:
-            x = x + st.residual
+            # np.asarray: the residual may live on DEVICE (a previous
+            # round rode the Pallas encode and the level has since
+            # moved to a host-only codec)
+            x = x + np.asarray(st.residual, np.float32)
         payload = wire.encode(level, x.astype(st.dtype, copy=False),
-                              div=self.topk_div)
+                              div=self.topk_div,
+                              seed=self._sr_seed(pskey, st, round_tag,
+                                                 level))
+        if level in wire.FP8_CODECS:
+            st.sr_seq += 1
         if self.ef:
             st.pending = (round_tag,
                           x - wire.decode(payload, st.size, np.float32))
@@ -165,6 +195,35 @@ class CompressionPlane:
         self._m_raw.inc(st.size * st.dtype.itemsize)
         self._m_wire.inc(len(payload))
         return payload
+
+    def encode_on_device(self, pskey: int, parts, level: int,
+                         round_tag: int) -> tuple:
+        """Device-side sibling of ``encode``: the bucket is gathered,
+        EF-folded, and quantized ON DEVICE (``compress/device.py``
+        Pallas pipeline) and only the ENCODED payload crosses D2H.
+        ``parts`` is the bucket's segment recipe
+        ``[(device leaf, leaf_offset, length), ...]``. EF residuals
+        stay device-resident (committed by the same ``commit`` the host
+        path uses). Returns ``(payload, d2h_bytes)``; raises to signal
+        the caller's probe-or-fallback."""
+        from . import device as cdev
+        st = self._keys[pskey]
+        seed = self._sr_seed(pskey, st, round_tag, level)
+        payload, new_resid, d2h = cdev.encode_bucket(
+            parts, st.size, level, seed,
+            st.residual if self.ef else None, self.ef,
+            div=self.topk_div)
+        # state mutations only AFTER the fallible device encode: a
+        # kernel failure falls back to plane.encode with the SAME
+        # sr_seq, keeping the run bitwise-equal to a pure-host one
+        if level in wire.FP8_CODECS:
+            st.sr_seq += 1
+        if self.ef:
+            st.pending = (round_tag, new_resid)
+        st.m_bytes.inc(len(payload))
+        self._m_raw.inc(st.size * st.dtype.itemsize)
+        self._m_wire.inc(len(payload))
+        return payload, d2h
 
     def note_dense_push(self, pskey: int, nbytes: int) -> None:
         """Account a DENSE push of a plane-managed key into its
@@ -175,6 +234,10 @@ class CompressionPlane:
         st = self._keys.get(pskey)
         if st is not None:
             st.m_bytes.inc(nbytes)
+            # a dense round means the level decayed to none: clear the
+            # fp8 SR sequence with it, so the layer re-entering the
+            # ladder starts from a clean, trace-reproducible state
+            st.sr_seq = 0
 
     def fold_residual(self, pskey: int, buf: np.ndarray,
                       round_tag: int) -> np.ndarray:
@@ -187,8 +250,10 @@ class CompressionPlane:
         if st is None or not self.ef or st.residual is None:
             return buf
         out = (np.asarray(buf, np.float32).reshape(-1)
-               + st.residual).astype(np.dtype(buf.dtype), copy=False)
+               + np.asarray(st.residual, np.float32)) \
+            .astype(np.dtype(buf.dtype), copy=False)
         st.pending = (round_tag, None)      # commit clears the residual
+        st.sr_seq = 0                       # clean SR state on decay too
         return out
 
     def decode(self, pskey: int, payload, round_tag: int) -> np.ndarray:

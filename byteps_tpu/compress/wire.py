@@ -20,15 +20,25 @@ raise :class:`CodecError` instead of scattering garbage into the store
 
 Codecs (the controller's ladder, cheapest first):
 
-    ``none``  raw bytes (self-describing dense — used by replay paths)
-    ``fp16``  float16 cast, 2x on fp32 buckets
-    ``int8``  symmetric max-abs linear quantization, one fp32 scale
-              per bucket, round-half-even — deterministic, 4x
-    ``topk``  largest-k magnitudes as (int32 idx | fp32 val), k =
-              elems/topk_div — sparse, ~4x over int8 at div=32
+    ``none``      raw bytes (self-describing dense — used by replay paths)
+    ``fp16``      float16 cast, 2x on fp32 buckets
+    ``int8``      symmetric max-abs linear quantization, one fp32 scale
+                  per bucket, round-half-even — deterministic, 4x
+    ``fp8_e4m3``  max-abs-scaled fp8 (OCP e4m3fn) with DETERMINISTIC
+                  counter-based stochastic rounding — same 4x as int8
+                  but an unbiased quantizer with ~2^13 dynamic range
+                  under the scale (EQuARX-style, arXiv 2506.17615);
+                  sits ABOVE int8 in the ladder
+    ``fp8_e5m2``  as above at e5m2 (range over mantissa) — the rung for
+                  long-tailed gradient distributions
+    ``topk``      largest-k magnitudes as (int32 idx | fp32 val), k =
+                  elems/topk_div — sparse, ~4x over int8 at div=32
 
-All codecs are DETERMINISTIC functions of the dense input (no RNG), so
-a fixed codec decision trace makes compressed training reproducible
+All codecs are DETERMINISTIC functions of the dense input — the fp8
+rungs' stochastic rounding draws its noise from a counter-based hash of
+``(element index, seed)`` with the seed derived from ``(key, round)``
+(``sr_seed``) or supplied by the caller, never from a global RNG — so a
+fixed codec decision trace makes compressed training reproducible
 bit-for-bit, and a server re-encoding a merged round serves
 byte-identical payloads to every puller without a cache being load-
 bearing (the cache in :class:`FusedPullCache` is for throughput only).
@@ -43,13 +53,40 @@ from typing import Dict, Optional
 import numpy as np
 
 MAGIC = 0xB5C1
-VERSION = 1
+#: v2 renumbered the codec ids to keep ladder order == codec id when
+#: the fp8 rungs landed above int8 (topk moved 3 -> 5) — a v1 peer's
+#: payloads are refused LOUDLY by the version check below, never
+#: misdecoded through the shifted id space
+VERSION = 2
 
-CODEC_NONE, CODEC_FP16, CODEC_INT8, CODEC_TOPK = 0, 1, 2, 3
+(CODEC_NONE, CODEC_FP16, CODEC_INT8, CODEC_FP8_E4M3, CODEC_FP8_E5M2,
+ CODEC_TOPK) = 0, 1, 2, 3, 4, 5
 
-#: controller ladder order — index = aggressiveness level
-LEVELS = ("none", "fp16", "int8", "topk")
+#: controller ladder order — index = aggressiveness level (the fp8
+#: rungs ride above int8: same wire bytes, unbiased quantizer)
+LEVELS = ("none", "fp16", "int8", "fp8_e4m3", "fp8_e5m2", "topk")
 _NAME_TO_ID = {n: i for i, n in enumerate(LEVELS)}
+
+FP8_CODECS = (CODEC_FP8_E4M3, CODEC_FP8_E5M2)
+
+
+def _fp8_kind(cid: int) -> int:
+    from ..ops.compression import fp8sr
+    return fp8sr.E4M3 if cid == CODEC_FP8_E4M3 else fp8sr.E5M2
+
+
+def sr_seed(key: int, rnd: int) -> int:
+    """The one (key, round) -> stochastic-rounding seed derivation,
+    shared by every server-side encode site (pull re-encode, the
+    homogeneous merge renormalize) so divergent paths serve
+    byte-identical fp8 payloads for the same round. splitmix64-style
+    fold to 32 bits; pure, no state."""
+    h = (int(key) * 0x9E3779B97F4A7C15 + int(rnd) * 0xBF58476D1CE4E5B9) \
+        & 0xFFFFFFFFFFFFFFFF
+    h ^= h >> 31
+    h = (h * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    h ^= h >> 29
+    return h & 0xFFFFFFFF
 
 _HDR = struct.Struct("<HBB8sQ")
 
@@ -94,7 +131,7 @@ def wire_nbytes(cid: int, elems: int, dtype, div: int = TOPK_DIV) -> int:
         body = elems * dt.itemsize
     elif cid == CODEC_FP16:
         body = elems * 2
-    elif cid == CODEC_INT8:
+    elif cid in (CODEC_INT8, CODEC_FP8_E4M3, CODEC_FP8_E5M2):
         body = 4 + elems
     elif cid == CODEC_TOPK:
         body = 4 + topk_k(elems, div) * 8
@@ -103,12 +140,34 @@ def wire_nbytes(cid: int, elems: int, dtype, div: int = TOPK_DIV) -> int:
     return _HDR.size + body
 
 
-def encode(cid: int, arr: np.ndarray, div: int = TOPK_DIV) -> bytes:
+def scale_from_amax(amax, denom: float) -> np.float32:
+    """``amax / denom`` in PURE f32 numpy ops — the one scale rule
+    every encode site shares. The device pipeline feeds its (exact)
+    device-computed amax through THIS host division rather than
+    dividing on device: XLA's constant-divide strength reduction is ~1
+    ulp off numpy's IEEE divide, which would break host<->device
+    payload byte-identity."""
+    amax = np.float32(amax)
+    if not amax > 0:
+        return np.float32(1.0)
+    return np.float32(amax / np.float32(denom))
+
+
+def amax_scale(x: np.ndarray, denom: float) -> np.float32:
+    return scale_from_amax(
+        np.max(np.abs(x)) if x.size else 0.0, denom)
+
+
+def encode(cid: int, arr: np.ndarray, div: int = TOPK_DIV,
+           seed: int = 0) -> bytes:
     """Compress a flat dense array into a self-describing payload.
 
     Lossy codecs run their math in fp32 regardless of the wire dtype
     recorded in the header (the decode target); ``none`` ships the raw
-    bytes. Deterministic for every codec (see module docstring)."""
+    bytes. Deterministic for every codec: the fp8 rungs' stochastic
+    rounding is a pure function of ``(arr, seed)`` (see module
+    docstring) — callers that need cross-site byte identity derive
+    ``seed`` via :func:`sr_seed`."""
     arr = np.ascontiguousarray(np.asarray(arr).reshape(-1))
     dt = arr.dtype
     hdr = _HDR.pack(MAGIC, VERSION, cid,
@@ -119,11 +178,16 @@ def encode(cid: int, arr: np.ndarray, div: int = TOPK_DIV) -> bytes:
     if cid == CODEC_FP16:
         return hdr + x.astype(np.float16).tobytes()
     if cid == CODEC_INT8:
-        amax = float(np.max(np.abs(x))) if x.size else 0.0
-        scale = np.float32(amax / 127.0 if amax > 0 else 1.0)
+        scale = amax_scale(x, 127.0)
         # rint = round-half-even, matching jnp.round → the Pallas
         # int8 kernel pair produces byte-identical q for the same scale
         q = np.clip(np.rint(x / scale), -127, 127).astype(np.int8)
+        return hdr + struct.pack("<f", scale) + q.tobytes()
+    if cid in FP8_CODECS:
+        from ..ops.compression import fp8sr
+        kind = _fp8_kind(cid)
+        scale = amax_scale(x, fp8sr.fmt_max(kind))
+        q = fp8sr.sr_quantize_bits(x, scale, kind, seed)
         return hdr + struct.pack("<f", scale) + q.tobytes()
     if cid == CODEC_TOPK:
         k = topk_k(x.size, div)
@@ -173,6 +237,47 @@ def peek(payload) -> tuple:
     return cid, dt.rstrip(b"\0").decode(), int(elems)
 
 
+def validate(payload, expect_elems: int) -> int:
+    """STRUCTURAL validation without materializing the dense array —
+    every check :func:`decode` would fail on (header, element count,
+    body length, topk k/index bounds), so a payload that passes here
+    cannot make a later decode raise. The homogeneous sum store runs
+    this at INGEST: a torn payload must refuse before it can count as
+    a round arrival (refusing inside the merge would discard the other
+    workers' buffered arrivals and poison the round). Returns the
+    codec id."""
+    payload = bytes(payload)
+    cid, dt_name, elems = peek(payload)
+    if elems != int(expect_elems):
+        raise CodecError(
+            f"fused payload declares {elems} elements, bucket plan "
+            f"expects {expect_elems} — key/plan mismatch")
+    body = len(payload) - _HDR.size
+    dt = np.dtype(dt_name)
+    if cid == CODEC_NONE:
+        want = elems * dt.itemsize
+    elif cid == CODEC_FP16:
+        want = elems * 2
+    elif cid in (CODEC_INT8, CODEC_FP8_E4M3, CODEC_FP8_E5M2):
+        want = 4 + elems
+    else:                                   # CODEC_TOPK
+        if body < 4:
+            raise CodecError("topk body missing its k prefix")
+        (k,) = struct.unpack("<I", payload[_HDR.size:_HDR.size + 4])
+        want = 4 + k * 8
+        if body == want and k:
+            idx = np.frombuffer(payload, np.int32,
+                                count=k, offset=_HDR.size + 4)
+            if idx.min() < 0 or idx.max() >= elems:
+                raise CodecError(
+                    f"topk index out of range 0..{elems} — torn payload")
+    if body != want:
+        raise CodecError(
+            f"{codec_name(cid)} body is {body} bytes for {elems} "
+            f"elements (expected {want})")
+    return cid
+
+
 def decode(payload, expect_elems: Optional[int] = None,
            expect_dtype=None) -> np.ndarray:
     """Decompress a payload to its dense flat array (header dtype, or
@@ -204,6 +309,14 @@ def decode(payload, expect_elems: Optional[int] = None,
                 f"int8 body is {len(body)} bytes for {elems} elements")
         (scale,) = struct.unpack("<f", body[:4])
         out = np.frombuffer(body[4:], np.int8).astype(np.float32) * scale
+    elif cid in FP8_CODECS:
+        if len(body) != 4 + elems:
+            raise CodecError(
+                f"fp8 body is {len(body)} bytes for {elems} elements")
+        (scale,) = struct.unpack("<f", body[:4])
+        from ..ops.compression import fp8sr
+        out = fp8sr.decode_bits(np.frombuffer(body[4:], np.uint8),
+                                _fp8_kind(cid)) * np.float32(scale)
     elif cid == CODEC_TOPK:
         if len(body) < 4:
             raise CodecError("topk body missing its k prefix")
@@ -287,7 +400,10 @@ def pull_encoded(backend, cache: Optional[FusedPullCache], key: int,
     dense = np.empty(int(nbytes) // np.dtype(dtype).itemsize,
                      dtype=np.dtype(dtype))
     backend.pull(key, dense, round=rnd, timeout_ms=timeout_ms)
-    payload = encode(cid, dense, div=div)
+    # fp8 SR seed pinned to (key, round): every serve site — this
+    # re-encode, a replica's, the homogeneous merge's renormalize —
+    # derives the same seed, so they stay byte-interchangeable
+    payload = encode(cid, dense, div=div, seed=sr_seed(key, rnd))
     if cache is not None:
         cache.put(key, rnd, cid, payload, div)
     return payload

@@ -35,8 +35,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 STAGES: Tuple[str, ...] = (
     "DISPATCH", "REDUCE", "CREDIT_BLOCK", "PUSH_PULL", "PS_PUSH_PULL",
     "REDUCE_WAIT", "COPYD2H",
-    "PS_BWD_SEG", "PS_D2H", "PS_PACK", "PS_COMPRESS", "PS_PUSH",
-    "PS_PULL", "PS_DECOMPRESS", "PS_UNPACK", "PS_H2D",
+    "PS_BWD_SEG", "PS_D2H", "PS_PACK", "PS_COMPRESS", "PS_COMPRESS_DEV",
+    "PS_PUSH", "PS_PULL", "PS_DECOMPRESS", "PS_UNPACK", "PS_H2D",
     "PS_APPLY_CHUNK", "PS_XSTEP_GATE",
     "PS_PARAM_PUT", "PS_PARAM_GET",
     "PP_FWD_SEG", "PP_BWD_SEG", "PP_ACT_SEND", "PP_ACT_RECV",
@@ -59,9 +59,20 @@ PLANE_COUNTERS: Tuple[str, ...] = ("plane/migrations", "plane/failovers",
 # alongside dynamically (layer set is a runtime property of the bucket
 # plan — the pull side registers at exchange plan time, the push side
 # at compress-plane registration).
-COMPRESS_COUNTERS: Tuple[str, ...] = ("compress/decisions",
-                                      "compress/raw_bytes",
-                                      "compress/wire_bytes")
+COMPRESS_COUNTERS: Tuple[str, ...] = (
+    "compress/decisions", "compress/raw_bytes", "compress/wire_bytes",
+    # device-side encode + homogeneous server summation (PR 11):
+    # ps/d2h_bytes = bytes buckets moved across D2H (dense segments on
+    # the host path, encoded payloads on the device path; per-layer
+    # ps/d2h_bytes/<decl>.<bucket> ride alongside dynamically);
+    # server/fused_* = the merge path's decode accounting — a
+    # homogeneous run keeps fused_dense_decodes at ZERO
+    "ps/d2h_bytes",
+    "server/fused_rounds_homog", "server/fused_rounds_fallback",
+    "server/fused_dense_decodes", "server/fused_merge_cpu_s",
+    "server/fused_pull_hits", "server/fused_pull_encodes",
+    # activation codecs (pipeline/exchange.py): raw vs wire bytes
+    "pp/act_raw_bytes")
 
 # Sharded weight update (byteps_tpu.sharded_update,
 # docs/sharded-update.md): param-frame byte counters pre-registered so

@@ -1,0 +1,129 @@
+"""Deterministic counter-based stochastic rounding to fp8 (numpy
+reference).
+
+The fused compression plane's fp8 rungs (``compress/wire.py``
+``fp8_e4m3`` / ``fp8_e5m2``, EQuARX-style quantized collectives, arXiv
+2506.17615) need stochastic rounding — RNE-quantized gradients at 8
+bits bias small coordinates to zero, SR keeps the quantizer unbiased —
+WITHOUT breaking the plane's bit-reproducibility contract: no global
+RNG, no hidden state. The noise here is a pure function of
+``(element index, seed)`` via a murmur3-style 32-bit mixer, and the
+whole rounding runs as integer bit-math on the f32 representation:
+
+  1. ``y = clip(x / scale, ±MAX)`` (the int8 codec's divide + clip
+     shape, MAX = the format's largest finite value),
+  2. per element, the number of low f32-mantissa bits below the fp8
+     grid is computed from the exponent (``base = 23 - mant`` for
+     normals, growing toward the subnormal range; values under the
+     subnormal quantum take an explicit Bernoulli branch),
+  3. hashed noise of exactly that width is ADDED to the magnitude bits
+     and the low bits truncated — the classic SR-by-integer-add, which
+     rounds up with probability equal to the discarded fraction,
+  4. the on-grid magnitude is re-packed into the fp8 byte encoding
+     (sign | exp | mantissa) directly — no float8 cast is ever taken,
+     so the kernel twin in ``pallas_kernels.fp8_sr_quantize`` can run
+     the SAME uint32 ops on backends whose Mosaic has no fp8 support,
+     and host↔device byte-identity holds by construction.
+
+Both fp8 formats follow the OCP / ml_dtypes encodings (``e4m3fn``:
+bias 7, no inf, max 448; ``e5m2``: IEEE-half-like, bias 15, max finite
+57344). Encodes never produce nan/inf — overflow saturates at ±MAX,
+exactly like the int8 codec's clip.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: kind ids (shared with the Pallas kernel; NOT wire codec ids)
+E4M3, E5M2 = 0, 1
+
+#: per-format constants: (max finite, mantissa bits, min-normal biased
+#: f32 exponent).  e4m3: min normal 2^-6 -> e=121; e5m2: 2^-14 -> 113.
+_FMT = {
+    E4M3: (448.0, 3, 121),
+    E5M2: (57344.0, 2, 113),
+}
+
+_U32 = np.uint32
+
+
+def fmt_max(kind: int) -> float:
+    return _FMT[kind][0]
+
+
+def fmt_params(kind: int):
+    """(MAX, mant_bits, base_discard, emin, e_sub, quantum_bits) —
+    ``base_discard`` = f32 mantissa bits below a normal fp8 grid point,
+    ``e_sub`` = biased f32 exponent of the subnormal quantum, and
+    ``quantum_bits`` = the f32 bit pattern of that quantum."""
+    mx, mant, emin = _FMT[kind]
+    base = 23 - mant
+    e_sub = emin - mant
+    return mx, mant, base, emin, e_sub, _U32(e_sub) << _U32(23)
+
+
+def mix32(idx: np.ndarray, seed: int) -> np.ndarray:
+    """murmur3 fmix32 over ``idx * golden ^ seed`` — the one noise
+    source, identical (op for op, wraparound and all) in the numpy
+    reference and the Pallas kernel."""
+    h = (idx.astype(_U32) * _U32(0x9E3779B9)) ^ _U32(seed & 0xFFFFFFFF)
+    h ^= h >> _U32(16)
+    h *= _U32(0x85EBCA6B)
+    h ^= h >> _U32(13)
+    h *= _U32(0xC2B2AE35)
+    h ^= h >> _U32(16)
+    return h
+
+
+def sr_quantize_bits(x: np.ndarray, scale: np.float32, kind: int,
+                     seed: int) -> np.ndarray:
+    """Stochastically round ``x / scale`` to fp8 ``kind``; returns the
+    raw fp8 BYTE encodings (uint8). Deterministic in (x, scale, seed)."""
+    mx, _, base, emin, e_sub, qbits = fmt_params(kind)
+    x = np.ascontiguousarray(np.asarray(x, np.float32).reshape(-1))
+    y = x / np.float32(scale)
+    y = np.clip(y, np.float32(-mx), np.float32(mx))
+    bits = y.view(_U32)
+    sign = bits >> _U32(31)
+    mag = bits & _U32(0x7FFFFFFF)
+    e = mag >> _U32(23)
+    h = mix32(np.arange(x.size, dtype=np.uint32), seed)
+    # grid-binade case (value >= subnormal quantum): add noise of the
+    # per-binade discard width, truncate — unbiased round within the
+    # uniform-grid span of each binade
+    d = np.clip(np.int64(emin + base) - e.astype(np.int64), base, 23) \
+        .astype(_U32)
+    mask = (_U32(1) << d) - _U32(1)
+    mag_grid = (mag + (h & mask)) & ~mask
+    # below-quantum case: neighbors are {0, quantum}; Bernoulli with
+    # p = |y| / quantum via a 24-bit uniform from the same hash
+    tiny = e < _U32(e_sub)
+    u24 = (h >> _U32(8)).astype(np.float32) * np.float32(2.0 ** -24)
+    t = np.abs(y) * np.float32(2.0 ** (127 - e_sub))   # |y| / quantum
+    mag_tiny = np.where(u24 < t, qbits, _U32(0))
+    mag2 = np.where(tiny, mag_tiny, mag_grid)
+    mag2 = np.where(mag == 0, _U32(0), mag2)
+    # pack the on-grid magnitude into the fp8 byte (mant = 23 - base)
+    e2 = mag2 >> _U32(23)
+    f2 = mag2 & _U32(0x7FFFFF)
+    norm = ((e2 - _U32(emin - 1)) << _U32(23 - base)) | (f2 >> _U32(base))
+    sub_shift = np.clip(np.int64(emin + base) - e2.astype(np.int64),
+                        0, 31).astype(_U32)
+    sub = ((_U32(1) << _U32(23)) | f2) >> sub_shift
+    out = np.where(e2 >= _U32(emin), norm, sub)
+    out = np.where(mag2 == 0, _U32(0), out)
+    return ((sign << _U32(7)) | out).astype(np.uint8)
+
+
+def fp8_view_dtype(kind: int):
+    """The ml_dtypes numpy dtype that decodes these byte encodings."""
+    import ml_dtypes
+    return np.dtype(ml_dtypes.float8_e4m3fn if kind == E4M3
+                    else ml_dtypes.float8_e5m2)
+
+
+def decode_bits(q: np.ndarray, kind: int) -> np.ndarray:
+    """fp8 byte encodings -> float32 values (unscaled)."""
+    return np.asarray(q, np.uint8).view(fp8_view_dtype(kind)) \
+        .astype(np.float32)

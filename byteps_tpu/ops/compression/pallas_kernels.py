@@ -162,6 +162,90 @@ def int8_quantize(x: jnp.ndarray, scale) -> jnp.ndarray:
     return q.reshape(-1)[:n]
 
 
+# ------------------------------------------------- fp8 stochastic round
+#
+# Kernel twin of ops/compression/fp8sr.py (the fused plane's fp8 rungs):
+# deterministic counter-based stochastic rounding to the fp8 byte
+# encoding, run ENTIRELY as uint32 bit-math — no float8 cast, so the
+# kernel works on backends whose Mosaic has no fp8 type support and is
+# byte-identical to the numpy reference by construction (same mixer,
+# same integer adds, same truncation). The per-element noise counter is
+# the element's flat index, so the payload is a pure function of
+# (x, scale, seed) on every backend.
+
+def _fp8_sr_kernel(x_ref, scale_ref, seed_ref, out_ref, *, kind: int,
+                   block: int):
+    from . import fp8sr
+    mx, _, base, emin, e_sub, qbits = fp8sr.fmt_params(kind)
+    u32 = jnp.uint32
+    y = x_ref[:] / scale_ref[0]
+    y = jnp.clip(y, -mx, mx)
+    bits = jax.lax.bitcast_convert_type(y, jnp.uint32)
+    sign = bits >> u32(31)
+    mag = bits & u32(0x7FFFFFFF)
+    e = (mag >> u32(23)).astype(jnp.int32)
+    # flat element index = this block's offset + local (row, lane)
+    off = (pl.program_id(0) * block * _LANES).astype(jnp.int32)
+    local = (jax.lax.broadcasted_iota(jnp.int32, y.shape, 0) * _LANES
+             + jax.lax.broadcasted_iota(jnp.int32, y.shape, 1))
+    idx = (off + local).astype(jnp.uint32)
+    h = (idx * u32(0x9E3779B9)) ^ seed_ref[0]
+    h = h ^ (h >> u32(16))
+    h = h * u32(0x85EBCA6B)
+    h = h ^ (h >> u32(13))
+    h = h * u32(0xC2B2AE35)
+    h = h ^ (h >> u32(16))
+    d = jnp.clip(jnp.int32(emin + base) - e, base, 23).astype(jnp.uint32)
+    mask = (u32(1) << d) - u32(1)
+    mag_grid = (mag + (h & mask)) & ~mask
+    tiny = e < jnp.int32(e_sub)
+    u24 = (h >> u32(8)).astype(jnp.float32) * jnp.float32(2.0 ** -24)
+    t = jnp.abs(y) * jnp.float32(2.0 ** (127 - e_sub))
+    mag_tiny = jnp.where(u24 < t, u32(qbits), u32(0))
+    mag2 = jnp.where(tiny, mag_tiny, mag_grid)
+    mag2 = jnp.where(mag == u32(0), u32(0), mag2)
+    e2 = (mag2 >> u32(23)).astype(jnp.int32)
+    f2 = mag2 & u32(0x7FFFFF)
+    norm = (((e2 - jnp.int32(emin - 1)).astype(jnp.uint32)
+             << u32(23 - base)) | (f2 >> u32(base)))
+    sub_shift = jnp.clip(jnp.int32(emin + base) - e2, 0, 31) \
+        .astype(jnp.uint32)
+    sub = ((u32(1) << u32(23)) | f2) >> sub_shift
+    out = jnp.where(e2 >= jnp.int32(emin), norm, sub)
+    out = jnp.where(mag2 == u32(0), u32(0), out)
+    out_ref[:] = ((sign << u32(7)) | out).astype(jnp.uint8)
+
+
+def fp8_sr_quantize(x: jnp.ndarray, scale, seed, kind: int) -> jnp.ndarray:
+    """Stochastically round a flat float buffer to fp8 byte encodings
+    (uint8) at ``scale`` — byte-identical to
+    ``fp8sr.sr_quantize_bits`` for the same (x, scale, seed). ``kind``
+    is ``fp8sr.E4M3`` / ``fp8sr.E5M2``; zero-padding quantizes to 0 and
+    is sliced off (the padded tail's noise never aliases real elements:
+    the counter is the flat index)."""
+    import functools as _ft
+    n = x.shape[0]
+    rows, grid = _q_grid(n)
+    xp = jnp.pad(x.astype(jnp.float32), (0, rows * _LANES - n))
+    scale = jnp.asarray(scale, jnp.float32).reshape(1)
+    # zero-amax rule shared with the host codec (fp8sr divides too)
+    scale = jnp.where(scale > 0, scale, 1.0)
+    seed = jnp.asarray(seed, jnp.uint32).reshape(1)
+    q = pl.pallas_call(
+        _ft.partial(_fp8_sr_kernel, kind=kind, block=_Q_ROWS),
+        out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.uint8),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((_Q_ROWS, _LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec((_Q_ROWS, _LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(xp.reshape(rows, _LANES), scale, seed)
+    return q.reshape(-1)[:n]
+
+
 def int8_dequantize(q: jnp.ndarray, scale, n: int = None) -> jnp.ndarray:
     """Expand int8 values back to fp32 (``q * scale``)."""
     m = q.shape[0]

@@ -149,7 +149,9 @@ class ActivationExchange:
     def __init__(self, stage: int, store: ActStore,
                  peer_prev=None, peer_next=None,
                  timeline=None, name: str = "pp",
-                 timeout_ms: int = 30000) -> None:
+                 timeout_ms: int = 30000,
+                 codec: Optional[str] = None) -> None:
+        import os
         self.stage = int(stage)
         self.store = store
         self.peer_prev = peer_prev
@@ -157,9 +159,33 @@ class ActivationExchange:
         self.timeline = timeline
         self.name = name
         self.timeout_ms = int(timeout_ms)
+        # activation compression (BPS_ACT_COMPRESS=fp16|int8|fp8_e4m3|
+        # fp8_e5m2, default none): boundary frames ride the SAME
+        # self-describing codecs as gradients — activation bytes are
+        # the pipeline fabric's whole load, and the fp8 rungs'
+        # stochastic rounding keeps the quantizer unbiased where no EF
+        # loop exists to absorb bias. SENDER-ONLY knob: the receiver
+        # disambiguates by SIZE (a compressed payload is never exactly
+        # the program's raw boundary size past the floor) then decodes
+        # by header, so mixed-config peers stay loud-or-correct.
+        # Opt-in: lossy boundaries perturb the forward, so the PP
+        # parity contract moves from bitwise to the grad-exactness
+        # tolerance (tested) — never silently.
+        from ..compress import wire as cwire
+        from ..compress.plane import OFF_VALUES
+        cname = (codec if codec is not None
+                 else os.environ.get("BPS_ACT_COMPRESS", "none")) \
+            .strip().lower() or "none"
+        self._codec = None if cname in OFF_VALUES \
+            else cwire.codec_id(cname)
+        if self._codec == cwire.CODEC_NONE:
+            self._codec = None
+        self._codec_min = int(os.environ.get("BPS_ACT_COMPRESS_MIN",
+                                             "1024") or 1024)
         reg = get_registry()
         self._m_send = reg.counter("pp/act_send_bytes")
         self._m_recv = reg.counter("pp/act_recv_bytes")
+        self._m_raw = reg.counter("pp/act_raw_bytes")
         self._lock = threading.Lock()
         self._waits: Dict[int, _Flight] = {}     # boundary -> flight
         self._progress_t = time.monotonic()
@@ -176,15 +202,47 @@ class ActivationExchange:
                 f"{boundary.dst_stage} (boundary {boundary.index})")
         return peer
 
+    def _codec_for(self, boundary) -> Optional[int]:
+        """The configured codec when this boundary is eligible: every
+        var fp32 (lossy codec math is f32) and the raw frame at or
+        above the floor — ineligible boundaries ship raw, same floor
+        rule as the gradient plane."""
+        if self._codec is None:
+            return None
+        total = 0
+        for shape, dtype in boundary.specs():
+            if np.dtype(dtype) != np.float32:
+                return None
+            total += int(np.prod(shape)) * 4
+        return self._codec if total >= self._codec_min else None
+
     def send(self, boundary, mb: int, seq: int, env: Dict) -> None:
         """Ship boundary ``boundary``'s vars (read from ``env``) to the
-        neighbor as one CLASS_ACT frame."""
+        neighbor as one CLASS_ACT frame (encoded when the activation
+        codec is on and the boundary is eligible)."""
+        from ..compress import wire as cwire
         t0 = time.time()
-        parts = []
-        for v in boundary.vars:
-            a = np.ascontiguousarray(np.asarray(env[v]))
-            parts.append(a.view(np.uint8).reshape(-1))
-        payload = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        cid = self._codec_for(boundary)
+        if cid is not None:
+            parts = [np.ascontiguousarray(np.asarray(env[v]))
+                     .reshape(-1) for v in boundary.vars]
+            flat = parts[0] if len(parts) == 1 else np.concatenate(parts)
+            self._m_raw.inc(flat.nbytes)
+            # seed pinned to (channel, seq): a resend re-encodes
+            # byte-identical frames, keeping the mailbox's last-wins
+            # idempotence intact
+            payload = np.frombuffer(
+                cwire.encode(cid, flat,
+                             seed=cwire.sr_seed(act_key(boundary.index),
+                                                seq)), np.uint8)
+        else:
+            parts = []
+            for v in boundary.vars:
+                a = np.ascontiguousarray(np.asarray(env[v]))
+                parts.append(a.view(np.uint8).reshape(-1))
+            payload = parts[0] if len(parts) == 1 \
+                else np.concatenate(parts)
+            self._m_raw.inc(int(payload.nbytes))
         try:
             self._peer_for(boundary).act_push(act_key(boundary.index),
                                               seq, payload)
@@ -222,23 +280,45 @@ class ActivationExchange:
         finally:
             with self._lock:
                 self._waits.pop(boundary.index, None)
-        off = 0
-        for v, (shape, dtype) in zip(boundary.vars, boundary.specs()):
-            n = int(np.prod(shape)) * np.dtype(dtype).itemsize
-            arr = np.frombuffer(data, dtype=np.dtype(dtype),
-                                count=n // np.dtype(dtype).itemsize,
-                                offset=off).reshape(shape)
-            env[v] = arr
-            off += n
-        if off != len(data):
-            raise RuntimeError(
-                f"stage {self.stage}: boundary {boundary.index} frame "
-                f"for microbatch {mb} is {len(data)}B, the shared "
-                f"program expects {off}B — peers are running different "
-                f"programs")
+        specs = list(boundary.specs())
+        expect = sum(int(np.prod(s)) * np.dtype(d).itemsize
+                     for s, d in specs)
+        if len(data) != expect:
+            # SIZE-FIRST disambiguation (the forward-log replay rule):
+            # not the program's raw boundary size, so it must be a
+            # self-describing codec frame — decode by header, loudly
+            # refusing anything torn. A genuinely mismatched program
+            # surfaces as the decode's element-count CodecError, still
+            # naming numbers.
+            from ..compress import wire as cwire
+            try:
+                flat = cwire.decode(data, expect_elems=expect // 4,
+                                    expect_dtype="float32")
+            except cwire.CodecError as e:
+                raise RuntimeError(
+                    f"stage {self.stage}: boundary {boundary.index} "
+                    f"frame for microbatch {mb} is {len(data)}B, the "
+                    f"shared program expects {expect}B, and it is not "
+                    f"a decodable codec frame ({e}) — peers are "
+                    f"running different programs") from e
+            off = 0
+            for v, (shape, dtype) in zip(boundary.vars, specs):
+                n = int(np.prod(shape))
+                env[v] = flat[off:off + n].reshape(shape)
+                off += n
+        else:
+            off = 0
+            for v, (shape, dtype) in zip(boundary.vars, specs):
+                n = int(np.prod(shape)) * np.dtype(dtype).itemsize
+                arr = np.frombuffer(data, dtype=np.dtype(dtype),
+                                    count=n // np.dtype(dtype).itemsize,
+                                    offset=off).reshape(shape)
+                env[v] = arr
+                off += n
         self._mark_progress()
         self._n += 1
-        self._m_recv.inc(off)
+        self._m_recv.inc(len(data))      # wire bytes (= raw when the
+        #                                  frame shipped uncompressed)
         dur = time.time() - t0
         observe_stage("PP_ACT_RECV", dur)
         if self.timeline is not None:

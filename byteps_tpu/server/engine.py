@@ -388,6 +388,13 @@ class HostPSBackend:
         self.servers = [PSServer(num_workers, engine_threads, enable_schedule,
                                  async_mode)
                         for _ in range(num_servers)]
+        self.num_workers = num_workers
+        # homogeneous fused summation (server/homog.py): keys declared
+        # ``fused=True`` at init have their ROUNDS owned by this store
+        # — same-codec arrivals merge in one widen->add pass and pulls
+        # are served as payload bytes, no dense decode through the
+        # engine. Lazy: plain deployments never allocate it.
+        self._homog = None
         self.hash_fn = hash_fn
         from ..common.naming import check_mixed_mode_enabled, placement_from_env
         check_mixed_mode_enabled(hash_fn)
@@ -434,6 +441,11 @@ class HostPSBackend:
         self._m_pull_wait = get_registry().histogram("server/pull_wait_s")
         self._m_queue_depth = get_registry().gauge(
             "server/engine_queue_depth")
+        # unmanaged fused pushes dense-decode per call: cache the
+        # counter off the per-bucket hot path (homog.FusedSumStore does
+        # the same for its own counters)
+        self._m_dense_decodes = get_registry().counter(
+            "server/fused_dense_decodes")
         self._qd_next_sample = 0.0
 
     def close(self) -> None:
@@ -463,15 +475,42 @@ class HostPSBackend:
     def _shard(self, key: int) -> PSServer:
         return self.servers[self._shard_index(key)]
 
+    def _homog_store(self):
+        if self._homog is None:
+            from .homog import FusedSumStore
+            self._homog = FusedSumStore(self.num_workers)
+        return self._homog
+
+    def _homog_managed(self, key: int) -> bool:
+        return self._homog is not None and self._homog.managed(key)
+
     def init_key(self, key: int, nbytes: int, dtype: str = "float32",
                  init: Optional[np.ndarray] = None,
-                 compression: Optional[Dict[str, str]] = None) -> None:
+                 compression: Optional[Dict[str, str]] = None,
+                 fused: bool = False) -> None:
         """``compression`` kwargs register a server-side codec for the key
         (reference: server.cc:222-252); the dense store still holds
-        ``nbytes`` — pushes arrive compressed, are decompressed into it."""
+        ``nbytes`` — pushes arrive compressed, are decompressed into it.
+        ``fused=True`` (the exchange's plan-time declaration for
+        compression-plane-managed keys) hands the key's rounds to the
+        homogeneous fused store — same-codec rounds merge decode-free
+        and pulls are served as payload bytes (server/homog.py); a
+        re-init resets the store (new tenancy), exactly like the fused
+        pull cache."""
         if compression:
             size = nbytes // np.dtype(dtype).itemsize
             self.compressed.register(key, compression, size, dtype)
+        from .homog import homog_enabled
+        if fused and homog_enabled():
+            self._homog_store().init_key(key, nbytes, dtype, init)
+        elif self._homog_managed(key):
+            self._homog.drop(key)     # re-declared non-fused
+        # a (re-)init is a new tenancy: shard-local rounds restart, so
+        # cached fused pulls from the previous tenancy would alias the
+        # recurring round numbers (the transport server applies the
+        # same rule to its own cache)
+        if self._fused_cache is not None:
+            self._fused_cache.drop(key)
         if self._ring is not None:
             self._ring.place(key, nbytes)    # byte-weighted, idempotent
         self._shard(key).init_key(key, nbytes, dtype, init)
@@ -494,7 +533,14 @@ class HostPSBackend:
 
     def push(self, key: int, data: np.ndarray) -> None:
         import time
-        self._shard(key).push(key, data)
+        if self._homog_managed(key):
+            # dense round of a fused-managed key (level none, or a
+            # divergent worker's dense arrival): the homog store owns
+            # the round either way — splitting one key's rounds across
+            # two stores would wedge the next pull
+            self._homog.ingest_dense(key, data)
+        else:
+            self._shard(key).push(key, data)
         # server-side backlog: how far the summation engine is behind
         # the pushes (the reference's engine_load). RATE-LIMITED — the
         # sample is engine_threads locked ctypes calls per shard, and a
@@ -510,12 +556,23 @@ class HostPSBackend:
                     #                 not fail the data plane after it
 
     def queue_depth(self) -> int:
-        """Enqueued-but-unsummed pushes across every shard's engine."""
-        return sum(s.queue_depth() for s in self.servers)
+        """Enqueued-but-unsummed pushes across every shard's engine,
+        plus the fused store's buffered arrivals — the backlog signal
+        the compression controller reads must keep tracking managed
+        keys after their rounds leave the engine."""
+        n = sum(s.queue_depth() for s in self.servers)
+        if self._homog is not None:
+            n += self._homog.pending()
+        return n
 
     def pull(self, key: int, out: np.ndarray, round: int = 0,
              timeout_ms: int = 30000) -> None:
         import time
+        if self._homog_managed(key):
+            t0 = time.time()
+            self._homog.pull_dense(key, out, round, timeout_ms)
+            self._m_pull_wait.observe(time.time() - t0)
+            return
         t0 = time.time()
         base = self._round_base.get(key, 0)
         if round and round <= base:
@@ -539,7 +596,12 @@ class HostPSBackend:
         counters to the server's instead of stalling on round 1
         (the elastic-rejoin analog of the reference's is_recovery
         skip-barrier, global.cc:283-297). Migrated keys report
-        ``base + shard round`` (the destination store counts from 0)."""
+        ``base + shard round`` (the destination store counts from 0).
+        Fused-managed keys answer from the homog store — its counter IS
+        the key's round authority (in-process migration never moves it,
+        so no base applies)."""
+        if self._homog_managed(key):
+            return self._homog.round(key)
         return (self._round_base.get(key, 0)
                 + int(self._shard(key).round(key)))
 
@@ -621,13 +683,20 @@ class HostPSBackend:
 
     def push_fused(self, key: int, payload) -> None:
         """Fused-plane push (byteps_tpu.compress): the payload is
-        SELF-DESCRIBING (codec header), so no per-key codec
-        registration exists to drift — decode on arrival, dense-sum in
-        the engine. A torn/mismatched payload raises CodecError loudly
-        before any bytes reach the store."""
+        SELF-DESCRIBING (codec header). Managed keys buffer it in the
+        homogeneous store — same-codec rounds merge in one widen->add
+        pass, no dense decode through the engine; unmanaged keys keep
+        the PR-7 decode-on-arrival dense sum (now counter-visible). A
+        torn/mismatched payload raises CodecError loudly before any
+        bytes reach either store."""
         from ..compress import wire
+        if self._homog_managed(key):
+            self._homog.ingest(key, payload)
+            return
         dense = wire.decode_for_store(payload, self._key_meta.get(key))
-        self.push(key, dense)
+        if wire.lossy(wire.peek(payload)[0]):   # `none` frames are a
+            self._m_dense_decodes.inc()         # frombuffer view, not
+        self.push(key, dense)                   # a decode
 
     def pull_fused(self, key: int, nbytes: int, dtype: str, codec: int,
                    round: int = 0, timeout_ms: int = 30000,
@@ -635,8 +704,13 @@ class HostPSBackend:
         """Fused-plane pull: the merged round encoded at the codec the
         caller's decision trace pinned for it (deterministic codecs —
         every puller of (round, codec, div) gets byte-identical
-        payloads; the cache only skips repeat encodes)."""
+        payloads; caches only skip repeat encodes). Managed keys serve
+        straight from the homog store's merged round."""
         from ..compress import wire
+        if self._homog_managed(key):
+            return self._homog.pull_payload(
+                key, codec, round, timeout_ms,
+                div=div if div else wire.TOPK_DIV)
         if self._fused_cache is None:
             self._fused_cache = wire.FusedPullCache()
         return wire.pull_encoded(self, self._fused_cache, key, nbytes,

@@ -139,6 +139,9 @@ class PlanePSBackend:
         self._migrating: set = set()
         self._dead: set = set()
         self._fused_ok = False      # _check_fused_shards verdict cache
+        self._fused_keys: set = set()   # fused-managed declarations —
+        #                                 re-inits (failover/migration
+        #                                 replay) carry the flag forward
         # rebalancer inputs: pushed bytes per shard / per key since the
         # last load_window() call
         self._win_shard: Dict[int, int] = {}
@@ -285,6 +288,11 @@ class PlanePSBackend:
                     f"need transport-backed plane shards")
             sh.init_key(key, nbytes, dtype, init=init,
                         compression=compression)
+        elif key in self._fused_keys:
+            # fused-managed declaration travels with every (re-)init —
+            # a failover/migration replay must re-manage the key on the
+            # new shard, not silently degrade it to dense decodes
+            sh.init_key(key, nbytes, dtype, init=init, fused=True)
         else:
             sh.init_key(key, nbytes, dtype, init=init)
 
@@ -385,7 +393,8 @@ class PlanePSBackend:
 
     def init_key(self, key: int, nbytes: int, dtype: str = "float32",
                  init: Optional[np.ndarray] = None,
-                 compression: Optional[Dict[str, str]] = None) -> None:
+                 compression: Optional[Dict[str, str]] = None,
+                 fused: bool = False) -> None:
         self.placement.place(key, nbytes)
         with self._lock:
             if key not in self._meta:
@@ -393,6 +402,14 @@ class PlanePSBackend:
                                    None if init is None else np.array(init),
                                    dict(compression) if compression
                                    else None)
+            if fused:
+                self._fused_keys.add(key)
+            else:
+                # re-declared non-fused: hand the key back (the same
+                # rule HostPSBackend and FusedFront apply), or replays
+                # would force homog management against the worker's
+                # current declaration forever
+                self._fused_keys.discard(key)
         self._run(key, lambda sh, i: self._init_on(
             i, key, nbytes, dtype, init, compression))
 

@@ -168,6 +168,28 @@ class _Round:
             ex._cplane.on_round()
             self.clevels = [ex._cplane.level_of(pskey)
                             for pskey, _ in self.keyed]
+        # device-side PS_COMPRESS plan (compress/device.py): buckets
+        # whose pinned level has a device codec encode ON DEVICE and
+        # D2H only the payload — their leaves skip the eager
+        # copy_to_host_async (that copy is exactly what the device
+        # encode exists to shrink); leaves any HOST bucket covers keep
+        # it. Resolved per round from the pinned trace.
+        self.dev_bucket = None
+        self.host_leaves = None
+        if self.clevels is not None and ex._device_encode_on():
+            from ..compress.device import DEVICE_CODECS
+            self.dev_bucket = [
+                bool(lvl in DEVICE_CODECS and ex._cplane.active(pskey)
+                     and pskey not in ex._chains)
+                for (pskey, _), lvl in zip(self.keyed, self.clevels)]
+            if any(self.dev_bucket):
+                need = set()
+                for dev, (_, b) in zip(self.dev_bucket, self.keyed):
+                    if not dev:
+                        need.update(s.leaf_index for s in b.segments)
+                self.host_leaves = need
+            else:
+                self.dev_bucket = None
         # epoch-tagged routing (server plane): the placement view this
         # round resolved its routes under. Every push/pull carries it;
         # a key that migrated since is refused with WrongEpoch (an
@@ -291,6 +313,16 @@ class _Round:
         ex = self.ex
         pskey, b = self.keyed[idx]
         self.rounds[idx] = ex._next_round(pskey)
+        if self.dev_bucket is not None and self.dev_bucket[idx]:
+            buf = ex._push_bucket_device(self, idx)
+            if buf is not None:
+                self.bucket_state[idx] = "pushed"
+                ex._mark_progress()
+                return buf
+            # device fallback (host-fed leaf / kernel failure): the
+            # eager D2H was skipped for device-only leaves, but
+            # get_flat's np.asarray below blocks on its own copy —
+            # slower, never wrong
         t0 = time.time()
         buf = np.empty(b.size, dtype=b.dtype)
         if ex._native_pack:
@@ -312,6 +344,10 @@ class _Round:
                         s.leaf_offset:s.leaf_offset + s.length]
         t0 = ex._record(self.decl_name, "PS_PACK", pskey, t0,
                         step=self.step_tag)
+        # host-path D2H accounting: this bucket's segments crossed
+        # PCIe dense (segments partition leaves, so per-bucket sums
+        # tile the real copy exactly)
+        ex._d2h_account(pskey, buf.nbytes)
         try:
             ex._push_bucket(pskey, b, buf, rnd=self, idx=idx)
         except Exception:
@@ -533,8 +569,12 @@ class _Round:
                     f"fed leaf {li} is {getattr(v, 'shape', ())}/"
                     f"{v.dtype}, plan expects {self.shapes[li]}/"
                     f"{self.dtypes[li]}")
-            if hasattr(v, "copy_to_host_async"):
-                v.copy_to_host_async()   # start D2H before any pack
+            if hasattr(v, "copy_to_host_async") and (
+                    self.host_leaves is None or li in self.host_leaves):
+                v.copy_to_host_async()   # start D2H before any pack —
+                #                          skipped for leaves only
+                #                          device-encoded buckets cover
+                #                          (their payload IS the D2H)
         self.ex._mark_progress()
         fire: List[int] = []
         with self.feed_lock:
@@ -626,6 +666,22 @@ class PSGradientExchange:
         # pskey -> per-layer ps/pull_bytes/<decl>.<bucket> counter,
         # registered at plan time (see _plan)
         self._pull_layer: Dict[int, object] = {}
+        # pskey -> per-layer ps/d2h_bytes/<decl>.<bucket> counter —
+        # bytes a bucket moved across D2H (its dense segments on the
+        # host path, the encoded payload on the device-encode path)
+        self._d2h_layer: Dict[int, object] = {}
+        # can the backend carry the fused-managed declaration on init?
+        # (duck-typed test backends may speak push_fused without it)
+        import inspect as _inspect
+        try:
+            self._init_fused_ok = "fused" in _inspect.signature(
+                backend.init_key).parameters
+        except (TypeError, ValueError):
+            self._init_fused_ok = False
+        # device-side PS_COMPRESS (compress/device.py): resolved + probed
+        # lazily at the first eligible bucket so CPU rigs with the
+        # default auto mode never pay the probe
+        self._dev_enc: Optional[bool] = None
         self._key_rounds: Dict[int, int] = {}
         self._key_rounds_lock = threading.Lock()
         self._push_ex: Optional[ThreadPoolExecutor] = None
@@ -665,6 +721,7 @@ class PSGradientExchange:
         reg = get_registry()
         self._m_push_bytes = reg.counter("ps/push_bytes")
         self._m_pull_bytes = reg.counter("ps/pull_bytes")
+        self._m_d2h_bytes = reg.counter("ps/d2h_bytes")
         self._m_buckets = reg.counter("ps/buckets_completed")
         self._m_rounds = reg.gauge("ps/rounds_in_flight")
         self._m_adm_wait = reg.histogram("ps/admission_wait_s")
@@ -821,24 +878,31 @@ class PSGradientExchange:
                         ckw, b.size, b.dtype)
                 self.backend.init_key(pskey, nbytes, b.dtype,
                                       compression=ckw)
+                continue
+            # fused-plane eligibility decided BEFORE init so the server
+            # learns it with the declaration: fused-managed keys get
+            # their rounds owned by the homogeneous sum store (legacy
+            # kwargs chains keep precedence and stay dense-keyed)
+            fused = (self._cplane is not None
+                     and self._cplane.register(
+                         pskey, b.size, b.dtype,
+                         layer=f"{decl_name}.{b.index}"))
+            if fused and self._init_fused_ok:
+                self.backend.init_key(pskey, nbytes, b.dtype, fused=True)
             else:
                 self.backend.init_key(pskey, nbytes, b.dtype)
-        if self._cplane is not None:
-            for pskey, b in keyed:
-                if pskey in self._chains:
-                    continue    # legacy kwargs chain: explicit opt-in,
-                    #             takes precedence over the fused plane
-                self._cplane.register(pskey, b.size, b.dtype,
-                                      layer=f"{decl_name}.{b.index}")
-        # per-layer pull-byte counters, dynamically registered at plan
-        # time exactly like the compress plane's ps/push_bytes/<layer>
-        # — the 1/dp pull reduction of the sharded update is directly
-        # observable per layer, and the compress controller can later
-        # read pull-side pressure from the same names
+        # per-layer pull-byte + D2H-byte counters, dynamically
+        # registered at plan time exactly like the compress plane's
+        # ps/push_bytes/<layer> — the 1/dp pull reduction of the
+        # sharded update and the device-encode D2H halving are both
+        # directly observable per layer
         for pskey, b in keyed:
             if pskey not in self._pull_layer:
                 self._pull_layer[pskey] = get_registry().counter(
                     f"ps/pull_bytes/{decl_name}.{b.index}")
+            if pskey not in self._d2h_layer:
+                self._d2h_layer[pskey] = get_registry().counter(
+                    f"ps/d2h_bytes/{decl_name}.{b.index}")
         if hasattr(self.backend, "set_send_priority"):
             # two-class wire scheduler (server/sched.py): gradient
             # frames carry reverse-FIRST-USE priority — the bucket
@@ -1023,6 +1087,89 @@ class PSGradientExchange:
             return 0
         return rnd.clevels[idx]
 
+    def _device_encode_on(self) -> bool:
+        """Resolve (once) whether PS_COMPRESS runs on device —
+        BPS_COMPRESS_DEVICE plus the bitwise probe-or-fallback
+        (compress/device.py)."""
+        if self._dev_enc is None:
+            if self._cplane is None:
+                self._dev_enc = False
+            else:
+                try:
+                    from ..compress.device import device_encode_enabled
+                    self._dev_enc = device_encode_enabled()
+                except Exception:   # noqa: BLE001 — probe-or-fallback
+                    self._dev_enc = False
+        return self._dev_enc
+
+    def _d2h_account(self, pskey: int, nbytes: int) -> None:
+        self._m_d2h_bytes.inc(nbytes)
+        m = self._d2h_layer.get(pskey)
+        if m is not None:
+            m.inc(nbytes)
+
+    def _push_bucket_device(self, rnd, idx: int):
+        """Device-side PS_COMPRESS: gather + EF fold + quantize ON
+        DEVICE, D2H only the encoded payload, push it fused. Returns
+        the pull staging buffer on success, None to signal the host
+        fallback (a host-fed leaf, or a kernel failure — logged once).
+        The encode runs BEFORE any state mutation commits, so a
+        fallback never leaves a half-staged EF pending."""
+        import time
+
+        import jax
+        pskey, b = rnd.keyed[idx]
+        level = rnd.clevels[idx]
+        parts = []
+        for s in b.segments:
+            src = rnd.sources[s.leaf_index]
+            if not isinstance(src, jax.Array):
+                return None
+            parts.append((src, s.leaf_offset, s.length))
+        t0 = time.time()
+        try:
+            payload, d2h = self._cplane.encode_on_device(
+                pskey, parts, level, rnd.rounds[idx])
+        except Exception as e:   # noqa: BLE001 — probe-or-fallback
+            if not getattr(self, "_dev_warned", False):
+                self._dev_warned = True
+                from ..common.logging import get_logger
+                get_logger().warning(
+                    "device encode failed for key %d (%s: %s) — "
+                    "falling back to the host codec", pskey,
+                    type(e).__name__, e)
+            return None
+        self._record(rnd.decl_name, "PS_COMPRESS_DEV", pskey, t0,
+                     step=rnd.step_tag)
+        # honest D2H accounting: a leaf SHARED with a host bucket
+        # crosses PCIe dense anyway (it is in host_leaves), so this
+        # bucket's segments on such leaves saved nothing — count their
+        # dense bytes on top of the payload, or the bench's d2h ratio
+        # would report a saving that never physically happened
+        if rnd.host_leaves:
+            item = np.dtype(b.dtype).itemsize
+            d2h += sum(s.length * item for s in b.segments
+                       if s.leaf_index in rnd.host_leaves)
+        self._d2h_account(pskey, d2h)
+        self._m_push_bytes.inc(len(payload))
+        try:
+            self._routed(rnd, lambda epoch:
+                         self.backend.push_fused(pskey, payload,
+                                                 epoch=epoch)
+                         if epoch is not None
+                         else self.backend.push_fused(pskey, payload))
+        except Exception:
+            # mirror push_one's host-path handler: the round counter
+            # advanced but the push never landed — drop the entry so a
+            # retried exchange() re-seeds from the server's round
+            # instead of pulling a round that will never complete
+            with self._key_rounds_lock:
+                self._key_rounds.pop(pskey, None)
+            raise
+        # pull staging buffer (the fused pull path decodes into its own
+        # array; np.empty is malloc-only)
+        return np.empty(b.size, dtype=b.dtype)
+
     def _push_bucket(self, pskey, b, buf, rnd=None, idx=None) -> None:
         chain = self._chains.get(pskey)
         if chain is not None:
@@ -1195,9 +1342,11 @@ class PSGradientExchange:
                        stream: bool = False, sharded=None):
         self._ensure_watchdog()
         rnd = _Round(self, tree, name, stream=stream, sharded=sharded)
-        for l in rnd.sources:            # start ALL D2H copies first so the
-            if hasattr(l, "copy_to_host_async"):   # transfers overlap instead
-                l.copy_to_host_async()             # of serializing per leaf
+        for li, l in enumerate(rnd.sources):   # start ALL D2H copies first so
+            if hasattr(l, "copy_to_host_async") and (   # transfers overlap
+                    rnd.host_leaves is None or li in rnd.host_leaves):
+                l.copy_to_host_async()   # device-encoded-only leaves skip —
+                #                          their payload IS the D2H
 
         if not detach and not stream and (self.pipeline_depth <= 1
                                           or len(rnd.keyed) == 1):

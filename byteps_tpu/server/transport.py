@@ -447,6 +447,17 @@ class PSTransportServer:
     def __init__(self, backend, host: str = "0.0.0.0", port: int = 0,
                  key_meta=None, nic=None):
         self.backend = backend
+        # fused/homogeneous front (server/homog.py): backends with a
+        # fused surface of their own (HostPSBackend) handle managed
+        # keys internally; a RAW engine (PSServer) gets wrapped so the
+        # homogeneous decode-free sum exists on every deployment. Ops
+        # that can touch managed keys route through ``_fb``.
+        if hasattr(backend, "push_fused"):
+            self._fb = backend
+        else:
+            from .homog import FusedFront
+            self._fb = FusedFront(backend,
+                                  getattr(backend, "num_workers", 1))
         # optional emulated-NIC throttle (throttle.Nic): every accepted
         # connection's bytes are charged to this server endpoint's
         # bandwidth — see throttle.py / the PS-vs-allreduce bench
@@ -494,11 +505,9 @@ class PSTransportServer:
         # param mailbox (sharded weight update, OP_PARAM_*) — lazy too
         self._params = None
         self._shm = _ShmCache()
-        # fused-plane pull cache (OP_PULL_F): one encoded payload per
-        # (key, round, codec), throughput-only — the codecs are
-        # deterministic, so a miss re-encodes identical bytes
-        from ..compress.wire import FusedPullCache
-        self._fused_cache = FusedPullCache()
+        # fused-pull caching lives behind self._fb (the backend's own
+        # FusedPullCache, or FusedFront's, or the homog store's merged
+        # payload dict) — the transport layer holds no codec state
         # striping reassembly/scatter state (OP_PUSH_PART/OP_PULL_PART):
         # parts of one logical op arrive on DIFFERENT connection
         # threads. Stages carry a last-activity stamp and are swept
@@ -580,13 +589,20 @@ class PSTransportServer:
             if op == OP_INIT:
                 init = (np.frombuffer(payload, dtype=dtype)
                         if payload is not None else None)
-                self.backend.init_key(key, nbytes, dtype, init=init)
+                # rnd bit 0 = the worker's plan-time fused-managed
+                # declaration (compression-plane keys): hands the key's
+                # rounds to the homogeneous fused store
+                self._fb.init_key(key, nbytes, dtype, init=init,
+                                  fused=bool(int(rnd) & 1))
                 self._key_meta[key] = (int(nbytes), dtype)
                 # a (re-)init marks a new tenancy of the key on this
                 # shard (migration replay): shard-local rounds restart,
                 # so cached fused pulls from a previous tenancy would
-                # alias the recurring round numbers
-                self._fused_cache.drop(key)
+                # alias the recurring round numbers. HostPSBackend
+                # drops its own cache inside init_key; FusedFront
+                # exposes the drop explicitly.
+                if hasattr(self._fb, "drop_cached"):
+                    self._fb.drop_cached(key)
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PUSH:
                 # wire transcode: a frame dtype narrower than the store
@@ -598,7 +614,7 @@ class PSTransportServer:
                 if meta is not None and meta[1] != dtype:
                     arr = arr.astype(meta[1])
                 self._apply_push_once(
-                    key, rnd, lambda: self.backend.push(key, arr))
+                    key, rnd, lambda: self._fb.push(key, arr))
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PULL:
                 out = self._pull_dense(key, rnd, nbytes, dtype, timeout)
@@ -620,11 +636,12 @@ class PSTransportServer:
                                             key, payload))
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PUSH_F:
-                from ..compress import wire as cwire
-                arr = cwire.decode_for_store(payload,
-                                             self._key_meta.get(key))
+                # payload stays ENCODED through the front: managed keys
+                # buffer it for the homogeneous merge (no dense decode
+                # on this path), unmanaged keys decode into the engine
+                pay = bytes(payload)
                 self._apply_push_once(
-                    key, rnd, lambda: self.backend.push(key, arr))
+                    key, rnd, lambda: self._fb.push_fused(key, pay))
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PULL_F:
                 from ..compress import wire as cwire
@@ -633,9 +650,9 @@ class PSTransportServer:
                 div = (struct.unpack("<H", pb[1:3])[0]
                        if len(pb) >= 3 else cwire.TOPK_DIV)
                 t0 = time.time()
-                buf = cwire.pull_encoded(
-                    self.backend, self._fused_cache, key, int(nbytes),
-                    dtype, cid, int(rnd), int(timeout) or 30000,
+                buf = self._fb.pull_fused(
+                    key, int(nbytes), dtype, cid, round=int(rnd),
+                    timeout_ms=int(timeout) or 30000,
                     div=div or cwire.TOPK_DIV)
                 # same bottleneck signal OP_PULL feeds (_pull_dense):
                 # merge wait + the slowest worker's push lag; cache
@@ -658,21 +675,21 @@ class PSTransportServer:
                                            meta=self._rs_cols))
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_ROUND:
-                rv = struct.pack("!Q", int(self.backend.round(key)))
+                rv = struct.pack("!Q", int(self._fb.round(key)))
                 conn.sendall(_RSP.pack(ST_OK, len(rv)) + rv)
             elif op == OP_PUSH_SHM:
                 view = self._shm.view(bytes(payload).decode(), int(nbytes))
                 data = np.frombuffer(view, dtype=dtype)
                 self._apply_push_once(key, rnd,
-                                      lambda: self.backend.push(key, data))
+                                      lambda: self._fb.push(key, data))
                 del data, view   # release the buffer before reuse/unlink
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PULL_SHM:
                 view = self._shm.view(bytes(payload).decode(), int(nbytes))
                 out = np.frombuffer(view, dtype=dtype)
                 try:
-                    self.backend.pull(key, out, round=int(rnd),
-                                      timeout_ms=int(timeout) or 30000)
+                    self._fb.pull(key, out, round=int(rnd),
+                                  timeout_ms=int(timeout) or 30000)
                 finally:
                     del out, view
                 conn.sendall(_RSP.pack(ST_OK, 0))
@@ -860,13 +877,13 @@ class PSTransportServer:
         meta = self._key_meta.get(key)
         if meta is not None and meta[1] != dtype:
             store = np.empty(elems, dtype=meta[1])
-            self.backend.pull(key, store, round=int(rnd),
-                              timeout_ms=int(timeout) or 30000)
+            self._fb.pull(key, store, round=int(rnd),
+                          timeout_ms=int(timeout) or 30000)
             out = store.astype(dtype)
         else:
             out = np.empty(elems, dtype=dtype)
-            self.backend.pull(key, out, round=int(rnd),
-                              timeout_ms=int(timeout) or 30000)
+            self._fb.pull(key, out, round=int(rnd),
+                          timeout_ms=int(timeout) or 30000)
         # server-side merge wait: sum time + the lag of the slowest
         # worker's push — the transport server's bottleneck signal
         self._m_merge_wait.observe(time.time() - t0)
@@ -1275,14 +1292,16 @@ class RemotePSBackend:
         for args in self._inits[i].values():
             self._send_init(ch.sock, *args)
 
-    def _send_init(self, sock, key, nbytes, dtype, init, compression):
+    def _send_init(self, sock, key, nbytes, dtype, init, compression,
+                   fused=False):
         if compression:
             from ..ops.compression.host import serialize_kwargs
             self._roundtrip(sock, OP_INIT_C, key, 0, nbytes, 0, dtype,
                             memoryview(serialize_kwargs(compression)))
         else:
             payload = None if init is None else _as_bytes(init)
-            self._roundtrip(sock, OP_INIT, key, 0, nbytes, 0, dtype, payload)
+            self._roundtrip(sock, OP_INIT, key, 1 if fused else 0,
+                            nbytes, 0, dtype, payload)
 
     @staticmethod
     def _roundtrip(sock, op, key, rnd, nbytes, timeout_ms, dtype, payload,
@@ -1428,7 +1447,8 @@ class RemotePSBackend:
 
     def init_key(self, key: int, nbytes: int, dtype: str = "float32",
                  init: Optional[np.ndarray] = None,
-                 compression: Optional[Dict[str, str]] = None) -> None:
+                 compression: Optional[Dict[str, str]] = None,
+                 fused: bool = False) -> None:
         if self._ring is not None:
             self._ring.place(key, nbytes)    # byte-weighted, idempotent
         if compression:
@@ -1437,15 +1457,22 @@ class RemotePSBackend:
                       memoryview(serialize_kwargs(compression)))
         else:
             payload = None if init is None else _as_bytes(init)
-            self._rpc(OP_INIT, key, 0, nbytes, 0, dtype, payload)
+            # OP_INIT rnd bit 0 = fused-managed declaration (the
+            # compression plane's plan-time eligibility): the server
+            # hands the key's rounds to its homogeneous fused store
+            self._rpc(OP_INIT, key, 1 if fused else 0, nbytes, 0, dtype,
+                      payload)
         # record for replay after a reconnect (restarted server has an
         # empty key table) — only once ACCEPTED, or a rejected conflicting
         # re-declaration would poison the replay log; keep a copy of init
-        # (the caller may mutate it)
+        # (the caller may mutate it). The fused flag replays too — a
+        # restarted server must re-manage the key, not silently fall
+        # back to dense decodes.
         i = self._shard(key)
         self._inits[i][key] = (key, nbytes, dtype,
                                None if init is None else np.array(init),
-                               dict(compression) if compression else None)
+                               dict(compression) if compression else None,
+                               bool(fused))
         # count only after the server accepted, once per key (re-inits are
         # no-ops server-side — don't skew the load stats)
         if key not in self._placed:

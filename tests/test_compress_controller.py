@@ -31,12 +31,31 @@ def test_wire_bound_ratchets_up():
     reg, c = make()
     stalls = reg.counter("nic/stalls")
     seen = []
-    for _ in range(8):
+    for _ in range(12):
         stalls.inc(5)
         c.decide()
         seen.append(c.level_of("l0"))
-    assert seen == [0, 1, 1, 2, 2, 3, 3, 3]     # none→fp16→int8→topk, capped
+    # none→fp16→int8→fp8_e4m3→fp8_e5m2→topk, capped at max_level
+    assert seen == [0, 1, 1, 2, 2, 3, 3, 4, 4, 5, 5, 5]
     assert c.level_of("l1") == wire.CODEC_TOPK
+
+
+def test_fp8_rungs_gated_by_max_level():
+    """BPS_COMPRESS_MAX=int8 (the default) keeps the ladder below the
+    fp8 rungs; raising it to fp8_e4m3 exposes exactly one more rung —
+    the explicit opt-in gate the fp8 rungs sit behind."""
+    reg, c = make(max_level="int8")
+    stalls = reg.counter("nic/stalls")
+    for _ in range(12):
+        stalls.inc(5)
+        c.decide()
+    assert c.level_of("l0") == wire.CODEC_INT8          # never fp8
+    reg2, c2 = make(max_level="fp8_e4m3")
+    stalls2 = reg2.counter("nic/stalls")
+    for _ in range(12):
+        stalls2.inc(5)
+        c2.decide()
+    assert c2.level_of("l0") == wire.CODEC_FP8_E4M3     # never topk
 
 
 def test_resends_and_queue_depth_also_count_as_pressure():
@@ -59,11 +78,11 @@ def test_idle_wire_decays_to_none():
     would lose (arXiv 2103.00543)."""
     reg, c = make()
     stalls = reg.counter("nic/stalls")
-    for _ in range(6):
+    for _ in range(10):
         stalls.inc(1)
         c.decide()
     assert c.level_of("l0") == wire.CODEC_TOPK
-    for _ in range(6):
+    for _ in range(10):
         c.decide()                               # no new stalls: idle
     assert c.level_of("l0") == wire.CODEC_NONE
     assert c.level_of("l1") == wire.CODEC_NONE

@@ -83,20 +83,22 @@ def _train(model: str, compress: str, steps: int, tag: str):
 
 
 def _assert_converges_like_dense(model: str, steps: int,
-                                 rel_tol: float) -> None:
+                                 rel_tol: float,
+                                 codec: str = "int8") -> None:
     dense_losses, _ = _train(model, "none", steps, "dense")
-    comp_losses, _ = _train(model, "int8", steps, "int8")
+    comp_losses, _ = _train(model, codec, steps, codec)
     assert dense_losses[-1] < dense_losses[0]
     assert comp_losses[-1] < comp_losses[0], (
         f"{model}: compressed training did not reduce the loss: "
         f"{comp_losses[:3]} .. {comp_losses[-3:]}")
-    # the tolerance contract: int8+EF lands at the same loss as dense
+    # the tolerance contract: codec+EF lands at the same loss as dense
     # within rel_tol (EF makes the compression error telescoping, so
-    # the trajectories track instead of drifting)
+    # the trajectories track instead of drifting; the fp8 rungs'
+    # stochastic rounding is additionally unbiased)
     rel = abs(comp_losses[-1] - dense_losses[-1]) / abs(dense_losses[-1])
     assert rel < rel_tol, (
         f"{model}: final loss diverged: dense {dense_losses[-1]:.5f} "
-        f"vs int8+EF {comp_losses[-1]:.5f} (rel {rel:.4f})")
+        f"vs {codec}+EF {comp_losses[-1]:.5f} (rel {rel:.4f})")
 
 
 def test_mlp_int8_ef_converges_to_dense_loss():
@@ -105,6 +107,16 @@ def test_mlp_int8_ef_converges_to_dense_loss():
 
 def test_bert_int8_ef_converges_to_dense_loss():
     _assert_converges_like_dense("bert", steps=8, rel_tol=0.05)
+
+
+def test_mlp_fp8_ef_converges_to_dense_loss():
+    _assert_converges_like_dense("mlp", steps=20, rel_tol=0.05,
+                                 codec="fp8_e4m3")
+
+
+def test_bert_fp8_ef_converges_to_dense_loss():
+    _assert_converges_like_dense("bert", steps=8, rel_tol=0.05,
+                                 codec="fp8_e4m3")
 
 
 @pytest.mark.slow
@@ -117,6 +129,12 @@ def test_t5_int8_ef_converges_to_dense_loss():
     _assert_converges_like_dense("t5", steps=8, rel_tol=0.05)
 
 
+@pytest.mark.slow
+def test_gpt2_fp8_ef_converges_to_dense_loss():
+    _assert_converges_like_dense("gpt2", steps=8, rel_tol=0.05,
+                                 codec="fp8_e5m2")
+
+
 def test_none_mode_bit_identical_runs():
     """BPS_COMPRESS=none is the dense path exactly: two runs are
     bit-identical (the fused plane must not perturb HEAD numerics)."""
@@ -127,11 +145,14 @@ def test_none_mode_bit_identical_runs():
         assert np.array_equal(va, vb)
 
 
-def test_int8_pinned_trace_deterministic():
+@pytest.mark.parametrize("codec", ["int8", "fp8_e4m3"])
+def test_pinned_trace_deterministic(codec):
     """Fixed codec = pinned decision trace: compressed training is
-    deterministic across runs (the ISSUE's determinism contract)."""
-    _, a = _train("mlp", "int8", 5, "det-a")
-    _, b = _train("mlp", "int8", 5, "det-b")
+    deterministic across runs (the ISSUE's determinism contract) — the
+    fp8 rung included, because its stochastic rounding is counter-based
+    (a pure function of key/round/sequence, never a global RNG)."""
+    _, a = _train("mlp", codec, 5, f"det-a-{codec}")
+    _, b = _train("mlp", codec, 5, f"det-b-{codec}")
     for va, vb in zip(jax.tree_util.tree_leaves(a),
                       jax.tree_util.tree_leaves(b)):
         assert np.array_equal(va, vb)

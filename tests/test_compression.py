@@ -275,7 +275,11 @@ def test_fused_codec_roundtrip_and_size(name):
         assert len(payload) < 1000 * 4          # it actually compresses
         # every codec is value-bounded: reconstruction error within the
         # codec's resolution on the unit-normal input
+        # fp8 bounds: SR picks a grid NEIGHBOR, so the error is one
+        # grid step at the value's binade — at amax≈3.7 that is
+        # amax/448*2^5 ≈ 0.27 (e4m3) / amax/57344*2^13 ≈ 0.53 (e5m2)
         tol = {cwire.CODEC_FP16: 1e-3, cwire.CODEC_INT8: 0.05,
+               cwire.CODEC_FP8_E4M3: 0.3, cwire.CODEC_FP8_E5M2: 0.6,
                cwire.CODEC_TOPK: 5.0}[cid]
         assert float(np.abs(out - x).max()) <= tol
 
@@ -369,6 +373,154 @@ def test_fused_plane_residual_commits_only_on_pull():
     # the retry compresses against the same committed residual
     p2_retry = plane.encode(3, g, cwire.CODEC_INT8, 2)
     assert p2 == p2_retry
+
+
+@pytest.mark.parametrize("name", ["fp8_e4m3", "fp8_e5m2"])
+def test_fp8_sr_deterministic_and_seeded(name):
+    """The fp8 rungs' stochastic rounding is COUNTER-BASED: a pure
+    function of (input, seed) — same seed = same bytes (the
+    bit-reproducibility contract), different seed = different noise —
+    and the default-seed encode is still RNG-free pure."""
+    cid = cwire.codec_id(name)
+    x = np.random.RandomState(30).randn(4096).astype(np.float32)
+    assert cwire.encode(cid, x, seed=5) == cwire.encode(cid, x.copy(),
+                                                       seed=5)
+    assert cwire.encode(cid, x, seed=5) != cwire.encode(cid, x, seed=6)
+    assert cwire.encode(cid, x) == cwire.encode(cid, x.copy())
+
+
+@pytest.mark.parametrize("name", ["fp8_e4m3", "fp8_e5m2"])
+def test_fp8_sr_rounds_to_grid_neighbors_unbiased(name):
+    """Every decoded value is one of the two fp8 grid neighbors of
+    x/scale (never nan/inf — saturation clips like int8), and
+    averaging over seeds approaches the true value: the quantizer is
+    unbiased, which is what lets fp8 sit ABOVE int8 in the ladder at
+    identical wire bytes."""
+    from byteps_tpu.ops.compression import fp8sr
+    cid = cwire.codec_id(name)
+    kind = fp8sr.E4M3 if name == "fp8_e4m3" else fp8sr.E5M2
+    mx = fp8sr.fmt_max(kind)
+    grid = np.unique(np.abs(fp8sr.decode_bits(
+        np.arange(256, dtype=np.uint8), kind)))
+    grid = grid[np.isfinite(grid)]
+    x = np.random.RandomState(31).randn(4096).astype(np.float32)
+    import struct as _struct
+    p = cwire.encode(cid, x, seed=9)
+    (scale,) = _struct.unpack("<f", p[cwire._HDR.size:
+                                      cwire._HDR.size + 4])
+    dec = cwire.decode(p, 4096, "float32")
+    assert np.isfinite(dec).all()
+    y = np.clip(x / scale, -mx, mx)
+    q = np.abs(dec) / scale
+    lo_i = np.clip(np.searchsorted(grid, np.abs(y), side="right") - 1,
+                   0, len(grid) - 1)
+    hi_i = np.clip(lo_i + 1, 0, len(grid) - 1)
+    ok = (np.abs(q - grid[lo_i]) < 1e-3 * np.maximum(grid[lo_i], 1)) | \
+         (np.abs(q - grid[hi_i]) < 1e-3 * np.maximum(grid[hi_i], 1))
+    assert ok.all()
+    acc = np.zeros(4096)
+    S = 64
+    for s in range(S):
+        acc += cwire.decode(cwire.encode(cid, x, seed=s), 4096,
+                            "float32")
+    # SR noise averages out ~ grid-step/sqrt(S)
+    assert float(np.abs(acc / S - x).max()) < 0.2
+
+
+@pytest.mark.parametrize("name", ["int8", "fp8_e4m3", "fp8_e5m2"])
+def test_fused_plane_residual_commits_only_on_pull_all_codecs(name):
+    """The EF commit-on-pull contract extended to the fp8 rungs: a
+    round that dies between push and pull never advances the EF state
+    OR the SR sequence's effect on the retry — the retry re-encodes
+    byte-identically."""
+    n = 64
+    cid = cwire.codec_id(name)
+    plane = CompressionPlane(name, min_bytes=0)
+    plane.register(4, n, "float32", "l.0")
+    g = np.random.RandomState(32).randn(n).astype(np.float32)
+    p1 = plane.encode(4, g, cid, 1)
+    plane.decode(4, p1, 1)
+    committed = plane._keys[4].residual.copy()
+    seq_after_r1 = plane._keys[4].sr_seq
+    plane.encode(4, g, cid, 2)              # round 2 pushed...
+    # ...but its pull never lands: committed state unchanged
+    np.testing.assert_array_equal(plane._keys[4].residual, committed)
+    # NOTE: the retry advances sr_seq (fresh noise per attempt is fine
+    # — determinism is per-(input, seed), and the dead round committed
+    # nothing), but the residual the retry folds is the committed one
+    p2_retry = plane.encode(4, g, cid, 2)
+    dec = cwire.decode(p2_retry, n, np.float32)
+    resid_base = np.asarray(plane._keys[4].pending[1]) + dec
+    np.testing.assert_allclose(resid_base, g + committed, atol=1e-6)
+    del seq_after_r1
+
+
+def test_fp8_idle_decay_flush_clears_sr_state():
+    """The satellite fix: a layer decaying to ``none`` flushes its EF
+    residual into one dense round AND resets the fp8 SR sequence — a
+    layer re-entering the ladder starts from a clean residual and a
+    clean, trace-reproducible SR state (same bytes as a fresh plane)."""
+    n = 64
+    cid = cwire.CODEC_FP8_E4M3
+    g = np.random.RandomState(33).randn(n).astype(np.float32)
+
+    plane = CompressionPlane("fp8_e4m3", min_bytes=0)
+    plane.register(5, n, "float32", "l.0")
+    for r in (1, 2):
+        plane.decode(5, plane.encode(5, g, cid, r), r)
+    st = plane._keys[5]
+    assert st.sr_seq == 2 and st.residual is not None
+    # level decays to none: the dense round flushes the residual...
+    flushed = plane.fold_residual(5, g.copy(), 3)
+    np.testing.assert_allclose(flushed, g + st.residual, atol=1e-6)
+    assert st.sr_seq == 0                    # ...and clears SR state
+    plane.commit(5, 3)                       # the flush round's pull
+    assert st.residual is None and st.pending is None
+    # re-entering the ladder = clean start: byte-identical to a fresh
+    # plane encoding the same input at the same round tag
+    fresh = CompressionPlane("fp8_e4m3", min_bytes=0)
+    fresh.register(5, n, "float32", "l.0")
+    assert plane.encode(5, g, cid, 4) == fresh.encode(5, g, cid, 4)
+
+
+def test_device_encode_failure_keeps_sr_sequence(monkeypatch):
+    """A device-encode failure must consume NO SR sequence value: the
+    host-codec fallback then encodes with the same seed a pure-host
+    run would use, keeping the run bitwise-equal (the probe-or-fallback
+    byte-identity contract)."""
+    import jax.numpy as jnp
+
+    from byteps_tpu.compress import device as cdev
+    n, cid = 256, cwire.CODEC_FP8_E4M3
+    g = np.random.RandomState(35).randn(n).astype(np.float32)
+    plane = CompressionPlane("fp8_e4m3", min_bytes=0)
+    plane.register(8, n, "float32", "l.0")
+    monkeypatch.setattr(cdev, "encode_bucket",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            RuntimeError("kernel died")))
+    with pytest.raises(RuntimeError):
+        plane.encode_on_device(8, [(jnp.asarray(g), 0, n)], cid, 1)
+    assert plane._keys[8].sr_seq == 0            # nothing consumed
+    assert plane._keys[8].pending is None        # nothing staged
+    # the fallback host encode == a pure-host plane's encode
+    fallback = plane.encode(8, g, cid, 1)
+    fresh = CompressionPlane("fp8_e4m3", min_bytes=0)
+    fresh.register(8, n, "float32", "l.0")
+    assert fallback == fresh.encode(8, g, cid, 1)
+    assert plane._keys[8].sr_seq == 1
+
+
+def test_dense_push_accounting_also_resets_sr_state():
+    """note_dense_push (a level-none round of a managed key with no
+    residual to flush) still resets the SR sequence — EF-off planes
+    decay clean too."""
+    plane = CompressionPlane("fp8_e4m3", min_bytes=0, ef=False)
+    plane.register(6, 64, "float32", "l.0")
+    g = np.random.RandomState(34).randn(64).astype(np.float32)
+    plane.encode(6, g, cwire.CODEC_FP8_E4M3, 1)
+    assert plane._keys[6].sr_seq == 1
+    plane.note_dense_push(6, 256)
+    assert plane._keys[6].sr_seq == 0
 
 
 def test_fused_plane_eligibility_floor():

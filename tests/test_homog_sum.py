@@ -1,0 +1,346 @@
+"""Codec-homogeneous server summation (byteps_tpu/server/homog.py).
+
+The decode-free merge path's contract, unit-level and through both
+server deployments (in-process HostPSBackend, TCP transport over a raw
+engine): same-codec rounds merge without any dense decode reaching the
+engine (counter-asserted), heterogeneous rounds fall back LOUDLY but
+bit-identically, and the merged payloads/pulls are BYTE-IDENTICAL to
+the dense path's (same arrival-order float ops, same sr_seed'd
+re-encode) — so flipping BPS_FUSED_HOMOG changes server CPU work, not
+a single result bit."""
+
+import numpy as np
+import pytest
+
+from byteps_tpu.compress import wire as cwire
+from byteps_tpu.obs.metrics import get_registry
+from byteps_tpu.server.engine import HostPSBackend
+from byteps_tpu.server.homog import FusedSumStore
+from byteps_tpu.server.transport import PSTransportServer, RemotePSBackend
+
+N = 2048
+
+
+def grads(*seeds):
+    return [np.random.RandomState(s).randn(N).astype(np.float32)
+            for s in seeds]
+
+
+def dense_path_merge(payloads):
+    """What the engine path computes: decode each arrival, arrival-order
+    sum (first copies)."""
+    acc = None
+    for p in payloads:
+        d = cwire.decode(p, N, "float32")
+        acc = d if acc is None else acc + d
+    return acc
+
+
+# ----------------------------------------------------- FusedSumStore
+
+@pytest.mark.parametrize("codec", ["fp16", "int8", "fp8_e4m3",
+                                   "fp8_e5m2"])
+def test_homog_merge_bitwise_parity_with_dense_path(codec):
+    """A homogeneous round's merged dense AND its served payload are
+    byte-identical to what the decode->engine->re-encode path would
+    produce — the property that lets failover/replay mix paths
+    bit-exactly."""
+    cid = cwire.codec_id(codec)
+    g1, g2 = grads(1, 2)
+    p1 = cwire.encode(cid, g1, seed=11)
+    p2 = cwire.encode(cid, g2, seed=22)
+    st = FusedSumStore(num_workers=2)
+    st.init_key(5, N * 4)
+    st.ingest(5, p1)
+    assert st.round(5) == 0 and st.pending() == 1
+    st.ingest(5, p2)
+    assert st.round(5) == 1 and st.pending() == 0
+    want = dense_path_merge([p1, p2])
+    out = np.empty(N, np.float32)
+    st.pull_dense(5, out, round=1)
+    np.testing.assert_array_equal(out, want)
+    # served payload == wire.encode of the dense merge under the shared
+    # (key, round) seed — the dense path's pull re-encode, verbatim
+    assert st.pull_payload(5, cid, 1) == cwire.encode(
+        cid, want, seed=cwire.sr_seed(5, 1))
+
+
+def test_homog_counters_and_hetero_fallback():
+    reg = get_registry()
+    g1, g2 = grads(3, 4)
+    st = FusedSumStore(num_workers=2)
+    st.init_key(6, N * 4)
+    h0 = reg.counter("server/fused_rounds_homog").value
+    f0 = reg.counter("server/fused_rounds_fallback").value
+    d0 = reg.counter("server/fused_dense_decodes").value
+    # homogeneous: no dense decodes counted
+    st.ingest(6, cwire.encode(cwire.CODEC_INT8, g1))
+    st.ingest(6, cwire.encode(cwire.CODEC_INT8, g2))
+    assert reg.counter("server/fused_rounds_homog").value == h0 + 1
+    assert reg.counter("server/fused_dense_decodes").value == d0
+    # heterogeneous codecs: loud fallback, per-lossy-payload decodes
+    st.ingest(6, cwire.encode(cwire.CODEC_INT8, g1))
+    st.ingest(6, cwire.encode(cwire.CODEC_FP16, g2))
+    assert reg.counter("server/fused_rounds_fallback").value == f0 + 1
+    assert reg.counter("server/fused_dense_decodes").value == d0 + 2
+    out = np.empty(N, np.float32)
+    st.pull_dense(6, out, round=2)
+    np.testing.assert_array_equal(out, dense_path_merge(
+        [cwire.encode(cwire.CODEC_INT8, g1),
+         cwire.encode(cwire.CODEC_FP16, g2)]))
+
+
+def test_homog_mixed_dense_and_all_dense_rounds():
+    """A divergent worker's dense arrival joins the round (fallback);
+    an ALL-dense round (level none) merges quietly — no fallback
+    counted, bit-equal to g1+g2."""
+    reg = get_registry()
+    g1, g2 = grads(5, 6)
+    st = FusedSumStore(num_workers=2)
+    st.init_key(7, N * 4)
+    f0 = reg.counter("server/fused_rounds_fallback").value
+    st.ingest_dense(7, g1)
+    st.ingest(7, cwire.encode(cwire.CODEC_INT8, g2))
+    assert reg.counter("server/fused_rounds_fallback").value == f0 + 1
+    out = np.empty(N, np.float32)
+    st.pull_dense(7, out, round=1)
+    np.testing.assert_array_equal(
+        out, g1 + cwire.decode(cwire.encode(cwire.CODEC_INT8, g2),
+                               N, "float32"))
+    st.ingest_dense(7, g1)
+    st.ingest_dense(7, g2)
+    assert reg.counter("server/fused_rounds_fallback").value == f0 + 1
+    st.pull_dense(7, out, round=2)
+    np.testing.assert_array_equal(out, g1 + g2)
+
+
+def test_homog_topk_falls_back_not_crashes():
+    """topk is not widenable (sparse union-sum): it always takes the
+    dense fallback, loudly counted, results identical to the engine
+    path."""
+    reg = get_registry()
+    g1, g2 = grads(7, 8)
+    p1 = cwire.encode(cwire.CODEC_TOPK, g1)
+    p2 = cwire.encode(cwire.CODEC_TOPK, g2)
+    st = FusedSumStore(num_workers=2)
+    st.init_key(8, N * 4)
+    f0 = reg.counter("server/fused_rounds_fallback").value
+    st.ingest(8, p1)
+    st.ingest(8, p2)
+    assert reg.counter("server/fused_rounds_fallback").value == f0 + 1
+    out = np.empty(N, np.float32)
+    st.pull_dense(8, out, round=1)
+    np.testing.assert_array_equal(out, dense_path_merge([p1, p2]))
+
+
+def test_homog_round_semantics_and_errors():
+    st = FusedSumStore(num_workers=1, retain=2)
+    init = np.full(N, 7.0, np.float32)
+    st.init_key(9, N * 4, init=init)
+    out = np.empty(N, np.float32)
+    st.pull_dense(9, out, round=0)          # latest before any round =
+    np.testing.assert_array_equal(out, init)   # the init value
+    for r in range(1, 5):
+        st.ingest(9, cwire.encode(cwire.CODEC_INT8, grads(r)[0]))
+    assert st.round(9) == 4
+    with pytest.raises(TimeoutError):
+        st.pull_dense(9, out, round=9, timeout_ms=100)
+    with pytest.raises(ValueError, match="evicted"):
+        st.pull_dense(9, out, round=1)      # outside the retain window
+    with pytest.raises(cwire.CodecError):
+        st.ingest(9, cwire.encode(cwire.CODEC_INT8,
+                                  grads(1)[0][: N // 2]))  # plan mismatch
+    # re-init = new tenancy: rounds restart
+    st.init_key(9, N * 4)
+    assert st.round(9) == 0
+
+
+def test_homog_validates_before_counting():
+    """A torn payload must refuse BEFORE it can count as an arrival —
+    otherwise the round would complete with garbage or wedge short.
+    Crucially this includes a VALID-HEADER/short-body frame arriving as
+    the round-completing push: refusing only inside the merge would
+    discard the other worker's buffered arrival and poison the round;
+    refused at ingest, the torn pusher's retry completes it."""
+    g1, g2 = grads(20, 21)
+    st = FusedSumStore(num_workers=2)
+    st.init_key(10, N * 4)
+    with pytest.raises(cwire.CodecError):
+        st.ingest(10, b"\x00" * 40)             # garbage header
+    assert st.pending() == 0
+    p1 = cwire.encode(cwire.CODEC_INT8, g1)
+    p2 = cwire.encode(cwire.CODEC_INT8, g2)
+    st.ingest(10, p1)
+    with pytest.raises(cwire.CodecError):
+        st.ingest(10, p2[:-100])                # torn BODY, intact header
+    assert st.pending() == 1                    # p1 survives...
+    st.ingest(10, p2)                           # ...and the retry
+    assert st.round(10) == 1                    # completes the round
+    out = np.empty(N, np.float32)
+    st.pull_dense(10, out, round=1)
+    np.testing.assert_array_equal(out, dense_path_merge([p1, p2]))
+    # torn topk bodies refuse too (index bounds checked at ingest)
+    pt = bytearray(cwire.encode(cwire.CODEC_TOPK, g1))
+    pt[cwire._HDR.size + 4:cwire._HDR.size + 8] = (
+        np.int32(N + 7).tobytes())              # out-of-range index
+    with pytest.raises(cwire.CodecError):
+        st.ingest(10, bytes(pt))
+    assert st.pending() == 0
+
+
+def test_backend_reinit_drops_stale_fused_pull_cache():
+    """A key (re-)init is a new tenancy: on a migration-replayed server
+    the shard-local rounds restart, so a cached UNMANAGED fused pull
+    from the previous tenancy would alias the recurring round numbers.
+    The backend must drop the key's cached rounds on init — asserted
+    directly on the cache (an in-process engine's re-init is a no-op,
+    so the aliasing geometry itself only exists across real replays)."""
+    (g1,) = grads(22)
+    be = HostPSBackend(num_servers=1, num_workers=1, engine_threads=1)
+    try:
+        be.init_key(45, N * 4, "float32")       # unmanaged (no fused=)
+        be.push_fused(45, cwire.encode(cwire.CODEC_INT8, g1))
+        be.pull_fused(45, N * 4, "float32", cwire.CODEC_INT8, round=1)
+        assert be._fused_cache.get(45, 1, cwire.CODEC_INT8) is not None
+        be.init_key(45, N * 4, "float32")       # new tenancy
+        assert be._fused_cache.get(45, 1, cwire.CODEC_INT8) is None
+    finally:
+        be.close()
+
+
+# ----------------------------------------- HostPSBackend integration
+
+def test_backend_homog_vs_dense_path_bit_identical(monkeypatch):
+    """BPS_FUSED_HOMOG on/off: same pushes, byte-identical fused pulls
+    and dense pulls — the homogeneous path changes server work, never
+    results."""
+    g1, g2 = grads(11, 12)
+    p1 = cwire.encode(cwire.CODEC_FP8_E4M3, g1, seed=1)
+    p2 = cwire.encode(cwire.CODEC_FP8_E4M3, g2, seed=2)
+
+    def run(enabled):
+        monkeypatch.setenv("BPS_FUSED_HOMOG", "1" if enabled else "0")
+        be = HostPSBackend(num_servers=1, num_workers=2,
+                           engine_threads=1)
+        try:
+            be.init_key(31, N * 4, "float32", fused=True)
+            be.push_fused(31, p1)
+            be.push_fused(31, p2)
+            pay = be.pull_fused(31, N * 4, "float32",
+                                cwire.CODEC_FP8_E4M3, round=1)
+            out = np.empty(N, np.float32)
+            be.pull(31, out, round=1)
+            return pay, out.copy(), be.round(31)
+        finally:
+            be.close()
+
+    pay_on, dense_on, rnd_on = run(True)
+    pay_off, dense_off, rnd_off = run(False)
+    assert rnd_on == rnd_off == 1
+    assert pay_on == pay_off
+    np.testing.assert_array_equal(dense_on, dense_off)
+
+
+def test_backend_homog_zero_dense_decodes():
+    reg = get_registry()
+    g1, g2 = grads(13, 14)
+    be = HostPSBackend(num_servers=1, num_workers=2, engine_threads=1)
+    try:
+        be.init_key(33, N * 4, "float32", fused=True)
+        d0 = reg.counter("server/fused_dense_decodes").value
+        h0 = reg.counter("server/fused_rounds_homog").value
+        for r in range(1, 4):
+            be.push_fused(33, cwire.encode(cwire.CODEC_INT8, g1 * r))
+            be.push_fused(33, cwire.encode(cwire.CODEC_INT8, g2 * r))
+            be.pull_fused(33, N * 4, "float32", cwire.CODEC_INT8,
+                          round=r)
+        assert reg.counter("server/fused_dense_decodes").value == d0
+        assert reg.counter("server/fused_rounds_homog").value == h0 + 3
+    finally:
+        be.close()
+
+
+def test_backend_unmanaged_fused_still_works_and_counts():
+    """A fused push of a key never declared fused keeps the PR-7
+    decode-into-engine path — now with the dense decode counted."""
+    reg = get_registry()
+    (g1,) = grads(15)
+    be = HostPSBackend(num_servers=1, num_workers=1, engine_threads=1)
+    try:
+        be.init_key(35, N * 4, "float32")
+        d0 = reg.counter("server/fused_dense_decodes").value
+        be.push_fused(35, cwire.encode(cwire.CODEC_INT8, g1))
+        assert reg.counter("server/fused_dense_decodes").value == d0 + 1
+        out = np.empty(N, np.float32)
+        be.pull(35, out, round=1)
+        np.testing.assert_array_equal(out, cwire.decode(
+            cwire.encode(cwire.CODEC_INT8, g1), N, "float32"))
+    finally:
+        be.close()
+
+
+# ------------------------------------------------ TCP (FusedFront)
+
+def test_transport_homog_over_raw_engine():
+    """The transport server wraps a RAW PSServer in FusedFront: the
+    OP_INIT fused flag rides the wire, same-codec rounds merge homog
+    (zero dense decodes), OP_ROUND answers from the homog store, and
+    dense pulls serve the merged round."""
+    from byteps_tpu.server.engine import PSServer
+
+    reg = get_registry()
+    g1, g2 = grads(16, 17)
+    eng = PSServer(num_workers=2, engine_threads=1)
+    srv = PSTransportServer(eng, host="127.0.0.1")
+    try:
+        w1 = RemotePSBackend([f"127.0.0.1:{srv.port}"])
+        w2 = RemotePSBackend([f"127.0.0.1:{srv.port}"])
+        w1.init_key(41, N * 4, "float32", fused=True)
+        w2.init_key(41, N * 4, "float32", fused=True)
+        p1 = cwire.encode(cwire.CODEC_INT8, g1)
+        p2 = cwire.encode(cwire.CODEC_INT8, g2)
+        d0 = reg.counter("server/fused_dense_decodes").value
+        h0 = reg.counter("server/fused_rounds_homog").value
+        w1.push_fused(41, p1)
+        w2.push_fused(41, p2)
+        want = dense_path_merge([p1, p2])
+        for w in (w1, w2):
+            pay = w.pull_fused(41, N * 4, "float32", cwire.CODEC_INT8,
+                               round=1)
+            assert pay == cwire.encode(cwire.CODEC_INT8, want,
+                                       seed=cwire.sr_seed(41, 1))
+        assert w1.round(41) == 1
+        out = np.empty(N, np.float32)
+        w1.pull(41, out, round=1)
+        np.testing.assert_array_equal(out, want)
+        assert reg.counter("server/fused_dense_decodes").value == d0
+        assert reg.counter("server/fused_rounds_homog").value == h0 + 1
+        w1.close()
+        w2.close()
+    finally:
+        srv.close()
+        eng.close()
+
+
+def test_exchange_declares_fused_keys_to_the_server():
+    """End to end through PSGradientExchange: plan-time registration
+    marks eligible buckets fused, so a pinned-codec exchange's rounds
+    ride the homog store — zero dense decodes on the merge path."""
+    from byteps_tpu.server.ps_mode import PSGradientExchange
+
+    reg = get_registry()
+    be = HostPSBackend(num_servers=1, num_workers=1, engine_threads=1)
+    try:
+        ex = PSGradientExchange(be, partition_bytes=8 << 10,
+                                min_compress_bytes=0, compress="int8")
+        d0 = reg.counter("server/fused_dense_decodes").value
+        h0 = reg.counter("server/fused_rounds_homog").value
+        tree = {"g": np.random.RandomState(18).randn(6000)
+                .astype(np.float32)}
+        out = ex.exchange(tree, name="hx")
+        np.testing.assert_allclose(out["g"], tree["g"], atol=0.02)
+        assert reg.counter("server/fused_dense_decodes").value == d0
+        assert reg.counter("server/fused_rounds_homog").value > h0
+        ex.close()
+    finally:
+        be.close()

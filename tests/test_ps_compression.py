@@ -471,6 +471,12 @@ def test_bench_ps_comp_smoke():
     out = bench.ps_comp_breakdown(iters=3, warm=4, pairs=1,
                                   compute_iters=20)
     assert out["comp_vs_dense_wire_bound"] > 1.3, out
+    # fp8 device-encode arm: the D2H halving and the homogeneous merge
+    # are machine-readable — encoded payloads crossing D2H instead of
+    # dense buckets, zero dense decodes on the server merge path
+    assert out["fp8_d2h_vs_dense"] <= 0.55, out
+    assert out["fp8_homog_rounds"] > 0, out
+    assert out["fp8_dense_decodes"] == 0, out
     # non-empty guards: a drift in the bench's layer-gauge naming must
     # fail here, not vacuously pass the all()-over-empty below
     assert out["wire_bound_levels"], out
@@ -527,3 +533,114 @@ def test_fused_refused_at_construction_on_incapable_backend():
 
     with pytest.raises(ValueError, match="push_fused"):
         PSGradientExchange(DenseOnly(), compress="auto")
+
+
+# ------------------------------------------- fp8 rungs + device encode
+
+def test_fused_exchange_fp8_end_to_end():
+    """A pinned fp8 exchange through the full PS path: payloads ride
+    the homogeneous store (two workers, one codec), the summed tree is
+    within SR-quantization tolerance, and two identical runs are
+    bit-identical (counter-based SR under a pinned trace)."""
+    from byteps_tpu.obs.metrics import get_registry
+
+    def run():
+        be = HostPSBackend(num_servers=1, num_workers=1,
+                           engine_threads=1)
+        try:
+            ex = PSGradientExchange(be, partition_bytes=8 << 10,
+                                    min_compress_bytes=0,
+                                    compress="fp8_e4m3")
+            tree = {"g": np.random.RandomState(60).randn(6000)
+                    .astype(np.float32)}
+            outs = [ex.exchange({"g": tree["g"] * (r + 1)},
+                                name="f8")["g"].copy()
+                    for r in range(3)]
+            ex.close()
+            return tree["g"], outs
+        finally:
+            be.close()
+
+    reg = get_registry()
+    d0 = reg.counter("server/fused_dense_decodes").value
+    g, a = run()
+    _, b = run()
+    # one fp8 round = two SR quantizations (worker push + server
+    # re-encode): error ≤ ~2 grid steps at the top binade ≈ 0.07·amax
+    np.testing.assert_allclose(a[0], g, atol=0.45)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    assert reg.counter("server/fused_dense_decodes").value == d0
+
+
+def test_device_encode_exchange_bitwise_vs_host(monkeypatch):
+    """BPS_COMPRESS_DEVICE=1 (interpret-mode kernels on CPU): the
+    device-encoded exchange produces BIT-IDENTICAL results to the host
+    codec path across EF rounds — the probe's byte-identity contract
+    holding through the full pipeline — while ps/d2h_bytes drops to
+    the payload size."""
+    from byteps_tpu.compress import device as cdev
+    from byteps_tpu.obs.metrics import get_registry
+
+    def run(dev):
+        monkeypatch.setenv("BPS_COMPRESS_DEVICE", "1" if dev else "0")
+        cdev.reset_probe()
+        be = HostPSBackend(num_servers=1, num_workers=1,
+                           engine_threads=1)
+        try:
+            ex = PSGradientExchange(be, partition_bytes=16 << 10,
+                                    min_compress_bytes=0,
+                                    compress="int8")
+            reg = get_registry()
+            d2h0 = reg.counter("ps/d2h_bytes").value
+            import jax.numpy as jnp
+            g = jnp.asarray(np.random.RandomState(61).randn(8000)
+                            .astype(np.float32))
+            outs = [ex.exchange({"g": g * (r + 1)},
+                                name="dv")["g"].copy()
+                    for r in range(3)]
+            d2h = reg.counter("ps/d2h_bytes").value - d2h0
+            ex.close()
+            return outs, d2h
+        finally:
+            be.close()
+            cdev.reset_probe()
+
+    host_outs, host_d2h = run(False)
+    dev_outs, dev_d2h = run(True)
+    for x, y in zip(host_outs, dev_outs):
+        np.testing.assert_array_equal(x, y)
+    # dense 32000B/bucket vs (8000 q bytes + 4) per round
+    assert 0 < dev_d2h < 0.3 * host_d2h, (dev_d2h, host_d2h)
+
+
+def test_device_encode_fp8_exchange_with_ef(monkeypatch):
+    """fp8 + EF + device encode end to end: device-resident residuals
+    commit on pull, the summed stream converges on the input (EF
+    telescoping), and per-layer ps/d2h_bytes counters register."""
+    from byteps_tpu.compress import device as cdev
+    from byteps_tpu.obs.metrics import get_registry
+
+    monkeypatch.setenv("BPS_COMPRESS_DEVICE", "1")
+    cdev.reset_probe()
+    be = HostPSBackend(num_servers=1, num_workers=1, engine_threads=1)
+    try:
+        ex = PSGradientExchange(be, partition_bytes=16 << 10,
+                                min_compress_bytes=0,
+                                compress="fp8_e4m3")
+        import jax.numpy as jnp
+        g = np.random.RandomState(62).randn(8000).astype(np.float32)
+        gd = jnp.asarray(g)
+        acc = np.zeros(8000)
+        rounds = 24
+        for _ in range(rounds):
+            acc += ex.exchange({"g": gd}, name="d8")["g"]
+        np.testing.assert_allclose(acc / rounds, g, atol=0.05)
+        reg = get_registry()
+        layers = [n for n in reg.names()
+                  if n.startswith("ps/d2h_bytes/d8.")]
+        assert layers and any(reg.counter(n).value > 0 for n in layers)
+        ex.close()
+    finally:
+        be.close()
+        cdev.reset_probe()
