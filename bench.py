@@ -30,6 +30,14 @@ import jax
 # (docs/observability.md). The line stays single-line JSON.
 STATS = "--stats" in sys.argv
 
+# --fleet-stats: attach the fleet telemetry columns (per-shard
+# engine_queue_depth p95 + merge CPU, scraped over OP_STATS by
+# obs.fleet.FleetScraper) to the PS breakdowns that run over the real
+# transport. The standalone `bench.py fleet_obs` breakdown also runs
+# the observability-overhead A/B smoke (stats+scrape on vs BPS_STATS=0
+# on the compute-bound arm, asserted within 2%).
+FLEET_STATS = "--fleet-stats" in sys.argv
+
 
 def _reset_metrics() -> None:
     from byteps_tpu.obs.metrics import get_registry
@@ -39,6 +47,28 @@ def _reset_metrics() -> None:
 def _metrics_summary() -> dict:
     from byteps_tpu.obs.metrics import get_registry
     return get_registry().summary()
+
+
+def _fleet_columns(scraper) -> dict:
+    """The --fleet-stats column set: per-shard engine backlog p95 (over
+    the scrape samples) + server merge CPU, read from the SCRAPED view
+    — shard-attributed server pressure, not worker-local proxies."""
+    cols = {}
+    view = scraper.view()
+    for label in scraper.shards():
+        mw = scraper.shard_metric(label, "server/merge_wait_s")
+        mw = mw if isinstance(mw, dict) else {}
+        sv = view.get(label, {})
+        cols[label] = {
+            "engine_queue_depth_p95": scraper.depth_percentile(label, 95),
+            "merge_wait_cpu_ms": round(mw.get("sum_ms", 0.0), 3),
+            "merge_wait_p95_ms": mw.get("p95_ms", 0.0),
+            "uptime_s": (sv.get("heartbeat") or {}).get("uptime_s"),
+            "scrape_age_s": sv.get("age_s"),
+            "up": sv.get("up"),
+        }
+    cols["scrapes"] = scraper.scrapes
+    return cols
 
 # Honor JAX_PLATFORMS even when a sitecustomize force-selects a platform
 # via jax.config (which outranks the env var): re-assert the user's choice.
@@ -489,6 +519,17 @@ def ps_cross_breakdown(iters: int = 10, warm: int = 3,
                     if STATS and rep == 0:
                         _reset_metrics()
                     bps.init(config=bps.Config.from_env())
+                    fl_sc = None
+                    if FLEET_STATS and rep == 0:
+                        # --fleet-stats: scrape the real transport
+                        # server's registry (OP_STATS) during the arm
+                        # and attach the shard-attributed columns
+                        from byteps_tpu.common.global_state import \
+                            GlobalState as _GS
+                        from byteps_tpu.obs.fleet import FleetScraper
+                        fl_sc = FleetScraper(
+                            _GS.get().ps_backend,
+                            interval_sec=0.05).start()
                     mesh = make_mesh({"data": 1},
                                      devices=jax.devices()[:1])
                     trainer = DistributedTrainer(
@@ -530,6 +571,9 @@ def ps_cross_breakdown(iters: int = 10, warm: int = 3,
                               "PS_APPLY_CHUNK", "PS_PULL")])
                     if STATS and rep == 0:
                         out[f"{mode}_metrics"] = _metrics_summary()
+                    if fl_sc is not None:
+                        fl_sc.stop()
+                        out[f"{mode}_fleet"] = _fleet_columns(fl_sc)
                     trainer.close()
                     bps.shutdown()
         import statistics
@@ -1307,6 +1351,145 @@ def probe_tpu(attempts: int = 3, timeout: float = 150.0,
     return False, err
 
 
+def fleet_obs_breakdown(rounds: int = 40, iters: int = 30, warm: int = 5,
+                        pairs: int = 3, dim: int = 384, depth: int = 4,
+                        batch: int = 512,
+                        scrape_sec: float = 0.25) -> dict:
+    """Fleet telemetry plane: the ``--fleet-stats`` column set + the
+    observability-overhead A/B smoke.
+
+    (1) COLUMN SET: a two-shard TCP rig (two real transport servers)
+    driven by a pipelined exchange while a ``FleetScraper`` polls
+    OP_STATS at 20 Hz — the output's per-shard columns
+    (``engine_queue_depth_p95``, ``merge_wait_cpu_ms``, heartbeat
+    uptime, scrape age) come from the SCRAPED view, i.e. the server
+    processes' own registries, not worker-local proxies.
+
+    (2) OVERHEAD A/B: the acceptance bound that always-on telemetry is
+    free where it must be — a compute-bound exchange loop (jitted MLP
+    grads, in-process backend, no throttle: the ``ps_cross``
+    compute-bound arm's shape) with BPS_STATS=1 + flight recorder +
+    a 20 Hz scraper versus BPS_STATS=0 and everything off. Interleaved
+    pairs, POOLED per-step medians (the ps_cross noise methodology),
+    ASSERTED within 2%."""
+    import statistics as _st
+
+    import jax.numpy as jnp
+
+    from byteps_tpu.obs import flight
+    from byteps_tpu.obs import metrics as obs_metrics
+    from byteps_tpu.obs.fleet import FleetScraper
+    from byteps_tpu.server.engine import HostPSBackend, PSServer
+    from byteps_tpu.server.ps_mode import PSGradientExchange
+    from byteps_tpu.server.transport import (PSTransportServer,
+                                             RemotePSBackend)
+
+    out: dict = {}
+    # ---- (1) two-shard TCP rig: the --fleet-stats column set
+    engines = [PSServer(num_workers=1, engine_threads=2)
+               for _ in range(2)]
+    servers = [PSTransportServer(e, host="127.0.0.1", port=0)
+               for e in engines]
+    be = RemotePSBackend([f"127.0.0.1:{s.port}" for s in servers])
+    sc = FleetScraper(be, interval_sec=0.05)
+    ex = PSGradientExchange(be, partition_bytes=256 << 10,
+                            pipeline_depth=2)
+    tree = {"a": np.ones(dim * dim, np.float32),
+            "b": np.ones(dim * dim, np.float32)}
+    try:
+        sc.start()
+        for _ in range(rounds):
+            ex.exchange(tree, name="fleet-demo")
+        time.sleep(0.12)        # let one more scrape land the tail
+        out["fleet"] = _fleet_columns(sc)
+        out["shards_scraped"] = len(sc.shards())
+    finally:
+        sc.stop()
+        ex.close()
+        be.close()
+        for s in servers:
+            s.close()
+        for e in engines:
+            e.close()
+
+    # ---- (2) observability-overhead A/B (compute-bound)
+    saved = {k: os.environ.get(k)
+             for k in ("BPS_STATS", "BPS_FLIGHT_RECORDER")}
+
+    def run_arm(obs_on: bool, n: int):
+        os.environ["BPS_STATS"] = "1" if obs_on else "0"
+        os.environ["BPS_FLIGHT_RECORDER"] = "1" if obs_on else "0"
+        obs_metrics.configure()
+        flight.configure()
+        abe = HostPSBackend(num_servers=1, num_workers=1,
+                            engine_threads=2)
+        aex = PSGradientExchange(abe, partition_bytes=1 << 20,
+                                 pipeline_depth=2)
+        # scrape at a production-like cadence (BPS_FLEET_SCRAPE_SEC
+        # defaults to 2 s; 0.25 s here is still 8x denser) — a scrape
+        # snapshots the WHOLE registry, so the A/B bounds the cadence
+        # an operator would actually run, not a 20 Hz stress mode
+        asc = (FleetScraper(abe, interval_sec=scrape_sec).start()
+               if obs_on else None)
+        rng = np.random.RandomState(0)
+        params = {f"w{i}": jnp.asarray(
+            rng.randn(dim, dim).astype(np.float32) * 0.05)
+            for i in range(depth)}
+        x = jnp.asarray(rng.randn(batch, dim).astype(np.float32))
+        y = jnp.tanh(x)
+
+        def loss_fn(p):
+            h = x
+            for i in range(depth):
+                h = jnp.tanh(h @ p[f"w{i}"])
+            return ((h - y) ** 2).mean()
+
+        grad = jax.jit(jax.grad(loss_fn))
+        walls = []
+        try:
+            for it in range(n):
+                t0 = time.perf_counter()
+                g = grad(params)
+                aex.exchange(g, name="obs-ab")
+                if it >= warm:
+                    walls.append(time.perf_counter() - t0)
+        finally:
+            if asc is not None:
+                asc.stop()
+            aex.close()
+            abe.close()
+        return walls
+
+    try:
+        pooled = {"obs": [], "off": []}
+        for rep in range(pairs):
+            arms = (("obs", True), ("off", False))
+            if rep % 2:              # alternate lead: drift hits both
+                arms = arms[::-1]
+            for tag, flag in arms:
+                pooled[tag].extend(run_arm(flag, warm + iters))
+        obs_ms = _st.median(pooled["obs"]) * 1e3
+        off_ms = _st.median(pooled["off"]) * 1e3
+        overhead = obs_ms / off_ms
+        out["obs_step_ms"] = round(obs_ms, 3)
+        out["off_step_ms"] = round(off_ms, 3)
+        out["obs_overhead"] = round(overhead, 4)
+        # the acceptance bound: stats + scrape-on within 2% of
+        # BPS_STATS=0 on the compute-bound arm
+        assert overhead <= 1.02, (
+            f"observability overhead {overhead:.4f}x exceeds the 2% "
+            f"bound (obs {obs_ms:.3f}ms vs off {off_ms:.3f}ms)")
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        obs_metrics.configure()
+        flight.configure()
+    return out
+
+
 _BREAKDOWNS = {
     "ps_tail": lambda: ps_tail_breakdown(),
     "ps_head": lambda: ps_head_breakdown(),
@@ -1315,6 +1498,7 @@ _BREAKDOWNS = {
     "ps_comp": lambda: ps_comp_breakdown(),
     "ps_zero": lambda: ps_zero_breakdown(compute_iters=20),
     "pp": lambda: pp_breakdown(),
+    "fleet_obs": lambda: fleet_obs_breakdown(),
 }
 
 

@@ -184,6 +184,21 @@ class Config:
     stats_every: int = 50                # BPS_STATS_EVERY: dump cadence
     watchdog_sec: float = 0.0            # BPS_WATCHDOG_SEC: stall
                                          # watchdog threshold (0 = off)
+    fleet_scrape_sec: float = 0.0        # BPS_FLEET_SCRAPE_SEC: fleet
+                                         # telemetry scrape cadence —
+                                         # >0 stands up a FleetScraper
+                                         # over the PS backend's
+                                         # stats() surface (OP_STATS),
+                                         # publishing the shard-labeled
+                                         # fleet/<shard>/<metric> view
+                                         # + scrape-age staleness
+    metrics_port: int = 0                # BPS_METRICS_PORT: HTTP
+                                         # exporter port (/metrics
+                                         # Prometheus text,
+                                         # /metrics.json, /fleet.json);
+                                         # 0 = off
+    # BPS_FLIGHT_RECORDER (default on) + BPS_FLIGHT_RECORDER_SIZE are
+    # read by obs/flight.py itself — they tune the ring, not a mode
 
     # --- logging ---
     log_level: str = "INFO"
@@ -236,6 +251,9 @@ class Config:
             stats_file=_env("BPS_STATS_FILE", None, ""),
             stats_every=_env_int("BPS_STATS_EVERY", None, 50),
             watchdog_sec=float(_env("BPS_WATCHDOG_SEC", None, "0") or 0),
+            fleet_scrape_sec=float(
+                _env("BPS_FLEET_SCRAPE_SEC", None, "0") or 0),
+            metrics_port=_env_int("BPS_METRICS_PORT", None, 0),
             log_level=_env("BPS_LOG_LEVEL", "BYTEPS_LOG_LEVEL", "INFO"),
         )
         cfg.update(overrides)

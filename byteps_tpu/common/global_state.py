@@ -77,6 +77,8 @@ class GlobalState:
         # variants) and stand up the per-step StepStats emitter
         from ..obs import metrics as obs_metrics
         obs_metrics.configure(config.stats_on)
+        from ..obs import flight as obs_flight
+        obs_flight.configure()       # re-read BPS_FLIGHT_RECORDER* too
         # two-class wire send scheduler (server/sched.py): resolve the
         # byte credit for THIS init, before any backend is constructed,
         # so every transport client sees the same gate
@@ -202,6 +204,32 @@ class GlobalState:
                     compress=config.compress)
                 self.engine.ps_exchange.timeline = self.timeline
                 self.engine.ps_world = config.num_worker
+        # fleet telemetry plane (obs/fleet.py): scrape every PS shard's
+        # registry + heartbeat on a cadence into the shard-labeled
+        # local view; the rebalancer and the compression controller
+        # pick it up via fleet.current(). Worker-role only concern —
+        # every backend kind carries the stats() surface.
+        self.fleet = None
+        if (config.fleet_scrape_sec > 0 and self.ps_backend is not None
+                and hasattr(self.ps_backend, "stats")):
+            from ..obs.fleet import FleetScraper, set_current
+            self.fleet = FleetScraper(
+                self.ps_backend, interval_sec=config.fleet_scrape_sec)
+            set_current(self.fleet)
+            self.fleet.start()
+        # metrics HTTP endpoint (obs/export.py): Prometheus text +
+        # JSON over BPS_METRICS_PORT. A bind failure (port taken)
+        # degrades with a warning — an exporter must not kill training.
+        self.metrics_server = None
+        if config.metrics_port:
+            from ..obs.export import MetricsHTTPServer
+            try:
+                self.metrics_server = MetricsHTTPServer(
+                    config.metrics_port).start()
+            except OSError as e:
+                get_logger().warning(
+                    "BPS_METRICS_PORT=%d unavailable (%s) — metrics "
+                    "endpoint disabled", config.metrics_port, e)
         if self.mesh is None:
             self.dp = config.num_worker
         else:
@@ -259,9 +287,27 @@ class GlobalState:
                 inst.engine.ps_exchange.close()
             if getattr(inst, "plane_rebalancer", None) is not None:
                 inst.plane_rebalancer.stop()
+            cls._stop_obs(inst)
             if inst.ps_backend is not None:
                 inst.ps_backend.close()
             cls._instance = None
+
+    @classmethod
+    def _stop_obs(cls, inst) -> None:
+        """Tear down the fleet scraper + metrics endpoint (before the
+        backend closes — the scraper reads it)."""
+        if getattr(inst, "fleet", None) is not None:
+            from ..obs.fleet import current, set_current
+            inst.fleet.stop()
+            if current() is inst.fleet:
+                set_current(None)
+            inst.fleet = None
+        if getattr(inst, "metrics_server", None) is not None:
+            try:
+                inst.metrics_server.stop()
+            except Exception:   # noqa: BLE001 — best-effort teardown
+                pass
+            inst.metrics_server = None
 
     @classmethod
     def suspend(cls) -> Optional[list]:
@@ -277,6 +323,7 @@ class GlobalState:
                 inst.engine.ps_exchange.close()
             if getattr(inst, "plane_rebalancer", None) is not None:
                 inst.plane_rebalancer.stop()
+            cls._stop_obs(inst)
             if inst.ps_backend is not None:
                 inst.ps_backend.close()
             cls._instance = None

@@ -57,8 +57,15 @@ class CompressController:
                  max_level: str = "int8",
                  hold: int = 2,
                  queue_depth_min: float = 2.0,
-                 interval: int = 1) -> None:
+                 interval: int = 1, fleet=None) -> None:
         self.reg = registry if registry is not None else get_registry()
+        # fleet telemetry view (obs.fleet): when a scraper is present
+        # (explicit, or the process-current one), the backlog signal is
+        # the SCRAPED max engine queue depth across fresh shards — on
+        # a remote deployment the worker-local gauge is a proxy that
+        # nobody even sets, so without the fleet the controller was
+        # blind to server pressure across process boundaries
+        self._fleet = fleet
         self.max_level = wire.codec_id(max_level)
         self.hold = max(1, int(hold))
         self.queue_depth_min = float(queue_depth_min)
@@ -112,6 +119,17 @@ class CompressController:
         stalls = self.reg.counter("nic/stalls").value
         resends = self.reg.counter("transport/resends").value
         depth = self.reg.gauge("server/engine_queue_depth").value
+        fl = self._fleet
+        if fl is None:
+            from ..obs import fleet as fleet_mod
+            fl = fleet_mod.current()
+        if fl is not None:
+            d = fl.max_queue_depth()
+            if d is not None:
+                # shard-attributed server pressure (scraped) replaces
+                # the worker-local proxy; a fully-stale fleet view
+                # (d None) falls back rather than reading 0-as-idle
+                depth = d
         d_stalls = stalls - self._last_stalls
         d_resends = resends - self._last_resends
         self._last_stalls, self._last_resends = stalls, resends
@@ -193,6 +211,12 @@ class CompressController:
                 self._layers[layer] = new
                 self._gauges[layer].set(new)
                 self._m_decisions.inc()
+                # key-less flight event: codec decisions are context
+                # for EVERY key's postmortem (a pull refused two
+                # rounds later traces back to this ladder move)
+                from ..obs import flight
+                flight.record("codec", stage=layer,
+                              detail=f"level {lvl}->{new}")
 
 
 class FixedController:

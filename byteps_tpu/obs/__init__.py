@@ -21,7 +21,21 @@ This package is the always-on counterpart:
     created (one lost pull wedges the per-key admission gate forever).
   - ``merge_trace``: a CLI (``python -m byteps_tpu.obs.merge_trace``)
     unifying per-rank ``comm.json`` traces into one Chrome trace with
-    per-rank process rows and flow events linking each bucket's spans.
+    per-rank process rows and flow events linking each bucket's spans
+    (and the pipeline plane's per-stage rows + activation flow arrows).
+  - ``fleet``: the fleet telemetry plane — a cadenced scraper over
+    every PS shard's registry (the OP_STATS wire op; never
+    credit-gated) into one shard-labeled local view with per-shard
+    scrape-age staleness and server heartbeats (uptime/op counters):
+    the first SERVER-side pressure + liveness signals the rebalancer
+    and the compression controller can steer on.
+  - ``flight``: the flight recorder — a bounded ring of recent
+    pipeline events (push/pull/admission/codec/act/param) the failure
+    paths dump as a structured postmortem, so a wedge diagnosis names
+    what HAPPENED, not just what is stuck.
+  - ``export``: Prometheus-text + JSON exporters — the
+    ``python -m byteps_tpu.obs.export`` CLI (OP_STATS scrape or local
+    registry) and the ``BPS_METRICS_PORT`` HTTP endpoint.
 """
 
 from __future__ import annotations
@@ -31,3 +45,5 @@ from .metrics import (MetricsRegistry, configure, get_registry,   # noqa: F401
 from .stats import StepStats, StepStatsEmitter                    # noqa: F401
 from .watchdog import StallWatchdog                               # noqa: F401
 from .merge_trace import merge_traces                             # noqa: F401
+from .fleet import FleetScraper                                   # noqa: F401
+from .flight import FlightRecorder, get_recorder                  # noqa: F401

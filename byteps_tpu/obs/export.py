@@ -1,0 +1,279 @@
+"""Metrics exporters: Prometheus text + JSON, CLI and HTTP.
+
+Three consumption paths for the registry (and the fleet view layered
+into it as ``fleet/<shard>/<metric>`` gauges):
+
+  - ``prometheus_text(source)``: render a ``MetricsRegistry`` (typed:
+    counters → ``…_total``, gauges, histograms → summaries with
+    quantiles in seconds) or a raw snapshot dict (untyped: scalars as
+    gauges) to Prometheus exposition text. ``fleet/<shard>/…`` names
+    become one metric family with a ``shard`` label, so a two-shard
+    fleet graphs as two series of one metric, not two metrics.
+  - ``MetricsHTTPServer`` (``BPS_METRICS_PORT``): a daemon-thread HTTP
+    endpoint serving ``/metrics`` (Prometheus), ``/metrics.json`` (raw
+    snapshot) and ``/fleet.json`` (the current FleetScraper's view) —
+    started by ``bps.init()``, read by any prometheus scraper or a
+    plain ``curl``.
+  - ``python -m byteps_tpu.obs.export [host:port …]``: one-shot CLI —
+    scrape remote server(s) over the ``OP_STATS`` wire op (no backend
+    object needed: a raw socket and one frame) or dump the local
+    process registry; ``--format prom|json``, ``-o`` file or stdout.
+
+The exporter layer READS; it never gates or schedules — the same
+"telemetry is never credit-gated" rule the OP_STATS op follows.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+import threading
+from typing import Dict, List, Optional, Tuple, Union
+
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry, get_registry
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+_FLEET_RE = re.compile(r"^fleet/([^/]+)/(.+)$")
+
+
+def _san(name: str) -> str:
+    return _NAME_RE.sub("_", name)
+
+
+def _fam(name: str, prefix: str) -> Tuple[str, str]:
+    """(family name, label string) — fleet/<shard>/<metric> folds the
+    shard into a label so one metric stays one family."""
+    m = _FLEET_RE.match(name)
+    if m:
+        return (f"{prefix}_fleet_{_san(m.group(2))}",
+                f'{{shard="{m.group(1)}"}}')
+    return f"{prefix}_{_san(name)}", ""
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def prometheus_text(source: Union[MetricsRegistry, Dict],
+                    prefix: str = "bps") -> str:
+    """Prometheus exposition text for a registry (typed) or a raw
+    snapshot dict (scalars as gauges, histogram summaries as
+    summaries). Output is sorted by family then label — deterministic,
+    golden-testable."""
+    if isinstance(source, MetricsRegistry):
+        with source._lock:
+            items = sorted(source._metrics.items())
+        rows = []
+        for name, m in items:
+            if isinstance(m, Counter):
+                rows.append((name, "counter", m.value, None))
+            elif isinstance(m, Gauge):
+                rows.append((name, "gauge", m.value, None))
+            elif isinstance(m, Histogram):
+                rows.append((name, "summary", None, m))
+    else:
+        rows = []
+        for name, v in sorted(source.items()):
+            if isinstance(v, dict):
+                rows.append((name, "summary_dict", None, v))
+            elif isinstance(v, (int, float)):
+                rows.append((name, "gauge", v, None))
+    fams: Dict[str, List[str]] = {}
+    types: Dict[str, str] = {}
+    for name, kind, val, extra in rows:
+        fam, label = _fam(name, prefix)
+        if kind == "counter":
+            types[fam + "_total"] = "counter"
+            fams.setdefault(fam + "_total", []).append(
+                f"{fam}_total{label} {_fmt(val)}")
+            continue
+        if kind == "gauge":
+            types[fam] = "gauge"
+            fams.setdefault(fam, []).append(f"{fam}{label} {_fmt(val)}")
+            continue
+        # histogram → summary: quantiles in SECONDS (the registry's
+        # native unit), count + sum alongside
+        types[fam] = "summary"
+        lines = fams.setdefault(fam, [])
+        if kind == "summary":
+            h: Histogram = extra
+            for q, p in (("0.5", 50), ("0.95", 95), ("0.99", 99)):
+                ql = (label[:-1] + f',quantile="{q}"}}') if label \
+                    else f'{{quantile="{q}"}}'
+                lines.append(f"{fam}{ql} {_fmt(h.percentile(p))}")
+            lines.append(f"{fam}_sum{label} {_fmt(h.sum)}")
+            lines.append(f"{fam}_count{label} {_fmt(h.count)}")
+        else:                       # summary dict (snapshot form, ms)
+            d: Dict = extra
+            for q, f in (("0.5", "p50_ms"), ("0.95", "p95_ms"),
+                         ("0.99", "p99_ms")):
+                if f in d:
+                    ql = (label[:-1] + f',quantile="{q}"}}') if label \
+                        else f'{{quantile="{q}"}}'
+                    lines.append(f"{fam}{ql} {_fmt(d[f] / 1e3)}")
+            lines.append(f"{fam}_sum{label} "
+                         f"{_fmt(d.get('sum_ms', 0.0) / 1e3)}")
+            lines.append(f"{fam}_count{label} {_fmt(d.get('count', 0))}")
+    out: List[str] = []
+    for fam in sorted(fams):
+        out.append(f"# TYPE {fam} {types[fam]}")
+        out.extend(sorted(fams[fam]))
+    return "\n".join(out) + "\n" if out else ""
+
+
+def registry_json(registry: Optional[MetricsRegistry] = None) -> Dict:
+    reg = registry if registry is not None else get_registry()
+    return {"schema": "byteps_tpu.MetricsSnapshot/v1",
+            "metrics": reg.snapshot()}
+
+
+# ------------------------------------------------------ remote scrape
+
+def scrape_addr(addr: str, timeout_s: float = 5.0) -> Dict:
+    """One OP_STATS roundtrip to ``host:port`` on a fresh socket — the
+    CLI's dependency-free server scrape (no RemotePSBackend, no key
+    table, no pools)."""
+    import socket
+
+    from ..server import transport as t
+    host, port = addr.rsplit(":", 1)
+    with socket.create_connection((host, int(port)),
+                                  timeout=timeout_s) as sock:
+        sock.settimeout(timeout_s)
+        t._send_req(sock, t.OP_STATS, 0, 0, 0,
+                    int(timeout_s * 1e3), "uint8", None)
+        status, rbytes = t._RSP.unpack(t._recv_exact(sock, t._RSP.size))
+        data = t._recv_exact(sock, rbytes) if rbytes else b""
+        if status != t.ST_OK:
+            raise RuntimeError(
+                f"{addr}: OP_STATS rejected: {bytes(data).decode()!r}")
+        return json.loads(bytes(data).decode())
+
+
+# --------------------------------------------------------- HTTP server
+
+class MetricsHTTPServer:
+    """``BPS_METRICS_PORT`` endpoint. Serves the LOCAL registry (which
+    already carries the fleet view when a scraper runs) — a read-only
+    observer on a daemon thread; it can never block the data plane."""
+
+    def __init__(self, port: int, host: str = "0.0.0.0",
+                 registry: Optional[MetricsRegistry] = None) -> None:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        reg = registry if registry is not None else get_registry()
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):               # noqa: N802 — http.server API
+                if self.path.startswith("/metrics.json"):
+                    body = json.dumps(registry_json(reg)).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/fleet.json"):
+                    from . import fleet as _fleet
+                    sc = _fleet.current()
+                    body = json.dumps(
+                        {"schema": "byteps_tpu.FleetView/v1",
+                         "shards": sc.view() if sc is not None else {},
+                         "scraper": sc is not None}).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/metrics"):
+                    body = prometheus_text(reg).encode()
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):      # no per-scrape stderr spam
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MetricsHTTPServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True,
+                name="bps-metrics-http")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+
+# ---------------------------------------------------------------- CLI
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m byteps_tpu.obs.export",
+        description="Export byteps_tpu metrics: scrape PS server(s) "
+                    "over OP_STATS, or dump this process's registry.")
+    ap.add_argument("addrs", nargs="*",
+                    help="server host:port(s) to scrape (none = the "
+                         "local process registry)")
+    ap.add_argument("--format", choices=("prom", "json"), default="prom")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output file (default stdout)")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args(argv)
+    if args.addrs:
+        scraped: Dict[str, Dict] = {}
+        rc = 0
+        for i, addr in enumerate(args.addrs):
+            try:
+                scraped[f"s{i}"] = scrape_addr(addr,
+                                               timeout_s=args.timeout)
+            except Exception as e:   # noqa: BLE001 — report and continue
+                print(f"error: {addr}: {e}", file=sys.stderr)
+                scraped[f"s{i}"] = {"error": str(e)}
+                rc = 1
+        if args.format == "json":
+            text = json.dumps(
+                {"schema": "byteps_tpu.FleetScrape/v1",
+                 "shards": {f"s{i}": a for i, a in enumerate(args.addrs)},
+                 "stats": scraped}, indent=2)
+        else:
+            # flatten into the fleet naming so shards become labels
+            flat: Dict[str, object] = {}
+            for label, payload in scraped.items():
+                if "error" in payload:
+                    flat[f"fleet/{label}/up"] = 0
+                    continue
+                flat[f"fleet/{label}/up"] = 1
+                for f, v in (payload.get("heartbeat") or {}).items():
+                    if isinstance(v, (int, float)):
+                        flat[f"fleet/{label}/{f}"] = v
+                qd = payload.get("queue_depth")
+                if qd is not None:
+                    flat[f"fleet/{label}/server/engine_queue_depth"] = qd
+                for name, v in (payload.get("metrics") or {}).items():
+                    if not name.startswith("fleet/"):
+                        flat[f"fleet/{label}/{name}"] = v
+            text = prometheus_text(flat)
+    else:
+        text = (json.dumps(registry_json(), indent=2)
+                if args.format == "json" else prometheus_text(get_registry()))
+        rc = 0
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    else:
+        print(text, end="" if text.endswith("\n") else "\n")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

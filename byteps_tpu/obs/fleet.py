@@ -1,0 +1,406 @@
+"""Fleet telemetry: worker-side scrape of every PS shard's registry.
+
+PR 4's registry is process-local: a remote ``PSTransportServer``
+records ``server/merge_wait_s`` / ``engine_queue_depth`` / ``sched/*``
+into a registry no worker can read over TCP, so every control loop
+that wants *server-side* pressure (the plane's rebalancer, the
+compression controller) has been steering on worker-local proxies.
+This module closes the gap:
+
+  - ``FleetScraper`` polls ``backend.stats()`` (the ``OP_STATS`` wire
+    op on remote shards — never credit-gated, served on a dedicated
+    connection, so telemetry flows even when the data plane is wedged)
+    on a cadence (``BPS_FLEET_SCRAPE_SEC``) and folds every shard's
+    snapshot into one role/shard-labeled view: each remote scalar
+    metric lands in the LOCAL registry as ``fleet/<shard>/<metric>``
+    (histograms as ``…/p95_ms`` + ``…/count``), so the whole fleet is
+    queryable through the one registry surface that already exists.
+  - per-shard **scrape-age** gauges (``fleet/<shard>/scrape_age_s``)
+    make staleness first-class: a shard that stops answering reads as
+    STALE within one cadence — never as healthy-with-old-numbers. A
+    failed scrape is an aged view plus ``fleet/<shard>/up = 0``, not an
+    exception on the scrape thread.
+  - **heartbeats** ride every scrape: the server reports its MONOTONIC
+    uptime and op counters, so the fleet observes a silent server
+    restart (uptime went backwards → ``fleet/<shard>/restarts``) and a
+    silent server death (scrape age grows) without any worker having
+    touched the data plane — the first server-side liveness signal
+    (ROADMAP item 2 grows from "worker observed a dead socket" to
+    "fleet observed a silent server").
+
+Consumers: ``server/plane/rebalance.py`` reads the scraped per-shard
+pressure (and skips stale shards), ``compress/controller.py`` reads the
+fleet's max queue depth instead of the worker-local gauge; both fall
+back to the local signals when no scraper is current.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Union
+
+from ..common.logging import get_logger
+from .metrics import MetricsRegistry, get_registry
+
+DEFAULT_SCRAPE_SEC = 2.0
+
+SERVER_STATS_SCHEMA = "byteps_tpu.ServerStats/v1"
+
+
+def server_stats_payload(uptime_s: float, keys: int,
+                         requests: Optional[int] = None,
+                         queue_depth_fn=None,
+                         start_ts: Optional[float] = None,
+                         registry: Optional[MetricsRegistry] = None
+                         ) -> dict:
+    """THE ServerStats/v1 wire shape — single-sourced so the OP_STATS
+    handler, ``HostPSBackend.stats`` and ``PlanePSBackend.stats``
+    cannot drift apart (the scraper's ``_absorb_ok`` parses exactly
+    this). ``queue_depth_fn`` is called under the one shared guard: a
+    dying engine's gauge must not fail the heartbeat that reports on
+    it."""
+    import os
+    qd = None
+    if queue_depth_fn is not None:
+        try:
+            qd = int(queue_depth_fn())
+        except Exception:   # noqa: BLE001 — see docstring
+            qd = None
+    hb: dict = {"uptime_s": round(float(uptime_s), 3),
+                "pid": os.getpid(),
+                "requests": requests,
+                "keys": int(keys)}
+    if start_ts is not None:
+        hb["start_ts"] = start_ts
+    reg = registry if registry is not None else get_registry()
+    return {"schema": SERVER_STATS_SCHEMA, "heartbeat": hb,
+            "queue_depth": qd, "metrics": reg.snapshot()}
+
+# remote metric names never re-published into the local fleet view:
+# a colocated rig shares one registry between "server" and "worker",
+# so the server's snapshot contains the fleet gauges this scraper
+# itself publishes — re-publishing them would nest fleet/s0/fleet/s0/…
+# one level deeper per scrape
+_SKIP_PREFIXES = ("fleet/",)
+
+
+def _interval_from_env() -> float:
+    try:
+        return float(os.environ.get("BPS_FLEET_SCRAPE_SEC", "") or
+                     DEFAULT_SCRAPE_SEC)
+    except ValueError:
+        return DEFAULT_SCRAPE_SEC
+
+
+class _ShardView:
+    """One shard's scrape state."""
+
+    __slots__ = ("label", "payload", "last_ok", "last_err", "fails",
+                 "restarts", "uptime", "depths", "published")
+
+    def __init__(self, label: str) -> None:
+        self.label = label
+        self.payload: Optional[dict] = None     # last GOOD payload
+        self.last_ok: Optional[float] = None    # monotonic
+        self.last_err: Optional[str] = None
+        self.fails = 0
+        self.restarts = 0
+        self.uptime: Optional[float] = None
+        # recent queue-depth samples (bench's per-shard p95 column)
+        self.depths: deque = deque(maxlen=256)
+        # metric names this scraper has published for the shard: a
+        # name that ever went nonzero must be RE-published when it
+        # returns to 0 (gauges hold their last value — skipping the
+        # zero would freeze a drained shard at its peak forever),
+        # while never-nonzero names stay unpublished (not ~200 zero
+        # gauges per shard per scrape)
+        self.published: set = set()
+
+
+class FleetScraper:
+    """Cadenced scraper over one backend's ``stats()`` surface."""
+
+    def __init__(self, backend, interval_sec: Optional[float] = None,
+                 stale_after: Optional[float] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 timeout_ms: int = 5000) -> None:
+        if not hasattr(backend, "stats"):
+            raise ValueError(
+                f"{type(backend).__name__} has no stats() surface — the "
+                f"fleet scraper needs a Host/Remote/Plane PS backend")
+        self.backend = backend
+        self.interval_sec = (_interval_from_env()
+                             if interval_sec is None
+                             else float(interval_sec))
+        # a shard is STALE once its last good scrape is older than
+        # this; 3 cadences tolerates one dropped scrape without
+        # flapping, while a dead shard still flips within ~3 intervals
+        # (the kill-a-shard acceptance bound is "within one cadence" of
+        # the first FAILED scrape — the up=0 gauge flips there; the
+        # stale verdict follows as the age crosses this line)
+        self.stale_after = (max(3.0 * self.interval_sec, 1.0)
+                            if stale_after is None else float(stale_after))
+        self.timeout_ms = int(timeout_ms)
+        self.reg = registry if registry is not None else get_registry()
+        self._t0 = time.monotonic()
+        self._lock = threading.Lock()
+        self._shards: Dict[str, _ShardView] = {}
+        self._scrapes = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._log = get_logger()
+
+    # ---------------------------------------------------------- scraping
+
+    def scrape_once(self) -> Dict[str, dict]:
+        """One scrape pass over every shard; returns ``view()``.
+
+        Never raises for a dead shard: ``backend.stats()`` folds
+        per-shard failures into ``{"error": …}`` entries, and anything
+        that still escapes is caught here — the scrape thread is a
+        control loop, one bad pass must not kill it."""
+        try:
+            payloads = self.backend.stats(timeout_ms=self.timeout_ms)
+        except TypeError:
+            payloads = self.backend.stats()
+        except Exception as e:   # noqa: BLE001 — see docstring
+            payloads = {}
+            self._log.warning("fleet scrape pass failed: %s", e)
+        now = time.monotonic()
+        with self._lock:
+            self._scrapes += 1
+            for label, payload in payloads.items():
+                sv = self._shards.get(label)
+                if sv is None:
+                    sv = self._shards[label] = _ShardView(label)
+                if isinstance(payload, dict) and "error" not in payload:
+                    self._absorb_ok(sv, payload, now)
+                else:
+                    sv.fails += 1
+                    sv.last_err = (payload or {}).get("error", "no payload") \
+                        if isinstance(payload, dict) else "no payload"
+            views = list(self._shards.values())
+        for sv in views:
+            self._publish(sv, now)
+        return self.view()
+
+    def _absorb_ok(self, sv: _ShardView, payload: dict,
+                   now: float) -> None:
+        hb = payload.get("heartbeat") or {}
+        up = hb.get("uptime_s")
+        if (up is not None and sv.uptime is not None
+                and up < sv.uptime - 1e-3):
+            # monotonic uptime went BACKWARDS: the process behind the
+            # address restarted between scrapes — the silent-restart
+            # signal no worker-side socket error ever carried
+            sv.restarts += 1
+            self._log.warning(
+                "fleet: shard %s restarted (uptime %.1fs -> %.1fs)",
+                sv.label, sv.uptime, up)
+        sv.uptime = up
+        sv.payload = payload
+        sv.last_ok = now
+        sv.last_err = None
+        qd = payload.get("queue_depth")
+        if qd is None:
+            qd = (payload.get("metrics") or {}).get(
+                "server/engine_queue_depth")
+        if qd is not None:
+            sv.depths.append(float(qd))
+
+    def _publish(self, sv: _ShardView, now: float) -> None:
+        """Flatten one shard's state into the local registry as
+        ``fleet/<shard>/…`` gauges. Runs outside the scraper lock —
+        gauge sets take only each metric's own lock."""
+        pre = f"fleet/{sv.label}"
+        age = (now - sv.last_ok) if sv.last_ok is not None \
+            else (now - self._t0)
+        self.reg.gauge(f"{pre}/scrape_age_s").set(round(age, 3))
+        self.reg.gauge(f"{pre}/up").set(
+            0.0 if sv.last_err is not None or sv.last_ok is None else 1.0)
+        self.reg.gauge(f"{pre}/stale").set(
+            1.0 if age > self.stale_after else 0.0)
+        if sv.restarts:
+            self.reg.gauge(f"{pre}/restarts").set(sv.restarts)
+        if sv.payload is None:
+            return
+        hb = sv.payload.get("heartbeat") or {}
+        for f in ("uptime_s", "requests", "keys"):
+            v = hb.get(f)
+            if v is not None:
+                self.reg.gauge(f"{pre}/{f}").set(float(v))
+        qd = sv.payload.get("queue_depth")
+        if qd is not None:
+            self.reg.gauge(f"{pre}/server/engine_queue_depth").set(
+                float(qd))
+        for name, v in (sv.payload.get("metrics") or {}).items():
+            if name.startswith(_SKIP_PREFIXES):
+                continue
+            if isinstance(v, dict):          # histogram summary
+                if v.get("count") or name in sv.published:
+                    sv.published.add(name)
+                    self.reg.gauge(f"{pre}/{name}/p95_ms").set(
+                        float(v.get("p95_ms", 0.0)))
+                    self.reg.gauge(f"{pre}/{name}/count").set(
+                        float(v.get("count", 0)))
+            elif isinstance(v, (int, float)):
+                if name == "server/engine_queue_depth" and qd is not None:
+                    continue                 # top-level field wins
+                # publish nonzero values, and ZEROS of names published
+                # before — a gauge that went 5 -> 0 on the shard must
+                # not stay 5 here (see _ShardView.published)
+                if v or name in sv.published:
+                    sv.published.add(name)
+                    self.reg.gauge(f"{pre}/{name}").set(float(v))
+
+    # ------------------------------------------------------------- views
+
+    def _label(self, shard: Union[int, str]) -> str:
+        return shard if isinstance(shard, str) else f"s{int(shard)}"
+
+    def view(self) -> Dict[str, dict]:
+        """{shard: {up, stale, age_s, heartbeat, queue_depth, restarts,
+        error}} — the fleet snapshot consumers read. A shard that never
+        answered is present (from the backend's shard list) with
+        ``up=False, stale=True``."""
+        now = time.monotonic()
+        out: Dict[str, dict] = {}
+        with self._lock:
+            for label, sv in self._shards.items():
+                age = (now - sv.last_ok) if sv.last_ok is not None \
+                    else (now - self._t0)
+                hb = (sv.payload or {}).get("heartbeat")
+                out[label] = {
+                    "up": sv.last_err is None and sv.last_ok is not None,
+                    "stale": age > self.stale_after,
+                    "age_s": round(age, 3),
+                    "heartbeat": hb,
+                    "queue_depth": (sv.payload or {}).get("queue_depth"),
+                    "restarts": sv.restarts,
+                    "error": sv.last_err,
+                }
+        return out
+
+    def is_stale(self, shard: Union[int, str]) -> bool:
+        """True when the shard's last good scrape is too old to steer
+        on (or the shard was never scraped) — the rebalancer's
+        skip-this-shard predicate."""
+        label = self._label(shard)
+        now = time.monotonic()
+        with self._lock:
+            sv = self._shards.get(label)
+            if sv is None or sv.last_ok is None:
+                return True
+            return (now - sv.last_ok) > self.stale_after
+
+    def shard_metric(self, shard: Union[int, str], name: str,
+                     default=None):
+        """A fresh shard's scraped metric value (scalar, or the summary
+        dict for histograms); ``default`` when stale/missing — stale
+        telemetry must read as absent, never as current."""
+        label = self._label(shard)
+        with self._lock:
+            sv = self._shards.get(label)
+            if (sv is None or sv.last_ok is None
+                    or time.monotonic() - sv.last_ok > self.stale_after
+                    or sv.payload is None):
+                return default
+            if name == "queue_depth":
+                qd = sv.payload.get("queue_depth")
+                if qd is not None:
+                    return qd
+            return (sv.payload.get("metrics") or {}).get(name, default)
+
+    def max_queue_depth(self) -> Optional[float]:
+        """Max scraped engine backlog across FRESH shards (None when no
+        shard is fresh) — the compression controller's shard-attributed
+        replacement for the worker-local gauge."""
+        now = time.monotonic()
+        best: Optional[float] = None
+        with self._lock:
+            for sv in self._shards.values():
+                if (sv.last_ok is None or sv.payload is None
+                        or now - sv.last_ok > self.stale_after):
+                    continue
+                qd = sv.payload.get("queue_depth")
+                if qd is None:
+                    qd = (sv.payload.get("metrics") or {}).get(
+                        "server/engine_queue_depth")
+                if qd is not None:
+                    best = qd if best is None else max(best, float(qd))
+        return best
+
+    def depth_percentile(self, shard: Union[int, str],
+                         p: float) -> Optional[float]:
+        """Percentile of the shard's recent scraped queue-depth samples
+        (the bench's per-shard p95 column); None with no samples."""
+        with self._lock:
+            sv = self._shards.get(self._label(shard))
+            samples = sorted(sv.depths) if sv is not None else []
+        if not samples:
+            return None
+        i = min(len(samples) - 1,
+                max(0, int(round(p / 100.0 * (len(samples) - 1)))))
+        return samples[i]
+
+    def shards(self) -> List[str]:
+        with self._lock:
+            return sorted(self._shards)
+
+    @property
+    def scrapes(self) -> int:
+        return self._scrapes
+
+    # ------------------------------------------------------------ thread
+
+    def start(self) -> "FleetScraper":
+        if self._thread is not None:
+            return self
+        if self.interval_sec <= 0:
+            raise ValueError("start() needs interval_sec > 0 "
+                             "(BPS_FLEET_SCRAPE_SEC)")
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="bps-fleet-scrape")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        # first scrape immediately: the control loops should not steer
+        # blind for a whole cadence after init
+        while True:
+            try:
+                self.scrape_once()
+            except Exception as e:   # noqa: BLE001 — the scrape loop
+                self._log.warning(   # must outlive one bad pass
+                    "fleet scrape pass failed: %s", e)
+            if self._stop.wait(self.interval_sec):
+                return
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+
+# ------------------------------------------------- process-wide current
+
+_current: Optional[FleetScraper] = None
+_current_lock = threading.Lock()
+
+
+def set_current(scraper: Optional[FleetScraper]) -> None:
+    """Install (or clear, with None) the process's fleet view — wired
+    by ``bps.init()``; the rebalancer and the compression controller
+    look it up at decision time."""
+    global _current
+    with _current_lock:
+        _current = scraper
+
+
+def current() -> Optional[FleetScraper]:
+    return _current

@@ -18,7 +18,13 @@ unifies them:
   - timestamps are kept per-rank as written (each rank's ``ts`` is
     relative to its own t0; the viewer aligns rows side-by-side, and
     flow arrows make cross-rank causality readable even without a
-    shared clock).
+    shared clock);
+  - the MPMD pipeline plane's spans (``PP_FWD_SEG`` / ``PP_BWD_SEG`` /
+    ``PP_ACT_SEND`` / ``PP_ACT_RECV``) get one process row PER STAGE
+    (their per-rank pid is the stage index; microbatch becomes the
+    tid) so the 1F1B overlap reads directly, plus ``ph: "s"/"f"`` flow
+    arrows along each ``PP_ACT_SEND → PP_ACT_RECV`` hop per
+    (boundary, microbatch).
 
 CLI::
 
@@ -31,6 +37,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import sys
 from typing import Dict, List, Optional, Tuple
 
@@ -40,6 +47,27 @@ _CHAINS = (
     ("PS_PACK", "PS_PUSH", "PS_PULL", "PS_UNPACK"),
     ("DISPATCH", "REDUCE"),
 )
+
+# pipeline-parallel plane (byteps_tpu.pipeline): these spans carry
+# pid = STAGE index in the per-rank trace, so in the merged view each
+# stage becomes its own PROCESS row (pid = _PP_PID_BASE-derived) —
+# PP_BWD_SEG(stage k) overlapping PP_FWD_SEG(stage k+1) side by side
+# is the 1F1B schedule's existence proof, unreadable when every stage
+# shares one rank row. PP_ACT_SEND → PP_ACT_RECV pairs additionally
+# get ph:s/f flow arrows per (boundary, microbatch, step) — each edge
+# is causal (the recv's take can only return after the send's put).
+_PP_STAGES = ("PP_FWD_SEG", "PP_BWD_SEG", "PP_ACT_SEND", "PP_ACT_RECV")
+_PP_PID_BASE = 10000
+# args.name formats: "<name>/s<stage>/b<boundary>/mb<mb>" (act frames)
+# and "<name>/s<stage>/mb<mb>" (segments)
+_PP_ACT_NAME = re.compile(r"/b(\d+)/mb(\d+)$")
+_PP_MB_NAME = re.compile(r"/mb(\d+)$")
+
+
+def _pp_pid(rank: int, stage: int) -> int:
+    """Synthetic process id for one (rank, stage) row — disjoint from
+    the rank pids (small ints) by construction."""
+    return _PP_PID_BASE + rank * 100 + stage
 
 
 def load_rank_traces(trace_dir: str) -> Dict[int, List[dict]]:
@@ -93,6 +121,14 @@ def merge_traces(trace_dir: str) -> dict:
     fid = 0
     # chains[(chain, rank? no — cross-rank needs rank-agnostic key)]
     by_chain: Dict[Tuple, Dict[str, List[dict]]] = {}
+    # PP act flow endpoints: (boundary, microbatch, step) → spans.
+    # Rank-agnostic on purpose — in a multi-process pipeline the send
+    # is in one rank's trace and the recv in another's, and the edge
+    # is causal regardless of their unaligned clocks (same rule as
+    # the PS_PUSH → PS_PULL cross-rank edges below).
+    pp_sends: Dict[Tuple, List[dict]] = {}
+    pp_recvs: Dict[Tuple, List[dict]] = {}
+    pp_rows: Dict[int, Tuple[int, int]] = {}   # pid -> (rank, stage)
     for rank, events in sorted(ranks.items()):
         merged.append({"ph": "M", "pid": rank, "name": "process_name",
                        "args": {"name": f"rank {rank}"}})
@@ -102,18 +138,54 @@ def merge_traces(trace_dir: str) -> dict:
             if e.get("ph") not in (None, "X"):
                 continue            # keep complete spans; drop foreign phs
             ne = dict(e)
-            ne["tid"] = e.get("pid", 0)
-            ne["pid"] = rank
             args = dict(e.get("args") or {})
             args["rank"] = rank
+            name = e.get("name")
+            if name in _PP_STAGES:
+                # per-STAGE process row: the per-rank pid field IS the
+                # stage index on the PP plane; microbatch becomes the
+                # tid so concurrent microbatches stay separate lanes
+                stage = int(e.get("pid", 0))
+                ne["pid"] = _pp_pid(rank, stage)
+                pp_rows.setdefault(ne["pid"], (rank, stage))
+                aname = str(args.get("name", ""))
+                mb_m = _PP_MB_NAME.search(aname)
+                ne["tid"] = int(mb_m.group(1)) if mb_m else 0
+                ne["args"] = args
+                merged.append(ne)
+                if name in ("PP_ACT_SEND", "PP_ACT_RECV"):
+                    act_m = _PP_ACT_NAME.search(aname)
+                    if act_m:       # older traces lack /b<k>: no arrow
+                        k = (int(act_m.group(1)), int(act_m.group(2)),
+                             args.get("step", 0))
+                        (pp_sends if name == "PP_ACT_SEND"
+                         else pp_recvs).setdefault(k, []).append(ne)
+                continue
+            ne["tid"] = e.get("pid", 0)
+            ne["pid"] = rank
             ne["args"] = args
             merged.append(ne)
-            name = e.get("name")
             for chain in _CHAINS:
                 if name in chain:
                     key = (chain, rank) + _span_key(e)
                     by_chain.setdefault(key, {}).setdefault(
                         name, []).append(ne)
+    # PP stage process rows + metadata, then the activation flow
+    # arrows: one s→f edge per matched (boundary, microbatch, step)
+    for pid, (rank, stage) in sorted(pp_rows.items()):
+        label = (f"pp stage {stage}" if len(ranks) == 1
+                 else f"pp stage {stage} (rank {rank})")
+        merged.append({"ph": "M", "pid": pid, "name": "process_name",
+                       "args": {"name": label}})
+        merged.append({"ph": "M", "pid": pid, "name": "process_sort_index",
+                       "args": {"sort_index": pid}})
+    for k, sends in pp_sends.items():
+        for send in sends:
+            for recv in pp_recvs.get(k, ()):
+                if recv["pid"] == send["pid"]:
+                    continue        # degenerate local echo: no edge
+                merged.extend(_flow_pair(fid, send, recv, "act"))
+                fid += 1
     # within-rank flow arrows: consecutive stages of each bucket chain
     for key, stages in by_chain.items():
         chain = key[0]

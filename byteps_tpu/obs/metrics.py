@@ -376,6 +376,18 @@ class MetricsRegistry:
                      if n.startswith("stage/") and isinstance(m, Histogram)]
         return {n[len("stage/"):]: (m.count, m.sum) for n, m in items}
 
+    def counters_with_prefix(
+            self, prefixes: Tuple[str, ...]) -> Dict[str, int]:
+        """{name: value} for every counter under ``prefixes`` — the
+        delta base for the DYNAMICALLY-registered per-layer byte
+        counters (``ps/pull_bytes/<decl>.<bucket>`` etc. appear at
+        exchange plan time, so a fixed pre-registered list can never
+        cover them; StepStats re-sweeps this each step)."""
+        with self._lock:
+            items = [(n, m) for n, m in self._metrics.items()
+                     if isinstance(m, Counter) and n.startswith(prefixes)]
+        return {n: m.value for n, m in items}
+
     def reset(self) -> None:
         """Zero every metric (bench A/B between variants; tests)."""
         with self._lock:

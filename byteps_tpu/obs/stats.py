@@ -34,6 +34,14 @@ from . import metrics as _metrics   # Config and the metrics switch
 
 SCHEMA = "byteps_tpu.StepStats/v1"
 
+# dynamically-registered per-layer byte counters folded into the
+# per-step delta pass: these appear at exchange plan time
+# (ps/pull_bytes/<decl>.<bucket>, ps/d2h_bytes/<…> — PR 10/11) or at
+# compress-plane registration (ps/push_bytes/<layer>), so the emitter
+# re-sweeps the registry by prefix each step instead of pinning a list
+_LAYER_BYTE_PREFIXES = ("ps/push_bytes/", "ps/pull_bytes/",
+                        "ps/d2h_bytes/")
+
 
 def overlap_stats(events, wall_s: Optional[float] = None,
                   step: Optional[int] = None) -> dict:
@@ -82,6 +90,10 @@ class StepStats:
     sps: Optional[float] = None            # samples / wall_s
     stages: Dict[str, dict] = field(default_factory=dict)
     #   {stage: {"count": n, "ms": total_ms}} — THIS step's delta
+    layer_bytes: Optional[Dict[str, int]] = None
+    #   {counter name: byte delta} for the dynamically-registered
+    #   per-layer counters (ps/pull_bytes/<…>, ps/d2h_bytes/<…>, …)
+    #   that moved THIS step — per-layer byte movement in the dump
     overlaps: Optional[dict] = None        # overlap_stats(), trace window only
 
     def line(self) -> str:
@@ -111,6 +123,8 @@ class StepStats:
             d["loss"] = self.loss
         if self.stages:
             d["stages"] = self.stages
+        if self.layer_bytes:
+            d["layer_bytes"] = self.layer_bytes
         if self.overlaps is not None:
             d["overlaps"] = self.overlaps
         return d
@@ -137,6 +151,8 @@ class StepStatsEmitter:
         self._every = max(1, every)
         self.recent = deque(maxlen=window)
         self._prev = _metrics.get_registry().stage_totals()
+        self._prev_bytes = _metrics.get_registry().counters_with_prefix(
+            _LAYER_BYTE_PREFIXES)
         self._lock = threading.Lock()
         # always-on default must not spam consoles: the per-step line
         # is INFO only when the operator explicitly asked for stats
@@ -178,14 +194,22 @@ class StepStatsEmitter:
             return None
         reg = _metrics.get_registry()
         cur = reg.stage_totals()
+        # re-sweep the per-layer byte counters by PREFIX: counters
+        # registered since the last step (exchange plan time) join the
+        # delta pass with an implicit previous value of 0
+        cur_bytes = reg.counters_with_prefix(_LAYER_BYTE_PREFIXES)
         with self._lock:
             prev, self._prev = self._prev, cur
+            prev_bytes, self._prev_bytes = self._prev_bytes, cur_bytes
         stages: Dict[str, dict] = {}
         for stage, (count, tot) in cur.items():
             pc, pt = prev.get(stage, (0, 0.0))
             if count > pc:
                 stages[stage] = {"count": count - pc,
                                  "ms": round((tot - pt) * 1e3, 3)}
+        layer_bytes = {n: v - prev_bytes.get(n, 0)
+                       for n, v in cur_bytes.items()
+                       if v > prev_bytes.get(n, 0)} or None
         overlaps = None
         if timeline is not None and getattr(timeline, "enabled", False) \
                 and timeline._active():
@@ -216,7 +240,7 @@ class StepStatsEmitter:
         st = StepStats(
             step=step, wall_s=wall_s, loss=loss, samples=samples,
             sps=(samples / wall_s if samples and wall_s > 0 else None),
-            stages=stages, overlaps=overlaps)
+            stages=stages, layer_bytes=layer_bytes, overlaps=overlaps)
         reg.histogram("step/wall_s").observe(wall_s)
         reg.counter("step/count").inc()
         if self._log.isEnabledFor(self._level):

@@ -25,7 +25,29 @@ import threading
 import time
 from typing import Callable, Optional
 
+from . import flight as _flight
 from . import metrics as _metrics
+
+
+def implicated_keys(state: dict) -> set:
+    """The keys a stall dump points at: wire-involved buckets
+    (pushed/failed/awaiting a param frame), admission-gate holders,
+    and — for pipeline stalls — the blocked activation channels
+    (``1<<40 | boundary``, the exchange's act_key rule)."""
+    keys: set = set()
+    for r in state.get("rounds", ()):
+        for b in r.get("buckets", ()):
+            if b.get("state") in ("pushed", "failed", "await_param"):
+                k = b.get("pskey")
+                if k is not None:
+                    keys.add(int(k))
+    for k in state.get("admission", {}).get("busy", ()):
+        keys.add(int(k))
+    for w in state.get("pp_waits", ()):
+        b = w.get("boundary")
+        if b is not None:
+            keys.add((1 << 40) | int(b))
+    return keys
 
 
 def format_dump(state: dict, stalled_s: float) -> str:
@@ -172,9 +194,23 @@ class StallWatchdog:
             return
         self._next_allowed = now + self.stall_sec   # once per stall period
         self.dumps += 1
+        # flight-recorder postmortem for the implicated keys: *what
+        # happened* on the path to the wedge (the pushes/admissions/
+        # codec decisions that led here), appended to the *what is
+        # stuck* state dump — and kept in last_dump for programmatic
+        # consumers (tests, external telemetry)
+        keys = implicated_keys(state)
+        pm = _flight.get_recorder().format_postmortem(
+            keys=keys or None, last=40)
+        state = dict(state)
+        state["flight"] = _flight.get_recorder().postmortem(
+            keys=keys or None, last=40)
         self.last_dump = state
         _metrics.get_registry().counter("watchdog/dumps").inc()
-        self._log.error("%s", format_dump(state, stalled))
+        msg = format_dump(state, stalled)
+        if pm:
+            msg = f"{msg}\n{pm}"
+        self._log.error("%s", msg)
         if self._on_dump is not None:
             try:
                 self._on_dump(state, stalled)

@@ -40,6 +40,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..common.logging import get_logger
+from ..obs import flight
 from ..obs.metrics import get_registry, observe_stage
 
 log = get_logger()
@@ -247,6 +248,14 @@ class ActivationExchange:
             self._peer_for(boundary).act_push(act_key(boundary.index),
                                               seq, payload)
         except (ConnectionError, OSError, RuntimeError) as e:
+            flight.record("act_send", key=act_key(boundary.index),
+                          round=seq, nbytes=int(payload.nbytes),
+                          outcome=f"error:{type(e).__name__}")
+            flight.dump(log, keys=[act_key(boundary.index)],
+                        reason=f"PeerDead on send: stage {self.stage} "
+                               f"-> stage {boundary.dst_stage}, "
+                               f"boundary {boundary.index}, "
+                               f"microbatch {mb}")
             raise PeerDead(
                 f"stage {self.stage} could not deliver "
                 f"{boundary.kind} (boundary {boundary.index}, "
@@ -254,11 +263,17 @@ class ActivationExchange:
                 f"{e}") from e
         self._mark_progress()
         self._m_send.inc(int(payload.nbytes))
+        flight.record("act_send", key=act_key(boundary.index),
+                      round=seq, nbytes=int(payload.nbytes))
         dur = time.time() - t0
         observe_stage("PP_ACT_SEND", dur)
         if self.timeline is not None:
-            self.timeline.record(f"{self.name}/s{self.stage}/mb{mb}",
-                                 "PP_ACT_SEND", t0, dur, self.stage)
+            # /b<boundary> in the name: the merged trace pairs
+            # PP_ACT_SEND -> PP_ACT_RECV flow arrows per (boundary,
+            # microbatch) from it (obs/merge_trace.py)
+            self.timeline.record(
+                f"{self.name}/s{self.stage}/b{boundary.index}/mb{mb}",
+                "PP_ACT_SEND", t0, dur, self.stage)
 
     def recv(self, boundary, mb: int, seq: int, env: Dict) -> None:
         """Block until boundary ``boundary``'s frame for ``seq``
@@ -272,6 +287,17 @@ class ActivationExchange:
             data = self.store.take(act_key(boundary.index), seq,
                                    timeout_ms=self.timeout_ms)
         except TimeoutError as e:
+            flight.record("act_recv", key=act_key(boundary.index),
+                          round=seq, outcome="error:TimeoutError")
+            # postmortem BEFORE the raise: what this stage saw happen
+            # on the channel (sends that landed, the seq that never
+            # came) — the PeerDead diagnosis names what happened, not
+            # just what is stuck
+            flight.dump(log, keys=[act_key(boundary.index)],
+                        reason=f"PeerDead on recv: stage {self.stage} "
+                               f"<- stage {boundary.src_stage}, "
+                               f"boundary {boundary.index}, "
+                               f"microbatch {mb}, seq {seq}")
             raise PeerDead(
                 f"stage {self.stage} never received {boundary.kind} "
                 f"(boundary {boundary.index}, microbatch {mb}, seq "
@@ -319,11 +345,14 @@ class ActivationExchange:
         self._n += 1
         self._m_recv.inc(len(data))      # wire bytes (= raw when the
         #                                  frame shipped uncompressed)
+        flight.record("act_recv", key=act_key(boundary.index),
+                      round=seq, nbytes=len(data))
         dur = time.time() - t0
         observe_stage("PP_ACT_RECV", dur)
         if self.timeline is not None:
-            self.timeline.record(f"{self.name}/s{self.stage}/mb{mb}",
-                                 "PP_ACT_RECV", t0, dur, self.stage)
+            self.timeline.record(
+                f"{self.name}/s{self.stage}/b{boundary.index}/mb{mb}",
+                "PP_ACT_RECV", t0, dur, self.stage)
 
     # ------------------------------------------------ watchdog contract
 

@@ -447,6 +447,8 @@ class HostPSBackend:
         self._m_dense_decodes = get_registry().counter(
             "server/fused_dense_decodes")
         self._qd_next_sample = 0.0
+        import time as _time
+        self._t0_mono = _time.monotonic()   # heartbeat base for stats()
 
     def close(self) -> None:
         for s in self.servers:
@@ -564,6 +566,28 @@ class HostPSBackend:
         if self._homog is not None:
             n += self._homog.pending()
         return n
+
+    def stats(self, timeout_ms: int = 0) -> Dict[str, dict]:
+        """In-process form of the fleet stats surface (the shared
+        ServerStats/v1 shape, obs/fleet.py — one entry per shard):
+        here the "server registry" IS this process's registry, so the
+        snapshot is shared across shards and only the per-shard engine
+        backlog differs. Keeps FleetScraper / bench / exporter code
+        backend-agnostic."""
+        import time as _time
+
+        from ..obs.fleet import server_stats_payload
+        up = _time.monotonic() - self._t0_mono
+        out: Dict[str, dict] = {}
+        for i, s in enumerate(self.servers):
+            def qd(s=s, i=i):
+                n = s.queue_depth()
+                if i == 0 and self._homog is not None:
+                    n += self._homog.pending()   # fold buffered fused
+                return n                         # arrivals once
+            out[f"s{i}"] = server_stats_payload(
+                up, len(self._key_meta), queue_depth_fn=qd)
+        return out
 
     def pull(self, key: int, out: np.ndarray, round: int = 0,
              timeout_ms: int = 30000) -> None:

@@ -154,6 +154,8 @@ class PlanePSBackend:
         # plane lock on each push/pull
         self._lag: Dict[int, int] = {}
         self._lag_argmax: Optional[int] = None
+        self._t0_mono = time.monotonic()   # stats() heartbeat base for
+        #                                    in-process shards
 
     # ------------------------------------------------------------ admin
 
@@ -195,6 +197,40 @@ class PlanePSBackend:
             except Exception:   # noqa: BLE001 — a dying shard's gauge
                 pass            # must not fail the caller
         return n
+
+    def stats(self, timeout_ms: int = 5000) -> Dict[str, dict]:
+        """Fleet stats surface over the plane's shard list: remote
+        shard clients answer via OP_STATS, in-process shards synthesize
+        the same shape, shards already failed over report as errors (a
+        scraper reads them as down — which they are). Per-shard
+        failures become ``{"error": …}`` entries, never exceptions: the
+        scrape thread is the observer of shard death, not a victim."""
+        from ...obs.fleet import server_stats_payload
+        out: Dict[str, dict] = {}
+        for i, s in enumerate(self._shards):
+            label = f"s{i}"
+            if i in self._dead:
+                out[label] = {"error": "failed over (shard marked dead)"}
+                continue
+            try:
+                if hasattr(s, "stats_shard"):
+                    # single-address RemotePSBackend shard client
+                    out[label] = s.stats_shard(0, timeout_ms)
+                elif hasattr(s, "stats"):
+                    sub = s.stats(timeout_ms=timeout_ms)
+                    out[label] = sub.get("s0") or next(iter(sub.values()))
+                else:
+                    # raw in-process PSServer shard: the shared shape,
+                    # local registry, plane-lifetime heartbeat
+                    out[label] = server_stats_payload(
+                        time.monotonic() - self._t0_mono,
+                        len(self._meta),
+                        queue_depth_fn=(s.queue_depth
+                                        if hasattr(s, "queue_depth")
+                                        else None))
+            except Exception as e:   # noqa: BLE001 — per-shard isolation
+                out[label] = {"error": f"{type(e).__name__}: {e}"}
+        return out
 
     # ------------------------------------------------- failover plumbing
 
@@ -291,7 +327,18 @@ class PlanePSBackend:
         elif key in self._fused_keys:
             # fused-managed declaration travels with every (re-)init —
             # a failover/migration replay must re-manage the key on the
-            # new shard, not silently degrade it to dense decodes
+            # new shard, not silently degrade it to dense decodes. Same
+            # signature guard as the compression branch: a raw
+            # in-process PSServer shard has no fused surface, and that
+            # must fail loudly at init/replay time, never as a
+            # TypeError inside a failover replay
+            import inspect
+            if "fused" not in inspect.signature(
+                    sh.init_key).parameters:
+                raise ValueError(
+                    f"shard {shard} ({type(sh).__name__}) cannot "
+                    f"manage fused key {key} — fused declarations "
+                    f"need transport-backed plane shards")
             sh.init_key(key, nbytes, dtype, init=init, fused=True)
         else:
             sh.init_key(key, nbytes, dtype, init=init)

@@ -31,14 +31,26 @@ class Rebalancer:
 
     def __init__(self, plane, interval_sec: float = 0.0,
                  imbalance: float = 1.3, max_moves: int = 2,
-                 min_key_bytes: int = 0) -> None:
+                 min_key_bytes: int = 0, fleet=None) -> None:
         self.plane = plane
         self.interval_sec = float(interval_sec)
         self.imbalance = float(imbalance)
         self.max_moves = int(max_moves)
         self.min_key_bytes = int(min_key_bytes)
+        # fleet telemetry view (obs.fleet.FleetScraper): when present
+        # (explicitly, or as the process-current scraper), per-shard
+        # SERVER pressure comes from the scraped registries instead of
+        # the worker-local proxies, and shards whose scrape went stale
+        # are skipped — never migrated onto on old numbers
+        self.fleet = fleet
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def _fleet(self):
+        if self.fleet is not None:
+            return self.fleet
+        from ...obs import fleet as fleet_mod
+        return fleet_mod.current()
 
     # ------------------------------------------------------------- policy
 
@@ -48,15 +60,57 @@ class Rebalancer:
         assigned-bytes table (cold start / idle plane). Returns the
         decision record (also the no-op reasons, for observability)."""
         reg = get_registry()
-        decision: Dict = {
-            "merge_wait_p95_ms": reg.histogram(
-                "server/merge_wait_s").summary().get("p95_ms", 0.0),
-            "queue_depth": reg.gauge("server/engine_queue_depth").value,
-            "moved": [],
-        }
+        fl = self._fleet()
+        if fl is not None:
+            # SHARD-ATTRIBUTED server pressure from the scraped fleet
+            # view (not the worker-local aggregate): the decision
+            # records exactly the signals it read, per shard, with the
+            # staleness verdict alongside
+            scraped: Dict = {}
+            for label, sv in fl.view().items():
+                mw = fl.shard_metric(label, "server/merge_wait_s")
+                scraped[label] = {
+                    "engine_queue_depth": fl.shard_metric(
+                        label, "queue_depth"),
+                    "merge_wait_p95_ms": (mw or {}).get("p95_ms", 0.0)
+                    if isinstance(mw, dict) else 0.0,
+                    "age_s": sv["age_s"],
+                    "stale": sv["stale"],
+                }
+            fresh = {k: v for k, v in scraped.items() if not v["stale"]}
+            decision = {
+                "signal_source": "fleet",
+                "scraped": scraped,
+                "merge_wait_p95_ms": max(
+                    (v["merge_wait_p95_ms"] for v in fresh.values()),
+                    default=0.0),
+                "queue_depth": max(
+                    (v["engine_queue_depth"] or 0
+                     for v in fresh.values()), default=0),
+                "moved": [],
+            }
+        else:
+            decision = {
+                "signal_source": "worker-local",
+                "merge_wait_p95_ms": reg.histogram(
+                    "server/merge_wait_s").summary().get("p95_ms", 0.0),
+                "queue_depth": reg.gauge(
+                    "server/engine_queue_depth").value,
+                "moved": [],
+            }
         live = self.plane.placement.live_shards()
+        if fl is not None:
+            # a stale shard's load numbers are fiction — skip it as
+            # both migration source and target until its scrape
+            # freshens (or failover removes it from live_shards)
+            stale = [s for s in live if fl.is_stale(s)]
+            if stale:
+                decision["stale_skipped"] = stale
+                live = [s for s in live if s not in stale]
         if len(live) < 2:
-            decision["skip"] = "single live shard"
+            decision["skip"] = ("single live shard" if fl is None
+                                or not decision.get("stale_skipped")
+                                else "fewer than 2 fresh shards")
             return decision
         win = self.plane.load_window()
         loads = {s: win["shards"].get(s, 0) for s in live}

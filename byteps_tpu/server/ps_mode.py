@@ -34,6 +34,7 @@ import numpy as np
 
 from ..common.naming import NameRegistry
 from ..common.partition import LeafSpec, plan_buckets
+from ..obs import flight
 from ..obs.metrics import get_registry, observe_stage
 from .engine import HostPSBackend
 
@@ -1023,6 +1024,15 @@ class PSGradientExchange:
         except BaseException as e:   # noqa: BLE001 — relayed below
             exc = e
             rnd.bucket_state[idx] = "failed"
+            # tail-failure postmortem: the error surfaces to the
+            # caller at the next sync point, possibly seconds from
+            # now — dump what HAPPENED on this key's path while the
+            # flight ring still holds it
+            from ..common.logging import get_logger
+            flight.dump(get_logger(), keys=[pskey],
+                        reason=f"pull failure key={pskey} "
+                               f"round={rnd.rounds[idx]}: "
+                               f"{type(e).__name__}: {e}")
         finally:
             self._release_key(pskey)
             rnd._pull_finished(exc)
@@ -1043,13 +1053,17 @@ class PSGradientExchange:
                 t0 = time.time()
 
                 def deferred(submit=submit, t0=t0):
-                    self._m_adm_wait.observe(time.time() - t0)
+                    wait = time.time() - t0
+                    self._m_adm_wait.observe(wait)
+                    flight.record("admit", key=pskey,
+                                  detail=f"deferred {wait:.3f}s")
                     submit()
 
                 self._key_waiters.setdefault(pskey,
                                              deque()).append(deferred)
                 return
             self._key_busy.add(pskey)
+        flight.record("admit", key=pskey)
         submit()
 
     def _release_key(self, pskey: int) -> None:
@@ -1158,19 +1172,39 @@ class PSGradientExchange:
                                                  epoch=epoch)
                          if epoch is not None
                          else self.backend.push_fused(pskey, payload))
-        except Exception:
+        except Exception as e:
             # mirror push_one's host-path handler: the round counter
             # advanced but the push never landed — drop the entry so a
             # retried exchange() re-seeds from the server's round
             # instead of pulling a round that will never complete
+            flight.record("push", key=pskey, round=rnd.rounds[idx],
+                          nbytes=len(payload), stage="PS_COMPRESS_DEV",
+                          outcome=f"error:{type(e).__name__}")
             with self._key_rounds_lock:
                 self._key_rounds.pop(pskey, None)
             raise
+        flight.record("push", key=pskey, round=rnd.rounds[idx],
+                      nbytes=len(payload), stage="PS_COMPRESS_DEV")
         # pull staging buffer (the fused pull path decodes into its own
         # array; np.empty is malloc-only)
         return np.empty(b.size, dtype=b.dtype)
 
     def _push_bucket(self, pskey, b, buf, rnd=None, idx=None) -> None:
+        # flight-recorder envelope: one event per wire push with its
+        # outcome — the postmortem's raw material (obs/flight.py)
+        rnd_num = (rnd.rounds[idx]
+                   if rnd is not None and idx is not None else None)
+        try:
+            self._push_bucket_impl(pskey, b, buf, rnd=rnd, idx=idx)
+        except BaseException as e:   # noqa: BLE001 — re-raised
+            flight.record("push", key=pskey, round=rnd_num,
+                          nbytes=buf.nbytes,
+                          outcome=f"error:{type(e).__name__}")
+            raise
+        flight.record("push", key=pskey, round=rnd_num,
+                      nbytes=buf.nbytes)
+
+    def _push_bucket_impl(self, pskey, b, buf, rnd=None, idx=None) -> None:
         chain = self._chains.get(pskey)
         if chain is not None:
             # legacy COMPRESS stage right before PUSH (reference:
@@ -1223,6 +1257,28 @@ class PSGradientExchange:
             m.inc(n)
 
     def _pull_bucket(self, pskey, b, buf, rnd_num, rnd=None, idx=None):
+        try:
+            out = self._pull_bucket_impl(pskey, b, buf, rnd_num,
+                                         rnd=rnd, idx=idx)
+        except BaseException as e:   # noqa: BLE001 — re-raised
+            flight.record("pull", key=pskey, round=rnd_num,
+                          outcome=f"error:{type(e).__name__}")
+            from ..compress.wire import CodecError
+            if isinstance(e, CodecError):
+                # a refused decode is a peer/config divergence, not a
+                # stall: dump the key's recent codec decisions and
+                # rounds alongside the loud refusal
+                from ..common.logging import get_logger
+                flight.dump(get_logger(), keys=[pskey],
+                            reason=f"CodecError on pull key={pskey} "
+                                   f"round={rnd_num}: {e}")
+            raise
+        flight.record("pull", key=pskey, round=rnd_num,
+                      nbytes=buf.nbytes)
+        return out
+
+    def _pull_bucket_impl(self, pskey, b, buf, rnd_num, rnd=None,
+                          idx=None):
         chain = self._chains.get(pskey)
         if chain is not None:
             payload = self.backend.pull_bytes(pskey, round=rnd_num)

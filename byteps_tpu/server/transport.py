@@ -151,6 +151,15 @@ OP_ACT_PUSH, OP_ACT_PULL = 19, 20
 #     OP_PULL) until the frame arrives; response = payload. A timeout
 #     is the owner-death diagnostic's trigger, never a silent hang.
 OP_PARAM_PUT, OP_PARAM_GET = 21, 22
+# Fleet telemetry plane (byteps_tpu.obs.fleet): serve this SERVER
+# process's registry snapshot + heartbeat (monotonic uptime, op
+# counters) as one JSON response. Request carries no payload and the
+# response is an ordinary reply, so the op is reuse-safe by
+# construction and NEVER credit-gated — the send scheduler only gates
+# payload-bearing frames, and the client scrapes on a DEDICATED
+# channel outside the data-plane pools: telemetry must flow when the
+# data plane is wedged (that is precisely when it is needed).
+OP_STATS = 23
 _PART = struct.Struct("!IIHHQ")  # offset, part_len, part_idx, nparts, nonce
 ST_OK, ST_ERR, ST_TIMEOUT, ST_GONE = 0, 1, 2, 3
 
@@ -529,6 +538,14 @@ class PSTransportServer:
         self._m_requests = get_registry().counter("transport/requests")
         self._m_merge_wait = get_registry().histogram(
             "server/merge_wait_s")
+        # heartbeat state for OP_STATS (obs/fleet.py): MONOTONIC birth
+        # time (a scraper seeing uptime go backwards has watched this
+        # process restart — wall clocks can step, this cannot) and a
+        # plain per-server request count (the registry counter above is
+        # process-wide and shared by colocated servers)
+        self._t0_mono = time.monotonic()
+        self._t0_wall = time.time()
+        self._n_requests = 0
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -577,6 +594,8 @@ class PSTransportServer:
         (the connection survives — one bad request must not take down the
         worker's whole data plane)."""
         self._m_requests.inc()
+        self._n_requests += 1    # heartbeat op counter; GIL-atomic int
+        #                          add is plenty for a liveness signal
         try:
             if self._key_log and op in (OP_PUSH, OP_PULL, OP_PUSH_C,
                                         OP_PUSH_RS):
@@ -810,6 +829,11 @@ class PSTransportServer:
                 rv = struct.pack("!Q",
                                  int(self._replica_store().base(key)))
                 conn.sendall(_RSP.pack(ST_OK, len(rv)) + rv)
+            elif op == OP_STATS:
+                import json as _json
+                body = _json.dumps(self.stats_payload()).encode()
+                conn.sendall(_RSP.pack(ST_OK, len(body)))
+                conn.sendall(body)
             elif op == OP_PULL_C:
                 from .compressed import compressed_pull
                 buf = compressed_pull(self.compressed, self.backend, key,
@@ -855,6 +879,22 @@ class PSTransportServer:
                     from ..pipeline.exchange import ActStore
                     self._acts = ActStore()
         return self._acts
+
+    def stats_payload(self) -> dict:
+        """The OP_STATS response body: this process's registry snapshot
+        plus this server's heartbeat (the shared ServerStats/v1 shape,
+        obs/fleet.py). Every field is a read of already-published state
+        — no round-blocking, no engine waits — so the scrape answers
+        even while the data plane is wedged on a lost pull (the whole
+        point of a liveness signal)."""
+        from ..obs.fleet import server_stats_payload
+        return server_stats_payload(
+            time.monotonic() - self._t0_mono, len(self._key_meta),
+            requests=self._n_requests,
+            queue_depth_fn=(self.backend.queue_depth
+                            if hasattr(self.backend, "queue_depth")
+                            else None),
+            start_ts=self._t0_wall)
 
     def param_store(self):
         """This server's param mailbox (sharded weight update,
@@ -1192,6 +1232,13 @@ class RemotePSBackend:
         self._placed: set = set()
         # init_key replay log per shard index: key -> args
         self._inits: List[Dict[int, tuple]] = [dict() for _ in addrs]
+        # DEDICATED telemetry channel per shard (OP_STATS, obs/fleet):
+        # scrapes must not draw from the data-plane pools — when every
+        # pooled channel is parked on a round-blocked pull (the wedged
+        # state the fleet plane exists to observe), a pool-queued
+        # scrape would block behind exactly the stall it should report
+        self._stats_chans: List[Optional[_Channel]] = [None] * len(addrs)
+        self._stats_locks = [threading.Lock() for _ in addrs]
         self._pools: List[_queue.Queue] = []
         for i in range(len(addrs)):
             pool = _queue.Queue()
@@ -1787,6 +1834,66 @@ class RemotePSBackend:
         self._sliced_pull(attempt, timeout_ms,
                           f"pull({key}) round={round}")
 
+    # Fleet telemetry client (byteps_tpu.obs.fleet): scrape EVERY
+    # shard's registry snapshot + heartbeat over OP_STATS — placement-
+    # independent (the scrape is about the servers, not any key),
+    # never credit-gated (no payload = nothing for the send scheduler
+    # to gate), and on a dedicated per-shard channel so a wedged data
+    # plane cannot starve telemetry.
+
+    def stats_shard(self, i: int, timeout_ms: int = 5000) -> dict:
+        """One shard's OP_STATS scrape; raises on an unreachable shard
+        (the aggregate ``stats()`` folds that into an error entry —
+        the scraper's staleness machinery owns the retry cadence)."""
+        import json as _json
+
+        # client-side SOCKET timeout, not just the frame field: a
+        # black-holed host (power loss, partition without an RST) —
+        # exactly the silent death the fleet plane detects — would
+        # otherwise block this recv forever and starve every shard's
+        # scrape behind it. socket.timeout is an OSError: it takes the
+        # same one-redial-then-fail path as a severed connection.
+        sock_to = timeout_ms / 1e3 + 1.0
+        with self._stats_locks[i]:
+            ch = self._stats_chans[i]
+            if ch is None:
+                ch = self._stats_chans[i] = _Channel(None)
+            try:
+                if ch.sock is None:
+                    ch.sock = self._dial(i)
+                ch.sock.settimeout(sock_to)
+                data = self._roundtrip(ch.sock, OP_STATS, 0, 0, 0,
+                                       timeout_ms, "uint8", None)
+            except (ConnectionError, OSError):
+                # ONE redial, then fail loudly: a scrape is cheap and
+                # periodic — burning the full reconnect budget here
+                # would hold the scrape thread through exactly the
+                # outage it should be reporting as staleness
+                old, ch.sock = ch.sock, None
+                if old is not None:
+                    try:
+                        old.close()
+                    except OSError:
+                        pass
+                ch.sock = self._dial(i)
+                ch.sock.settimeout(sock_to)
+                data = self._roundtrip(ch.sock, OP_STATS, 0, 0, 0,
+                                       timeout_ms, "uint8", None)
+            return _json.loads(bytes(data).decode())
+
+    def stats(self, timeout_ms: int = 5000) -> Dict[str, dict]:
+        """{shard label: OP_STATS payload} for EVERY shard. Unreachable
+        shards become ``{"error": …}`` entries instead of raising — the
+        fleet scraper turns those into stale scrape-age + ``up=0``,
+        never an exception on its control thread."""
+        out: Dict[str, dict] = {}
+        for i in range(len(self._addrs)):
+            try:
+                out[f"s{i}"] = self.stats_shard(i, timeout_ms)
+            except Exception as e:   # noqa: BLE001 — per-shard isolation
+                out[f"s{i}"] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
     def round(self, key: int) -> int:
         """The server's latest completed round for ``key`` (see
         HostPSBackend.round — the elastic-rejoin resync point). A
@@ -1936,6 +2043,14 @@ class RemotePSBackend:
         if self._stripe_exec is not None:
             self._stripe_exec.shutdown(wait=True)
             self._stripe_exec = None
+        for i, ch in enumerate(self._stats_chans):
+            if ch is not None and ch.sock is not None:
+                with self._stats_locks[i]:
+                    try:
+                        ch.sock.close()
+                    except OSError:
+                        pass
+                    ch.sock = None
         for pool in self._pools:
             while True:
                 try:

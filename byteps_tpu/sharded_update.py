@@ -434,6 +434,10 @@ class ShardedUpdateState:
                 self.exchange.backend.param_put(
                     self.plan.param_keys[gi], seq, payload)
                 self._m_put.inc(len(payload))
+                from .obs import flight
+                flight.record("param_put",
+                              key=self.plan.param_keys[gi], round=seq,
+                              nbytes=len(payload))
                 observe_stage("PS_PARAM_PUT", time.time() - t0)
                 tl = self.timeline
                 if tl is not None:

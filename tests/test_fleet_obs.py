@@ -1,0 +1,744 @@
+"""Fleet telemetry plane (ISSUE 12): the OP_STATS wire op + backend
+``stats()`` surfaces, the FleetScraper's shard-labeled view with
+scrape-age staleness + heartbeats, the flight recorder's postmortems
+on the failure paths, and the Prometheus/JSON exporters.
+
+Tier-1 covers the wire roundtrip (incl. reconnect + server restart),
+the two-shard fleet snapshot with shard labels, the killed-shard
+staleness contract (no exception, rebalancer skips it), the wedged-pull
+and PeerDead postmortems, and an exporter golden; the slow lane severs
+a live shard through the chaos proxy mid-scrape."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from byteps_tpu.obs import flight
+from byteps_tpu.obs import metrics as obs_metrics
+from byteps_tpu.obs.export import (MetricsHTTPServer, main as export_main,
+                                   prometheus_text, scrape_addr)
+from byteps_tpu.obs.fleet import FleetScraper
+from byteps_tpu.server.engine import HostPSBackend, PSServer
+from byteps_tpu.server.transport import PSTransportServer, RemotePSBackend
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    """Zeroed metrics, enabled recording, a clean flight ring, and no
+    process-current fleet scraper leaking across tests."""
+    from byteps_tpu.obs import fleet as fleet_mod
+    obs_metrics.configure(True)
+    obs_metrics.get_registry().reset()
+    flight.configure(enabled=True)
+    flight.get_recorder().clear()
+    fleet_mod.set_current(None)
+    yield
+    fleet_mod.set_current(None)
+    obs_metrics.configure(None)
+    obs_metrics.get_registry().reset()
+    flight.configure()
+    flight.get_recorder().clear()
+
+
+def _tcp_rig(n_shards=1, num_workers=1):
+    engines = [PSServer(num_workers=num_workers, engine_threads=1)
+               for _ in range(n_shards)]
+    servers = [PSTransportServer(e, host="127.0.0.1", port=0)
+               for e in engines]
+    be = RemotePSBackend([f"127.0.0.1:{s.port}" for s in servers])
+    return engines, servers, be
+
+
+def _close_rig(engines, servers, be):
+    be.close()
+    for s in servers:
+        s.close()
+    for e in engines:
+        e.close()
+
+
+# ------------------------------------------------------------ OP_STATS
+
+def test_op_stats_tcp_roundtrip():
+    engines, servers, be = _tcp_rig()
+    try:
+        be.init_key(7, 16, "float32")
+        be.push(7, np.ones(4, np.float32))
+        out = np.empty(4, np.float32)
+        be.pull(7, out, round=1)
+        st = be.stats()
+        assert set(st) == {"s0"}
+        p = st["s0"]
+        assert p["schema"] == "byteps_tpu.ServerStats/v1"
+        hb = p["heartbeat"]
+        assert hb["uptime_s"] >= 0 and hb["keys"] == 1
+        assert hb["requests"] >= 3           # init + push + pull at least
+        # the server process's registry crossed the wire: the signals
+        # only the server side records are present in the snapshot
+        assert "server/merge_wait_s" in p["metrics"]
+        assert "transport/requests" in p["metrics"]
+        assert "sched/admitted_grad" in p["metrics"]
+    finally:
+        _close_rig(engines, servers, be)
+
+
+def test_op_stats_reconnects_on_severed_stats_channel():
+    engines, servers, be = _tcp_rig()
+    try:
+        first = be.stats_shard(0)
+        # sever the DEDICATED stats channel under the client: the next
+        # scrape must redial (one retry) instead of failing or touching
+        # the data-plane pools
+        ch = be._stats_chans[0]
+        assert ch is not None and ch.sock is not None
+        ch.sock.close()
+        second = be.stats_shard(0)
+        assert second["heartbeat"]["uptime_s"] >= first["heartbeat"][
+            "uptime_s"]
+    finally:
+        _close_rig(engines, servers, be)
+
+
+def test_op_stats_never_takes_a_pooled_channel():
+    """Telemetry must flow when the data plane is wedged: park EVERY
+    pooled channel on round-blocked pulls, then scrape."""
+    engines, servers, be = _tcp_rig()
+    try:
+        be.init_key(7, 16, "float32")
+        nconns = be._nconns
+        threads = []
+        for _ in range(nconns):
+            def blocked_pull():
+                buf = np.empty(4, np.float32)
+                try:       # round 5 never completes: blocks server-side
+                    be.pull(7, buf, round=5, timeout_ms=3000)
+                except Exception:
+                    pass
+            t = threading.Thread(target=blocked_pull, daemon=True)
+            t.start()
+            threads.append(t)
+        time.sleep(0.3)          # pulls reach the server and block
+        t0 = time.time()
+        st = be.stats_shard(0, timeout_ms=2000)
+        assert time.time() - t0 < 1.5, "stats blocked behind the wedge"
+        assert st["heartbeat"]["uptime_s"] >= 0
+        for t in threads:
+            t.join(timeout=10)
+    finally:
+        _close_rig(engines, servers, be)
+
+
+def test_scraper_detects_server_restart():
+    engines, servers, be = _tcp_rig()
+    port = servers[0].port
+    sc = FleetScraper(be, interval_sec=5.0, stale_after=60.0)
+    try:
+        sc.scrape_once()
+        assert sc.view()["s0"]["up"]
+        time.sleep(0.55)
+        sc.scrape_once()      # recorded uptime now >= 0.55
+        # simulate the restart at the heartbeat level: a restarted
+        # server process reports a FRESH monotonic birth, which is
+        # exactly what resetting _t0_mono produces (an in-process
+        # listener swap can't model it — established conns survive a
+        # transport close(), and the port stays pinned by them; the
+        # wire-level reconnect is covered separately above)
+        servers[0]._t0_mono = time.monotonic()
+        sc.scrape_once()
+        # uptime went BACKWARDS across the restart: observed + counted
+        assert sc.view()["s0"]["restarts"] >= 1
+        assert port == servers[0].port           # same address all along
+    finally:
+        sc.stop()
+        _close_rig(engines, servers, be)
+
+
+# ----------------------------------------------------------- fleet view
+
+def test_two_shard_fleet_snapshot_with_labels():
+    """Acceptance: a two-shard TCP rig exposes BOTH servers'
+    engine_queue_depth / merge_wait_s / sched/* in one worker-side
+    snapshot with shard labels."""
+    from byteps_tpu.server.ps_mode import PSGradientExchange
+    engines, servers, be = _tcp_rig(n_shards=2)
+    ex = PSGradientExchange(be, partition_bytes=4 << 10,
+                            pipeline_depth=2)
+    sc = FleetScraper(be, interval_sec=5.0)
+    try:
+        tree = {"a": np.ones(2048, np.float32),
+                "b": np.ones(2048, np.float32)}
+        for _ in range(3):
+            ex.exchange(tree, name="fleet")
+        view = sc.scrape_once()
+        assert set(view) == {"s0", "s1"}
+        for label in ("s0", "s1"):
+            assert view[label]["up"] and not view[label]["stale"]
+            assert view[label]["queue_depth"] is not None
+            assert view[label]["heartbeat"]["uptime_s"] >= 0
+            mw = sc.shard_metric(label, "server/merge_wait_s")
+            assert isinstance(mw, dict)          # histogram summary
+            assert sc.shard_metric(label,
+                                   "sched/admitted_grad") is not None
+            # the shard-labeled gauges landed in the LOCAL registry
+            reg = obs_metrics.get_registry()
+            assert reg.gauge(f"fleet/{label}/up").value == 1.0
+            assert reg.gauge(
+                f"fleet/{label}/scrape_age_s").value < 5.0
+        assert sc.max_queue_depth() is not None
+    finally:
+        sc.stop()
+        ex.close()
+        _close_rig(engines, servers, be)
+
+
+class _FakeStatsBackend:
+    """stats() surface with a controllable dead shard."""
+
+    def __init__(self):
+        self.dead = set()
+        self.depth = {0: 1.0, 1: 9.0}
+        self.lag = {0: 5.0, 1: 0.0}
+
+    def stats(self, timeout_ms=0):
+        out = {}
+        for i in (0, 1):
+            if i in self.dead:
+                out[f"s{i}"] = {"error": "ConnectionError: refused"}
+            else:
+                out[f"s{i}"] = {
+                    "schema": "byteps_tpu.ServerStats/v1",
+                    "heartbeat": {"uptime_s": time.monotonic(),
+                                  "requests": 1, "keys": 2},
+                    "queue_depth": self.depth[i],
+                    "metrics": {"server/merge_wait_s": {
+                        "count": 4, "p95_ms": 12.5, "sum_ms": 20.0},
+                        "plane/replication_lag": self.lag[i]},
+                }
+        return out
+
+
+def test_fleet_gauge_returns_to_zero():
+    """A scraped gauge that went nonzero must be RE-published when the
+    shard reports 0 again — a drained shard must not read as
+    permanently loaded (falsy-zero regression)."""
+    be = _FakeStatsBackend()
+    sc = FleetScraper(be, interval_sec=0.05)
+    reg = obs_metrics.get_registry()
+    sc.scrape_once()
+    assert reg.gauge("fleet/s0/plane/replication_lag").value == 5.0
+    be.lag[0] = 0.0
+    sc.scrape_once()
+    assert reg.gauge("fleet/s0/plane/replication_lag").value == 0.0
+    # a never-nonzero metric stays unpublished (s1's lag was always 0)
+    assert "fleet/s1/plane/replication_lag" not in reg.names()
+
+
+def test_killed_shard_goes_stale_not_healthy():
+    be = _FakeStatsBackend()
+    sc = FleetScraper(be, interval_sec=0.05, stale_after=0.15)
+    sc.scrape_once()
+    assert not sc.is_stale(1)
+    be.dead.add(1)
+    sc.scrape_once()              # failed scrape: up flips immediately
+    assert sc.view()["s1"]["up"] is False
+    assert sc.view()["s1"]["error"]
+    time.sleep(0.2)
+    sc.scrape_once()              # age crossed stale_after
+    v = sc.view()
+    assert v["s1"]["stale"] and not v["s0"]["stale"]
+    # stale telemetry reads as ABSENT, never as current
+    assert sc.shard_metric(1, "queue_depth") is None
+    assert sc.max_queue_depth() == 1.0          # only the fresh shard
+    reg = obs_metrics.get_registry()
+    assert reg.gauge("fleet/s1/stale").value == 1.0
+    assert reg.gauge("fleet/s1/up").value == 0.0
+
+
+def test_rebalancer_reads_scraped_signals_and_skips_stale_shard():
+    """Acceptance: the rebalancer's decision records the SCRAPED (not
+    worker-local) signals it read, and a stale shard is skipped."""
+    from byteps_tpu.server.plane import PlanePSBackend, Rebalancer
+    shards = [PSServer(num_workers=1, engine_threads=1)
+              for _ in range(2)]
+    plane = PlanePSBackend(shards, num_workers=1, replicas=1,
+                           owns_shards=True)
+    fake = _FakeStatsBackend()
+    sc = FleetScraper(fake, interval_sec=0.05, stale_after=0.15)
+    try:
+        for k in range(4):
+            plane.init_key(k, 8 << 10)
+        sc.scrape_once()
+        rb = Rebalancer(plane, imbalance=1.3, fleet=sc)
+        d = rb.step()
+        assert d["signal_source"] == "fleet"
+        assert set(d["scraped"]) == {"s0", "s1"}
+        assert d["scraped"]["s1"]["engine_queue_depth"] == 9.0
+        assert d["scraped"]["s1"]["merge_wait_p95_ms"] == 12.5
+        assert d["queue_depth"] == 9.0          # max over fresh shards
+        # kill shard 1's telemetry: its scrape goes stale and the
+        # rebalancer must SKIP it (one live shard left -> no migration
+        # decision at all), not steer on its old numbers
+        fake.dead.add(1)
+        sc.scrape_once()
+        time.sleep(0.2)
+        sc.scrape_once()
+        d2 = rb.step()
+        assert d2["scraped"]["s1"]["stale"] is True
+        assert 1 in d2.get("stale_skipped", [])
+        assert not d2["moved"]
+        assert d2.get("skip")
+    finally:
+        plane.close()
+
+
+def test_controller_reads_fleet_queue_depth():
+    from byteps_tpu.compress.controller import CompressController
+
+    class _Fleet:
+        def __init__(self, d):
+            self.d = d
+
+        def max_queue_depth(self):
+            return self.d
+
+    reg = obs_metrics.MetricsRegistry()
+    ctl = CompressController(registry=reg, hold=1, fleet=_Fleet(9.0))
+    ctl.register_layer("l0")
+    reg.counter("ps/push_bytes/l0").inc(100)
+    ctl.decide()
+    assert ctl.level_of("l0") > 0      # scraped backlog ratcheted it up
+    # a fully-stale fleet view (None) falls back to the local gauge (0
+    # here) -> idle verdict decays
+    ctl2 = CompressController(registry=reg, hold=1, fleet=_Fleet(None))
+    ctl2.register_layer("l0")
+    ctl2.decide()
+    assert ctl2.level_of("l0") == 0
+
+
+class _KillableProxy:
+    """TCP forwarder with a RELIABLE one-shot kill: ``kill()`` severs
+    every live pair (shutdown — wakes pumps, the ChaosProxy lesson)
+    AND flips the accept loop to accept-then-close, so redials get an
+    immediate EOF instead of a served connection. Models real process
+    death from the client's perspective — needed because a transport
+    ``close()`` alone leaves established conns serving, and
+    ChaosProxy.close() cannot interrupt a blocked accept (the zombie
+    thread keeps proxying the next dial)."""
+
+    def __init__(self, target_port: int):
+        import socket as _socket
+        self._target = target_port
+        self.dead = False
+        self._pairs = []
+        self._lock = threading.Lock()
+        self._sock = _socket.socket()
+        self._sock.setsockopt(_socket.SOL_SOCKET,
+                              _socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(16)
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        import socket as _socket
+        while True:
+            try:
+                client, _ = self._sock.accept()
+            except OSError:
+                return
+            if self.dead:
+                client.close()           # dead process: instant EOF
+                continue
+            try:
+                upstream = _socket.create_connection(
+                    ("127.0.0.1", self._target))
+            except OSError:
+                client.close()
+                continue
+            with self._lock:
+                self._pairs.append((client, upstream))
+            for a, b in ((client, upstream), (upstream, client)):
+                threading.Thread(target=self._pump, args=(a, b),
+                                 daemon=True).start()
+
+    @staticmethod
+    def _pump(src, dst):
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        for s in (src, dst):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def kill(self):
+        import socket as _socket
+        self.dead = True
+        with self._lock:
+            for pair in self._pairs:
+                for s in pair:
+                    try:
+                        s.shutdown(_socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+
+    def close(self):
+        self.kill()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+@pytest.mark.slow
+def test_tcp_killed_shard_scrape_goes_stale():
+    """Slow lane: sever a LIVE shard mid-scrape through a killable
+    proxy. The scraper must flip it down within one cadence and stale
+    shortly after, keep the other shard fresh, and never raise."""
+    engines = [PSServer(num_workers=1, engine_threads=1)
+               for _ in range(2)]
+    servers = [PSTransportServer(e, host="127.0.0.1", port=0)
+               for e in engines]
+    proxy = _KillableProxy(servers[1].port)
+    be = RemotePSBackend([f"127.0.0.1:{servers[0].port}",
+                          f"127.0.0.1:{proxy.port}"])
+    sc = FleetScraper(be, interval_sec=0.1, stale_after=0.3,
+                      timeout_ms=500)
+    try:
+        sc.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and (len(sc.shards()) < 2
+                                          or sc.is_stale(1)):
+            time.sleep(0.05)
+        assert not sc.is_stale(1)
+        proxy.kill()             # "the process died"
+        deadline = time.time() + 6
+        while time.time() < deadline and not sc.is_stale(1):
+            time.sleep(0.05)
+        v = sc.view()
+        assert v["s1"]["stale"] and v["s1"]["up"] is False
+        assert not v["s0"]["stale"]              # healthy shard fresh
+        assert sc._thread is not None            # scrape loop survived
+    finally:
+        sc.stop()
+        be.close()
+        proxy.close()
+        for s in servers:
+            s.close()
+        for e in engines:
+            e.close()
+
+
+# ------------------------------------------------------ flight recorder
+
+def test_flight_recorder_ring_and_filter():
+    rec = flight.FlightRecorder(size=16, enabled=True)
+    for i in range(40):
+        rec.record("push", key=i % 2, round=i, nbytes=64)
+    evs = rec.events()
+    assert len(evs) == 16                        # bounded ring
+    only0 = rec.events(keys=[0])
+    assert only0 and all(e["key"] == 0 for e in only0)
+    rec.record("codec", stage="l0", detail="level 0->2")
+    assert any(e["kind"] == "codec"              # key-less events pass
+               for e in rec.events(keys=[0]))    # every key filter
+    pm = rec.postmortem(keys=[0], last=5)
+    assert pm["keys"] == [0] and len(pm["events"]) <= 5
+    assert "flight recorder" in rec.format_postmortem(keys=[0])
+    off = flight.FlightRecorder(enabled=False)
+    off.record("push", key=1)
+    assert off.events() == [] and off.format_postmortem() == ""
+
+
+def test_watchdog_dump_carries_flight_postmortem(monkeypatch):
+    """Extends the PR-4 wedged-pull injection: the stall dump now also
+    names WHAT HAPPENED — the wedge key's pushes/admissions from the
+    flight ring ride along in last_dump['flight']."""
+    monkeypatch.setenv("BPS_WATCHDOG_SEC", "0.3")
+    from test_obs import _WedgedBackend
+
+    from byteps_tpu.server.ps_mode import PSGradientExchange
+    be = _WedgedBackend()
+    ex = PSGradientExchange(be, partition_bytes=4 << 10,
+                            pipeline_depth=2)
+    tree = {"a": np.ones(2048, np.float32),
+            "b": np.ones(2048, np.float32)}
+    try:
+        ex.plan_for(tree, name="wedge")
+        keys = [k for k, _ in ex._plans[next(iter(ex._plans))][2]]
+        assert len(keys) >= 2
+        be.wedge_key = keys[-1]
+        h = ex.exchange_async(tree, name="wedge")
+        t0 = time.time()
+        while ex._watchdog is None or ex._watchdog.dumps == 0:
+            assert time.time() - t0 < 5.0, "watchdog never fired"
+            time.sleep(0.02)
+        dump = ex._watchdog.last_dump
+        pm = dump.get("flight")
+        assert pm is not None
+        assert pm["keys"] and be.wedge_key in pm["keys"]
+        pushes = [e for e in pm["events"]
+                  if e["kind"] == "push" and e.get("key") == be.wedge_key]
+        assert pushes, pm["events"]       # the wedged key's push is on
+        #                                   record: round + bytes named
+        assert pushes[-1]["round"] == 1
+        assert any(e["kind"] == "admit" for e in pm["events"])
+        be.release.set()
+        h.result()
+    finally:
+        be.release.set()
+        ex.close()
+
+
+def test_pull_failure_records_error_event():
+    from test_obs import _WedgedBackend
+
+    from byteps_tpu.server.ps_mode import PSGradientExchange
+    be = _WedgedBackend()
+    ex = PSGradientExchange(be, partition_bytes=64 << 10,
+                            pipeline_depth=2)
+    tree = {"a": np.ones(256, np.float32)}
+    try:
+        ex.plan_for(tree, name="boom")
+        key = next(k for k, _ in ex._plans[next(iter(ex._plans))][2])
+
+        def failing(k, out, round=0, timeout_ms=30000):
+            raise TimeoutError(f"pull({k}) injected failure")
+        be.pull = failing
+        h = ex.exchange_async(tree, name="boom")
+        with pytest.raises(Exception):
+            h.result()
+        evs = flight.get_recorder().events(keys=[key])
+        assert any(e["kind"] == "pull" and
+                   e["outcome"].startswith("error:") for e in evs)
+    finally:
+        be.release.set()
+        ex.close()
+
+
+def test_peerdead_recv_dumps_postmortem():
+    """A recv timeout raises PeerDead AND leaves the channel's events
+    (the postmortem's content) in the flight ring."""
+    from byteps_tpu.pipeline.exchange import (ActStore,
+                                              ActivationExchange,
+                                              LocalActPeer, PeerDead,
+                                              act_key)
+
+    class _Boundary:
+        index = 3
+        kind = "act"
+        src_stage = 0
+        dst_stage = 1
+        vars = ("v0",)
+
+        def specs(self):
+            return [((4,), "float32")]
+
+    store = ActStore()
+    ex = ActivationExchange(1, store, peer_prev=LocalActPeer(store),
+                            timeout_ms=200)
+    b = _Boundary()
+    env = {}
+    with pytest.raises(PeerDead) as ei:
+        ex.recv(b, mb=0, seq=0, env=env)
+    assert "boundary 3" in str(ei.value)
+    evs = flight.get_recorder().events(keys=[act_key(3)])
+    assert any(e["kind"] == "act_recv"
+               and e["outcome"] == "error:TimeoutError" for e in evs)
+
+
+def test_act_roundtrip_records_flight_events():
+    from byteps_tpu.pipeline.exchange import (ActStore,
+                                              ActivationExchange,
+                                              LocalActPeer, act_key)
+
+    class _Boundary:
+        index = 1
+        kind = "act"
+        src_stage = 0
+        dst_stage = 1
+        vars = ("v0",)
+        local = False
+
+        def specs(self):
+            return [((4,), "float32")]
+
+    store = ActStore()
+    sender = ActivationExchange(0, ActStore(),
+                                peer_next=LocalActPeer(store))
+    receiver = ActivationExchange(1, store,
+                                  peer_prev=LocalActPeer(ActStore()))
+    b = _Boundary()
+    env = {"v0": np.ones(4, np.float32)}
+    sender.send(b, mb=0, seq=0, env=env)
+    out_env = {}
+    receiver.recv(b, mb=0, seq=0, env=out_env)
+    np.testing.assert_array_equal(out_env["v0"],
+                                  np.ones(4, np.float32))
+    kinds = {e["kind"] for e in
+             flight.get_recorder().events(keys=[act_key(1)])}
+    assert {"act_send", "act_recv"} <= kinds
+
+
+# ----------------------------------------------------------- exporters
+
+def test_prometheus_text_golden():
+    reg = obs_metrics.MetricsRegistry.__new__(obs_metrics.MetricsRegistry)
+    reg._lock = threading.Lock()
+    reg._metrics = {}
+    reg.counter("ps/push_bytes").inc(1024)
+    reg.gauge("plane/epoch").set(3)
+    h = reg.histogram("stage/PS_PUSH", bounds=(0.001, 0.01, 0.1))
+    h.observe(0.005)
+    h.observe(0.005)
+    reg.gauge("fleet/s0/server/engine_queue_depth").set(2)
+    reg.gauge("fleet/s1/server/engine_queue_depth").set(7)
+    golden = "\n".join([
+        '# TYPE bps_fleet_server_engine_queue_depth gauge',
+        'bps_fleet_server_engine_queue_depth{shard="s0"} 2',
+        'bps_fleet_server_engine_queue_depth{shard="s1"} 7',
+        '# TYPE bps_plane_epoch gauge',
+        'bps_plane_epoch 3',
+        '# TYPE bps_ps_push_bytes_total counter',
+        'bps_ps_push_bytes_total 1024',
+        '# TYPE bps_stage_PS_PUSH summary',
+        'bps_stage_PS_PUSH_count 2',
+        'bps_stage_PS_PUSH_sum 0.01',
+        'bps_stage_PS_PUSH{quantile="0.5"} 0.005',
+        'bps_stage_PS_PUSH{quantile="0.95"} 0.005',
+        'bps_stage_PS_PUSH{quantile="0.99"} 0.005',
+    ]) + "\n"
+    assert prometheus_text(reg) == golden
+
+
+def test_export_cli_scrapes_servers(tmp_path, capsys):
+    engines, servers, be = _tcp_rig()
+    try:
+        be.init_key(1, 16, "float32")
+        addr = f"127.0.0.1:{servers[0].port}"
+        rc = export_main([addr, "--format", "json"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["stats"]["s0"]["heartbeat"]["keys"] == 1
+        rc = export_main([addr, "--format", "prom", "-o",
+                          str(tmp_path / "m.prom")])
+        assert rc == 0
+        text = (tmp_path / "m.prom").read_text()
+        assert 'bps_fleet_up{shard="s0"} 1' in text
+        assert 'shard="s0"' in text
+        # scrape_addr is the same path the CLI uses — sanity direct
+        assert scrape_addr(addr)["schema"] == "byteps_tpu.ServerStats/v1"
+    finally:
+        _close_rig(engines, servers, be)
+
+
+def test_export_cli_local_registry(capsys):
+    obs_metrics.get_registry().counter("ps/push_bytes").inc(7)
+    rc = export_main(["--format", "prom"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "bps_ps_push_bytes_total 7" in out
+
+
+def test_metrics_http_server():
+    obs_metrics.get_registry().gauge("plane/epoch").set(5)
+    srv = MetricsHTTPServer(0, host="127.0.0.1").start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        text = urllib.request.urlopen(f"{base}/metrics",
+                                      timeout=5).read().decode()
+        assert "bps_plane_epoch 5" in text
+        js = json.loads(urllib.request.urlopen(
+            f"{base}/metrics.json", timeout=5).read().decode())
+        assert js["metrics"]["plane/epoch"] == 5
+        fj = json.loads(urllib.request.urlopen(
+            f"{base}/fleet.json", timeout=5).read().decode())
+        assert fj["scraper"] is False and fj["shards"] == {}
+    finally:
+        srv.stop()
+
+
+def test_metrics_http_serves_fleet_view():
+    from byteps_tpu.obs import fleet as fleet_mod
+    be = _FakeStatsBackend()
+    sc = FleetScraper(be, interval_sec=0.05)
+    fleet_mod.set_current(sc)
+    sc.scrape_once()
+    srv = MetricsHTTPServer(0, host="127.0.0.1").start()
+    try:
+        fj = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/fleet.json",
+            timeout=5).read().decode())
+        assert fj["scraper"] is True
+        assert fj["shards"]["s0"]["up"] is True
+    finally:
+        srv.stop()
+        fleet_mod.set_current(None)
+
+
+# ------------------------------------------------- host backend surface
+
+def test_host_backend_stats_surface():
+    be = HostPSBackend(num_servers=2, num_workers=1, engine_threads=1)
+    try:
+        be.init_key(1, 16, "float32")
+        st = be.stats()
+        assert set(st) == {"s0", "s1"}
+        for p in st.values():
+            assert p["heartbeat"]["uptime_s"] >= 0
+            assert "server/engine_queue_depth" in p["metrics"]
+        sc = FleetScraper(be, interval_sec=5.0)
+        v = sc.scrape_once()
+        assert v["s0"]["up"] and v["s1"]["up"]
+    finally:
+        be.close()
+
+
+@pytest.mark.slow
+def test_bench_fleet_obs_smoke():
+    """CI slow-lane smoke of ``bench.py fleet_obs``: the scraped
+    two-shard column set is populated and the observability-overhead
+    A/B holds its asserted 2% bound (the assert lives in the bench)."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    out = bench.fleet_obs_breakdown(rounds=10, iters=12, warm=3,
+                                    pairs=2)
+    assert out["shards_scraped"] == 2
+    for label in ("s0", "s1"):
+        col = out["fleet"][label]
+        assert col["up"] is True
+        assert col["engine_queue_depth_p95"] is not None
+        assert col["uptime_s"] is not None
+    assert out["obs_overhead"] <= 1.02
+    assert json.dumps(out)               # still one-line-JSON-able
+
+
+def test_plane_backend_stats_marks_dead_shard():
+    from byteps_tpu.server.plane import PlanePSBackend
+    shards = [PSServer(num_workers=1, engine_threads=1)
+              for _ in range(2)]
+    plane = PlanePSBackend(shards, num_workers=1, replicas=1,
+                           owns_shards=True)
+    try:
+        st = plane.stats()
+        assert set(st) == {"s0", "s1"}
+        assert all("error" not in p for p in st.values())
+        plane._dead.add(1)
+        st2 = plane.stats()
+        assert "error" in st2["s1"] and "error" not in st2["s0"]
+    finally:
+        plane.close()

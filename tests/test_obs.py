@@ -407,6 +407,108 @@ def test_merge_trace_missing_dir(tmp_path):
         merge_traces(str(tmp_path / "nothing"))
 
 
+def _write_pp_rank_trace(td, rank=0, step=1):
+    """Two-stage 1F1B fixture: one microbatch crossing boundary 0
+    forward (s0 -> s1) and boundary 1 backward (s1 -> s0), the trace
+    shape pipeline/driver.py + pipeline/exchange.py record (pid =
+    stage; args.name carries /s<stage>/[b<boundary>/]mb<mb>)."""
+    os.makedirs(os.path.join(td, str(rank)), exist_ok=True)
+
+    def x(name, stage, ts, aname):
+        return {"name": name, "ph": "X", "pid": stage, "tid": 0,
+                "ts": ts, "dur": 8, "args": {"name": aname,
+                                             "step": step}}
+    ev = [
+        x("PP_FWD_SEG", 0, 0, "pp/s0/mb0"),
+        x("PP_ACT_SEND", 0, 10, "pp/s0/b0/mb0"),
+        x("PP_ACT_RECV", 1, 20, "pp/s1/b0/mb0"),
+        x("PP_FWD_SEG", 1, 30, "pp/s1/mb0"),
+        x("PP_BWD_SEG", 1, 40, "pp/s1/mb0"),
+        x("PP_ACT_SEND", 1, 50, "pp/s1/b1/mb0"),
+        x("PP_ACT_RECV", 0, 60, "pp/s0/b1/mb0"),
+        x("PP_BWD_SEG", 0, 70, "pp/s0/mb0"),
+    ]
+    with open(os.path.join(td, str(rank), "comm.json"), "w") as f:
+        json.dump({"traceEvents": ev, "displayTimeUnit": "ms"}, f)
+
+
+def test_merge_trace_pp_stage_rows_and_act_flow(tmp_path):
+    """ISSUE-12 satellite: PP spans get per-STAGE process rows and
+    PP_ACT_SEND -> PP_ACT_RECV flow arrows per (boundary, microbatch)."""
+    td = str(tmp_path)
+    _write_pp_rank_trace(td)
+    merged = merge_traces(td)
+    events = merged["traceEvents"]
+    spans = [e for e in events if e.get("ph") == "X"]
+    # every PP span moved off the rank row onto its stage's process row
+    pids = {e["pid"] for e in spans}
+    assert pids == {10000, 10001}
+    names = {e["pid"]: e["args"]["name"] for e in events
+             if e.get("ph") == "M" and e.get("name") == "process_name"
+             and e["pid"] >= 10000}
+    assert names == {10000: "pp stage 0", 10001: "pp stage 1"}
+    # microbatch is the lane (tid) within the stage row
+    assert all(e["tid"] == 0 for e in spans)
+    # one act flow arrow per boundary crossing: b0 fwd + b1 bwd
+    starts = [e for e in events if e.get("ph") == "s"]
+    finishes = {e["id"]: e for e in events if e.get("ph") == "f"}
+    assert len(starts) == 2
+    assert all(e["name"] == "act" for e in starts)
+    for s in starts:
+        f = finishes[s["id"]]
+        assert s["pid"] != f["pid"]      # send row -> recv row
+        assert {s["pid"], f["pid"]} == {10000, 10001}
+    json.loads(json.dumps(merged))
+
+
+def test_merge_trace_pp_mixed_with_ps_chains(tmp_path):
+    """PP rows and the PS bucket chains coexist in one merged view."""
+    td = str(tmp_path)
+    _write_rank_trace(td, 0)
+    # append PP spans to the same rank file
+    path = os.path.join(td, "0", "comm.json")
+    data = json.load(open(path))
+    _write_pp_rank_trace(td, rank=0)
+    pp = json.load(open(path))["traceEvents"]
+    json.dump({"traceEvents": data["traceEvents"] + pp,
+               "displayTimeUnit": "ms"}, open(path, "w"))
+    merged = merge_traces(td)
+    spans = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    assert {e["pid"] for e in spans} == {0, 10000, 10001}
+    assert any(e["name"] == "PS_PUSH" and e["pid"] == 0 for e in spans)
+
+
+# ------------------------------------------- StepStats dynamic counters
+
+def test_stepstats_folds_dynamic_layer_byte_counters(tmp_path):
+    """ISSUE-12 satellite: per-layer counters registered AFTER the
+    emitter exists (exchange plan time) join the per-step delta pass
+    and show up in the BPS_STATS_FILE dump."""
+    reg = obs_metrics.get_registry()
+    path = tmp_path / "stats.json"
+    em = StepStatsEmitter(stats_file=str(path), every=1)
+    # dynamic registrations land between steps, exactly like _plan does
+    reg.counter("ps/pull_bytes/grads0.0").inc(1024)
+    reg.counter("ps/d2h_bytes/grads0.0").inc(256)
+    reg.counter("ps/push_bytes/grads0.0").inc(64)
+    reg.counter("ps/pull_bytes").inc(9999)   # the GLOBAL counter stays
+    #                                          out of the per-layer set
+    st = em.on_step(1, 0.01)
+    assert st.layer_bytes == {"ps/pull_bytes/grads0.0": 1024,
+                              "ps/d2h_bytes/grads0.0": 256,
+                              "ps/push_bytes/grads0.0": 64}
+    reg.counter("ps/pull_bytes/grads0.0").inc(10)
+    st2 = em.on_step(2, 0.01)
+    assert st2.layer_bytes == {"ps/pull_bytes/grads0.0": 10}  # delta
+    # a quiet step reports none at all
+    st3 = em.on_step(3, 0.01)
+    assert st3.layer_bytes is None
+    dump = json.loads(path.read_text())
+    assert dump["steps"][0]["layer_bytes"][
+        "ps/pull_bytes/grads0.0"] == 1024
+    assert "layer_bytes" not in dump["steps"][2]
+
+
 # ------------------------------------------------- timeline satellites
 
 def _mk_timeline(tmp_path, start=0, end=10**9):
