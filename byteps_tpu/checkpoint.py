@@ -6,13 +6,21 @@ guarantees consistent init + stable name→key across elastic resume
 Here checkpointing is first-class via orbax: save/restore the full train
 state (params + optimizer state + step + declared-tensor registry) so
 elastic resume restores byte-identical state on a new mesh size.
+
+Sharded variant (docs/elasticity.md): under ``BPS_SHARDED_UPDATE=1``
+each replica owns 1/dp of the optimizer state —
+``save_sharded_checkpoint`` persists exactly the owned slices (per-step
+directories, meta as the commit marker) and
+``DistributedTrainer.restore_sharded`` re-installs them into the
+sharded tail, so restore composes with the sharded update instead of
+falling back to the full-tree apply.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import numpy as np
@@ -22,6 +30,31 @@ try:
     _HAS_ORBAX = True
 except Exception:  # pragma: no cover - orbax is in the image, but be safe
     _HAS_ORBAX = False
+
+
+def _save_state(path: str, state: Any) -> None:
+    """THE state serialization (orbax, npz fallback) — one copy shared
+    by the full-tree and sharded savers so the two formats cannot
+    drift."""
+    if _HAS_ORBAX:
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.join(path, "state"), state, force=True)
+        ckptr.wait_until_finished()
+    else:
+        flat, _ = jax.tree_util.tree_flatten(state)
+        np.savez(os.path.join(path, "state.npz"),
+                 **{str(i): np.asarray(l) for i, l in enumerate(flat)})
+
+
+def _restore_state(path: str, template: Any) -> Any:
+    """Dual of ``_save_state`` — shared by both restore paths."""
+    if _HAS_ORBAX:
+        ckptr = ocp.StandardCheckpointer()
+        return ckptr.restore(os.path.join(path, "state"), template)
+    data = np.load(os.path.join(path, "state.npz"))
+    flat, treedef = jax.tree_util.tree_flatten(template)
+    return jax.tree_util.tree_unflatten(
+        treedef, [data[str(i)] for i in range(len(flat))])
 
 
 def save_checkpoint(path: str, params: Any, opt_state: Any = None,
@@ -46,14 +79,102 @@ def save_checkpoint(path: str, params: Any, opt_state: Any = None,
     state = {"params": params}
     if opt_state is not None:
         state["opt_state"] = opt_state
-    if _HAS_ORBAX:
-        ckptr = ocp.StandardCheckpointer()
-        ckptr.save(os.path.join(path, "state"), state, force=True)
-        ckptr.wait_until_finished()
-    else:
-        flat, _ = jax.tree_util.tree_flatten(state)
-        np.savez(os.path.join(path, "state.npz"),
-                 **{str(i): np.asarray(l) for i, l in enumerate(flat)})
+    _save_state(path, state)
+
+
+def save_sharded_checkpoint(path: str, trainer, step: Optional[int] = None
+                            ) -> None:
+    """Durable SHARDED state (``BPS_SHARDED_UPDATE=1``,
+    docs/elasticity.md): full params (replicated — every rank holds
+    them) plus THIS replica's owned 1/dp optimizer-state slices, one
+    frame per owned group in the same ``pack_opt_state`` format the
+    membership handoff ships through the param mailbox. Every replica
+    calls this against the same path at the same step boundary: slice
+    files are disjoint by ownership, and rank 0 also writes the params
+    + membership meta (identical on every rank by the plan determinism
+    contract). Restore composes with the sharded tail through
+    ``DistributedTrainer.restore_sharded`` — the per-group slices
+    install into the chunked states, so the full-tree-opt_state
+    fallback never fires.
+
+    Crash consistency: slices land in a PER-STEP directory
+    (``opt_shard/s<step>/``) and ``bps_meta.json`` is renamed into
+    place LAST — the meta is the checkpoint's commit marker, and it
+    names the slice directory it pairs with, so an interrupted re-save
+    to the same path can never mix one save's slices with another's
+    params or meta."""
+    st = getattr(trainer, "_sharded", None)
+    chunked = getattr(trainer, "_chunked", None)
+    if st is None or chunked is None or not chunked.decomposable:
+        raise RuntimeError(
+            "save_sharded_checkpoint needs an engaged sharded update "
+            "(BPS_SHARDED_UPDATE=1, dp>1, at least one step run) — use "
+            "save_checkpoint for the full-tree state")
+    params = trainer.params          # sync point: drains in-flight tails
+    step_val = int(trainer.step_count if step is None else step)
+    path = os.path.abspath(path)
+    shard_dir = os.path.join(path, "opt_shard", f"s{step_val}")
+    os.makedirs(shard_dir, exist_ok=True)
+    from .sharded_update import pack_opt_state
+    plan = st.plan
+    for gi in plan.owned:
+        blob = pack_opt_state(chunked.states[gi])
+        tmp = os.path.join(shard_dir, f".g{gi}.bin.tmp.{os.getpid()}")
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, os.path.join(shard_dir, f"g{gi}.bin"))
+    if plan.rank != 0:
+        return
+    # params next, the meta rename LAST (commit marker — see docstring)
+    _save_state(path, {"params": params})
+    meta = {
+        "step": step_val,
+        "sharded": {
+            "member_epoch": st.member_epoch,
+            "world": plan.world,
+            "live": sorted(plan.live),
+            "owner": list(plan.owner),
+            "name": st.name,
+            "groups": [list(g) for g in plan.groups],
+        },
+    }
+    tmp = os.path.join(path, f".bps_meta.json.tmp.{os.getpid()}")
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, os.path.join(path, "bps_meta.json"))
+
+
+def restore_sharded_checkpoint(path: str, params_like: Any):
+    """Read a sharded checkpoint: (params, {group: opt-state blob},
+    step, meta). Blobs are raw ``pack_opt_state`` bytes — the caller
+    (``DistributedTrainer.restore_sharded``) unpacks each against a
+    fresh per-group ``inner.init`` template once the chunked tail
+    builds, so structure mismatches refuse loudly there. ALL group
+    slices of the COMMITTED step (the meta names its slice directory)
+    are returned regardless of the saved owner map — any rank can
+    adopt any group (the kill-and-replace path). Stale slices from an
+    interrupted or superseded save live in other per-step directories
+    and are never read."""
+    path = os.path.abspath(path)
+    with open(os.path.join(path, "bps_meta.json")) as f:
+        meta = json.load(f)
+    if "sharded" not in meta:
+        raise ValueError(
+            f"{path} is not a sharded checkpoint (no membership meta) "
+            f"— restore_checkpoint handles full-tree saves")
+    state = _restore_state(path, {"params": params_like})
+    shard_dir = os.path.join(path, "opt_shard", f"s{meta.get('step', 0)}")
+    n_groups = len(meta["sharded"].get("groups") or []) or None
+    blobs = {}
+    if os.path.isdir(shard_dir):
+        for fn in sorted(os.listdir(shard_dir)):
+            if fn.startswith("g") and fn.endswith(".bin"):
+                gi = int(fn[1:-4])
+                if n_groups is not None and gi >= n_groups:
+                    continue
+                with open(os.path.join(shard_dir, fn), "rb") as f:
+                    blobs[gi] = f.read()
+    return state["params"], blobs, meta.get("step", 0), meta
 
 
 def restore_checkpoint(path: str, params_like: Any, opt_state_like: Any = None):
@@ -65,13 +186,6 @@ def restore_checkpoint(path: str, params_like: Any, opt_state_like: Any = None):
     template = {"params": params_like}
     if opt_state_like is not None:
         template["opt_state"] = opt_state_like
-    if _HAS_ORBAX:
-        ckptr = ocp.StandardCheckpointer()
-        state = ckptr.restore(os.path.join(path, "state"), template)
-    else:
-        data = np.load(os.path.join(path, "state.npz"))
-        flat, treedef = jax.tree_util.tree_flatten(template)
-        state = jax.tree_util.tree_unflatten(
-            treedef, [data[str(i)] for i in range(len(flat))])
+    state = _restore_state(path, template)
     return (state["params"], state.get("opt_state"), meta.get("step", 0),
             meta.get("declared", []))

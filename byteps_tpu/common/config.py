@@ -107,6 +107,14 @@ class Config:
     plane_vnodes: int = 0                # BPS_PLANE_VNODES: virtual nodes
                                          # per shard on the hash ring
                                          # (0 = default 64)
+    plane_liveness: bool = True          # BPS_PLANE_LIVENESS: act on the
+                                         # fleet scraper's staleness
+                                         # verdicts — a black-holed shard
+                                         # (scrape age past 3 cadences)
+                                         # is failed over server-side,
+                                         # not just observed; needs the
+                                         # scraper (BPS_FLEET_SCRAPE_SEC)
+                                         # and plane_replicas>0 to act
 
     # --- pipeline parallelism (ours: byteps_tpu/pipeline,
     # docs/pipeline-parallelism.md) ---
@@ -230,6 +238,7 @@ class Config:
             plane_rebalance_sec=float(
                 _env("BPS_PLANE_REBALANCE_SEC", None, "0") or 0),
             plane_vnodes=int(_env("BPS_PLANE_VNODES", None, "0") or 0),
+            plane_liveness=_env_bool("BPS_PLANE_LIVENESS", None, True),
             pp_stages=_env_int("BPS_PP_STAGES", None, 1),
             pp_rank=_env_int("BPS_PP_RANK", None, 0),
             pp_microbatch=_env_int("BPS_PP_MICROBATCH", None, 1),

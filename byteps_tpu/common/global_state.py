@@ -135,8 +135,13 @@ class GlobalState:
                     # logged to its backup shard (failover = reroute +
                     # replay, docs/server-plane.md)
                     from ..server.plane import PlanePSBackend, Rebalancer
+                    # lazy_dial: an elastic replacement must be able to
+                    # join a fleet that already lost a shard — the
+                    # plane's failover, not a constructor crash, owns
+                    # dead-addr handling (docs/elasticity.md)
                     shards = [RemotePSBackend(
-                        [a], async_mode=config.enable_async, nic=nic)
+                        [a], async_mode=config.enable_async, nic=nic,
+                        lazy_dial=True)
                         for a in addrs]
                     self.ps_backend = PlanePSBackend(
                         shards, num_workers=config.num_worker,
@@ -214,7 +219,14 @@ class GlobalState:
                 and hasattr(self.ps_backend, "stats")):
             from ..obs.fleet import FleetScraper, set_current
             self.fleet = FleetScraper(
-                self.ps_backend, interval_sec=config.fleet_scrape_sec)
+                self.ps_backend, interval_sec=config.fleet_scrape_sec,
+                # liveness acted-on (BPS_PLANE_LIVENESS, default on): a
+                # plane shard whose scrape goes stale is declared dead
+                # server-side and failed over — note_stale itself
+                # refuses (observed-only) when there is no replica log
+                failover_backend=(
+                    self.ps_backend if config.plane_liveness
+                    and hasattr(self.ps_backend, "note_stale") else None))
             set_current(self.fleet)
             self.fleet.start()
         # metrics HTTP endpoint (obs/export.py): Prometheus text +

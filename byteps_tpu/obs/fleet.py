@@ -126,12 +126,22 @@ class FleetScraper:
     def __init__(self, backend, interval_sec: Optional[float] = None,
                  stale_after: Optional[float] = None,
                  registry: Optional[MetricsRegistry] = None,
-                 timeout_ms: int = 5000) -> None:
+                 timeout_ms: int = 5000,
+                 failover_backend=None) -> None:
         if not hasattr(backend, "stats"):
             raise ValueError(
                 f"{type(backend).__name__} has no stats() surface — the "
                 f"fleet scraper needs a Host/Remote/Plane PS backend")
         self.backend = backend
+        # liveness ACTED ON (docs/elasticity.md): when a plane backend
+        # (anything with ``note_stale``) is installed here, a shard
+        # whose scrape goes stale — BLACK-HOLED, answering nothing, not
+        # just refusing connections — is declared dead server-side and
+        # failed over within one scrape of crossing the staleness line
+        # (~3 cadences). The verdict path never raises into the scrape
+        # loop, and note_stale itself is idempotent + refuses when
+        # there is no replica log to fail onto.
+        self.failover_backend = failover_backend
         self.interval_sec = (_interval_from_env()
                              if interval_sec is None
                              else float(interval_sec))
@@ -185,7 +195,36 @@ class FleetScraper:
             views = list(self._shards.values())
         for sv in views:
             self._publish(sv, now)
+        self._act_on_staleness(views, now)
         return self.view()
+
+    def _act_on_staleness(self, views, now: float) -> None:
+        """Promote staleness from observed to ACTED-ON: hand every
+        stale ``sN`` shard to the failover backend's ``note_stale``.
+        One bad verdict must never kill the scrape loop — this is the
+        control path, errors are logged and swallowed."""
+        be = self.failover_backend
+        if be is None or not hasattr(be, "note_stale"):
+            return
+        for sv in views:
+            age = (now - sv.last_ok) if sv.last_ok is not None \
+                else (now - self._t0)
+            if age <= self.stale_after:
+                continue
+            label = sv.label
+            if not (label.startswith("s") and label[1:].isdigit()):
+                continue
+            try:
+                if be.note_stale(int(label[1:]), age_s=round(age, 3),
+                                 source="fleet-scrape"):
+                    self._log.warning(
+                        "fleet: shard %s failed over on staleness "
+                        "(scrape age %.1fs > %.1fs)", label, age,
+                        self.stale_after)
+            except Exception as e:   # noqa: BLE001 — see docstring
+                self._log.warning(
+                    "fleet: staleness failover of shard %s failed: %s",
+                    label, e)
 
     def _absorb_ok(self, sv: _ShardView, payload: dict,
                    now: float) -> None:

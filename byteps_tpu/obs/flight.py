@@ -7,7 +7,11 @@ sequence (the pushes that landed, the admission grants that ordered
 them, the codec the controller picked two rounds ago, the param frame
 an owner never published). This module records that sequence: every
 push, pull, admission grant, codec decision, activation hop, and param
-publish appends one small event to a per-process ring
+publish appends one small event to a per-process ring — and MEMBERSHIP
+events ride it first-class (``failover`` / ``member_join`` /
+``member_leave`` / ``reshard`` / ``state_put``, recorded KEY-LESS so
+every postmortem names the epoch transition whatever keys it filters
+on; docs/elasticity.md)
 (``BPS_FLIGHT_RECORDER``, default on; ``BPS_FLIGHT_RECORDER_SIZE``
 events, default 1024), and the failure paths — the watchdog's stall
 dump, ``PeerDead``, ``CodecError``, a tail pull failure — dump the

@@ -255,6 +255,37 @@ class ChunkedApply:
         self._apply = jax.jit(
             _apply, donate_argnums=(0, 1) if donate else ())
 
+    def init_group(self, gi: int, params_list):
+        """A fresh ``inner.init`` state for group ``gi``'s current
+        leaves — the unpack template for a membership handoff frame or
+        a sharded-checkpoint slice, and the crashed-leave fallback."""
+        return self.inner.init(list(params_list))
+
+    def adopt_group(self, gi: int, state) -> None:
+        """Install optimizer state for a group this replica is taking
+        OWNERSHIP of (membership reshard handoff / sharded-checkpoint
+        restore). Leaves are placed on device so the donating jitted
+        apply never consumes host buffers."""
+        import jax
+        import jax.numpy as jnp
+        if not self.decomposable:
+            raise RuntimeError(
+                "adopt_group on a non-decomposable tail — sharded "
+                "ownership never engages there")
+        self.states[gi] = jax.tree_util.tree_map(jnp.asarray, state)
+
+    def release_group(self, gi: int) -> None:
+        """Drop a group's optimizer state after handing ownership away
+        (the ~1/dp memory contract holds through membership changes)."""
+        if self.states is not None:
+            self.states[gi] = None
+
+    def set_owned(self, owned) -> None:
+        """Flip the owned-group set at a membership epoch boundary —
+        the callers (ShardedUpdateState.reshard) adopt gained groups'
+        state BEFORE flipping and release lost groups' after."""
+        self.owned = None if owned is None else frozenset(owned)
+
     def apply_group(self, gi: int, params_list, grads_list):
         """Update group ``gi``'s leaves; returns the new leaf list.
         ``params_list``/``grads_list`` follow ``self.groups[gi]`` order.

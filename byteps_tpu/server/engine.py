@@ -760,6 +760,11 @@ class HostPSBackend:
         """Blocking non-destructive fetch of a (key, seq) param frame."""
         return self.param_store().get(key, seq, timeout_ms=timeout_ms)
 
+    def param_latest(self, key: int) -> int:
+        """Newest retained param seq for ``key`` (0 = empty) — the
+        elastic-rejoin seq seed (sharded_update)."""
+        return self.param_store().latest(key)
+
     def pull_bytes(self, key: int, round: int = 0,
                    timeout_ms: int = 30000) -> bytes:
         """Compressed pull: merged dense round recompressed once, served

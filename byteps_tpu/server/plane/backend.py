@@ -7,10 +7,13 @@ push / pull / round / push_bytes / pull_bytes), so
   1. routed through the ``PlacementService`` (byte-weighted ring
      assignment, versioned epochs — an op tagged with a stale epoch is
      refused with ``WrongEpoch`` before it can tear a round),
-  2. replicated (``replicas=1``): the merged bytes of every completed
-     round are forward-logged to the key's backup shard the moment this
-     worker's pull lands, and the one round the admission gate allows
-     in flight is retained worker-side for replay,
+  2. replicated (``replicas=R``): the merged bytes of every completed
+     round are forward-logged to the key's replication CHAIN — its
+     first R live ring successors — the moment this worker's pull
+     lands, and the one round the admission gate allows in flight is
+     retained worker-side for replay. R=1 is classic primary-backup;
+     R>1 tolerates R successive shard deaths on one key's chain
+     (docs/elasticity.md),
   3. failed over: a shard-unreachable error triggers reroute — the dead
      shard's keys move to their ring successors (where their replica
      logs already live), inits are replayed from the plane's meta, round
@@ -156,6 +159,7 @@ class PlanePSBackend:
         self._lag_argmax: Optional[int] = None
         self._t0_mono = time.monotonic()   # stats() heartbeat base for
         #                                    in-process shards
+        self._liveness_warned: set = set()   # note_stale replicas=0 warn
 
     # ------------------------------------------------------------ admin
 
@@ -274,38 +278,118 @@ class PlanePSBackend:
                 "plane: shard %d unreachable (%s) — failing over %d "
                 "key(s), placement epoch now %d", shard, cause,
                 len(moved), self.placement.epoch)
+            # membership events are FIRST-CLASS flight events, recorded
+            # key-less so every postmortem (any key filter) carries the
+            # epoch transition — a post-failover wedge diagnosis names
+            # the membership change, not just the stuck keys
+            from ...obs import flight
+            flight.record(
+                "failover", outcome="failover",
+                detail=f"shard {shard} dead ({type(cause).__name__}) -> "
+                       f"placement epoch {self.placement.epoch}; "
+                       f"{len(moved)} key(s) moved")
+            # per-key replay errors (the DESTINATION shard dying too —
+            # a double death) must not abort the loop: fail_shard is
+            # idempotent-by-_dead, so keys left unprocessed here would
+            # stay moved-but-never-rebased FOREVER (sheared numbering,
+            # silently wrong pulls). Process every key, then re-raise
+            # the first transport error — the caller's retry hits the
+            # dead destination and fails IT over, which re-bases any
+            # key this pass could not (its own fail_shard recomputes
+            # from the logs and the new store).
+            dst_err: Optional[BaseException] = None
             for key, dst in moved.items():
-                meta = self._meta.get(key)
-                if meta is not None:
-                    nbytes, dtype, init, compression = meta
-                    self._init_on(dst, key, nbytes, dtype, init,
-                                  compression)
-                # the new primary WAS the key's backup (ring successor),
-                # so the forward log is already local to it; its store
-                # counts rounds from 0 → re-base onto the logged round
-                base = self._repl_base_any(key, prefer=dst)
-                self._round_base[key] = base
-                inf = self._inflight.get(key)
-                if inf is not None and inf[0] > base and inf[1] is not None:
-                    # the admission-gate round in flight at death: only
-                    # this worker can replace its own contribution. Mark
-                    # the round replayed so a push retry racing this
-                    # failover (the push that DETECTED the death) does
-                    # not apply it a second time. A fused-plane copy is
-                    # re-pushed as its PAYLOAD — the new shard decodes
-                    # it exactly like the dead one did (deterministic
-                    # codecs), so the replayed sum stays bit-identical.
-                    if (isinstance(inf[1], tuple)
-                            and inf[1][0] == "fused"):
-                        self._shards[dst].push_fused(key, inf[1][1])
-                    else:
-                        self._shards[dst].push(key, inf[1])
-                    self._replayed[key] = inf[0]
+                try:
+                    meta = self._meta.get(key)
+                    if meta is not None:
+                        nbytes, dtype, init, compression = meta
+                        self._init_on(dst, key, nbytes, dtype, init,
+                                      compression)
+                    # the new primary WAS the key's backup (ring
+                    # successor), so the forward log is already local to
+                    # it; its store counts rounds from 0 → re-base onto
+                    # the logged round MINUS the rounds the promoted
+                    # store itself already completed: a LATE failover
+                    # (an elastic replacement joining after the fleet
+                    # promoted, or a worker whose detection staggers a
+                    # round behind its peers') sees a log head that
+                    # includes rounds the new primary served —
+                    # translating by the raw head would shear this
+                    # worker's round numbering off the store's. round()
+                    # answers 0 for a key the store never saw (the
+                    # engine contract — no raise), so there is no silent
+                    # fallback here: a transport failure takes the
+                    # double-death path below.
+                    base = self._repl_base_any(key, prefer=dst)
+                    local = int(self._shards[dst].round(key))
+                    base = max(0, base - local)
+                    self._round_base[key] = base
+                    inf = self._inflight.get(key)
+                    if (inf is not None and inf[0] > base
+                            and inf[1] is not None):
+                        # the admission-gate round in flight at death:
+                        # only this worker can replace its own
+                        # contribution. Mark the round replayed so a
+                        # push retry racing this failover (the push that
+                        # DETECTED the death) does not apply it a second
+                        # time. A fused-plane copy is re-pushed as its
+                        # PAYLOAD — the new shard decodes it exactly
+                        # like the dead one did (deterministic codecs),
+                        # so the replayed sum stays bit-identical.
+                        if (isinstance(inf[1], tuple)
+                                and inf[1][0] == "fused"):
+                            self._shards[dst].push_fused(key, inf[1][1])
+                        else:
+                            self._shards[dst].push(key, inf[1])
+                        self._replayed[key] = inf[0]
+                except (ConnectionError, OSError, ServerClosed) as e:
+                    if isinstance(e, TimeoutError):
+                        raise       # application answer, never a death
+                    if dst_err is None:
+                        dst_err = e
             try:
                 self._shards[shard].close()
             except Exception:   # noqa: BLE001 — it is already dead
                 pass
+            if dst_err is not None:
+                raise dst_err
         return moved
+
+    def note_stale(self, shard: int, age_s: Optional[float] = None,
+                   source: str = "fleet") -> bool:
+        """Server-side liveness, ACTED ON: the fleet scraper's
+        staleness verdict (scrape age past 3 cadences — a BLACK-HOLED
+        shard, not just a refused connection) declares the shard dead
+        and triggers the same reroute + replay a worker-observed socket
+        error would. Returns True when a failover was triggered; False
+        when the shard is already dead, out of range, or the plane
+        cannot fail over (replicas=0 — observed-only, with one warning
+        per shard). Idempotent per shard, like ``fail_shard``."""
+        if not 0 <= int(shard) < len(self._shards):
+            return False
+        shard = int(shard)
+        with self._lock:
+            if shard in self._dead:
+                return False
+        if self.replicas <= 0:
+            if shard not in self._liveness_warned:
+                self._liveness_warned.add(shard)
+                get_logger().warning(
+                    "plane: shard %d stale per %s (scrape age %.1fs) but "
+                    "BPS_PLANE_REPLICAS=0 — liveness verdict stays "
+                    "observed-only (no replica log to fail over onto)",
+                    shard, source, age_s if age_s is not None else -1.0)
+            return False
+        from ...obs import flight
+        flight.record(
+            "member_leave",
+            detail=f"shard {shard} declared dead by {source} "
+                   f"(scrape age {age_s if age_s is not None else '?'}s)")
+        self.fail_shard(shard, cause=TimeoutError(
+            f"{source}: scrape age "
+            f"{age_s if age_s is not None else '?'}s past the staleness "
+            f"line — black-holed shard declared dead server-side"))
+        return True
 
     def _init_on(self, shard: int, key: int, nbytes: int, dtype: str,
                  init, compression) -> None:
@@ -392,24 +476,39 @@ class PlanePSBackend:
         return key % self.num_workers == self.worker_id % self.num_workers
 
     def _log_round_bytes(self, key: int, round: int, payload) -> None:
-        """Forward-log a completed round to the key's backup. The
-        backup dying is a shard death like any other: fail it over
-        (idempotent) and log to the NEW backup — the pull that carried
-        this merge was healthy and must not error. The log stores the
-        exact BYTES the pull returned (dense for plain rounds, the
-        encoded payload for fused ones), so a replayed pull of the
-        round decodes bit-identically to the original."""
-        for attempt in (0, 1):
-            b = self.placement.backup_of(key)
+        """Forward-log a completed round to the key's replication
+        CHAIN — the first ``replicas`` live ring successors
+        (``PlacementService.backups_of``), so ``BPS_PLANE_REPLICAS=R``
+        keeps every logged round reachable through R successive shard
+        deaths, not just one. A chain member dying is a shard death
+        like any other: fail it over (idempotent), recompute the chain,
+        and keep logging — the pull that carried this merge was healthy
+        and must not error. The log stores the exact BYTES the pull
+        returned (dense for plain rounds, the encoded payload for fused
+        ones), so a replayed pull of the round decodes bit-identically
+        to the original."""
+        logged: set = set()
+        fails = 0
+        # ONE chain computation per round on the healthy path (this is
+        # the per-pull hot path); recomputed only after a chain
+        # member's death actually changed membership
+        chain = [b for b in self.placement.backups_of(key, self.replicas)
+                 if b not in logged]
+        while chain:
+            b = chain[0]
             try:
                 self._repl[b].repl_put(key, round, payload)
-                break
+                logged.add(b)
+                chain = chain[1:]
             except TimeoutError:
                 raise   # repl ops never block server-side: surface it
             except (ConnectionError, OSError, ServerClosed) as e:
-                if attempt:
+                fails += 1
+                if fails > len(self._shards):
                     raise
                 self.fail_shard(b, cause=e)
+                chain = [c for c in self.placement.backups_of(
+                    key, self.replicas) if c not in logged]
         with self._lock:
             self._logged[key] = max(self._logged.get(key, 0), round)
             self._update_lag_locked(key)
@@ -606,6 +705,23 @@ class PlanePSBackend:
                 return c.get(key, seq, timeout_ms=timeout_ms)
             except TimeoutError:
                 raise          # application answer: owner never put
+            except (ConnectionError, OSError, ServerClosed) as e:
+                if attempt:
+                    raise
+                self.fail_shard(s, cause=e)   # idempotent per shard
+
+    def param_latest(self, key: int) -> int:
+        """Newest retained seq in ``key``'s param mailbox (0 = empty) —
+        the elastic-rejoin seed: a rejoining owner resumes its
+        param-frame sequence from the server's retained frames instead
+        of re-publishing from seq 0 (which would strand every non-owner
+        blocked on the real next seq)."""
+        for attempt in (0, 1):
+            c, s = self._param_client(key)
+            try:
+                if hasattr(c, "param_latest"):
+                    return int(c.param_latest(key))
+                return int(c.latest(key))
             except (ConnectionError, OSError, ServerClosed) as e:
                 if attempt:
                     raise

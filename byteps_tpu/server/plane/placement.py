@@ -312,19 +312,36 @@ class PlacementService:
         return moved
 
     def backup_of(self, key: int) -> int:
-        """The key's replication target: its first live ring successor
-        AFTER the primary — which is exactly the shard ``fail_shard``
-        walks to first, so after a failover the new primary already
-        holds the key's replica log locally."""
+        """The key's FIRST replication target: its first live ring
+        successor AFTER the primary — which is exactly the shard
+        ``fail_shard`` walks to first, so after a failover the new
+        primary already holds the key's replica log locally."""
+        chain = self.backups_of(key, 1)
+        return chain[0] if chain else 0
+
+    def backups_of(self, key: int, n: int) -> List[int]:
+        """The key's replication CHAIN: its first ``n`` live ring
+        successors after the primary, in walk order. ``fail_shard``
+        promotes exactly ``chain[0]``, and after that promotion the old
+        ``chain[1:]`` become the new primary's successors — so a chain
+        of length ``n`` keeps every logged round reachable through
+        ``n`` successive shard deaths (the BPS_PLANE_REPLICAS>1
+        contract). Degenerate plane (one live shard): that shard, like
+        ``backup_of`` always did."""
+        if n <= 0:
+            return []
         with self._lock:
             s = self._assign.get(key)
             order = self.ring.successors(key, self.num_shards,
                                          skip=self._dead)
-        if len(order) < 2:
-            return order[0] if order else 0
+        if not order:
+            return []
         if s in order:
-            return order[(order.index(s) + 1) % len(order)]
-        return order[0]
+            i = order.index(s)
+            rest = order[i + 1:] + order[:i]
+        else:
+            rest = order
+        return rest[:n] if rest else [order[0]]
 
     # ------------------------------------------------------------- views
 

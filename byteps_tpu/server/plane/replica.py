@@ -1,13 +1,17 @@
-"""Primary-backup replication: the forward-log of summed rounds.
+"""Chain replication: the forward-log of summed rounds.
 
 A ``ReplicaStore`` lives NEXT TO a shard (attached to the in-process
 ``PSServer`` by the plane backend, or hosted inside a
 ``PSTransportServer`` and reached over the OP_REPL_* wire ops): it
 holds, per key, the BYTES of the last few completed (merged) rounds.
-Workers forward-log each round the moment its pull lands — the merged
-bytes are identical on every worker by construction (the server
-publishes one merge per round), so concurrent logs of the same
-(key, round) are idempotent last-wins writes.
+Workers forward-log each round the moment its pull lands — to the
+key's whole replication CHAIN, its first ``BPS_PLANE_REPLICAS`` live
+ring successors (``PlacementService.backups_of``; 1 = classic
+primary-backup, R>1 tolerates R successive deaths on one key's chain,
+docs/elasticity.md). The merged bytes are identical on every worker
+by construction (the server publishes one merge per round), so
+concurrent logs of the same (key, round) are idempotent last-wins
+writes.
 
 After a primary dies, the key's ring successor — which is where the
 replica log already lives (``PlacementService.backup_of``) — is

@@ -160,6 +160,12 @@ OP_PARAM_PUT, OP_PARAM_GET = 21, 22
 # channel outside the data-plane pools: telemetry must flow when the
 # data plane is wedged (that is precisely when it is needed).
 OP_STATS = 23
+# Elastic rejoin (docs/elasticity.md): the newest retained seq in a
+# key's param mailbox, so a rejoining sharded-update owner resumes its
+# param-frame sequence from the server's retained frames instead of
+# re-publishing from seq 0 (which would strand every non-owner blocked
+# on the real next seq). Response payload = u64 seq (0 = empty).
+OP_PARAM_SEQ = 24
 _PART = struct.Struct("!IIHHQ")  # offset, part_len, part_idx, nparts, nonce
 ST_OK, ST_ERR, ST_TIMEOUT, ST_GONE = 0, 1, 2, 3
 
@@ -804,6 +810,10 @@ class PSTransportServer:
                 conn.sendall(_RSP.pack(ST_OK, len(data)))
                 if data:
                     conn.sendall(data)
+            elif op == OP_PARAM_SEQ:
+                rv = struct.pack("!Q",
+                                 int(self.param_store().latest(key)))
+                conn.sendall(_RSP.pack(ST_OK, len(rv)) + rv)
             elif op == OP_ACT_PUSH:
                 self.act_store().put(key, int(rnd),
                                      bytes(payload or b""))
@@ -1164,7 +1174,7 @@ class RemotePSBackend:
                  async_mode: bool = False,
                  reconnect_secs: Optional[float] = None,
                  conns_per_shard: Optional[int] = None,
-                 nic=None):
+                 nic=None, lazy_dial: bool = False):
         import os as _os
         import queue as _queue
         self._addrs = [a.rsplit(":", 1) for a in addrs]
@@ -1242,7 +1252,16 @@ class RemotePSBackend:
         self._pools: List[_queue.Queue] = []
         for i in range(len(addrs)):
             pool = _queue.Queue()
-            pool.put(_Channel(self._dial(i)))   # eager: validate the addr
+            if lazy_dial:
+                # plane-managed shard clients (docs/elasticity.md): an
+                # elastic REPLACEMENT joins a fleet that may already
+                # have a dead shard — construction must succeed and the
+                # first op's connection error drive the plane's
+                # failover, not a constructor crash. Plain deployments
+                # keep the eager dial (a typo'd addr fails at startup).
+                pool.put(_Channel(None))
+            else:
+                pool.put(_Channel(self._dial(i)))  # eager: validate addr
             for _ in range(self._nconns - 1):
                 pool.put(_Channel(None))        # dialed on first use
             self._pools.append(pool)
@@ -2007,6 +2026,13 @@ class RemotePSBackend:
             lambda slice_ms: self._rpc(OP_PARAM_GET, key, int(seq), 0,
                                        slice_ms, "uint8", None),
             timeout_ms, f"param_get({key:#x}) seq={seq}")
+
+    def param_latest(self, key: int) -> int:
+        """Newest retained seq in the server's param mailbox for
+        ``key`` (0 = empty) — the elastic-rejoin seq seed
+        (OP_PARAM_SEQ; docs/elasticity.md)."""
+        data = self._rpc(OP_PARAM_SEQ, key, 0, 0, 0, "uint8", None)
+        return struct.unpack("!Q", data)[0]
 
     def push_rowsparse(self, key: int, idx, rows, dense_nbytes: int,
                       dtype=None) -> None:

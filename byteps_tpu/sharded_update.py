@@ -74,6 +74,13 @@ from .obs.metrics import get_registry, observe_stage
 #: >= 2^40 so the transport never re-stripes them).
 PARAM_KEY_BASE = 1 << 41
 
+#: membership-handoff key space (bit 42): a departing owner's packed
+#: optimizer-state slice for one group rides the SAME param mailbox,
+#: keyed ``1<<42 | decl<<16 | group`` with seq = the membership epoch
+#: that hands the group over — so handoff retention is independent of
+#: the per-step param frames (docs/elasticity.md).
+STATE_KEY_BASE = 1 << 42
+
 #: bounded mailbox retention (seqs per key): two rounds in flight
 #: (cross-step) + slack for a straggling fetcher's retry.
 PARAM_RETAIN = 4
@@ -121,6 +128,15 @@ class ParamStore:
                         f"after {timeout_ms}ms — owner never published")
                 self._cv.wait(min(left, 0.5))
 
+    def latest(self, key: int) -> int:
+        """Newest retained seq for ``key`` (0 = nothing stored) — the
+        elastic-rejoin seed served over OP_PARAM_SEQ: a rejoining owner
+        resumes publishing above the retained frames instead of from
+        seq 0."""
+        with self._cv:
+            d = self._data.get(int(key))
+            return max(d) if d else 0
+
     def pending(self) -> List[Tuple[int, int]]:
         """(key, newest stored seq) per channel — debug visibility."""
         with self._cv:
@@ -154,7 +170,8 @@ class ShardedUpdatePlan:
     """
 
     def __init__(self, keyed, groups, leaf_meta, rank: int, world: int,
-                 vnodes: int = 0) -> None:
+                 vnodes: int = 0, live=None, prev_owner=None,
+                 weights=None, owner_map=None) -> None:
         from .server.plane.placement import DEFAULT_VNODES, HashRing
         if world <= 1:
             raise ValueError("sharded update needs dp > 1")
@@ -162,6 +179,17 @@ class ShardedUpdatePlan:
             raise ValueError(f"shard rank {rank} outside [0, {world})")
         self.rank, self.world = int(rank), int(world)
         self.groups = [tuple(g) for g in groups]
+        # membership: the ranks eligible to OWN groups this epoch. A
+        # rank outside ``live`` stays in the job (pushes grads, fetches
+        # params) but owns nothing — the drained state a graceful LEAVE
+        # transitions through (docs/elasticity.md state machine).
+        self.live = (frozenset(range(world)) if live is None
+                     else frozenset(int(r) for r in live))
+        if not self.live:
+            raise ValueError("membership needs at least one live rank")
+        if not all(0 <= r < world for r in self.live):
+            raise ValueError(f"live ranks {sorted(self.live)} outside "
+                             f"[0, {world})")
         # leaf_meta: per flat leaf (shape, dtype, nbytes)
         self.leaf_meta = list(leaf_meta)
         leaf_group: Dict[int, int] = {}
@@ -179,22 +207,94 @@ class ShardedUpdatePlan:
         self.needed = [frozenset(n) for n in needed]
         self.group_bytes = [sum(self.leaf_meta[li][2] for li in g)
                             for g in self.groups]
+        # per-layer counter labels for the live-load weighting
+        # (ps/push_bytes/<decl>.<bucket> rides the bucket's index)
+        self.bucket_labels = [getattr(b, "index", bi)
+                              for bi, (_, b) in enumerate(keyed)]
         # defining bucket = the LAST bucket covering the group (the one
         # whose pull completes it); groups of only zero-size leaves
         # have no bucket and key off their index
         self.group_bucket = [max(n) if n else None for n in needed]
+        # assignment weight per group: live byte counters when the
+        # caller measured them, the static plan bytes otherwise —
+        # IDENTICAL on every replica or the plans diverge (callers
+        # guarantee it; live_group_weights documents when they can)
+        if weights is not None and len(weights) != len(self.groups):
+            raise ValueError(f"{len(weights)} weights for "
+                             f"{len(self.groups)} groups")
+        w = ([max(0, int(x)) for x in weights] if weights is not None
+             else list(self.group_bytes))
+        self.weights = w
+        dead = set(range(world)) - self.live
         ring = HashRing(world, vnodes=vnodes or DEFAULT_VNODES)
+        n = len(self.groups)
         load = [0] * world
-        owner: List[int] = []
-        for gi in range(len(self.groups)):
-            bi = self.group_bucket[gi]
-            ring_key = keyed[bi][0] if bi is not None else gi
-            cands = ring.successors(ring_key, world)
-            r = min(cands, key=lambda c: load[c])   # first-wins tie-break
-            owner.append(r)
-            load[r] += self.group_bytes[gi]
-        self.owner = owner
+        owner: List[Optional[int]] = [None] * n
+        if owner_map is not None:
+            # authoritative map (a sharded checkpoint's membership
+            # meta): install verbatim — the map IS the shared state
+            if len(owner_map) != n:
+                raise ValueError(
+                    f"owner map covers {len(owner_map)} groups, plan "
+                    f"has {n} — peers are running different bucket "
+                    f"plans")
+            for gi, o in enumerate(owner_map):
+                o = int(o)
+                if o not in self.live:
+                    raise ValueError(f"owner map assigns group {gi} to "
+                                     f"rank {o} outside the live set")
+                owner[gi] = o
+                load[o] += w[gi]
+        else:
+            if prev_owner is not None and len(prev_owner) != n:
+                raise ValueError(
+                    f"previous owner map covers {len(prev_owner)} "
+                    f"groups, plan has {n}")
+            if prev_owner is not None:
+                # MINIMAL MOVEMENT: a group whose owner is still live
+                # stays put — membership change moves only the delta
+                # (the departed rank's orphans, plus the leveling moves
+                # below), never a global re-deal
+                for gi, o in enumerate(prev_owner):
+                    if o in self.live:
+                        owner[gi] = int(o)
+                        load[o] += w[gi]
+            for gi in range(n):
+                if owner[gi] is not None:
+                    continue
+                bi = self.group_bucket[gi]
+                ring_key = keyed[bi][0] if bi is not None else gi
+                cands = ring.successors(ring_key, world, skip=dead)
+                r = min(cands, key=lambda c: load[c])   # first-wins ties
+                owner[gi] = r
+                load[r] += w[gi]
+            if prev_owner is not None:
+                # leveling after a JOIN: kept assignments leave the new
+                # member empty — move the largest strictly-improving
+                # group from the heaviest to the lightest owner until
+                # the spread is within one group (the same bound the
+                # fresh greedy guarantees). Deterministic: sorted live
+                # ranks, (weight desc, index) group order.
+                lv = sorted(self.live)
+                for _ in range(n):
+                    h = max(lv, key=lambda r: load[r])
+                    l = min(lv, key=lambda r: load[r])
+                    best = None
+                    for gi in sorted(range(n), key=lambda g: (-w[g], g)):
+                        if owner[gi] == h and 2 * w[gi] <= load[h] - load[l]:
+                            best = gi
+                            break
+                    if best is None:
+                        break
+                    owner[best] = l
+                    load[h] -= w[best]
+                    load[l] += w[best]
+        self.owner = [int(o) for o in owner]
         self.load = load
+        # reshard() rebuilds the plan from these (the bucket objects are
+        # shared refs, not copies)
+        self._keyed = list(keyed)
+        self._vnodes = int(vnodes)
         self.owned = tuple(gi for gi, o in enumerate(owner) if o == rank)
         self.owned_set = frozenset(self.owned)
         self.stream_leaves = frozenset(
@@ -225,9 +325,39 @@ class ShardedUpdatePlan:
             (gi for gi in range(len(self.groups)) if owner[gi] != rank),
             key=lambda gi: min(self.groups[gi], default=0)))
         decl_key = (keyed[0][0] >> 16) if keyed else 0
+        self.decl_key = decl_key
         self.param_keys = {
             gi: PARAM_KEY_BASE | (decl_key << 16) | gi
             for gi in range(len(self.groups))}
+        self.state_keys = {
+            gi: STATE_KEY_BASE | (decl_key << 16) | gi
+            for gi in range(len(self.groups))}
+
+    def reshard(self, live, weights=None) -> "ShardedUpdatePlan":
+        """The next membership epoch's plan: deterministic
+        minimal-movement re-shard of ownership over ``live`` — kept
+        owners stay put, a departed rank's orphans go to the lightest
+        live candidate on their ring walk, and a joiner is leveled up
+        by moving the largest strictly-improving groups only. Every
+        replica calling this with the same (current plan, live,
+        weights) computes the identical next plan — no coordination
+        round, the ZeRO plan determinism contract extended over
+        membership epochs."""
+        return ShardedUpdatePlan(self._keyed, self.groups,
+                                 self.leaf_meta, self.rank, self.world,
+                                 vnodes=self._vnodes, live=live,
+                                 prev_owner=self.owner, weights=weights)
+
+    def with_owner_map(self, owner_map, live=None) -> "ShardedUpdatePlan":
+        """A plan with ownership installed VERBATIM from an
+        authoritative map (a sharded checkpoint's membership meta) —
+        the rejoin path: the map, not a replayed epoch history, is the
+        shared state."""
+        return ShardedUpdatePlan(
+            self._keyed, self.groups, self.leaf_meta, self.rank,
+            self.world, vnodes=self._vnodes,
+            live=live if live is not None else sorted(set(owner_map)),
+            owner_map=owner_map)
 
     def round_view(self) -> _RoundView:
         return _RoundView(self.pull_buckets, self.stream_leaves,
@@ -284,6 +414,93 @@ class ShardedUpdatePlan:
             nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
             metas.append((shape, dtype, nbytes))
         return metas
+
+
+def pack_opt_state(state) -> bytes:
+    """Serialize one group's optimizer-state pytree (the membership
+    handoff frame AND the sharded checkpoint slice — one format for
+    both): flat leaves as an npz, structure implied by the shared
+    optimizer recipe, so ``unpack_opt_state`` rebuilds against a fresh
+    ``inner.init`` template and a mismatch refuses loudly instead of
+    reinterpreting bytes."""
+    import io
+
+    import jax
+    leaves = jax.tree_util.tree_leaves(state)
+    bio = io.BytesIO()
+    np.savez(bio, **{f"a{i}": np.asarray(l) for i, l in enumerate(leaves)})
+    return bio.getvalue()
+
+
+def unpack_opt_state(payload: bytes, template):
+    """Rebuild a group's optimizer state from ``pack_opt_state`` bytes
+    against ``template`` (a fresh ``inner.init`` on the group's current
+    leaves — same structure by the shared-recipe contract). Shape or
+    leaf-count mismatch = peers on different plans, refused loudly."""
+    import io
+
+    import jax
+    data = np.load(io.BytesIO(payload))
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    if len(data.files) != len(leaves):
+        raise ValueError(
+            f"opt-state frame has {len(data.files)} leaves, template "
+            f"expects {len(leaves)} — peers are running different "
+            f"optimizer recipes or bucket plans")
+    out = []
+    for i, t in enumerate(leaves):
+        a = data[f"a{i}"]
+        want = tuple(getattr(t, "shape", ()))
+        if tuple(a.shape) != want:
+            raise ValueError(
+                f"opt-state leaf {i} is {tuple(a.shape)}, template "
+                f"expects {want} — peers are running different plans")
+        out.append(a)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+#: live-weight quantization: weights are meaningful only as RATIOS for
+#: the balance greedy, and every replica must compute identical values
+#: — quantizing to 1/64ths of the max absorbs sub-percent cross-worker
+#: counter skew without flipping assignments on it.
+_WEIGHT_BUCKETS = 64
+
+
+def live_group_weights(plan: "ShardedUpdatePlan", name: str,
+                       registry=None) -> Optional[List[int]]:
+    """Per-group re-shard weights from the LIVE per-layer
+    ``ps/push_bytes/<decl>.<bucket>`` counters (registered at exchange
+    plan time), quantized to ``_WEIGHT_BUCKETS`` rungs of the max.
+    None when no counter has moved (cold start — callers fall back to
+    the static plan bytes).
+
+    PUSH counters only, deliberately: every replica pushes every
+    bucket every round, so in lockstep sync rounds with pinned codecs
+    the cumulative push counters are identical across replicas. The
+    pull counters are rank-ASYMMETRIC under the sharded update itself
+    (an owner pulls its buckets, non-owners fetch params instead) —
+    summing them would derive a different weight vector on every rank
+    and diverge the plans.
+
+    Determinism caveat (docs/elasticity.md): under ``BPS_COMPRESS=auto``
+    even the push traces diverge per worker — pass explicit weights
+    (or None for static bytes) there."""
+    reg = registry if registry is not None else get_registry()
+    names = reg.counters_with_prefix(("ps/push_bytes/",))
+    raw = []
+    for gi in range(len(plan.groups)):
+        b = 0
+        for bi in plan.needed[gi]:
+            label = f"{name}.{plan.bucket_labels[bi]}"
+            b += names.get(f"ps/push_bytes/{label}", 0)
+        raw.append(b)
+    top = max(raw, default=0)
+    if top <= 0:
+        return None
+    # quantized, floor 1 for any group that saw traffic at all — a
+    # zero-weight group would be free to stack anywhere
+    return [max(1, round(_WEIGHT_BUCKETS * b / top)) if b else 1
+            for b in raw]
 
 
 def _fallback(reason: str) -> None:
@@ -346,9 +563,53 @@ class ShardedUpdateState:
         self.plan = plan
         self.name = name
         self.timeline = timeline
-        self._seq = 0
+        self.member_epoch = 1
         self._seq_lock = threading.Lock()
         self.timeout_ms = param_timeout_ms()
+        # ELASTIC REJOIN seed: a rejoining owner must resume its
+        # param-frame sequence from the server's retained frames — a
+        # fresh state re-publishing from seq 0 would strand every
+        # non-owner blocked on the real next seq while stale frames
+        # overwrite nothing (the mailbox is last-wins per (key, seq)).
+        # Max over ALL param keys: surviving owners kept publishing
+        # while this worker was down, and the grad rounds reseed from
+        # the server the same way (OP_ROUND; tests/test_elastic.py).
+        self._seq = 0
+        be = exchange.backend
+        if hasattr(be, "param_latest"):
+            from .common.logging import get_logger
+            try:
+                self._seq = max((int(be.param_latest(k))
+                                 for k in plan.param_keys.values()),
+                                default=0)
+            except Exception as e:   # noqa: BLE001 — seed from zero,
+                self._seq = 0        # but LOUDLY: a transient scan
+                get_logger().warning(   # failure on a real rejoin would
+                    # otherwise reinstate the stranded-non-owner bug
+                    # this seed exists to fix, silently
+                    "sharded update: param-seq seed scan failed (%s: "
+                    "%s) — seq starts at 0; if this is an elastic "
+                    "REJOIN into a live job, peers will block on the "
+                    "real next seq until BPS_PARAM_TIMEOUT_MS",
+                    type(e).__name__, e)
+            if self._seq:
+                get_logger().info(
+                    "sharded update: elastic rejoin — param seq resumes "
+                    "at %d from the server's retained frames", self._seq)
+                get_logger().warning(
+                    "sharded update: rejoined a LIVE job (retained "
+                    "param frames found). This fresh plan is at member "
+                    "epoch 1 — if the fleet's membership epoch has "
+                    "moved, adopt the current owner map BEFORE any "
+                    "reshard (restore_sharded from the sharded "
+                    "checkpoint, or adopt_membership): a fresh plan "
+                    "cannot replay membership history and a reshard "
+                    "from it would diverge from the peers' "
+                    "(docs/elasticity.md failure matrix)")
+                from .obs import flight
+                flight.record("member_join",
+                              detail=f"rank {plan.rank} rejoined; param "
+                                     f"seq resumed at {self._seq}")
         reg = get_registry()
         self._m_put = reg.counter("ps/param_put_bytes")
         self._m_fetch = reg.counter("ps/param_fetch_bytes")
@@ -375,6 +636,122 @@ class ShardedUpdateState:
         with self._seq_lock:
             self._seq += 1
             return self._seq
+
+    # ------------------------------------------------------- membership
+
+    def reshard(self, chunked, params_flat, live, weights=None,
+                handoff_timeout_ms: Optional[int] = None) -> Dict:
+        """Membership epoch bump: re-shard ownership over ``live`` with
+        minimal movement and hand the moved groups' OPTIMIZER STATE to
+        their new owners through the param mailbox — no global drain,
+        no server re-init; the grad keys, placement, and param keys all
+        stay put, only group ownership moves.
+
+        Protocol (every participating rank runs this identically, at a
+        step boundary — the trainer's ``reshard`` drains first):
+          1. losing owners PUBLISH each lost group's packed opt_state
+             as a STATE frame (bit-42 key, seq = the new epoch);
+          2. gaining owners FETCH those frames and adopt them bitwise —
+             publish-before-fetch on every rank, so there is no
+             cross-rank wait cycle;
+          3. a frame that never arrives (the old owner CRASHED — a
+             LEAVE by death, nobody publishes) times out loudly and the
+             group's moments restart from ``inner.init`` on the current
+             params, with one WARNING naming the group and dead rank
+             (docs/elasticity.md failure matrix; a sharded checkpoint
+             restore is the lossless alternative).
+
+        Returns {"member_epoch", "gained", "lost", "live"}."""
+        import jax  # noqa: F401 — chunked.init_group jits lazily
+        from .common.logging import get_logger
+        from .obs import flight
+        plan = self.plan
+        live = frozenset(int(r) for r in live)
+        if live == plan.live:
+            return {"member_epoch": self.member_epoch, "gained": (),
+                    "lost": (), "live": sorted(live)}
+        if chunked is None or not getattr(chunked, "decomposable", False):
+            raise RuntimeError(
+                "reshard needs the engaged chunked sharded tail — run "
+                "at least one step first")
+        timeout = (self.timeout_ms if handoff_timeout_ms is None
+                   else int(handoff_timeout_ms))
+        new_plan = plan.reshard(live, weights=weights)
+        epoch = self.member_epoch + 1
+        before, after = plan.owned_set, new_plan.owned_set
+        lost = tuple(sorted(before - after))
+        gained = tuple(sorted(after - before))
+        be = self.exchange.backend
+        # 1. publish lost groups' state FIRST: with every rank
+        # publishing before fetching, no wait cycle can form
+        for gi in lost:
+            payload = pack_opt_state(chunked.states[gi])
+            be.param_put(plan.state_keys[gi], epoch, payload)
+            # key-LESS like every membership event: a wedge postmortem
+            # filtered to the implicated grad/param keys must still
+            # carry the handoff frames (the state key itself would be
+            # filtered out)
+            flight.record("state_put", round=epoch, nbytes=len(payload),
+                          detail=f"group {gi} opt-state handoff "
+                                 f"(key {plan.state_keys[gi]:#x})")
+        # 2. adopt gained groups from the losing owners' frames
+        for gi in gained:
+            group = new_plan.groups[gi]
+            template = chunked.init_group(
+                gi, [params_flat[li] for li in group])
+            try:
+                payload = be.param_get(plan.state_keys[gi], epoch,
+                                       timeout_ms=timeout)
+                state = unpack_opt_state(payload, template)
+            except TimeoutError:
+                get_logger().warning(
+                    "reshard (member epoch %d): group %d's previous "
+                    "owner (rank %s) never published its opt_state "
+                    "handoff frame — crashed leave: the group's "
+                    "optimizer moments restart from init (restore a "
+                    "sharded checkpoint for lossless takeover)",
+                    epoch, gi, plan.owner[gi])
+                state = template
+            chunked.adopt_group(gi, state)
+        # 3. flip ownership; release lost state only AFTER publishing
+        chunked.set_owned(after)
+        for gi in lost:
+            chunked.release_group(gi)
+        if plan.rank in plan.live and plan.rank not in live:
+            flight.record("member_leave",
+                          detail=f"rank {plan.rank} left the ownership "
+                                 f"plan at member epoch {epoch}")
+        elif plan.rank not in plan.live and plan.rank in live:
+            flight.record("member_join",
+                          detail=f"rank {plan.rank} joined the ownership "
+                                 f"plan at member epoch {epoch}")
+        flight.record(
+            "reshard",
+            detail=f"member epoch {self.member_epoch}->{epoch}: "
+                   f"live={sorted(live)} gained={list(gained)} "
+                   f"lost={list(lost)}")
+        get_logger().info(
+            "sharded update reshard: member epoch %d -> %d, live=%s, "
+            "rank %d gained %s lost %s", self.member_epoch, epoch,
+            sorted(live), plan.rank, list(gained), list(lost))
+        self.plan = new_plan
+        self.member_epoch = epoch
+        return {"member_epoch": epoch, "gained": gained, "lost": lost,
+                "live": sorted(live)}
+
+    def adopt_membership(self, owner_map, member_epoch: int,
+                         live=None) -> None:
+        """Install a membership view restored from a sharded
+        checkpoint's meta (no handoff — the opt_state slices come from
+        the checkpoint itself). Must run before the first step builds
+        the chunked tail, so ownership and state allocation agree."""
+        self.plan = self.plan.with_owner_map(owner_map, live=live)
+        self.member_epoch = int(member_epoch)
+        from .obs import flight
+        flight.record("member_join",
+                      detail=f"rank {self.plan.rank} adopted membership "
+                             f"epoch {member_epoch} from checkpoint "
+                             f"meta")
 
     def check_publisher(self) -> None:
         """Raise if the background publisher died — called at the

@@ -505,6 +505,15 @@ class DistributedTrainer:
                 self._ps_exchange, self.params, self.tx, self._name,
                 rank, world,
                 timeline=gs0.timeline if gs0 is not None else None)
+            mem = getattr(self, "_restored_membership", None)
+            if self._sharded is not None and mem:
+                # sharded checkpoint carried a membership view: the
+                # owner map is the authoritative shared state — install
+                # it verbatim (no handoff; the slices came from disk)
+                self._sharded.adopt_membership(
+                    mem["owner"], mem["member_epoch"],
+                    live=mem.get("live"))
+                self._restored_membership = None
         if (self._bwd_staged and self._apply_chunked
                 and self.backward_passes_per_step == 1):
             # the staged program is shape-specialized; each new batch
@@ -621,6 +630,9 @@ class DistributedTrainer:
             # source of truth the chunked applies update in place, and
             # what checkpoints of a chunked-mode trainer round-trip
             self.opt_state = self._chunked.states
+        # sharded checkpoint restore (restore_sharded): the per-group
+        # slices install over the fresh states now that they exist
+        self._install_restored_groups()
         # the restore-detection compare above is one-shot; keeping the
         # alias would pin a full optimizer-state tree (2× params for
         # adam) on device for the trainer's lifetime
@@ -737,6 +749,110 @@ class DistributedTrainer:
             # a dead publisher means frames this trainer OWED its peers
             # never shipped — surface it at the sync point, loudly
             st.check_publisher()
+
+    def reshard(self, live, weights=None,
+                handoff_timeout_ms: Optional[int] = None):
+        """Live membership change (JOIN/LEAVE) for the sharded update:
+        drain this trainer's in-flight tails to a step boundary, then
+        bump the membership epoch — ownership re-shards over ``live``
+        with minimal movement and moved groups' optimizer state hands
+        off through the param mailbox (docs/elasticity.md). EVERY
+        participating replica's trainer must make the same call at the
+        same step boundary; ``weights=None`` re-balances from the live
+        per-layer byte counters when they agree across replicas (falls
+        back to the static plan bytes on a cold registry)."""
+        st = getattr(self, "_sharded", None)
+        if st is None:
+            raise RuntimeError(
+                "reshard needs an engaged sharded update "
+                "(BPS_SHARDED_UPDATE=1, dp>1, at least one step run) — "
+                "see docs/elasticity.md")
+        self.drain()
+        if weights is None:
+            from .sharded_update import live_group_weights
+            gs = GlobalState._instance
+            compress = (gs.config.compress if gs is not None else "none")
+            if compress != "auto":
+                # pinned codecs (incl. none) push identical frame sizes
+                # on every replica, so the cumulative counters agree;
+                # "auto" traces diverge per worker — static bytes keep
+                # the plans deterministic (live_group_weights docs)
+                weights = live_group_weights(st.plan, self._name)
+        flat, treedef = jax.tree_util.tree_flatten(self._params)
+        out = st.reshard(self._chunked, flat, live, weights=weights,
+                         handoff_timeout_ms=handoff_timeout_ms)
+        return out
+
+    def restore_sharded(self, path: str) -> dict:
+        """Restore a SHARDED checkpoint (``save_sharded_checkpoint``:
+        full params + per-group 1/dp opt_state slices + membership
+        meta) WITHOUT tripping the restored-full-tree fallback: params
+        install now; the per-group optimizer slices and the saved
+        membership (owner map, member epoch) install when the first
+        step builds the sharded tail — so training continues sharded,
+        composed with ``BPS_SHARDED_UPDATE=1``, never silently dropping
+        to the full apply. Call between construction and the first
+        step. Returns the checkpoint meta."""
+        if getattr(self, "_chunked", None) is not None:
+            raise RuntimeError(
+                "restore_sharded must run before the first step — the "
+                "chunked tail already built its optimizer states")
+        from .checkpoint import restore_sharded_checkpoint
+        params, blobs, step, meta = restore_sharded_checkpoint(
+            path, self._params)
+        rep = NamedSharding(self.mesh, P())
+        self.params = jax.tree_util.tree_map(
+            lambda x: jax.device_put(jnp.asarray(x), rep), params)
+        self.step_count = int(step)
+        # deliberately NOT touching self.opt_state: the identity check
+        # in _sharded_active/_ensure_streamed_tail is exactly the
+        # full-tree fallback this path exists to avoid
+        self._restored_groups = dict(blobs)
+        self._restored_membership = meta.get("sharded")
+        return meta
+
+    def _install_restored_groups(self) -> None:
+        """First streamed step, after the chunked states exist: unpack
+        the sharded checkpoint's per-group opt_state slices into the
+        owned groups' states (bitwise resume). Non-owned groups'
+        slices are ignored here — their owners install their own."""
+        blobs = getattr(self, "_restored_groups", None)
+        if not blobs:
+            return
+        if not self._chunked.decomposable:
+            raise RuntimeError(
+                "sharded checkpoint restore needs the decomposable "
+                "chunked tail (it holds per-group optimizer state) — "
+                "the optimizer changed since the save, or "
+                "BPS_APPLY_CHUNKED=0")
+        from .sharded_update import unpack_opt_state
+        st = getattr(self, "_sharded", None)
+        flat = jax.tree_util.tree_leaves(self._params)
+        for gi, payload in sorted(blobs.items()):
+            if gi >= len(self._chunked.groups):
+                raise ValueError(
+                    f"sharded checkpoint has a slice for group {gi} "
+                    f"but the plan has {len(self._chunked.groups)} "
+                    f"groups — different bucket plans")
+            if st is not None and gi not in st.plan.owned_set:
+                continue
+            template = self._chunked.states[gi]
+            if template is None:
+                template = self._chunked.init_group(
+                    gi, [flat[li] for li in self._chunked.groups[gi]])
+            self._chunked.adopt_group(
+                gi, unpack_opt_state(payload, template))
+        missing = [gi for gi in
+                   (st.plan.owned if st is not None
+                    else range(len(self._chunked.groups)))
+                   if gi not in blobs]
+        if missing:
+            from .common.logging import get_logger
+            get_logger().warning(
+                "sharded checkpoint restore: no slice for owned "
+                "group(s) %s — their optimizer moments restart from "
+                "init (the owner's save was lost?)", missing)
+        self._restored_groups = None
 
     def close(self) -> None:
         """Release the trainer's PS-tail resources (H2D dispatch thread,

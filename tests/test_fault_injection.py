@@ -755,3 +755,52 @@ def test_sharded_owner_death_over_tcp_is_loud():
             c.close()
         srv.close()
         eng.close()
+
+
+@pytest.mark.slow
+def test_kill_both_worker_and_server_staggered():
+    """ISSUE 13 acceptance: kill-and-replace a WORKER and a SERVER,
+    staggered, mid-run, over real TCP — driven through the ONE shared
+    rig, ``bench.ps_elastic_breakdown`` (the bench measures, this test
+    asserts the contract on the same choreography so the two can never
+    drift):
+
+      - rounds 1..k_srv: both workers, both plane shards healthy;
+      - after k_srv: the shard owning key 0 dies → each live plane
+        fails over (reroute + replay from the OP_REPL_* forward logs);
+      - after k_w: one worker exits at a round boundary and a
+        REPLACEMENT joins (fresh plane, lazy_dial against the
+        already-dead addr, per-key round seeds from the server, late
+        failover re-based onto the promoted store);
+      - the survivor never restarts, checks EVERY round's sum exact
+        inside the rig (bit-documented: this path is exact), and its
+        per-round walls bound the stall: at most one >5x-median round
+        per membership change (two changes) — the <2-step contract.
+    """
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+    from byteps_tpu.obs import flight
+    from byteps_tpu.obs.metrics import get_registry
+
+    get_registry().reset()
+    flight.get_recorder().clear()
+    out = bench.ps_elastic_breakdown(rounds=10, nbytes=64 << 10,
+                                     kill_srv_at=3, kill_worker_at=5)
+    # exact sums on the survivor through both membership changes (the
+    # rig raises into `errors` on any mismatch), no hung worker
+    assert not out["errors"], out
+    assert out["survivor_rounds_completed"] == 10, out
+    # one failover per live plane: survivor, the dying peer, and the
+    # replacement's late failover
+    assert out["failovers"] == 3, out
+    # the <2-step stall bound, per membership change (two changes)
+    assert out["stall_rounds_ok"], out
+    assert len(out["stall_rounds"]) <= 2, out
+    # the flight postmortem names the membership transition for ANY
+    # implicated key — not just the stuck keys
+    evs = flight.get_recorder().events(keys=[0])
+    assert any(e["kind"] == "failover" for e in evs), \
+        [e["kind"] for e in evs]

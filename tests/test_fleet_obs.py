@@ -742,3 +742,128 @@ def test_plane_backend_stats_marks_dead_shard():
         assert "error" in st2["s1"] and "error" not in st2["s0"]
     finally:
         plane.close()
+
+
+# ------------------------- liveness ACTED ON (ISSUE 13): plane failover
+
+def _mk_plane_rig(n_shards=3, replicas=1):
+    from byteps_tpu.server.plane import PlanePSBackend
+    shards = [PSServer(num_workers=1, engine_threads=1)
+              for _ in range(n_shards)]
+    plane = PlanePSBackend(shards, num_workers=1, replicas=replicas,
+                           owns_shards=True)
+    for k in range(n_shards):
+        plane.init_key(k, 4096)
+    d = np.ones(1024, np.float32)
+    for k in range(n_shards):
+        plane.push(k, d)
+        out = np.empty_like(d)
+        plane.pull(k, out, round=1)
+    return plane, d
+
+
+class _BlackHoleStats:
+    """A stats() view in which one shard answers NOTHING — the
+    black-holed failure mode: the data-plane socket is alive but the
+    process behind it is wedged, so no connection error ever fires."""
+
+    def __init__(self, plane, victim):
+        self.plane = plane
+        self.victim = victim
+
+    def stats(self, timeout_ms=5000):
+        out = self.plane.stats(timeout_ms=timeout_ms)
+        out[f"s{self.victim}"] = {"error": "black-holed (no answer)"}
+        return out
+
+
+def test_stale_shard_triggers_plane_failover():
+    """ISSUE 13 satellite: the FleetScraper's staleness verdict is
+    wired into the plane's failover trigger path — a black-holed shard
+    (stats answering nothing, no socket error anywhere) fails over
+    within 3 scrape cadences, keys reroute, and the data plane serves
+    the moved keys from the replica log."""
+    plane, d = _mk_plane_rig()
+    try:
+        victim = plane.placement.shard_of(0)
+        sc = FleetScraper(_BlackHoleStats(plane, victim),
+                          interval_sec=0.05, stale_after=0.15,
+                          failover_backend=plane)
+        t0 = time.monotonic()
+        deadline = t0 + 5.0
+        while (time.monotonic() < deadline
+               and victim in plane.placement.live_shards()):
+            sc.scrape_once()
+            time.sleep(0.05)
+        assert victim not in plane.placement.live_shards(), \
+            "staleness verdict never became a failover"
+        # within ~3 cadences of the staleness line (generous CI bound)
+        assert time.monotonic() - t0 < 3.0
+        reg = obs_metrics.get_registry()
+        assert reg.counter("plane/failovers").value == 1
+        # the data plane never saw an error: the moved key still serves
+        out = np.empty_like(d)
+        plane.pull(0, out, round=1)
+        np.testing.assert_array_equal(out, d)
+        # membership events rode the flight recorder, key-less (every
+        # postmortem carries the epoch transition)
+        evs = flight.get_recorder().events(keys=[424242])
+        kinds = [e["kind"] for e in evs]
+        assert "member_leave" in kinds and "failover" in kinds, kinds
+        # idempotent: further stale scrapes do not double-fail
+        sc.scrape_once()
+        assert reg.counter("plane/failovers").value == 1
+    finally:
+        plane.close()
+
+
+def test_stale_verdict_observed_only_without_replicas():
+    """BPS_PLANE_REPLICAS=0: there is no replica log to fail onto, so
+    the liveness verdict stays OBSERVED-only — one warning per shard,
+    no failover, the plane untouched."""
+    plane, _ = _mk_plane_rig(replicas=0)
+    try:
+        victim = plane.placement.shard_of(0)
+        sc = FleetScraper(_BlackHoleStats(plane, victim),
+                          interval_sec=0.05, stale_after=0.1,
+                          failover_backend=plane)
+        for _ in range(6):
+            sc.scrape_once()
+            time.sleep(0.04)
+        assert victim in plane.placement.live_shards()
+        assert obs_metrics.get_registry().counter(
+            "plane/failovers").value == 0
+    finally:
+        plane.close()
+
+
+def test_global_state_wires_liveness_failover(monkeypatch):
+    """bps.init installs the plane as the scraper's failover backend
+    when BPS_PLANE_LIVENESS is on (the default) and leaves it unwired
+    when off — the observed-vs-acted-on switch."""
+    import byteps_tpu as bps
+
+    engines = [PSServer(num_workers=1, engine_threads=1)
+               for _ in range(2)]
+    servers = [PSTransportServer(e, host="127.0.0.1", port=0)
+               for e in engines]
+    addrs = ",".join(f"127.0.0.1:{s.port}" for s in servers)
+    for env, wired in (("1", True), ("0", False)):
+        monkeypatch.setenv("BPS_ENABLE_PS", "1")
+        monkeypatch.setenv("BPS_SERVER_ADDRS", addrs)
+        monkeypatch.setenv("BPS_PLANE_REPLICAS", "1")
+        monkeypatch.setenv("BPS_FLEET_SCRAPE_SEC", "30")
+        monkeypatch.setenv("BPS_PLANE_LIVENESS", env)
+        bps.init(config=bps.Config.from_env())
+        try:
+            from byteps_tpu.common.global_state import GlobalState
+            gs = GlobalState.get()
+            assert gs.fleet is not None
+            assert (gs.fleet.failover_backend is gs.ps_backend) == wired
+            assert hasattr(gs.ps_backend, "note_stale")
+        finally:
+            bps.shutdown()
+    for s in servers:
+        s.close()
+    for e in engines:
+        e.close()

@@ -564,3 +564,86 @@ def test_ring_striping_lands_on_distinct_successors(monkeypatch):
             s.close()
         for e in engines:
             e.close()
+
+
+# ------------------------------------------ chain replication (ISSUE 13)
+
+def test_backups_of_chain_walk():
+    """The replication chain: first n live ring successors after the
+    primary, in walk order — chain[0] is exactly ``backup_of``, and a
+    dead member drops out of the walk."""
+    from byteps_tpu.server.plane.placement import PlacementService
+    ps = PlacementService(4)
+    ps.place(7, 1024)
+    chain = ps.backups_of(7, 2)
+    assert len(chain) == 2
+    assert chain[0] == ps.backup_of(7)
+    assert ps.shard_of(7) not in chain
+    assert len(set(chain)) == 2
+    # chain members die: the walk skips them
+    ps.fail_shard(chain[0])
+    chain2 = ps.backups_of(7, 2)
+    assert chain[0] not in chain2
+    assert ps.backups_of(7, 0) == []
+
+
+def test_chain_replication_survives_two_shard_deaths():
+    """BPS_PLANE_REPLICAS=2 acceptance: every completed round is
+    forward-logged to BOTH chain members, so losing a key's primary
+    AND its promoted backup still replays every retained round
+    bit-identically from the second chain member — with one failover
+    counted per death."""
+    get_registry().reset()
+    keys = list(range(4))
+    nb = 16 * KB
+
+    def data(k, r):
+        return np.random.RandomState(100 * k + r).randn(
+            nb // 4).astype(np.float32)
+
+    plane, shards = _mk_plane(n_shards=4, replicas=2)
+    ref = {}
+    try:
+        for k in keys:
+            plane.init_key(k, nb)
+        _run_rounds(plane, keys, 3, data, ref)
+        victim = plane.placement.shard_of(keys[0])
+        chain = plane.placement.backups_of(keys[0], 2)
+        assert len(chain) == 2
+        shards[victim].close()
+        # first death: the promoted backup (chain[0]) serves the log
+        out = np.empty(nb // 4, np.float32)
+        plane.pull(keys[0], out, round=3)
+        np.testing.assert_array_equal(out, ref[(keys[0], 3)])
+        assert get_registry().counter("plane/failovers").value == 1
+        promoted = plane.placement.shard_of(keys[0])
+        assert promoted == chain[0]
+        # second death on the SAME key's chain: replicas=1 would have
+        # lost the log here — the second chain member still has it.
+        # Logged rounds are served from the chain WITHOUT touching the
+        # (dead) primary, so failure detection stays lazy: the next
+        # NEW round's push observes the death and fails over.
+        shards[promoted].close()
+        for r in range(1, 4):
+            out = np.empty(nb // 4, np.float32)
+            plane.pull(keys[0], out, round=r)
+            np.testing.assert_array_equal(out, ref[(keys[0], r)]), r
+        # the plane keeps training: new rounds run on the survivors,
+        # and the first push at the dead promoted shard triggers the
+        # second failover (reroute + replay, counted)
+        got = {}
+        _run_rounds(plane, keys, 1, data, got, start=4)
+        for k in keys:
+            np.testing.assert_array_equal(
+                got[(k, 4)], data(k, 4))
+        assert get_registry().counter("plane/failovers").value == 2
+        assert plane.placement.shard_of(keys[0]) == chain[1]
+        # failovers are first-class flight events naming the epoch
+        # transition (postmortems carry them for ANY key filter)
+        from byteps_tpu.obs import flight
+        evs = flight.get_recorder().events(keys=[999999])
+        fo = [e for e in evs if e["kind"] == "failover"]
+        assert len(fo) >= 2, [e["kind"] for e in evs]
+        assert "placement epoch" in fo[-1]["detail"]
+    finally:
+        plane.close()

@@ -746,3 +746,384 @@ def test_bench_ps_zero_smoke():
     assert out["sharded_vs_full"] > 0, out
     assert out["grad_pull_ratio"] < 0.75, out
     assert out["param_fetch_bytes"] > 0, out
+
+
+# ------------------------------------------- elasticity (ISSUE 13)
+
+def test_param_latest_tcp_and_store():
+    """OP_PARAM_SEQ: the mailbox's newest retained seq, 0 when empty —
+    in-process and over the real transport."""
+    st = ParamStore(retain=4)
+    assert st.latest(7) == 0
+    st.put(7, 3, b"x")
+    st.put(7, 5, b"y")
+    assert st.latest(7) == 5
+    eng = PSServer(num_workers=1, engine_threads=1)
+    srv = PSTransportServer(eng, host="127.0.0.1", port=0)
+    cli = RemotePSBackend([f"127.0.0.1:{srv.port}"])
+    try:
+        key = (1 << 41) | 9
+        assert cli.param_latest(key) == 0
+        cli.param_put(key, 4, b"frame")
+        assert cli.param_latest(key) == 4
+    finally:
+        cli.close()
+        srv.close()
+        eng.close()
+
+
+def test_param_seq_resumes_from_retained_frames(_clean_env):
+    """Elastic-rejoin regression (ISSUE 13 satellite): a rejoining
+    sharded-update owner must resume its param-mailbox sequence from
+    the server's RETAINED frames, not re-publish from seq 0 — stale
+    seqs overwrite nothing in the last-wins mailbox while every
+    non-owner blocks on the real next seq."""
+    eng = PSServer(num_workers=1, engine_threads=1)
+    srv = PSTransportServer(eng, host="127.0.0.1", port=0)
+    cli = RemotePSBackend([f"127.0.0.1:{srv.port}"])
+    exs, sts = [], []
+    try:
+        rng = np.random.RandomState(0)
+        tree = {f"k{i}": rng.randn(3000).astype(np.float32)
+                for i in range(4)}
+        ex = PSGradientExchange(cli, partition_bytes=4 << 10)
+        exs.append(ex)
+        st = build_sharded_state(ex, tree, optax.adam(1e-3), "seq", 0, 2)
+        sts.append(st)
+        assert st is not None
+        assert st.next_seq() == 1          # cold mailbox: starts at 1
+        # the predecessor's frames survive in the mailbox up to seq 5
+        key = next(iter(st.plan.param_keys.values()))
+        cli.param_put(key, 5, b"x" * 64)
+        ex2 = PSGradientExchange(cli, partition_bytes=4 << 10)
+        exs.append(ex2)
+        st2 = build_sharded_state(ex2, tree, optax.adam(1e-3), "seq",
+                                  0, 2)
+        sts.append(st2)
+        assert st2.next_seq() == 6, \
+            "rejoining owner restarted its param seqs from 0"
+    finally:
+        for st in sts:
+            if st is not None:
+                st.close()
+        for ex in exs:
+            ex.close()
+        cli.close()
+        srv.close()
+        eng.close()
+
+
+def test_reshard_minimal_movement_and_determinism():
+    """Membership epoch bumps move only the delta: a LEAVE reassigns
+    the departed rank's orphans alone (kept owners stay put), a JOIN
+    levels the newcomer up by bounded moves — and every rank computes
+    the identical next plan from the same inputs."""
+    keyed, groups, meta = _plan_inputs()
+    world = 4
+    plans = [ShardedUpdatePlan(keyed, groups, meta, r, world)
+             for r in range(world)]
+    p0 = plans[0]
+    leaver = p0.owner[0]
+    live = frozenset(range(world)) - {leaver}
+    q = [p.reshard(live) for p in plans]
+    for r in q[1:]:
+        assert r.owner == q[0].owner         # deterministic across ranks
+    assert all(o in live for o in q[0].owner)
+    kept = [gi for gi in range(len(groups)) if p0.owner[gi] != leaver]
+    assert all(q[0].owner[gi] == p0.owner[gi] for gi in kept), \
+        "a live owner's group moved on an unrelated LEAVE"
+    # JOIN back: the rejoined rank is leveled up, spread bounded by the
+    # largest single weight, again identically on every rank
+    j = [r.reshard(frozenset(range(world))) for r in q]
+    for r in j[1:]:
+        assert r.owner == j[0].owner
+    assert any(o == leaver for o in j[0].owner), "joiner got nothing"
+    lv = sorted(j[0].live)
+    spread = max(j[0].load[r] for r in lv) - min(j[0].load[r] for r in lv)
+    assert spread <= max(j[0].weights), (spread, j[0].weights)
+    # a rank OUTSIDE the live set owns nothing but keeps a valid plan
+    # (it still pushes grads and fetches every group's params)
+    drained = ShardedUpdatePlan(keyed, groups, meta, leaver, world,
+                                live=live)
+    assert drained.owned == ()
+    assert drained.pull_buckets == frozenset()
+    assert set(drained.fetch_order) == set(range(len(groups)))
+    # the authoritative-map path (checkpoint meta) installs verbatim
+    w = j[0].with_owner_map(j[0].owner)
+    assert w.owner == j[0].owner
+
+
+def test_reshard_weights_quantized_from_live_counters():
+    """live_group_weights: reads the per-layer push/pull byte counters,
+    quantizes to ratio rungs, None on a cold registry."""
+    from byteps_tpu.sharded_update import live_group_weights
+    keyed, groups, meta = _plan_inputs()
+    plan = ShardedUpdatePlan(keyed, groups, meta, 0, 2)
+    reg = get_registry()
+    reg.reset()
+    assert live_group_weights(plan, "wq", registry=reg) is None
+    # traffic on the first group's buckets only
+    for bi in plan.needed[0]:
+        reg.counter(
+            f"ps/push_bytes/wq.{plan.bucket_labels[bi]}").inc(1 << 20)
+    w = live_group_weights(plan, "wq", registry=reg)
+    assert w is not None and len(w) == len(groups)
+    assert w[0] == max(w)
+    assert all(x >= 1 for x in w)            # floor: no zero weights
+
+
+def test_reshard_crashed_owner_falls_back_loud(caplog):
+    """A LEAVE by death: the dead rank never publishes its handoff
+    frames — the gaining rank's fetch times out, WARNs naming the
+    group and dead rank, and the group's moments restart from init
+    (training continues; a sharded checkpoint restore is the lossless
+    path)."""
+    import logging
+
+    from byteps_tpu.common.logging import get_logger
+    from byteps_tpu.optim import ChunkedApply
+
+    keyed, groups, meta = _plan_inputs()
+    rng = np.random.RandomState(1)
+    tree = {f"k{i}": rng.randn(3000 + 64 * i).astype(np.float32)
+            for i in range(5)}
+    leaves = jax.tree_util.tree_leaves(tree)
+    be = HostPSBackend(num_servers=1, num_workers=1, engine_threads=1)
+    ex = PSGradientExchange(be, partition_bytes=4 << 10)
+    try:
+        st = build_sharded_state(ex, tree, optax.adam(1e-3), "crash",
+                                 0, 2)
+        assert st is not None
+        plan = st.plan
+        dead = 1
+        victim_groups = [gi for gi, o in enumerate(plan.owner)
+                         if o == dead]
+        assert victim_groups, "rank 1 owned nothing — degenerate plan"
+        chunked = ChunkedApply(optax.adam(1e-3), tree,
+                               plan.groups, donate=False,
+                               owned=plan.owned_set)
+        records = []
+        handler = logging.Handler()
+        handler.emit = lambda r: records.append(r.getMessage())
+        logger = get_logger()
+        logger.addHandler(handler)
+        try:
+            out = st.reshard(chunked, leaves, frozenset({0}),
+                             handoff_timeout_ms=200)
+        finally:
+            logger.removeHandler(handler)
+        assert out["member_epoch"] == 2
+        assert set(out["gained"]) == set(victim_groups)
+        warned = [m for m in records if "never published" in m]
+        assert warned, records
+        # ownership flipped; fresh-init state allocated for the gained
+        # groups, so training continues
+        assert chunked.owned == frozenset(range(len(plan.groups)))
+        for gi in victim_groups:
+            assert chunked.states[gi] is not None
+        st.close()
+    finally:
+        ex.close()
+        be.close()
+
+
+def _phased_rig(phases, params0, wb, name, dp=2):
+    """dp trainer threads over one TCP server; between phases every
+    rank reshards CONCURRENTLY (publish-before-fetch per rank — the
+    protocol's no-deadlock shape). Returns per-worker final flats."""
+    eng = PSServer(num_workers=dp, engine_threads=2)
+    srv = PSTransportServer(eng, host="127.0.0.1", port=0)
+    os.environ.update(BPS_ENABLE_PS="1", BPS_NUM_WORKER=str(dp),
+                      BPS_SERVER_ADDRS=f"127.0.0.1:{srv.port}",
+                      BPS_SHARDED_UPDATE="1", BPS_CROSS_STEP="0")
+    bps.init(config=bps.Config.from_env())
+    get_registry().reset()
+    from byteps_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
+    privs, trs = [], []
+    try:
+        for w in range(dp):
+            tr = DistributedTrainer(_chain_loss, dict(params0),
+                                    optax.adam(1e-3), mesh=mesh,
+                                    partition_bytes=8 << 10, name=name,
+                                    shard_rank=w)
+            priv = RemotePSBackend([f"127.0.0.1:{srv.port}"],
+                                   conns_per_shard=8)
+            tr._ps_exchange.backend = priv
+            privs.append(priv)
+            trs.append(tr)
+        done = 0
+        for steps, live in phases:
+            if live is not None:
+                rerrs = []
+
+                def rs(w):
+                    try:
+                        trs[w].reshard(live, handoff_timeout_ms=20000)
+                    except BaseException as e:  # noqa: BLE001
+                        rerrs.append((w, e))
+
+                rts = [threading.Thread(target=rs, args=(w,))
+                       for w in range(dp)]
+                for t in rts:
+                    t.start()
+                for t in rts:
+                    t.join(60)
+                assert not rerrs, rerrs
+                owners = {tuple(tr._sharded.plan.owner) for tr in trs}
+                assert len(owners) == 1, \
+                    f"reshard diverged across ranks: {owners}"
+            errs = []
+
+            def run(w, s=done, n=steps):
+                try:
+                    for i in range(n):
+                        trs[w].step(wb[w][s + i])
+                    trs[w].drain()
+                except BaseException as e:  # noqa: BLE001
+                    errs.append((w, e))
+
+            ts = [threading.Thread(target=run, args=(w,))
+                  for w in range(dp)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(180)
+            assert not any(t.is_alive() for t in ts), "workers hung"
+            assert not errs, errs
+            done += steps
+        # the 1/dp memory contract survives membership changes: state
+        # allocated exactly for the CURRENT owned groups
+        for tr in trs:
+            alloc = {gi for gi, s in enumerate(tr._chunked.states)
+                     if s is not None}
+            assert alloc == set(tr._sharded.plan.owned), \
+                (alloc, tr._sharded.plan.owned)
+        finals = [[np.asarray(l)
+                   for l in jax.tree_util.tree_leaves(tr.params)]
+                  for tr in trs]
+        for tr in trs:
+            tr.close()
+        return finals
+    finally:
+        bps.shutdown()
+        for p in privs:
+            p.close()
+        srv.close()
+        eng.close()
+
+
+def test_reshard_leave_join_bitwise_with_handoff(_clean_env):
+    """LIVE MEMBERSHIP CHANGE end to end: dp=2 trains 3 steps, rank 1
+    gracefully LEAVES the ownership plan (its groups' optimizer state
+    hands off through the param mailbox), 3 more steps run with rank 0
+    owning everything, then rank 1 REJOINS (state hands back) for 2
+    steps — and the whole trajectory is BITWISE identical to an
+    uninterrupted run, on both replicas. No server re-init, no key
+    migration, no global drain: only group ownership moved."""
+    params0 = _chain_setup(depth=3, dim=64)
+    wb = [_chain_batches(64, 10 + w, 8, bs=16) for w in range(2)]
+    ref = _phased_rig([(8, None)], params0, wb, "rsref")
+    got = _phased_rig([(3, None), (3, frozenset({0})),
+                       (2, frozenset({0, 1}))], params0, wb, "rsgot")
+    for a, b in zip(got[0], got[1]):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(ref[0], got[0]):
+        np.testing.assert_array_equal(a, b)
+    # membership transitions are first-class flight events — a
+    # post-reshard postmortem names the epoch, whatever keys it filters
+    from byteps_tpu.obs import flight
+    evs = flight.get_recorder().events(keys=[12345])   # unrelated key
+    kinds = {e["kind"] for e in evs}
+    assert "reshard" in kinds, kinds
+    assert "member_leave" in kinds and "member_join" in kinds, kinds
+
+
+def test_sharded_checkpoint_roundtrip_no_fallback(_clean_env, tmp_path):
+    """DURABLE SHARDED STATE: save under BPS_SHARDED_UPDATE=1 (each
+    owner persists its 1/dp opt_state slice), restore into fresh
+    trainers, and continue WITHOUT the restored-full-tree fallback
+    firing — the continued run is BITWISE identical to an
+    uninterrupted one at dp=2."""
+    from byteps_tpu.checkpoint import save_sharded_checkpoint
+
+    params0 = _chain_setup(depth=3, dim=64)
+    wb = [_chain_batches(64, 20 + w, 8, bs=16) for w in range(2)]
+    ck = str(tmp_path / "ck")
+
+    def run_rig(steps, restore=False, save=False, start=0, name="ckpt"):
+        dp = 2
+        eng = PSServer(num_workers=dp, engine_threads=2)
+        srv = PSTransportServer(eng, host="127.0.0.1", port=0)
+        os.environ.update(BPS_ENABLE_PS="1", BPS_NUM_WORKER=str(dp),
+                          BPS_SERVER_ADDRS=f"127.0.0.1:{srv.port}",
+                          BPS_SHARDED_UPDATE="1", BPS_CROSS_STEP="0")
+        bps.init(config=bps.Config.from_env())
+        get_registry().reset()
+        from byteps_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
+        privs, trs = [], []
+        try:
+            for w in range(dp):
+                tr = DistributedTrainer(
+                    _chain_loss, dict(params0), optax.adam(1e-3),
+                    mesh=mesh, partition_bytes=8 << 10, name=name,
+                    shard_rank=w)
+                priv = RemotePSBackend([f"127.0.0.1:{srv.port}"],
+                                       conns_per_shard=8)
+                tr._ps_exchange.backend = priv
+                privs.append(priv)
+                trs.append(tr)
+            if restore:
+                for tr in trs:
+                    meta = tr.restore_sharded(ck)
+                assert meta["step"] == 3
+                assert trs[0].step_count == 3
+            errs = []
+
+            def run(w):
+                try:
+                    for i in range(steps):
+                        trs[w].step(wb[w][start + i])
+                    trs[w].drain()
+                except BaseException as e:  # noqa: BLE001
+                    errs.append((w, e))
+
+            ts = [threading.Thread(target=run, args=(w,))
+                  for w in range(dp)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(180)
+            assert not errs, errs
+            # the acceptance bound: restore composes with the sharded
+            # tail — the full-tree-opt_state fallback never fired
+            for tr in trs:
+                assert tr._sharded is not None, \
+                    "sharded update fell back after restore"
+                alloc = {gi for gi, s in enumerate(tr._chunked.states)
+                         if s is not None}
+                assert alloc == set(tr._sharded.plan.owned)
+            if save:
+                for tr in trs:
+                    save_sharded_checkpoint(ck, tr)
+            finals = [[np.asarray(l)
+                       for l in jax.tree_util.tree_leaves(tr.params)]
+                      for tr in trs]
+            for tr in trs:
+                tr.close()
+            return finals
+        finally:
+            bps.shutdown()
+            for p in privs:
+                p.close()
+            srv.close()
+            eng.close()
+
+    ref = run_rig(6, name="ckref")
+    run_rig(3, save=True, name="cksave")
+    got = run_rig(3, restore=True, start=3, name="ckrest")
+    for a, b in zip(got[0], got[1]):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(ref[0], got[0]):
+        np.testing.assert_array_equal(a, b)
