@@ -1369,9 +1369,11 @@ def fleet_obs_breakdown(rounds: int = 40, iters: int = 30, warm: int = 5,
     free where it must be — a compute-bound exchange loop (jitted MLP
     grads, in-process backend, no throttle: the ``ps_cross``
     compute-bound arm's shape) with BPS_STATS=1 + flight recorder +
-    a 20 Hz scraper versus BPS_STATS=0 and everything off. Interleaved
-    pairs, POOLED per-step medians (the ps_cross noise methodology),
-    ASSERTED within 2%."""
+    the causal span ring + a scraper (which now ALSO scrapes the span
+    ring + clock samples over the trace surface each pass — ISSUE 14's
+    tracing rides the same A/B) versus BPS_STATS=0 and everything off.
+    Interleaved pairs, POOLED per-step medians (the ps_cross noise
+    methodology), ASSERTED within 2%."""
     import statistics as _st
 
     import jax.numpy as jnp
@@ -1487,6 +1489,232 @@ def fleet_obs_breakdown(rounds: int = 40, iters: int = 30, warm: int = 5,
                 os.environ[k] = v
         obs_metrics.configure()
         flight.configure()
+    return out
+
+
+def critpath_rig(mode: str, rounds: int = 8, warm: int = 2,
+                 elems: int = 1 << 18, delay: float = 0.06,
+                 dim: int = 384, depth: int = 6, batch: int = 4096,
+                 server_rate: float = 2.5e7) -> dict:
+    """ONE ground-truth critical-path rig (ISSUE 14 acceptance): run a
+    traced exchange loop whose bottleneck is PHYSICALLY pinned by
+    construction, then ask ``obs.critpath`` what gated it — the
+    attribution must name the category the rig was built to be.
+
+      - ``wire``: single worker over the real transport behind an
+        emulated-NIC throttle (``throttle.Nic``) — every byte's wire
+        time is real, nothing else is slow → dominant must be
+        ``wire``.
+      - ``straggler``: TWO workers on one 2-worker server; worker B
+        sleeps ``delay`` before each push, worker A is traced — A's
+        pulls block on the server's merge-wait for B's arrival →
+        dominant must be ``straggler`` AND the blamed worker id must
+        be B's push-dedup incarnation (returned as ``slow_wid``).
+      - ``compute``: in-process backend, a jitted MLP grad per step
+        under a DISPATCH span, tiny exchange → dominant must be
+        ``compute``.
+
+    Server spans reach the analyzer the PRODUCTION way: scraped over
+    OP_TRACE (``backend.trace()``), clock-probed (min-RTT estimator)
+    and re-based — not read out of process-local state — so the rigs
+    exercise the whole trace plane, PR-8 overtake-test style. Shared
+    by ``bench.py critpath`` and tests/test_critpath.py (one rig, no
+    drift). Returns {"agg": merged attribution, "per_step": […],
+    "slow_wid": B's wid (straggler mode)}."""
+    import jax.numpy as jnp
+
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.obs import critpath
+    from byteps_tpu.obs import spans as spans_mod
+    from byteps_tpu.server import throttle
+    from byteps_tpu.server.engine import HostPSBackend, PSServer
+    from byteps_tpu.server.ps_mode import PSGradientExchange
+    from byteps_tpu.server.transport import (PSTransportServer,
+                                             RemotePSBackend)
+    from byteps_tpu.timeline import Timeline
+
+    import threading
+
+    assert mode in ("wire", "straggler", "compute"), mode
+    spans_mod.reset()
+    tl = Timeline(Config(trace_on=True, trace_start_step=0,
+                         trace_end_step=1 << 30))
+    engine = server = be = be_b = ex = ex_b = None
+    out: dict = {"mode": mode}
+    try:
+        if mode == "compute":
+            be = HostPSBackend(num_servers=1, num_workers=1,
+                               engine_threads=2)
+            rng = np.random.RandomState(0)
+            params = {f"w{i}": jnp.asarray(
+                rng.randn(dim, dim).astype(np.float32) * 0.05)
+                for i in range(depth)}
+            x = jnp.asarray(rng.randn(batch, dim).astype(np.float32))
+            y = jnp.tanh(x)
+
+            def loss_fn(p):
+                h = x
+                for i in range(depth):
+                    h = jnp.tanh(h @ p[f"w{i}"])
+                return ((h - y) ** 2).mean()
+
+            grad = jax.jit(jax.grad(loss_fn))
+            jax.block_until_ready(grad(params))     # compile outside
+            ex = PSGradientExchange(be, partition_bytes=16 << 20,
+                                    pipeline_depth=2)
+            ex.timeline = tl
+            for it in range(rounds):
+                tl.set_step(it)
+                with tl.span("model", "DISPATCH", step=it):
+                    g = grad(params)
+                    jax.block_until_ready(g)
+                ex.exchange(g, name="crit")
+        else:
+            # wire mode runs TWO shards (the CLI-smoke rig is a real
+            # sharded deployment, keys hashed across both); straggler
+            # needs one 2-worker shard so the merge-wait is real
+            nworkers = 2 if mode == "straggler" else 1
+            n_shards = 2 if mode == "wire" else 1
+            engine = [PSServer(num_workers=nworkers, engine_threads=2)
+                      for _ in range(n_shards)]
+            server = [PSTransportServer(
+                e, host="127.0.0.1", port=0,
+                nic=(throttle.Nic(server_rate) if mode == "wire"
+                     else None)) for e in engine]
+            addr = [f"127.0.0.1:{s.port}" for s in server]
+            be = RemotePSBackend(addr)
+            tree = {"a": np.ones(elems, np.float32),
+                    "b": np.ones(elems, np.float32)}
+            ex = PSGradientExchange(be, partition_bytes=elems * 2,
+                                    pipeline_depth=2)
+            ex.timeline = tl
+            if mode == "straggler":
+                be_b = RemotePSBackend(addr)
+                out["slow_wid"] = be_b._wid
+                ex_b = PSGradientExchange(be_b,
+                                          partition_bytes=elems * 2,
+                                          pipeline_depth=2)
+                stop = threading.Event()
+                b_err = []
+
+                def worker_b():
+                    try:
+                        for _ in range(rounds):
+                            if stop.is_set():
+                                return
+                            time.sleep(delay)
+                            ex_b.exchange(tree, name="crit")
+                    except Exception as e:   # noqa: BLE001 — surfaced
+                        b_err.append(e)      # after the join below
+
+                tb = threading.Thread(target=worker_b, daemon=True)
+                tb.start()
+            for it in range(rounds):
+                tl.set_step(it)
+                ex.exchange(tree, name="crit")
+            if mode == "straggler":
+                tb.join(timeout=60)
+                if b_err:
+                    raise b_err[0]
+        # ---- attribution, via the PRODUCTION scrape path
+        est = spans_mod.ClockEstimator()
+        server_spans = []
+        by_shard: dict = {}
+        for label, ent in (be.trace() or {}).items():
+            if "payload" not in ent:
+                continue
+            p = ent["payload"]
+            got = est.probe(label, ent["t_send"], ent["t_recv"],
+                            p.get("now"))
+            off = got[0] if got is not None else 0.0
+            by_shard[label] = spans_mod.rebase(p["spans"] or [], off)
+            server_spans.extend(by_shard[label])
+        snap = tl.snapshot()
+        per_step = [critpath.attribute(snap, server_spans=server_spans,
+                                       step=s, t0=tl._t0)
+                    for s in range(warm, rounds)]
+        per_step = [r for r in per_step if r]
+        out["agg"] = critpath.merge_results(per_step)
+        out["per_step"] = per_step
+        out["server_spans"] = server_spans
+        out["spans_by_shard"] = by_shard
+        out["events"] = snap
+        out["t0"] = tl._t0
+        return out
+    finally:
+        closers = [ex, ex_b, be, be_b]
+        closers += server if isinstance(server, list) else [server]
+        closers += engine if isinstance(engine, list) else [engine]
+        for closer in closers:
+            if closer is not None:
+                try:
+                    closer.close()
+                except Exception:   # noqa: BLE001 — teardown best-effort
+                    pass
+
+
+def critpath_breakdown(rounds: int = 10, warm: int = 3) -> dict:
+    """Critical-path acceptance set (ISSUE 14): the three ground-truth
+    rigs, each ASSERTED to blame its built-in bottleneck — wire on the
+    egress-throttled rig, the slow worker's merge-wait (with the
+    correct worker id) on the injected-straggler rig, compute on the
+    compute-bound rig — plus a CLI smoke: the TWO-SHARD wire run's
+    trace + per-shard scraped server spans dumped to disk and
+    re-analyzed through ``python -m byteps_tpu.obs.critpath`` (the
+    verdict must survive the disk round-trip)."""
+    import tempfile
+
+    from byteps_tpu.obs import critpath
+    out: dict = {}
+    wire = critpath_rig("wire", rounds=rounds, warm=warm)
+    out["wire"] = {"dominant": wire["agg"]["dominant"],
+                   "fracs": wire["agg"]["fracs"]}
+    assert wire["agg"]["dominant"] == "wire", (
+        f"egress-throttled rig must attribute to wire, got "
+        f"{wire['agg']['dominant']} ({wire['agg']['fracs']})")
+
+    strag = critpath_rig("straggler", rounds=rounds, warm=warm)
+    out["straggler"] = {"dominant": strag["agg"]["dominant"],
+                        "fracs": strag["agg"]["fracs"],
+                        "blamed": (strag["agg"].get("straggler")
+                                   or {}).get("worker"),
+                        "slow_wid": strag["slow_wid"]}
+    assert strag["agg"]["dominant"] == "straggler", (
+        f"injected-straggler rig must attribute to straggler "
+        f"merge-wait, got {strag['agg']['dominant']} "
+        f"({strag['agg']['fracs']})")
+    assert (strag["agg"].get("straggler") or {}).get("worker") == \
+        strag["slow_wid"], (
+        f"straggler blame must name the slow worker's id "
+        f"{strag['slow_wid']:#x}, got {strag['agg'].get('straggler')}")
+
+    comp = critpath_rig("compute", rounds=rounds, warm=warm)
+    out["compute"] = {"dominant": comp["agg"]["dominant"],
+                      "fracs": comp["agg"]["fracs"]}
+    assert comp["agg"]["dominant"] == "compute", (
+        f"compute-bound rig must attribute to compute, got "
+        f"{comp['agg']['dominant']} ({comp['agg']['fracs']})")
+
+    # ---- CLI smoke over the two-shard wire run's artifacts
+    from byteps_tpu.obs import spans as spans_mod
+    with tempfile.TemporaryDirectory() as td:
+        rankdir = os.path.join(td, "0")
+        os.makedirs(rankdir)
+        with open(os.path.join(rankdir, "comm.json"), "w") as f:
+            json.dump({"traceEvents": wire["events"],
+                       "metadata": {"t0_unix_s": wire["t0"],
+                                    "rank": 0}}, f)
+        assert len(wire["spans_by_shard"]) == 2, "wire rig is 2-shard"
+        for label, spans in wire["spans_by_shard"].items():
+            spans_mod.dump_server_trace(td, label, spans)
+        rc = critpath.main([td])
+        assert rc == 0, f"critpath CLI smoke failed rc={rc}"
+        cli_steps, cli_agg = critpath.analyze_dir(td)
+        assert cli_agg["dominant"] == "wire", (
+            f"CLI re-analysis must agree with the live verdict, got "
+            f"{cli_agg['dominant']}")
+        out["cli_rc"] = rc
+        out["cli_dominant"] = cli_agg["dominant"]
     return out
 
 
@@ -1660,6 +1888,7 @@ _BREAKDOWNS = {
     "ps_zero": lambda: ps_zero_breakdown(compute_iters=20),
     "pp": lambda: pp_breakdown(),
     "fleet_obs": lambda: fleet_obs_breakdown(),
+    "critpath": lambda: critpath_breakdown(),
     "ps_elastic": lambda: ps_elastic_breakdown(),
 }
 

@@ -47,3 +47,5 @@ from .watchdog import StallWatchdog                               # noqa: F401
 from .merge_trace import merge_traces                             # noqa: F401
 from .fleet import FleetScraper                                   # noqa: F401
 from .flight import FlightRecorder, get_recorder                  # noqa: F401
+from .spans import ClockEstimator, ServerSpanRing                 # noqa: F401
+from .critpath import attribute as critpath_attribute             # noqa: F401

@@ -129,6 +129,17 @@ def registry_json(registry: Optional[MetricsRegistry] = None) -> Dict:
             "metrics": reg.snapshot()}
 
 
+def flight_json() -> Dict:
+    """The process's flight-recorder ring as a structured dump — the
+    ``/flight.json`` endpoint and ``--flight`` CLI body. Events oldest
+    first, exactly as the postmortem renderer would consume them."""
+    from . import flight as _flight
+    rec = _flight.get_recorder()
+    return {"schema": "byteps_tpu.FlightDump/v1",
+            "enabled": rec.enabled,
+            "events": rec.events()}
+
+
 # ------------------------------------------------------ remote scrape
 
 def scrape_addr(addr: str, timeout_s: float = 5.0) -> Dict:
@@ -176,6 +187,12 @@ class MetricsHTTPServer:
                         {"schema": "byteps_tpu.FleetView/v1",
                          "shards": sc.view() if sc is not None else {},
                          "scraper": sc is not None}).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/flight.json"):
+                    # the current flight-recorder ring as a structured
+                    # dump — a postmortem an operator pulls with curl,
+                    # no debugger attached (obs/flight.py)
+                    body = json.dumps(flight_json()).encode()
                     ctype = "application/json"
                 elif self.path.startswith("/metrics"):
                     body = prometheus_text(reg).encode()
@@ -228,8 +245,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("-o", "--out", default=None,
                     help="output file (default stdout)")
     ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument("--flight", action="store_true",
+                    help="dump THIS process's flight-recorder ring "
+                         "(JSON) instead of metrics — the ring is "
+                         "per-process, so this takes no addresses")
     args = ap.parse_args(argv)
-    if args.addrs:
+    if args.flight:
+        if args.addrs:
+            print("error: --flight dumps the LOCAL process ring; "
+                  "remote servers expose theirs via "
+                  "BPS_METRICS_PORT /flight.json", file=sys.stderr)
+            return 2
+        text = json.dumps(flight_json(), indent=2)
+        rc = 0
+    elif args.addrs:
         scraped: Dict[str, Dict] = {}
         rc = 0
         for i, addr in enumerate(args.addrs):

@@ -162,6 +162,18 @@ class FleetScraper:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._log = get_logger()
+        # causal trace plane (obs/spans.py): when the backend speaks
+        # ``trace()`` (OP_TRACE on remote shards), every scrape pass
+        # also pulls each shard's span ring + a clock sample; the
+        # NTP-style min-RTT estimator turns the samples into
+        # ``fleet/<shard>/clock_offset_s`` (± ``clock_err_s``) and the
+        # spans are re-based onto THIS worker's timebase and ingested
+        # for the critical-path analyzer. Rides the same dedicated
+        # channel as OP_STATS — never credit-gated, never pooled.
+        from .spans import ClockEstimator
+        self.clock = ClockEstimator()
+        self._trace_ok = hasattr(backend, "trace")
+        self._trace_warned = False
 
     # ---------------------------------------------------------- scraping
 
@@ -195,8 +207,47 @@ class FleetScraper:
             views = list(self._shards.values())
         for sv in views:
             self._publish(sv, now)
+        if self._trace_ok:
+            self._scrape_trace()
         self._act_on_staleness(views, now)
         return self.view()
+
+    def _scrape_trace(self) -> None:
+        """One causal-trace pass: per-shard span ring + clock sample.
+        The ENTIRE pass is guarded — trace is an enrichment, and the
+        staleness-failover step that follows it in ``scrape_once`` must
+        run even when a shard hands back a malformed payload (a raised
+        probe/rebase here would silently disable the PR-13 acted-on
+        liveness for as long as the trace plane misbehaves). Failures
+        log once and retry next cadence."""
+        from . import spans as _spans
+        try:
+            try:
+                tr = self.backend.trace(timeout_ms=self.timeout_ms)
+            except TypeError:
+                tr = self.backend.trace()
+            for label, ent in (tr or {}).items():
+                if not isinstance(ent, dict) or "payload" not in ent:
+                    continue        # unreachable shard: stats staleness
+                p = ent["payload"] or {}
+                est = self.clock.probe(label, ent.get("t_send", 0.0),
+                                       ent.get("t_recv", 0.0),
+                                       p.get("now"))
+                off = 0.0
+                if est is not None:
+                    off, err = est
+                    self.reg.gauge(f"fleet/{label}/clock_offset_s").set(
+                        round(off, 6))
+                    self.reg.gauge(f"fleet/{label}/clock_err_s").set(
+                        round(err, 6))
+                spans = p.get("spans") or []
+                if spans:
+                    _spans.ingest(label, _spans.rebase(spans, off))
+        except Exception as e:   # noqa: BLE001 — see docstring
+            if not self._trace_warned:
+                self._trace_warned = True
+                self._log.warning("fleet trace scrape failed: %s "
+                                  "(retrying each cadence)", e)
 
     def _act_on_staleness(self, views, now: float) -> None:
         """Promote staleness from observed to ACTED-ON: hand every

@@ -24,7 +24,16 @@ unifies them:
     (their per-rank pid is the stage index; microbatch becomes the
     tid) so the 1F1B overlap reads directly, plus ``ph: "s"/"f"`` flow
     arrows along each ``PP_ACT_SEND → PP_ACT_RECV`` hop per
-    (boundary, microbatch).
+    (boundary, microbatch);
+  - SERVER span dumps (``server_<shard>.json``, written by
+    ``obs.spans.dump_server_trace`` from the OP_TRACE scrape and
+    already clock-offset-re-based onto the worker timebase) become one
+    process row per shard: ``SRV_MERGE`` (first arrival →
+    num_workers-th arrival) + ``SRV_SERVE`` spans per (key, round),
+    anchored by the first rank's ``metadata.t0_unix_s``, with
+    ``srv-in`` / ``srv-out`` flow arrows joining each worker's
+    round-tagged ``PS_PUSH`` → ``SRV_MERGE`` → ``PS_PULL`` — the
+    worker→server→worker causal path per round, exactly paired.
 
 CLI::
 
@@ -63,6 +72,25 @@ _PP_PID_BASE = 10000
 _PP_ACT_NAME = re.compile(r"/b(\d+)/mb(\d+)$")
 _PP_MB_NAME = re.compile(r"/mb(\d+)$")
 
+# server span rows (byteps_tpu.obs.spans): each ``server_<label>.json``
+# dump becomes one PROCESS row (pid from _SRV_PID_BASE, disjoint from
+# rank and PP pids) with one SRV_MERGE span per (key, round) — first
+# arrival → num_workers-th arrival — and SRV_SERVE spans per pull.
+# Server records are wall-clock (worker timebase after the clock-offset
+# re-base); the FIRST rank carrying ``metadata.t0_unix_s`` anchors them
+# onto the per-rank relative µs axis. NOTE the same caveat as the
+# existing cross-rank arrows: every rank keeps its OWN t0 base in the
+# merged view (a deliberate property — see the module docstring), so
+# server rows are time-accurate relative to the anchoring rank only;
+# for other ranks the ARROWS remain causally exact (both ends carry
+# the round tag) even where the row offsets by the inter-rank t0
+# delta. Flow arrows: every worker PS_PUSH tagged (key, round) → that
+# round's SRV_MERGE, and SRV_MERGE → every worker PS_PULL of
+# (key, round) — the worker→server→worker causal path per round,
+# exact pairing (no positional guessing).
+_SRV_FILE = re.compile(r"^server_(.+)\.json$")
+_SRV_PID_BASE = 20000
+
 
 def _pp_pid(rank: int, stage: int) -> int:
     """Synthetic process id for one (rank, stage) row — disjoint from
@@ -70,13 +98,14 @@ def _pp_pid(rank: int, stage: int) -> int:
     return _PP_PID_BASE + rank * 100 + stage
 
 
-def load_rank_traces(trace_dir: str) -> Dict[int, List[dict]]:
-    """{rank: traceEvents} for every ``<trace_dir>/<rank>/comm.json``.
+def load_rank_files(trace_dir: str) -> Dict[int, Tuple[List[dict], dict]]:
+    """{rank: (traceEvents, metadata)} for every
+    ``<trace_dir>/<rank>/comm.json``.
 
     A corrupt/truncated rank file (the writer was SIGKILLed mid-flush —
     common in exactly the killed-job scenario this tool diagnoses) is
     skipped with a warning so the healthy ranks still merge."""
-    out: Dict[int, List[dict]] = {}
+    out: Dict[int, Tuple[List[dict], dict]] = {}
     for entry in sorted(os.listdir(trace_dir)):
         path = os.path.join(trace_dir, entry, "comm.json")
         if not entry.isdigit() or not os.path.isfile(path):
@@ -88,7 +117,35 @@ def load_rank_traces(trace_dir: str) -> Dict[int, List[dict]]:
             print(f"warning: skipping unreadable trace {path}: {e}",
                   file=sys.stderr)
             continue
-        out[int(entry)] = data.get("traceEvents", [])
+        out[int(entry)] = (data.get("traceEvents", []),
+                           data.get("metadata") or {})
+    return out
+
+
+def load_rank_traces(trace_dir: str) -> Dict[int, List[dict]]:
+    """{rank: traceEvents} — the historical loader shape."""
+    return {r: ev for r, (ev, _) in load_rank_files(trace_dir).items()}
+
+
+def load_server_spans(trace_dir: str) -> Dict[str, List[dict]]:
+    """{shard label: span records} from every
+    ``<trace_dir>/server_<label>.json`` dump
+    (``obs.spans.dump_server_trace`` — wall-clock records already
+    re-based onto the worker timebase by the clock-offset estimate)."""
+    out: Dict[str, List[dict]] = {}
+    for entry in sorted(os.listdir(trace_dir)):
+        m = _SRV_FILE.match(entry)
+        if not m:
+            continue
+        path = os.path.join(trace_dir, entry)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"warning: skipping unreadable span dump {path}: {e}",
+                  file=sys.stderr)
+            continue
+        out[m.group(1)] = data.get("spans", [])
     return out
 
 
@@ -113,12 +170,18 @@ def _flow_pair(fid: int, a: dict, b: dict, name: str) -> List[dict]:
 def merge_traces(trace_dir: str) -> dict:
     """Merge every per-rank comm.json under ``trace_dir`` into one
     Chrome-trace dict (see module docstring for the layout)."""
-    ranks = load_rank_traces(trace_dir)
+    rank_files = load_rank_files(trace_dir)
+    ranks = {r: ev for r, (ev, _) in rank_files.items()}
     if not ranks:
         raise FileNotFoundError(
             f"no <rank>/comm.json traces under {trace_dir!r}")
     merged: List[dict] = []
     fid = 0
+    # (key, round)-tagged wire span endpoints for the server rows'
+    # worker→server→worker flow arrows (spans since the trace plane
+    # carry args.round; older traces simply grow no arrows)
+    rr_push: Dict[Tuple, List[dict]] = {}
+    rr_pull: Dict[Tuple, List[dict]] = {}
     # chains[(chain, rank? no — cross-rank needs rank-agnostic key)]
     by_chain: Dict[Tuple, Dict[str, List[dict]]] = {}
     # PP act flow endpoints: (boundary, microbatch, step) → spans.
@@ -165,6 +228,10 @@ def merge_traces(trace_dir: str) -> dict:
             ne["pid"] = rank
             ne["args"] = args
             merged.append(ne)
+            if name in ("PS_PUSH", "PS_PULL") and "round" in args:
+                k = (ne["tid"], args["round"])
+                (rr_push if name == "PS_PUSH"
+                 else rr_pull).setdefault(k, []).append(ne)
             for chain in _CHAINS:
                 if name in chain:
                     key = (chain, rank) + _span_key(e)
@@ -231,6 +298,63 @@ def merge_traces(trace_dir: str) -> dict:
                     merged.extend(_flow_pair(fid, push, pull,
                                              "server-merge"))
                     fid += 1
+    # SERVER process rows + worker→server→worker arrows (obs/spans.py
+    # dumps): anchored on rank 0's wall-clock t0 — without that
+    # metadata (older traces) the rows are skipped with a warning
+    server = load_server_spans(trace_dir)
+    if server:
+        t0 = None
+        for rank in sorted(rank_files):
+            t0 = rank_files[rank][1].get("t0_unix_s")
+            if t0 is not None:
+                break
+        if t0 is None:
+            print("warning: server span dumps present but no rank "
+                  "comm.json carries metadata.t0_unix_s — server rows "
+                  "skipped (re-trace with the current build)",
+                  file=sys.stderr)
+        else:
+            for si, label in enumerate(sorted(server)):
+                pid = _SRV_PID_BASE + si
+                merged.append({"ph": "M", "pid": pid,
+                               "name": "process_name",
+                               "args": {"name": f"server {label}"}})
+                merged.append({"ph": "M", "pid": pid,
+                               "name": "process_sort_index",
+                               "args": {"sort_index": pid}})
+                for rec in server[label]:
+                    first = rec.get("first_t")
+                    if first is None:
+                        continue
+                    key, rnd = rec.get("key", 0), rec.get("round", 0)
+                    end = rec.get("complete_t") or first
+                    mspan = {"ph": "X", "name": "SRV_MERGE", "pid": pid,
+                             "tid": key,
+                             "ts": (first - t0) * 1e6,
+                             "dur": max(0.0, (end - first) * 1e6),
+                             "args": {"key": key, "round": rnd,
+                                      "shard": label,
+                                      "arrivals": len(
+                                          rec.get("arrivals") or ()),
+                                      "merge_wait_ms": round(
+                                          (end - first) * 1e3, 3)}}
+                    merged.append(mspan)
+                    for srv in rec.get("serves", ()):
+                        merged.append({
+                            "ph": "X", "name": "SRV_SERVE", "pid": pid,
+                            "tid": key, "ts": (srv["t"] - t0) * 1e6,
+                            "dur": srv["dur"] * 1e6,
+                            "args": {"key": key, "round": rnd,
+                                     "shard": label}})
+                    rk = (key, rnd)
+                    for push in rr_push.get(rk, ()):
+                        merged.extend(_flow_pair(fid, push, mspan,
+                                                 "srv-in"))
+                        fid += 1
+                    for pull in rr_pull.get(rk, ()):
+                        merged.extend(_flow_pair(fid, mspan, pull,
+                                                 "srv-out"))
+                        fid += 1
     return {"traceEvents": merged, "displayTimeUnit": "ms",
             "metadata": {"tool": "byteps_tpu.obs.merge_trace",
                          "ranks": sorted(ranks)}}

@@ -93,6 +93,16 @@ PP_COUNTERS: Tuple[str, ...] = (
 PP_GAUGES: Tuple[str, ...] = ("pp/stage", "pp/stages",
                               "sched/inflight_bytes")
 
+# Critical-path attribution (byteps_tpu.obs.critpath): the last traced
+# step's wall, split along its BLOCKING CHAIN into these categories —
+# pre-registered so "what can critpath blame" is answerable before any
+# traffic. Gauges hold the latest step's seconds per category
+# (crit/<cat>_s) and its fraction of the step wall (crit/<cat>_frac);
+# crit/steps counts attributed steps.
+CRIT_CATEGORIES: Tuple[str, ...] = (
+    "compute", "d2h", "host", "wire", "server_queue", "straggler",
+    "admission", "credit", "h2d", "apply", "gap", "other")
+
 # ONE truthiness rule shared with Config (BPS_STATS must resolve
 # identically whether read here or through Config.stats_on)
 from ..common.config import _TRUE  # noqa: E402
@@ -308,6 +318,10 @@ class MetricsRegistry:
             self.counter(c)
         for g in PP_GAUGES:
             self.gauge(g)
+        for c in CRIT_CATEGORIES:
+            self.gauge(f"crit/{c}_s")
+            self.gauge(f"crit/{c}_frac")
+        self.counter("crit/steps")
 
     def _get(self, name: str, cls, *args):
         m = self._metrics.get(name)

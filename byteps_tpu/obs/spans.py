@@ -1,0 +1,319 @@
+"""Server-side causal spans + clock alignment (the trace plane).
+
+The PR-12 fleet plane says *how slow* each shard is (p95s, queue
+depths); it cannot say *which key, worker, or hop gated this step*.
+This module records the server side of every round as a structured
+span — the data the critical-path analyzer (``obs/critpath.py``) joins
+against the worker timeline:
+
+  - ``ServerSpanRing``: a bounded flight-style ring of per-(key, round)
+    records — first-arrival timestamp, per-worker arrival ts + bytes
+    (worker = the push dedup token's incarnation id), merge-wait =
+    first→``num_workers``-th arrival gap, and per-pull serve spans
+    (round-block + sum + transcode, ending before the response bytes
+    hit the socket). The homog/fused push path rides the same ring:
+    arrivals are noted at the transport/backend layer, which every
+    codec path passes through. Rounds are derived by ARRIVAL COUNT
+    (``(n-1) // num_workers + 1``): under the exchange's per-key
+    admission gate exactly one round's arrivals are in flight per key,
+    so the count matches the engine's round counter on the sync path
+    (best-effort for async/replayed rounds — this is a diagnostic, not
+    an oracle).
+  - ``ClockEstimator``: NTP-style min-RTT offset estimation over the
+    dedicated stats channel (``OP_TRACE`` responses carry the server's
+    wall clock; offset = server_now − request midpoint, uncertainty =
+    rtt/2; the estimate with the smallest RTT in the window wins).
+    The fleet scraper publishes the result as
+    ``fleet/<shard>/clock_offset_s`` / ``clock_err_s`` and re-bases
+    scraped server spans onto the worker timebase with it.
+
+Like OP_STATS, the scrape is NEVER credit-gated (no payload to gate,
+dedicated channel, no server round-blocks — the three-layer rule,
+docs/observability.md). ``BPS_SERVER_SPANS=0`` disables recording;
+``BPS_STATS=0`` (the master switch) short-circuits it too.
+
+Process-local collection: every ring registers here (weakly), and a
+scraper ``ingest``s re-based remote spans — ``collected()`` is the one
+surface the critical-path analyzer reads, whichever deployment shape
+produced the spans.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import weakref
+from collections import OrderedDict, deque
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..common.config import _TRUE
+from . import metrics as _metrics
+
+SCHEMA = "byteps_tpu.ServerSpans/v1"
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("BPS_SERVER_SPANS", "1").strip().lower() in _TRUE
+
+
+def _env_size() -> int:
+    try:
+        return max(16, int(os.environ.get("BPS_SERVER_SPANS_SIZE",
+                                          "512") or 512))
+    except ValueError:
+        return 512
+
+
+class ServerSpanRing:
+    """Bounded per-server ring of per-(key, round) span records.
+
+    Record shape (times are wall-clock seconds on the SERVER's clock;
+    the scraper re-bases them onto the worker timebase)::
+
+        {"key": k, "round": r,
+         "first_t": s, "arrivals": [{"w": wid, "t": s, "b": bytes}],
+         "complete_t": s | None,          # num_workers-th arrival
+         "serves": [{"t": s, "dur": s}]}  # per-pull round-block+sum
+
+    ``snapshot()`` adds the derived fields ``merge_wait_s``
+    (first→last arrival gap — the straggler signal) and ``queue_s``
+    (last arrival → first serve END — sum + publication latency).
+    """
+
+    def __init__(self, num_workers: int = 1, size: Optional[int] = None,
+                 enabled: Optional[bool] = None) -> None:
+        self.num_workers = max(1, int(num_workers))
+        self._enabled = _env_enabled() if enabled is None else bool(enabled)
+        self._cap = _env_size() if size is None else max(16, int(size))
+        self._lock = threading.Lock()
+        self._rounds: "OrderedDict[Tuple[int, int], dict]" = OrderedDict()
+        self._counts: Dict[int, int] = {}     # key -> applied arrivals
+        register_ring(self)
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled and _metrics.metrics_enabled()
+
+    def configure(self, enabled: Optional[bool] = None) -> None:
+        """Re-resolve ``BPS_SERVER_SPANS`` (or force)."""
+        self._enabled = _env_enabled() if enabled is None else bool(enabled)
+
+    def _rec(self, key: int, rnd: int) -> dict:
+        """Record for (key, rnd), creating + bounding (caller locks)."""
+        rk = (key, rnd)
+        rec = self._rounds.get(rk)
+        if rec is None:
+            rec = self._rounds[rk] = {
+                "key": int(key), "round": int(rnd), "first_t": None,
+                "arrivals": [], "complete_t": None, "serves": []}
+            while len(self._rounds) > self._cap:
+                self._rounds.popitem(last=False)
+        return rec
+
+    def note_arrival(self, key: int, wid: int, nbytes: int) -> None:
+        """One APPLIED push landed for ``key`` (dedup duplicates are the
+        caller's job to filter — ``_apply_push_once`` reports them)."""
+        if not self.enabled:
+            return
+        t = time.time()
+        with self._lock:
+            n = self._counts.get(key, 0) + 1
+            self._counts[key] = n
+            rnd = (n - 1) // self.num_workers + 1
+            rec = self._rec(key, rnd)
+            if rec["first_t"] is None:
+                rec["first_t"] = t
+            rec["arrivals"].append({"w": int(wid), "t": t,
+                                    "b": int(nbytes)})
+            if len(rec["arrivals"]) >= self.num_workers:
+                rec["complete_t"] = t
+
+    def note_serve(self, key: int, rnd: int, t0: float,
+                   dur_s: float) -> None:
+        """One pull of (key, rnd) was served: ``t0``→``t0+dur`` covers
+        the round-block + sum + transcode (the response's socket write
+        happens after). ``rnd == 0`` (async latest) attaches to the
+        key's newest round record."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if not rnd:
+                n = self._counts.get(key, 0)
+                if n <= 0:
+                    return
+                rnd = (n - 1) // self.num_workers + 1
+            rec = self._rec(key, int(rnd))
+            rec["serves"].append({"t": float(t0),
+                                  "dur": round(float(dur_s), 6)})
+
+    def snapshot(self, keys: Optional[Iterable[int]] = None) -> List[dict]:
+        """Copies of the records (oldest first) with the derived
+        ``merge_wait_s`` / ``queue_s`` fields, optionally filtered."""
+        with self._lock:
+            recs = [dict(r, arrivals=list(r["arrivals"]),
+                         serves=list(r["serves"]))
+                    for r in self._rounds.values()]
+        if keys is not None:
+            ks = {int(k) for k in keys}
+            recs = [r for r in recs if r["key"] in ks]
+        for r in recs:
+            if r["complete_t"] is not None and r["first_t"] is not None:
+                r["merge_wait_s"] = round(r["complete_t"] - r["first_t"], 6)
+            if r["complete_t"] is not None and r["serves"]:
+                s0 = min(r["serves"], key=lambda s: s["t"])
+                r["queue_s"] = round(
+                    max(0.0, s0["t"] + s0["dur"] - r["complete_t"]), 6)
+        return recs
+
+    def payload(self, now: Optional[float] = None) -> dict:
+        """The OP_TRACE response body (``now`` = the server's wall
+        clock at serve time — the clock-alignment sample)."""
+        return {"schema": SCHEMA,
+                "now": time.time() if now is None else float(now),
+                "num_workers": self.num_workers,
+                "spans": self.snapshot()}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rounds.clear()
+            self._counts.clear()
+
+
+# --------------------------------------------------- clock alignment
+
+class ClockEstimator:
+    """Min-RTT NTP-style offset estimation per shard.
+
+    One probe: the client stamps ``t_send``/``t_recv`` around an
+    OP_TRACE roundtrip whose response carries the server's ``now``;
+    ``offset = now − (t_send + t_recv)/2`` with uncertainty ``rtt/2``
+    (the server could have stamped anywhere inside the roundtrip).
+    The estimate from the SMALLEST-RTT probe in the window wins —
+    queueing delay only ever inflates RTT, so the tightest roundtrip
+    carries the least-skewed midpoint (classic NTP reasoning)."""
+
+    def __init__(self, window: int = 64) -> None:
+        self._probes: Dict[str, deque] = {}
+        self._lock = threading.Lock()
+        self._window = max(1, int(window))
+
+    def probe(self, label: str, t_send: float, t_recv: float,
+              server_now: Optional[float]
+              ) -> Optional[Tuple[float, float]]:
+        """Fold one roundtrip in; returns the shard's current best
+        (offset_s, err_s), or None without a usable sample."""
+        if server_now is None or t_recv < t_send:
+            return self.offset(label)
+        rtt = t_recv - t_send
+        off = float(server_now) - (t_send + t_recv) / 2.0
+        with self._lock:
+            dq = self._probes.setdefault(
+                label, deque(maxlen=self._window))
+            dq.append((rtt, off))
+        return self.offset(label)
+
+    def offset(self, label: str) -> Optional[Tuple[float, float]]:
+        """(offset_s, err_s) from the min-RTT probe in the window."""
+        with self._lock:
+            dq = self._probes.get(label)
+            if not dq:
+                return None
+            rtt, off = min(dq)
+        return off, rtt / 2.0
+
+
+def rebase(spans: List[dict], offset_s: float) -> List[dict]:
+    """Re-base server span records onto the WORKER timebase:
+    ``worker_t = server_t − offset`` for every timestamp field
+    (offset = server clock − worker clock, per ``ClockEstimator``)."""
+    if not offset_s:
+        return [dict(r) for r in spans]
+    out = []
+    for r in spans:
+        nr = dict(r)
+        for f in ("first_t", "complete_t"):
+            if nr.get(f) is not None:
+                nr[f] = nr[f] - offset_s
+        nr["arrivals"] = [dict(a, t=a["t"] - offset_s)
+                          for a in r.get("arrivals", ())]
+        nr["serves"] = [dict(s, t=s["t"] - offset_s)
+                        for s in r.get("serves", ())]
+        out.append(nr)
+    return out
+
+
+# -------------------------------------- process-local span collection
+
+_RINGS: "weakref.WeakSet" = weakref.WeakSet()
+_INGESTED: Dict[str, List[dict]] = {}
+_INGEST_LOCK = threading.Lock()
+
+
+def register_ring(ring: ServerSpanRing) -> None:
+    """Every ring self-registers so in-process rigs (colocated server,
+    HostPSBackend) feed the analyzer without any scrape."""
+    _RINGS.add(ring)
+
+
+def ingest(label: str, spans: List[dict]) -> None:
+    """Store a shard's scraped spans (ALREADY re-based onto this
+    worker's timebase) for local consumption — the fleet scraper calls
+    this each trace scrape; last scrape wins per shard."""
+    with _INGEST_LOCK:
+        _INGESTED[label] = list(spans)
+
+
+def clear_ingested() -> None:
+    with _INGEST_LOCK:
+        _INGESTED.clear()
+
+
+def reset() -> None:
+    """Forget every registered ring and ingested batch (tests/bench
+    arms — a previous rig's rings must not leak spans into the next)."""
+    with _INGEST_LOCK:
+        _INGESTED.clear()
+    for ring in list(_RINGS):
+        _RINGS.discard(ring)
+
+
+def collected(keys: Optional[Iterable[int]] = None) -> List[dict]:
+    """Every server span visible to this process, worker timebase:
+    scraped (ingested) shards first, then live local rings — deduped by
+    (key, round), scraped records winning (they are offset-corrected,
+    and an in-process TCP rig would otherwise contribute each record
+    twice: once via its local ring, once via the scrape)."""
+    seen = set()
+    out: List[dict] = []
+    with _INGEST_LOCK:
+        batches = [list(v) for v in _INGESTED.values()]
+    for ring in list(_RINGS):
+        batches.append(ring.snapshot(keys=keys))
+    for batch in batches:
+        for r in batch:
+            rk = (r.get("key"), r.get("round"))
+            if rk in seen:
+                continue
+            seen.add(rk)
+            if keys is not None and r.get("key") not in set(keys):
+                continue
+            out.append(r)
+    return out
+
+
+def dump_server_trace(trace_dir: str, label: str, spans: List[dict],
+                      offset_s: float = 0.0) -> str:
+    """Write one shard's spans as ``<trace_dir>/server_<label>.json``
+    (re-based by ``offset_s``) — the file ``obs.merge_trace`` turns
+    into a server process row with worker→server→worker flow arrows."""
+    os.makedirs(trace_dir, exist_ok=True)
+    path = os.path.join(trace_dir, f"server_{label}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"schema": SCHEMA, "shard": label,
+                   "offset_s": offset_s,
+                   "spans": rebase(spans, offset_s)}, f)
+    os.replace(tmp, path)
+    return path

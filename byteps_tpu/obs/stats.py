@@ -95,6 +95,9 @@ class StepStats:
     #   per-layer counters (ps/pull_bytes/<…>, ps/d2h_bytes/<…>, …)
     #   that moved THIS step — per-layer byte movement in the dump
     overlaps: Optional[dict] = None        # overlap_stats(), trace window only
+    crit: Optional[dict] = None            # critpath attribution, trace
+    #   window only: this step's wall split along the blocking chain
+    #   ({categories, fracs, dominant, straggler…} — obs/critpath.py)
 
     def line(self) -> str:
         """The structured one-line-per-step log record."""
@@ -111,6 +114,11 @@ class StepStats:
                 o = self.overlaps.get(k)
                 if o and o.get("overlapped"):
                     parts.append(f"{k}_overlap_ms={o['overlap_ms']}")
+        if self.crit is not None and self.crit.get("dominant"):
+            dom = self.crit["dominant"]
+            parts.append(
+                f"crit={dom}:"
+                f"{(self.crit.get('fracs') or {}).get(dom, 0) * 100:.0f}%")
         return "bps.stats " + " ".join(parts)
 
     def to_dict(self) -> dict:
@@ -127,6 +135,8 @@ class StepStats:
             d["layer_bytes"] = self.layer_bytes
         if self.overlaps is not None:
             d["overlaps"] = self.overlaps
+        if self.crit is not None:
+            d["crit"] = self.crit
         return d
 
 
@@ -160,6 +170,18 @@ class StepStatsEmitter:
                     in _TRUE) or self._file is not None
         self._level = logging.INFO if explicit else logging.DEBUG
         self._steps = 0
+        # slow-step auto-capture (BPS_SLOW_STEP_FACTOR, default off):
+        # a step exceeding K× the rolling median dumps its flight
+        # postmortem + critpath attribution ONCE, rate-limited — the
+        # wedge-free cousin of the watchdog (a slow step finishes, so
+        # the watchdog never fires; this names why it was slow)
+        try:
+            self._slow_factor = float(
+                os.environ.get("BPS_SLOW_STEP_FACTOR", "0") or 0)
+        except ValueError:
+            self._slow_factor = 0.0
+        self._slow_next = 0.0          # monotonic rate-limit gate
+        self._slow_min_gap_s = 60.0
         # separate warn-once flags: an emission hiccup must not silence
         # the dump path's first real failure (or vice versa)
         self._warned_step = False
@@ -211,6 +233,7 @@ class StepStatsEmitter:
                        for n, v in cur_bytes.items()
                        if v > prev_bytes.get(n, 0)} or None
         overlaps = None
+        crit = None
         if timeline is not None and getattr(timeline, "enabled", False) \
                 and timeline._active():
             snap = timeline.snapshot()
@@ -225,6 +248,21 @@ class StepStatsEmitter:
                 from ..telemetry import _step_of
                 newest = max(_step_of(e) for e in snap)
                 overlaps = overlap_stats(snap, wall_s, step=newest)
+                # critical-path attribution for the same step (the
+                # blocking-chain blame split, obs/critpath.py) — an
+                # enrichment; its failure must not cost the step record
+                try:
+                    from . import critpath as _critpath
+                    crit = _critpath.step_attribution(
+                        snap, newest, getattr(timeline, "_t0", 0.0))
+                    _critpath.publish(crit)
+                except Exception as e:   # noqa: BLE001 — see above
+                    if not getattr(self, "_warned_crit", False):
+                        self._warned_crit = True
+                        self._log.warning(
+                            "critpath attribution failed (%s: %s) — "
+                            "still attempted each traced step, further "
+                            "failures are silent", type(e).__name__, e)
         # float() of a jax scalar costs ~0.5 ms even when the value is
         # ready — convert only when something will consume it (the log
         # line fires, or the rolling dump is armed); the silent
@@ -240,9 +278,12 @@ class StepStatsEmitter:
         st = StepStats(
             step=step, wall_s=wall_s, loss=loss, samples=samples,
             sps=(samples / wall_s if samples and wall_s > 0 else None),
-            stages=stages, layer_bytes=layer_bytes, overlaps=overlaps)
+            stages=stages, layer_bytes=layer_bytes, overlaps=overlaps,
+            crit=crit)
         reg.histogram("step/wall_s").observe(wall_s)
         reg.counter("step/count").inc()
+        if self._slow_factor > 0:
+            self._maybe_capture_slow(st)
         if self._log.isEnabledFor(self._level):
             self._log.log(self._level, "%s", st.line())
         with self._lock:
@@ -252,6 +293,46 @@ class StepStatsEmitter:
         if due:
             self.flush()
         return st
+
+    def _maybe_capture_slow(self, st: StepStats) -> None:
+        """Slow-step auto-capture: when this step's wall exceeds
+        ``BPS_SLOW_STEP_FACTOR`` × the rolling median, dump the flight
+        postmortem + critpath attribution once at WARNING, rate-limited
+        (one dump per minute at most) — a postmortem without attaching
+        a debugger, for the step that was slow but not stuck. Called
+        BEFORE this step joins ``recent``, so the median is the
+        baseline the outlier is judged against, never diluted by it."""
+        import statistics
+        import time as _time
+        with self._lock:
+            walls = [s.wall_s for s in self.recent][-64:]
+        if len(walls) < 8:
+            return                      # no baseline yet
+        med = statistics.median(walls)
+        if med <= 0 or st.wall_s <= self._slow_factor * med:
+            return
+        now = _time.monotonic()
+        if now < self._slow_next:
+            return                      # rate-limited
+        self._slow_next = now + self._slow_min_gap_s
+        from . import flight
+        msg = (f"slow step {st.step}: wall {st.wall_s * 1e3:.1f}ms > "
+               f"{self._slow_factor:g}x rolling median "
+               f"{med * 1e3:.1f}ms (BPS_SLOW_STEP_FACTOR)")
+        if st.crit is not None:
+            keep = {k: st.crit.get(k)
+                    for k in ("window_s", "categories", "fracs",
+                              "dominant", "straggler")
+                    if st.crit.get(k) is not None}
+            msg += "\ncritpath attribution: " + json.dumps(keep)
+        else:
+            msg += ("\n(no critpath attribution — the step is outside "
+                    "a trace window; set BPS_TRACE_ON + window to get "
+                    "the blame split)")
+        pm = flight.get_recorder().format_postmortem(last=60)
+        if pm:
+            msg += "\n" + pm
+        self._log.warning("%s", msg)
 
     def flush(self) -> None:
         """Dump the rolling window to ``BPS_STATS_FILE`` (atomic).

@@ -449,6 +449,13 @@ class HostPSBackend:
         self._qd_next_sample = 0.0
         import time as _time
         self._t0_mono = _time.monotonic()   # heartbeat base for stats()
+        # causal span ring (obs/spans.py): per-(key, round) arrival +
+        # serve records for the critical-path analyzer. In-process
+        # callers carry no dedup token, so the worker id is 0; a
+        # fronting PSTransportServer reuses THIS ring (and skips its
+        # own recording) so colocated rigs never double-count.
+        from ..obs.spans import ServerSpanRing
+        self.spans = ServerSpanRing(num_workers=num_workers)
 
     def close(self) -> None:
         for s in self.servers:
@@ -543,6 +550,7 @@ class HostPSBackend:
             self._homog.ingest_dense(key, data)
         else:
             self._shard(key).push(key, data)
+        self.spans.note_arrival(key, 0, data.nbytes)
         # server-side backlog: how far the summation engine is behind
         # the pushes (the reference's engine_load). RATE-LIMITED — the
         # sample is engine_threads locked ctypes calls per shard, and a
@@ -589,6 +597,16 @@ class HostPSBackend:
                 up, len(self._key_meta), queue_depth_fn=qd)
         return out
 
+    def trace(self, timeout_ms: int = 0) -> Dict[str, dict]:
+        """In-process form of the causal trace scrape (one shared ring
+        across shards — see ``spans``): the shape ``RemotePSBackend
+        .trace()`` returns, with a zero-width roundtrip (same process,
+        same clock — offset estimates to ~0 by construction)."""
+        import time as _time
+        now = _time.time()
+        return {"s0": {"payload": self.spans.payload(now=now),
+                       "t_send": now, "t_recv": now}}
+
     def pull(self, key: int, out: np.ndarray, round: int = 0,
              timeout_ms: int = 30000) -> None:
         import time
@@ -596,6 +614,7 @@ class HostPSBackend:
             t0 = time.time()
             self._homog.pull_dense(key, out, round, timeout_ms)
             self._m_pull_wait.observe(time.time() - t0)
+            self.spans.note_serve(key, round, t0, time.time() - t0)
             return
         t0 = time.time()
         base = self._round_base.get(key, 0)
@@ -613,6 +632,7 @@ class HostPSBackend:
         # how long the merge took to publish from this worker's view —
         # server sum time plus the wait for the other workers' pushes
         self._m_pull_wait.observe(time.time() - t0)
+        self.spans.note_serve(key, round, t0, time.time() - t0)
 
     def round(self, key: int) -> int:
         """Latest COMPLETED sync round for ``key`` (0 = none yet) — lets
@@ -683,6 +703,11 @@ class HostPSBackend:
     def push_onebit(self, key: int, payload) -> None:
         """Native onebit push on the key's shard (see PSServer)."""
         self._shard(key).push_onebit(key, payload)
+        # every codec path notes its arrival, or the ring's
+        # count-derived rounds shear on keys that mix dense and
+        # compressed rounds (the serve of round r would be joined
+        # against an earlier round's arrivals)
+        self.spans.note_arrival(key, 0, len(payload))
 
     def pull_onebit(self, key: int, payload_nbytes: int, round: int = 0,
                     timeout_ms: int = 30000,
@@ -693,6 +718,7 @@ class HostPSBackend:
     def push_topk(self, key: int, payload) -> None:
         """Native topk push on the key's shard (see PSServer)."""
         self._shard(key).push_topk(key, payload)
+        self.spans.note_arrival(key, 0, len(payload))   # see push_onebit
 
     def pull_topk(self, key: int, payload_nbytes: int, round: int = 0,
                   timeout_ms: int = 30000) -> bytes:
@@ -704,6 +730,7 @@ class HostPSBackend:
         engine (reference: decompress before SUM_RECV, server.cc:86-113)."""
         from .compressed import compressed_push
         compressed_push(self.compressed, self._shard(key), key, payload)
+        self.spans.note_arrival(key, 0, len(payload))   # see push_onebit
 
     def push_fused(self, key: int, payload) -> None:
         """Fused-plane push (byteps_tpu.compress): the payload is
@@ -716,6 +743,7 @@ class HostPSBackend:
         from ..compress import wire
         if self._homog_managed(key):
             self._homog.ingest(key, payload)
+            self.spans.note_arrival(key, 0, len(payload))
             return
         dense = wire.decode_for_store(payload, self._key_meta.get(key))
         if wire.lossy(wire.peek(payload)[0]):   # `none` frames are a
@@ -769,9 +797,13 @@ class HostPSBackend:
                    timeout_ms: int = 30000) -> bytes:
         """Compressed pull: merged dense round recompressed once, served
         byte-identical to every worker."""
+        import time as _time
         from .compressed import compressed_pull
-        return compressed_pull(self.compressed, self._shard(key), key,
-                               round, timeout_ms)
+        t0 = _time.time()
+        out = compressed_pull(self.compressed, self._shard(key), key,
+                              round, timeout_ms)
+        self.spans.note_serve(key, round, t0, _time.time() - t0)
+        return out
 
     def push_rowsparse(self, key: int, idx, rows, dense_nbytes: int,
                        dtype=None) -> None:
@@ -782,6 +814,8 @@ class HostPSBackend:
         from .rowsparse import rowsparse_push
         rowsparse_push(self._shard(key), key, idx, rows, dense_nbytes,
                        dtype, meta=self._rs_cols)
+        self.spans.note_arrival(
+            key, 0, int(getattr(rows, "nbytes", 0)))    # see push_onebit
 
     def push_pull(self, key: int, data: np.ndarray,
                   timeout_ms: int = 30000) -> np.ndarray:

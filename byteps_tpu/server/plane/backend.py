@@ -236,6 +236,34 @@ class PlanePSBackend:
                 out[label] = {"error": f"{type(e).__name__}: {e}"}
         return out
 
+    def trace(self, timeout_ms: int = 5000) -> Dict[str, dict]:
+        """Causal trace scrape over the plane's shard list (the
+        ``RemotePSBackend.trace()`` shape): remote shard clients answer
+        via OP_TRACE with real roundtrip stamps, backends with a local
+        ring answer in-process, raw PSServer shards (test rigs) have no
+        ring and report an error entry — never an exception."""
+        out: Dict[str, dict] = {}
+        for i, s in enumerate(self._shards):
+            label = f"s{i}"
+            if i in self._dead:
+                out[label] = {"error": "failed over (shard marked dead)"}
+                continue
+            try:
+                if hasattr(s, "trace_shard"):
+                    p, t0, t1 = s.trace_shard(0, timeout_ms)
+                    out[label] = {"payload": p, "t_send": t0,
+                                  "t_recv": t1}
+                elif hasattr(s, "trace"):
+                    sub = s.trace(timeout_ms=timeout_ms)
+                    out[label] = (sub.get("s0")
+                                  or next(iter(sub.values())))
+                else:
+                    out[label] = {"error": "no trace surface "
+                                           "(raw in-process shard)"}
+            except Exception as e:   # noqa: BLE001 — per-shard isolation
+                out[label] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
     # ------------------------------------------------- failover plumbing
 
     def _run(self, key: int, op):

@@ -360,7 +360,7 @@ class _Round:
                 ex._key_rounds.pop(pskey, None)
             raise
         ex._record(self.decl_name, "PS_PUSH", pskey, t0,
-                   step=self.step_tag)
+                   step=self.step_tag, round=self.rounds[idx])
         self.bucket_state[idx] = "pushed"
         ex._mark_progress()
         return buf
@@ -373,7 +373,7 @@ class _Round:
         merged = ex._pull_bucket(pskey, b, buf, self.rounds[idx],
                                  rnd=self, idx=idx)
         t0 = ex._record(self.decl_name, "PS_PULL", pskey, t0,
-                        step=self.step_tag)
+                        step=self.step_tag, round=self.rounds[idx])
         if ex._native_pack and merged.flags["C_CONTIGUOUS"]:
             item = np.dtype(b.dtype).itemsize
             from .engine import unpack_segments
@@ -952,16 +952,20 @@ class PSGradientExchange:
         return [g for g in groups if g]
 
     def _record(self, name: str, stage: str, key: int, t0: float,
-                step: Optional[int] = None) -> float:
+                step: Optional[int] = None,
+                round: Optional[int] = None) -> float:
         """Timeline + stage-histogram helper; returns a fresh t0. The
         histogram observation is ALWAYS on (the latency distributions
         are the production signal); the timeline event only inside a
-        trace window."""
+        trace window. ``round`` tags wire spans (PS_PUSH/PS_PULL) with
+        their PS round so the merged trace / critical-path analyzer
+        joins them against the server's (key, round) span records."""
         import time
         now = time.time()
         observe_stage(stage, now - t0)
         if self.timeline is not None:
-            self.timeline.record(name, stage, t0, now - t0, key, step=step)
+            self.timeline.record(name, stage, t0, now - t0, key,
+                                 step=step, round=round)
         return now
 
     def _next_round(self, pskey: int) -> int:

@@ -143,11 +143,29 @@ class SendScheduler:
                 "key": key, "prio": prio, "nbytes": t.nbytes,
                 "enq_seq": t.seq, "admit_seq": self._admit_seq,
                 "wait_s": waited, "overtook": overtook,
+                # wall-clock ADMIT stamp: the credit wait occupied
+                # [t - wait_s, t] — the interval the critical-path
+                # analyzer subtracts out of PS_PUSH spans as "credit"
+                "t": time.time(),
             })
         (self._m_act if klass == CLASS_ACT else self._m_grad).inc()
         if overtook:
             self._m_overtakes.inc()
         self._m_wait.observe(waited)
+        # flight-recorder send-admission event, KEY-LESS like the codec
+        # decisions (obs/flight.py): the admission ordering is context
+        # for EVERY key's postmortem — a frame that waited did so
+        # because of some OTHER key's burst, so filtering it out of
+        # that key's dump would hide exactly the why. The enabled check
+        # comes FIRST: with the recorder off the per-frame cost must
+        # stay one attribute read, not an f-string build.
+        from ..obs import flight
+        if flight.get_recorder().enabled:
+            flight.record(
+                "send_admit", nbytes=t.nbytes,
+                detail=f"class={'act' if klass == CLASS_ACT else 'grad'} "
+                       f"key={key} prio={prio} wait_ms={waited * 1e3:.1f} "
+                       f"overtook={overtook}")
         return t
 
     def release(self, ticket: Optional[_Ticket]) -> None:

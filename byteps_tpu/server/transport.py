@@ -166,6 +166,13 @@ OP_STATS = 23
 # re-publishing from seq 0 (which would strand every non-owner blocked
 # on the real next seq). Response payload = u64 seq (0 = empty).
 OP_PARAM_SEQ = 24
+# Causal trace plane (byteps_tpu.obs.spans): serve this server's
+# per-(key, round) span ring — first arrival, per-worker arrival
+# ts+bytes, merge-wait, per-pull serve spans — plus the server's wall
+# clock ``now`` (the NTP-style clock-alignment sample). Same contract
+# as OP_STATS: no payload, reuse-safe, NEVER credit-gated, scraped on
+# the dedicated stats channel so a wedged data plane cannot starve it.
+OP_TRACE = 25
 _PART = struct.Struct("!IIHHQ")  # offset, part_len, part_idx, nparts, nonce
 ST_OK, ST_ERR, ST_TIMEOUT, ST_GONE = 0, 1, 2, 3
 
@@ -552,6 +559,18 @@ class PSTransportServer:
         self._t0_mono = time.monotonic()
         self._t0_wall = time.time()
         self._n_requests = 0
+        # causal span ring (obs/spans.py, OP_TRACE): per-(key, round)
+        # arrival/serve records. A backend with its OWN ring
+        # (HostPSBackend) records internally — this layer then only
+        # serves it, never double-notes the same push into two rings.
+        from ..obs.spans import ServerSpanRing
+        ring = getattr(backend, "spans", None)
+        self._own_spans = ring is None
+        self.spans = ring if ring is not None else ServerSpanRing(
+            num_workers=getattr(backend, "num_workers", 1))
+        # the clock-alignment sample source — an attribute so skew
+        # tests (and one day a chaos rig) can inject a stepped clock
+        self._trace_now = time.time
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -638,8 +657,9 @@ class PSTransportServer:
                 meta = self._key_meta.get(key)
                 if meta is not None and meta[1] != dtype:
                     arr = arr.astype(meta[1])
-                self._apply_push_once(
-                    key, rnd, lambda: self._fb.push(key, arr))
+                self._note_push(self._apply_push_once(
+                    key, rnd, lambda: self._fb.push(key, arr)),
+                    key, rnd, len(payload))
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PULL:
                 out = self._pull_dense(key, rnd, nbytes, dtype, timeout)
@@ -655,18 +675,21 @@ class PSTransportServer:
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PUSH_C:
                 from .compressed import compressed_push
-                self._apply_push_once(
+                plen_c = len(payload)
+                self._note_push(self._apply_push_once(
                     key, rnd,
                     lambda: compressed_push(self.compressed, self.backend,
-                                            key, payload))
+                                            key, payload)),
+                    key, rnd, plen_c)
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PUSH_F:
                 # payload stays ENCODED through the front: managed keys
                 # buffer it for the homogeneous merge (no dense decode
                 # on this path), unmanaged keys decode into the engine
                 pay = bytes(payload)
-                self._apply_push_once(
-                    key, rnd, lambda: self._fb.push_fused(key, pay))
+                self._note_push(self._apply_push_once(
+                    key, rnd, lambda: self._fb.push_fused(key, pay)),
+                    key, rnd, len(pay))
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PULL_F:
                 from ..compress import wire as cwire
@@ -683,6 +706,9 @@ class PSTransportServer:
                 # merge wait + the slowest worker's push lag; cache
                 # hits observe ~0 and don't skew the histogram
                 self._m_merge_wait.observe(time.time() - t0)
+                if self._own_spans:
+                    self.spans.note_serve(key, int(rnd), t0,
+                                          time.time() - t0)
                 if self._key_log:
                     from ..common.logging import get_logger
                     get_logger().info(
@@ -693,11 +719,13 @@ class PSTransportServer:
             elif op == OP_PUSH_RS:
                 from .rowsparse import rowsparse_push, unpack_rows
                 idx, rows = unpack_rows(payload, dtype)
-                self._apply_push_once(
+                plen_rs = len(payload)
+                self._note_push(self._apply_push_once(
                     key, rnd,
                     lambda: rowsparse_push(self.backend, key, idx, rows,
                                            int(nbytes), dtype,
-                                           meta=self._rs_cols))
+                                           meta=self._rs_cols)),
+                    key, rnd, plen_rs)
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_ROUND:
                 rv = struct.pack("!Q", int(self._fb.round(key)))
@@ -705,18 +733,23 @@ class PSTransportServer:
             elif op == OP_PUSH_SHM:
                 view = self._shm.view(bytes(payload).decode(), int(nbytes))
                 data = np.frombuffer(view, dtype=dtype)
-                self._apply_push_once(key, rnd,
-                                      lambda: self._fb.push(key, data))
+                self._note_push(self._apply_push_once(
+                    key, rnd, lambda: self._fb.push(key, data)),
+                    key, rnd, int(nbytes))
                 del data, view   # release the buffer before reuse/unlink
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PULL_SHM:
                 view = self._shm.view(bytes(payload).decode(), int(nbytes))
                 out = np.frombuffer(view, dtype=dtype)
+                t0 = time.time()
                 try:
                     self._fb.pull(key, out, round=int(rnd),
                                   timeout_ms=int(timeout) or 30000)
                 finally:
                     del out, view
+                if self._own_spans:
+                    self.spans.note_serve(key, int(rnd), t0,
+                                          time.time() - t0)
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PUSH_PART:
                 off, plen_, idx, nparts, _ = _PART.unpack(
@@ -750,8 +783,9 @@ class PSTransportServer:
                     meta = self._key_meta.get(key)
                     if meta is not None and meta[1] != dtype:
                         arr = arr.astype(meta[1])
-                    self._apply_push_once(
-                        key, rnd, lambda: self.backend.push(key, arr))
+                    self._note_push(self._apply_push_once(
+                        key, rnd, lambda: self.backend.push(key, arr)),
+                        key, rnd, int(nbytes))
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_PULL_PART:
                 off, plen_, idx, nparts, nonce = _PART.unpack(
@@ -844,6 +878,11 @@ class PSTransportServer:
                 body = _json.dumps(self.stats_payload()).encode()
                 conn.sendall(_RSP.pack(ST_OK, len(body)))
                 conn.sendall(body)
+            elif op == OP_TRACE:
+                import json as _json
+                body = _json.dumps(self.trace_payload()).encode()
+                conn.sendall(_RSP.pack(ST_OK, len(body)))
+                conn.sendall(body)
             elif op == OP_PULL_C:
                 from .compressed import compressed_pull
                 buf = compressed_pull(self.compressed, self.backend, key,
@@ -870,6 +909,24 @@ class PSTransportServer:
             else:   # backend rejections (bad length, key, …)
                 msg = f"{type(e).__name__}: {e}".encode()[:4096]
                 conn.sendall(_RSP.pack(ST_ERR, len(msg)) + msg)
+
+    def _note_push(self, applied: bool, key: int, rnd: int,
+                   nbytes: int) -> None:
+        """One data-plane push reached the store: record the arrival in
+        the span ring (dedup duplicates — ``applied=False`` — are NOT
+        arrivals; counting them would shear the count-derived round
+        attribution). The worker id is the push dedup token's
+        incarnation (``rnd >> 32``; 0 for tokenless/legacy frames).
+        Skipped when the backend runs its own ring (it noted already)."""
+        if applied and self._own_spans:
+            self.spans.note_arrival(key, rnd >> 32, nbytes)
+
+    def trace_payload(self) -> dict:
+        """The OP_TRACE response body: the span ring + this server's
+        wall clock (``now`` — the clock-alignment sample the client
+        midpoints against its own send/recv stamps). Reads only
+        already-published state, like ``stats_payload``."""
+        return self.spans.payload(now=self._trace_now())
 
     def _replica_store(self):
         if self._replica is None:
@@ -937,6 +994,8 @@ class PSTransportServer:
         # server-side merge wait: sum time + the lag of the slowest
         # worker's push — the transport server's bottleneck signal
         self._m_merge_wait.observe(time.time() - t0)
+        if self._own_spans:
+            self.spans.note_serve(key, int(rnd), t0, time.time() - t0)
         return out
 
     _STRIPE_TTL_SECS = 120.0
@@ -956,9 +1015,12 @@ class PSTransportServer:
                       and ("ev" not in st or st["ev"].is_set())]:
                 del d[k]
 
-    def _apply_push_once(self, key: int, rnd: int, apply_fn) -> None:
-        """Run ``apply_fn`` exactly once per dedup token. Tokenless pushes
-        (rnd=0: legacy frames, raw clients) apply unconditionally. A
+    def _apply_push_once(self, key: int, rnd: int, apply_fn) -> bool:
+        """Run ``apply_fn`` exactly once per dedup token; returns True
+        when THIS call applied the payload (False = dedup hit — the
+        span ring must not count a retried frame as a second arrival).
+        Tokenless pushes (rnd=0: legacy frames, raw clients) apply
+        unconditionally. A
         duplicate of an APPLIED seq is acknowledged without re-applying; a
         duplicate racing the original's in-flight apply (conn reset
         mid-sum + instant redial) WAITS for that apply's outcome — ack if
@@ -970,7 +1032,7 @@ class PSTransportServer:
         push lost mid-apply (that stalls the round loudly instead)."""
         if not rnd:
             apply_fn()
-            return
+            return True
         ident = (key, rnd >> 32)
         seq = rnd & 0xFFFFFFFF
         now = time.time()
@@ -987,7 +1049,7 @@ class PSTransportServer:
             while True:
                 if st.is_applied(seq):
                     st.ts = now
-                    return                        # duplicate, already applied
+                    return False                  # duplicate, already applied
                 if seq not in st.claims:
                     st.claims.add(seq)            # we own the apply
                     break
@@ -1006,6 +1068,7 @@ class PSTransportServer:
             st.ts = time.time()
             st.claims.discard(seq)
             self._push_cv.notify_all()
+        return True
 
     def _serve_conn(self, conn: socket.socket) -> None:
         rholder = [bytearray()]  # reused across this connection's frames
@@ -1860,10 +1923,12 @@ class RemotePSBackend:
     # to gate), and on a dedicated per-shard channel so a wedged data
     # plane cannot starve telemetry.
 
-    def stats_shard(self, i: int, timeout_ms: int = 5000) -> dict:
-        """One shard's OP_STATS scrape; raises on an unreachable shard
-        (the aggregate ``stats()`` folds that into an error entry —
-        the scraper's staleness machinery owns the retry cadence)."""
+    def _stats_rpc(self, i: int, op: int,
+                   timeout_ms: int) -> Tuple[dict, float, float]:
+        """One telemetry roundtrip (OP_STATS/OP_TRACE) on shard ``i``'s
+        dedicated channel; returns (payload, t_send, t_recv) — the
+        send/recv wall stamps bracket the roundtrip for the NTP-style
+        clock-offset midpoint (obs.spans.ClockEstimator)."""
         import json as _json
 
         # client-side SOCKET timeout, not just the frame field: a
@@ -1881,8 +1946,10 @@ class RemotePSBackend:
                 if ch.sock is None:
                     ch.sock = self._dial(i)
                 ch.sock.settimeout(sock_to)
-                data = self._roundtrip(ch.sock, OP_STATS, 0, 0, 0,
+                t_send = time.time()
+                data = self._roundtrip(ch.sock, op, 0, 0, 0,
                                        timeout_ms, "uint8", None)
+                t_recv = time.time()
             except (ConnectionError, OSError):
                 # ONE redial, then fail loudly: a scrape is cheap and
                 # periodic — burning the full reconnect budget here
@@ -1896,9 +1963,17 @@ class RemotePSBackend:
                         pass
                 ch.sock = self._dial(i)
                 ch.sock.settimeout(sock_to)
-                data = self._roundtrip(ch.sock, OP_STATS, 0, 0, 0,
+                t_send = time.time()
+                data = self._roundtrip(ch.sock, op, 0, 0, 0,
                                        timeout_ms, "uint8", None)
-            return _json.loads(bytes(data).decode())
+                t_recv = time.time()
+            return _json.loads(bytes(data).decode()), t_send, t_recv
+
+    def stats_shard(self, i: int, timeout_ms: int = 5000) -> dict:
+        """One shard's OP_STATS scrape; raises on an unreachable shard
+        (the aggregate ``stats()`` folds that into an error entry —
+        the scraper's staleness machinery owns the retry cadence)."""
+        return self._stats_rpc(i, OP_STATS, timeout_ms)[0]
 
     def stats(self, timeout_ms: int = 5000) -> Dict[str, dict]:
         """{shard label: OP_STATS payload} for EVERY shard. Unreachable
@@ -1909,6 +1984,26 @@ class RemotePSBackend:
         for i in range(len(self._addrs)):
             try:
                 out[f"s{i}"] = self.stats_shard(i, timeout_ms)
+            except Exception as e:   # noqa: BLE001 — per-shard isolation
+                out[f"s{i}"] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
+    def trace_shard(self, i: int,
+                    timeout_ms: int = 5000) -> Tuple[dict, float, float]:
+        """One shard's OP_TRACE scrape on the dedicated stats channel:
+        (ServerSpans payload, t_send, t_recv). The wall stamps bracket
+        the roundtrip — the clock-offset probe's raw material."""
+        return self._stats_rpc(i, OP_TRACE, timeout_ms)
+
+    def trace(self, timeout_ms: int = 5000) -> Dict[str, dict]:
+        """{shard label: {"payload", "t_send", "t_recv"}} for every
+        shard (``{"error": …}`` for unreachable ones) — the causal
+        span + clock-alignment scrape the fleet scraper drives."""
+        out: Dict[str, dict] = {}
+        for i in range(len(self._addrs)):
+            try:
+                p, t0, t1 = self.trace_shard(i, timeout_ms)
+                out[f"s{i}"] = {"payload": p, "t_send": t0, "t_recv": t1}
             except Exception as e:   # noqa: BLE001 — per-shard isolation
                 out[f"s{i}"] = {"error": f"{type(e).__name__}: {e}"}
         return out

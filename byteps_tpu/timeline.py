@@ -99,12 +99,17 @@ class Timeline:
             self.flush()
 
     def record(self, name: str, stage: str, start_s: float, dur_s: float,
-               key: int = 0, step: Optional[int] = None) -> None:
+               key: int = 0, step: Optional[int] = None,
+               round: Optional[int] = None) -> None:
         """One complete ('X') event, microsecond timestamps like the
         reference (global.cc:489-538). ``step`` overrides the ambient
         step tag — cross-step pipelines record step k's straggler tail
         spans while the timeline has already advanced to k+1, and the
-        per-step overlap aggregates need the true owner."""
+        per-step overlap aggregates need the true owner. ``round`` tags
+        the span with its PS round number (PS_PUSH/PS_PULL) so the
+        merged view and the critical-path analyzer can join it against
+        the server's per-(key, round) span records exactly, instead of
+        pairing positionally."""
         # gate on the event's TRUE owning step, not the ambient one: a
         # cross-step straggler tail records step k's spans after the
         # timeline advanced to k+1 — if k+1 left the trace window, an
@@ -114,11 +119,14 @@ class Timeline:
         if not (self.enabled and self.cfg.trace_start_step <= owner
                 <= self.cfg.trace_end_step):
             return
+        args = {"name": name, "step": owner}
+        if round is not None:
+            args["round"] = int(round)
         with self._lock:
             self._events.append({
                 "name": stage, "ph": "X", "pid": key, "tid": 0,
                 "ts": int((start_s - self._t0) * 1e6), "dur": int(dur_s * 1e6),
-                "args": {"name": name, "step": owner},
+                "args": args,
             })
 
     def span(self, name: str, stage: str, key: int = 0,
@@ -175,5 +183,10 @@ class Timeline:
                 prior = []      # unreadable/torn file: keep new events
             events = prior + events
         with open(path, "w") as f:
-            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+            # metadata.t0_unix_s anchors this rank's relative ts to the
+            # wall clock — merge_trace uses it to place clock-aligned
+            # SERVER span rows on the same axis (docs/observability.md)
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms",
+                       "metadata": {"t0_unix_s": self._t0,
+                                    "rank": rank}}, f)
         self._flushed = True

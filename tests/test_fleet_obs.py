@@ -603,7 +603,13 @@ def test_prometheus_text_golden():
     h.observe(0.005)
     reg.gauge("fleet/s0/server/engine_queue_depth").set(2)
     reg.gauge("fleet/s1/server/engine_queue_depth").set(7)
+    reg.gauge("crit/wire_frac").set(0.62)
+    reg.gauge("fleet/s0/clock_offset_s").set(0.003)
     golden = "\n".join([
+        '# TYPE bps_crit_wire_frac gauge',
+        'bps_crit_wire_frac 0.62',
+        '# TYPE bps_fleet_clock_offset_s gauge',
+        'bps_fleet_clock_offset_s{shard="s0"} 0.003',
         '# TYPE bps_fleet_server_engine_queue_depth gauge',
         'bps_fleet_server_engine_queue_depth{shard="s0"} 2',
         'bps_fleet_server_engine_queue_depth{shard="s1"} 7',
