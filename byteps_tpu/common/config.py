@@ -125,6 +125,14 @@ class Config:
     pp_microbatch: int = 1               # BPS_PP_MICROBATCH: microbatches
                                          # per step driving the 1F1B
                                          # schedule
+    pp_virtual: int = 1                  # BPS_PP_VIRTUAL: virtual model
+                                         # chunks per physical stage —
+                                         # >1 selects the interleaved
+                                         # 1F1B schedule over a
+                                         # P*V-stage program (sub-
+                                         # linear bubbles at depth;
+                                         # needs microbatch % stages
+                                         # == 0)
 
     # --- sharded weight update (ours: byteps_tpu/sharded_update,
     # docs/sharded-update.md) ---
@@ -242,6 +250,7 @@ class Config:
             pp_stages=_env_int("BPS_PP_STAGES", None, 1),
             pp_rank=_env_int("BPS_PP_RANK", None, 0),
             pp_microbatch=_env_int("BPS_PP_MICROBATCH", None, 1),
+            pp_virtual=_env_int("BPS_PP_VIRTUAL", None, 1),
             sharded_update=_env_bool("BPS_SHARDED_UPDATE", None),
             shard_rank=_env_int("BPS_SHARD_RANK", None, -1),
             shard_world=_env_int("BPS_SHARD_WORLD", None, 0),

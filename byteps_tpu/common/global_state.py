@@ -10,6 +10,7 @@ global.cc:431-436).
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Optional
 
@@ -253,6 +254,37 @@ class GlobalState:
                  "host-only" if self.mesh is None else dict(self.mesh.shape),
                  self.dp, config.partition_bytes)
 
+    @staticmethod
+    def _enable_cpu_collectives() -> None:
+        """Multi-process on the CPU backend needs an explicit collectives
+        implementation: jaxlib's CPU client ships with collectives=none
+        and every cross-process computation fails with "Multiprocess
+        computations aren't implemented on the CPU backend" (the root
+        cause of the long-failing tests/test_multiprocess.py pair).
+        jax 0.4.37 has a gloo implementation behind the
+        ``jax_cpu_collectives_implementation`` config — which is NOT
+        read from the environment in this version, so a launcher env
+        contract cannot carry it: it must be set in-process, before the
+        first backend client is created. No-op when the platform is not
+        CPU, the flag is already set, or this jax predates the option."""
+        platforms = (os.environ.get("JAX_PLATFORMS", "")
+                     or getattr(jax.config, "jax_platforms", None) or "")
+        # empty = default resolution, which MAY land on cpu — probing
+        # with jax.default_backend() here would create the very client
+        # the flag must precede, so set it anyway (harmless on TPU:
+        # the option only affects the CPU client's collectives). Skip
+        # only when cpu is EXPLICITLY excluded.
+        if platforms and "cpu" not in str(platforms):
+            return
+        try:
+            cur = jax.config._value_holders[
+                "jax_cpu_collectives_implementation"].value
+            if cur in (None, "none"):    # the do-nothing default
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+        except (KeyError, AttributeError):
+            pass   # older/newer jax: option absent or spelled differently
+
     # -- lifecycle ----------------------------------------------------------
     @classmethod
     def init(cls, config: Optional[Config] = None, mesh=None) -> "GlobalState":
@@ -262,6 +294,7 @@ class GlobalState:
             cfg = config or Config.from_env()
             if (not cfg.host_only and cfg.coordinator_address
                     and cfg.num_processes and cfg.num_processes > 1):
+                cls._enable_cpu_collectives()
                 jax.distributed.initialize(
                     coordinator_address=cfg.coordinator_address,
                     num_processes=cfg.num_processes, process_id=cfg.process_id)

@@ -1,0 +1,10 @@
+"""Launchers: the single-process env resolver (``launch`` /
+``bpslaunch-tpu``) and the L5 fleet orchestrator (``fleet`` — role
+manifests, supervised multi-process local fleets, restart-on-death;
+docs/launcher.md)."""
+
+from .fleet import (FleetManifest, FleetSupervisor, ProcessSpec,
+                    run_command_fleet, run_fleet)
+
+__all__ = ["FleetManifest", "FleetSupervisor", "ProcessSpec",
+           "run_command_fleet", "run_fleet"]

@@ -1,0 +1,737 @@
+"""Fleet orchestration: one-command multi-process training fleets.
+
+The reference ships this as its L5 launcher (``bpslaunch`` spawning
+per-device workers + ``dist_launcher.py`` SSHing servers/schedulers
+across hosts, PAPER.md); until now this repo's launcher exec'd exactly
+ONE process and every multi-process proof hand-rolled its own
+``subprocess.Popen`` choreography. This module is the missing layer:
+
+  - **Role manifest** (``FleetManifest``): a declarative description of
+    the job — P pipeline stages x dp data-parallel replicas x S server
+    plane shards (+ chain replicas), microbatches/virtual chunks, and
+    the training spec — from which the FULL per-process ``BPS_*`` env
+    contract is derived (docs/launcher.md has the role/env table):
+    worker ranks, stage ranks, activation-mailbox ring addresses
+    (``BPS_PP_ACT_ADDRS``, one mailbox per stage per replica), server
+    shard addresses, plane replication, and the round-gate
+    ``BPS_NUM_WORKER``.
+  - **Supervisor** (``FleetSupervisor``): spawns every role as a real
+    OS process over real sockets, captures per-role stdout/stderr to a
+    log directory, watches liveness (process exit + the PR-12 fleet
+    telemetry plane over the servers' never-credit-gated OP_STATS
+    channel), restarts dead roles with backoff up to a restart budget
+    — a restarted WORKER rejoins through the PR-13 elasticity path
+    (``PSGradientExchange`` per-key round counters seed from the
+    server, so it resumes the job's round, not round 1), a dead SERVER
+    shard is absorbed by the plane's chain failover while the
+    supervisor respawns it — and drains the fleet cleanly (workers
+    exit 0 on completion, then servers get SIGTERM).
+  - **One command**: ``python -m byteps_tpu.launcher.fleet --stages 4
+    --dp 2 --shards 2 --steps 5`` (or ``bpslaunch-tpu --fleet ...``)
+    stands the whole thing up locally; ``bench.py fleet`` drives the
+    same manifest for the headline number.
+  - **Command fan-out** (``run_command_fleet``): the generic N-process
+    form — derive the coordinator/rank env for an arbitrary command
+    and supervise it to completion — which tests/test_multiprocess.py
+    and examples/scaling_bench.py ride instead of bespoke Popen loops.
+
+Every role is an ordinary subprocess of THIS machine (the local-fleet
+form the acceptance bench runs); the same manifest prints per-role
+env/argv so an operator can lift it onto k8s/SSH (docs/launcher.md,
+docker/k8s-psjob.yaml).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..common.logging import get_logger
+
+log = get_logger()
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def wait_for_ports(addrs: Sequence[str], timeout_s: float = 30.0,
+                   interval_s: float = 0.05) -> None:
+    """Block until every ``host:port`` accepts a TCP connect — the
+    worker-side readiness gate before dialing a peer mailbox or server
+    shard (a connect-refused here is a supervisor ordering bug, not a
+    dead peer; loud after the timeout)."""
+    deadline = time.monotonic() + timeout_s
+    for addr in addrs:
+        host, port = addr.rsplit(":", 1)
+        while True:
+            try:
+                with socket.create_connection((host, int(port)),
+                                              timeout=1.0):
+                    break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"peer {addr} never came up within "
+                        f"{timeout_s:.0f}s")
+                time.sleep(interval_s)
+
+
+# Topology/bootstrap keys the launcher itself derives. They are
+# STRIPPED from the inherited environment before a role's contract is
+# applied: the manifest owns the FULL topology, so a stale value from
+# the invoking process (a prior job in the same shell, an earlier test
+# in the same pytest process) must never leak into a role — a recycled
+# port behind a stale BPS_SERVER_ADDRS can belong to ANYTHING by spawn
+# time. Tuning knobs (compression, credits, stats, ...) still inherit.
+_TOPOLOGY_KEYS = frozenset({
+    "BPS_ROLE", "BPS_WORKER_ID", "BPS_NUM_WORKER", "BPS_LOCAL_RANK",
+    "BPS_LOCAL_SIZE", "BPS_COORDINATOR_ADDRESS", "BPS_NUM_PROCESSES",
+    "BPS_PROCESS_ID", "BPS_FORCE_DISTRIBUTED", "BPS_ENABLE_PS",
+    "BPS_SERVER_ADDRS", "BPS_SERVER_PORT", "BPS_PLANE_REPLICAS",
+    "BPS_PP_STAGES", "BPS_PP_RANK", "BPS_PP_MICROBATCH",
+    "BPS_PP_VIRTUAL", "BPS_PP_ACT_ADDRS",
+})
+
+
+def _inherited_env() -> Dict[str, str]:
+    return {k: v for k, v in os.environ.items()
+            if k not in _TOPOLOGY_KEYS
+            and not k.startswith("BPS_FLEET_")}
+
+
+# =====================================================================
+# Process specs + manifest
+# =====================================================================
+
+@dataclass
+class ProcessSpec:
+    """One supervised OS process: the full argv + env contract."""
+    name: str                      # unique role instance, e.g. "w-s0r1"
+    role: str                      # "server" | "worker"
+    argv: List[str]
+    env: Dict[str, str]
+    restartable: bool = True       # supervisor may respawn on death
+    expect_exit: bool = False      # exit 0 == job done (workers), vs
+    #                                long-running until drained (servers)
+    group: Optional[str] = None    # co-restart group: one member's
+    #                                death restarts the whole group
+    #                                (a dead pipeline stage wedges its
+    #                                neighbors' blocking recvs)
+
+
+@dataclass
+class FleetManifest:
+    """Declarative fleet shape -> derived ProcessSpecs.
+
+    ``stages`` x ``dp`` worker grid (each stage worker hosts an
+    activation mailbox; replicas of a stage share PS keys), ``shards``
+    standalone reduction servers (wrapped in the managed plane with
+    chain replication when ``plane_replicas`` > 0), and the training
+    spec the built-in fleet worker (launcher/fleet_worker.py) reads
+    from its ``BPS_FLEET_*`` env. ``build()`` allocates ports and
+    freezes the per-process env contract.
+    """
+    stages: int = 1
+    dp: int = 1
+    virtual: int = 1               # BPS_PP_VIRTUAL model chunks/stage
+    micro: int = 4                 # microbatches per step
+    shards: int = 0                # 0 = auto: servers only when needed
+    plane_replicas: int = 0
+    steps: int = 4
+    schedule: str = "1f1b"
+    # training spec (the built-in mlp fleet worker)
+    dim: int = 64
+    depth: int = 8
+    batch: int = 32
+    seed: int = 0
+    host: str = "127.0.0.1"
+    scheduling_credit: int = 0
+    extra_env: Dict[str, str] = field(default_factory=dict)
+    # filled by build()
+    server_addrs: List[str] = field(default_factory=list)
+    act_addrs: List[List[str]] = field(default_factory=list)
+
+    def needs_servers(self) -> bool:
+        return self.dp > 1 or self.shards > 0
+
+    def validate(self) -> None:
+        from ..pipeline.topology import validate_topology
+        validate_topology(self.stages, self.virtual, self.micro)
+        # the worker slices batch // dp rows per replica and splits
+        # THOSE into micro microbatches — validate what it will
+        # actually do, or a bad shape burns the restart budget on a
+        # deterministic step-1 crash (or silently drops rows)
+        if self.batch % self.dp:
+            raise ValueError(f"batch {self.batch} not divisible by "
+                             f"dp {self.dp} (rows would be dropped)")
+        if (self.batch // self.dp) % self.micro:
+            raise ValueError(
+                f"per-replica batch {self.batch // self.dp} not "
+                f"divisible by micro {self.micro}")
+        if self.plane_replicas > 0 and self.shards < 2:
+            raise ValueError("plane replication needs shards >= 2")
+
+    # ------------------------------------------------------------ build
+
+    def build(self) -> List[ProcessSpec]:
+        self.validate()
+        specs: List[ProcessSpec] = []
+        nshards = self.shards if self.shards > 0 else (
+            1 if self.needs_servers() else 0)
+        self.server_addrs = []
+        for i in range(nshards):
+            port = free_port(self.host)
+            self.server_addrs.append(f"{self.host}:{port}")
+            specs.append(ProcessSpec(
+                name=f"srv{i}", role="server",
+                argv=[sys.executable, "-m", "byteps_tpu.launcher.launch",
+                      "--server"],
+                env=self._server_env(port),
+                restartable=True, expect_exit=False))
+        # one activation mailbox per (replica, stage); replica-private
+        # rings — activations never cross replicas
+        self.act_addrs = [[f"{self.host}:{free_port(self.host)}"
+                           for _ in range(self.stages)]
+                          for _ in range(self.dp)]
+        for r in range(self.dp):
+            for s in range(self.stages):
+                specs.append(ProcessSpec(
+                    name=f"w-s{s}r{r}", role="worker",
+                    argv=[sys.executable, "-m",
+                          "byteps_tpu.launcher.fleet_worker"],
+                    env=self._worker_env(s, r),
+                    restartable=True, expect_exit=True,
+                    # a dead stage wedges its ring neighbors' blocking
+                    # recvs: restart the whole replica's stage group
+                    # together (docs/launcher.md failure matrix). Pure
+                    # DP fleets (stages == 1) restart singly — the
+                    # PR-13 per-key reseed path.
+                    group=(f"r{r}" if self.stages > 1 else None)))
+        return specs
+
+    # ----------------------------------------------------- env contracts
+
+    def _base_env(self) -> Dict[str, str]:
+        env = _inherited_env()
+        env.update({
+            "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
+            "BPS_STATS": env.get("BPS_STATS", "1"),
+        })
+        env.update(self.extra_env)
+        return env
+
+    def _server_env(self, port: int) -> Dict[str, str]:
+        env = self._base_env()
+        env.update({
+            "BPS_ROLE": "server",
+            "BPS_SERVER_PORT": str(port),
+            # round gate: each PS key is pushed by the dp replicas of
+            # ONE stage (stage-suffixed declaration names keep stages
+            # disjoint in the keyspace)
+            "BPS_NUM_WORKER": str(self.dp),
+            "BPS_SERVER_ENGINE_THREAD":
+                env.get("BPS_SERVER_ENGINE_THREAD", "2"),
+        })
+        return env
+
+    def _worker_env(self, stage: int, replica: int) -> Dict[str, str]:
+        env = self._base_env()
+        env.update({
+            "BPS_ROLE": "worker",
+            "BPS_WORKER_ID": str(replica),
+            "BPS_NUM_WORKER": str(self.dp),
+            "BPS_PP_STAGES": str(self.stages),
+            "BPS_PP_RANK": str(stage),
+            "BPS_PP_MICROBATCH": str(self.micro),
+            "BPS_PP_VIRTUAL": str(self.virtual),
+            "BPS_PP_ACT_ADDRS": ",".join(self.act_addrs[replica]),
+            "BPS_FLEET_STEPS": str(self.steps),
+            "BPS_FLEET_DIM": str(self.dim),
+            "BPS_FLEET_DEPTH": str(self.depth),
+            "BPS_FLEET_BATCH": str(self.batch),
+            "BPS_FLEET_SEED": str(self.seed),
+            "BPS_FLEET_SCHEDULE": self.schedule,
+        })
+        if self.scheduling_credit:
+            env["BPS_SCHEDULING_CREDIT"] = str(self.scheduling_credit)
+        if self.server_addrs:
+            env["BPS_ENABLE_PS"] = "1"
+            env["BPS_SERVER_ADDRS"] = ",".join(self.server_addrs)
+            if self.plane_replicas > 0:
+                env["BPS_PLANE_REPLICAS"] = str(self.plane_replicas)
+        return env
+
+
+# =====================================================================
+# Supervisor
+# =====================================================================
+
+class _Managed:
+    __slots__ = ("spec", "proc", "log_path", "log_file", "restarts",
+                 "state", "rc", "started_at")
+
+    def __init__(self, spec: ProcessSpec, log_path: str) -> None:
+        self.spec = spec
+        self.proc: Optional[subprocess.Popen] = None
+        self.log_path = log_path
+        self.log_file = None
+        self.restarts = 0
+        self.state = "pending"    # pending|running|done|failed|draining
+        self.rc: Optional[int] = None
+        self.started_at = 0.0
+
+
+class FleetSupervisor:
+    """Spawn, watch, restart, drain.
+
+    Liveness is process-level (``poll``) plus — when the manifest has
+    server shards — the PR-12 telemetry plane: a ``FleetScraper`` over
+    the shards' OP_STATS channel feeds ``status()`` with per-shard
+    up/stale/restart gauges, so a silently-restarted or black-holed
+    server is visible even while its process object still looks alive.
+    Restart policy: an unexpected death (nonzero exit, or any exit of
+    a long-running role) respawns the role — or its whole co-restart
+    ``group`` (pipeline replicas: a dead stage wedges its neighbors'
+    blocking recvs, so the group restarts together and every member
+    re-derives "steps remaining" from the PS plane's round counters,
+    the PR-13 rejoin path) — after ``backoff_s``, up to
+    ``max_restarts`` per role; past the budget the fleet FAILS loudly.
+    ``events`` records every transition for the tests/bench to assert
+    (restart evidence, stall accounting).
+    """
+
+    def __init__(self, specs: Sequence[ProcessSpec],
+                 logdir: Optional[str] = None,
+                 max_restarts: int = 2, backoff_s: float = 0.5,
+                 scrape_addrs: Optional[Sequence[str]] = None,
+                 scrape_sec: float = 0.0,
+                 on_event: Optional[Callable] = None) -> None:
+        names = [s.name for s in specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate role names in manifest: {names}")
+        self.logdir = logdir or tempfile.mkdtemp(prefix="bps-fleet-")
+        os.makedirs(self.logdir, exist_ok=True)
+        self._managed: Dict[str, _Managed] = {
+            s.name: _Managed(s, os.path.join(self.logdir,
+                                             f"{s.name}.log"))
+            for s in specs}
+        self.max_restarts = int(max_restarts)
+        self.backoff_s = float(backoff_s)
+        self.events: List[dict] = []
+        self._on_event = on_event
+        self._scraper = None
+        self._scrape_backend = None
+        if scrape_addrs and scrape_sec > 0:
+            # telemetry-plane liveness: stats-only client (OP_STATS is
+            # served on a dedicated never-credit-gated channel, so this
+            # cannot perturb the data plane), lazy-dialed so a shard
+            # that is still booting reads as down, not a crash here
+            from ..obs.fleet import FleetScraper
+            from ..server.transport import RemotePSBackend
+            self._scrape_backend = RemotePSBackend(
+                list(scrape_addrs), lazy_dial=True)
+            self._scraper = FleetScraper(self._scrape_backend,
+                                         interval_sec=scrape_sec)
+
+    # ------------------------------------------------------------ events
+
+    def _event(self, name: str, kind: str, **detail) -> None:
+        ev = {"t": time.time(), "role": name, "event": kind, **detail}
+        self.events.append(ev)
+        log.info("fleet: %s %s %s", name, kind,
+                 {k: v for k, v in detail.items()} or "")
+        if self._on_event is not None:
+            try:
+                self._on_event(ev)
+            except Exception:   # noqa: BLE001 — observer must not kill us
+                pass
+
+    # ------------------------------------------------------------- spawn
+
+    def start(self) -> "FleetSupervisor":
+        for m in self._managed.values():
+            self._spawn(m)
+        if self._scraper is not None:
+            self._scraper.start()
+        return self
+
+    def _spawn(self, m: _Managed) -> None:
+        # the spec's env (ports included) is FROZEN at build time and
+        # reused across restarts on purpose: peers hold this role's
+        # address (workers redial a respawned server; stage neighbors
+        # redial a respawned mailbox), so a fresh port would strand
+        # every survivor. The cost is a small allocate-to-bind window
+        # where another process can steal the port (EADDRINUSE on
+        # every respawn) — surfaced by the restart-budget failure with
+        # the bind error in the role's log (docs/launcher.md).
+        env = dict(m.spec.env)
+        env["BPS_FLEET_INCARNATION"] = str(m.restarts)
+        m.log_file = open(m.log_path, "ab", buffering=0)
+        m.log_file.write(
+            f"\n--- fleet spawn {m.spec.name} incarnation "
+            f"{m.restarts} ---\n".encode())
+        m.proc = subprocess.Popen(
+            m.spec.argv, env=env, stdout=m.log_file,
+            stderr=subprocess.STDOUT,
+            start_new_session=True)   # own process group: a drain
+        #                               signal never leaks to us
+        m.state = "running"
+        m.rc = None
+        m.started_at = time.monotonic()
+        self._event(m.spec.name, "spawned", pid=m.proc.pid,
+                    incarnation=m.restarts)
+
+    # ------------------------------------------------------- supervision
+
+    def poll_once(self) -> None:
+        """One watch pass: reap exits, apply the restart policy."""
+        dead_groups: Dict[str, List[_Managed]] = {}
+        for m in self._managed.values():
+            if m.state != "running" or m.proc is None:
+                continue
+            rc = m.proc.poll()
+            if rc is None:
+                continue
+            m.rc = rc
+            self._close_log(m)
+            if rc == 0 and m.spec.expect_exit:
+                m.state = "done"
+                self._event(m.spec.name, "done", rc=0)
+                continue
+            # unexpected death (nonzero, or a long-running role exited)
+            self._event(m.spec.name, "died", rc=rc)
+            if not m.spec.restartable:
+                m.state = "failed"
+                continue
+            if m.spec.group is not None:
+                dead_groups.setdefault(m.spec.group, []).append(m)
+            else:
+                self._restart(m)
+        for group, members in dead_groups.items():
+            self._restart_group(group, members)
+
+    def _restart(self, m: _Managed) -> None:
+        if m.restarts >= self.max_restarts:
+            m.state = "failed"
+            self._event(m.spec.name, "restart_budget_exhausted",
+                        restarts=m.restarts)
+            return
+        m.restarts += 1
+        self._event(m.spec.name, "restarting", attempt=m.restarts)
+        time.sleep(self.backoff_s)
+        self._spawn(m)
+
+    def _restart_group(self, group: str, dead: List[_Managed]) -> None:
+        """Co-restart: terminate every still-running member (their
+        blocking recvs are already wedged on the dead one), then
+        respawn the whole group. Counts one restart against each
+        member's budget."""
+        members = [m for m in self._managed.values()
+                   if m.spec.group == group]
+        if any(m.restarts >= self.max_restarts for m in members):
+            for m in members:
+                m.state = "failed"
+            self._event(dead[0].spec.name,
+                        "group_restart_budget_exhausted", group=group)
+            return
+        self._event(dead[0].spec.name, "group_restart", group=group,
+                    members=[m.spec.name for m in members])
+        for m in members:
+            if m.state == "running" and m.proc is not None \
+                    and m.proc.poll() is None:
+                self._terminate(m, kill_after=5.0)
+            self._close_log(m)
+        time.sleep(self.backoff_s)
+        for m in members:
+            if m.state in ("running", "done"):
+                m.restarts += 1
+                self._spawn(m)
+
+    def wait(self, timeout_s: float = 600.0,
+             poll_interval: float = 0.1) -> bool:
+        """Supervise until every ``expect_exit`` role is done (True) or
+        one fails past its budget / the deadline passes (False)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            self.poll_once()
+            states = [m.state for m in self._managed.values()
+                      if m.spec.expect_exit]
+            if states and all(s == "done" for s in states):
+                return True
+            if any(m.state == "failed" for m in self._managed.values()):
+                return False
+            time.sleep(poll_interval)
+        self._event("fleet", "timeout", timeout_s=timeout_s)
+        return False
+
+    # ----------------------------------------------------------- control
+
+    def kill(self, name: str, sig: int = signal.SIGKILL) -> None:
+        """Kill one role (the fault-injection hook the slow-lane kill
+        test drives). The next poll sees the death and applies the
+        restart policy — exactly what a real crash would do."""
+        m = self._managed[name]
+        if m.proc is not None and m.proc.poll() is None:
+            try:
+                os.killpg(m.proc.pid, sig)
+            except ProcessLookupError:
+                return      # lost the race with its own exit: the
+                #             poll pass will classify the death
+            self._event(name, "killed_by_operator", sig=int(sig))
+
+    def drain(self, timeout_s: float = 30.0) -> Dict[str, Optional[int]]:
+        """Clean shutdown: workers should already be done; every
+        still-running role gets SIGTERM (the server loop's drain
+        signal), then SIGKILL past the timeout. Returns {role: rc}."""
+        for m in self._managed.values():
+            if m.state == "running" and m.proc is not None \
+                    and m.proc.poll() is None:
+                m.state = "draining"
+                self._terminate(m, kill_after=timeout_s)
+                self._event(m.spec.name, "drained", rc=m.rc)
+        if self._scraper is not None:
+            self._scraper.stop()
+            self._scraper = None
+        if self._scrape_backend is not None:
+            self._scrape_backend.close()
+            self._scrape_backend = None
+        for m in self._managed.values():
+            self._close_log(m)
+        return {n: m.rc for n, m in self._managed.items()}
+
+    def _terminate(self, m: _Managed, kill_after: float) -> None:
+        try:
+            os.killpg(m.proc.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+        try:
+            m.rc = m.proc.wait(timeout=kill_after)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(m.proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            m.rc = m.proc.wait()
+
+    def _close_log(self, m: _Managed) -> None:
+        if m.log_file is not None:
+            try:
+                m.log_file.close()
+            except OSError:
+                pass
+            m.log_file = None
+
+    # ------------------------------------------------------------- views
+
+    def tail(self, name: str, nbytes: int = 4000) -> str:
+        try:
+            with open(self._managed[name].log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - nbytes))
+                return f.read().decode(errors="replace")
+        except OSError:
+            return ""
+
+    def output_lines(self, name: str, prefix: str = "") -> List[str]:
+        """Captured stdout lines of a role (optionally filtered) — the
+        result-collection surface for benches/tests."""
+        return [l for l in self.tail(name, 1 << 20).splitlines()
+                if l.startswith(prefix)]
+
+    def restarts(self, name: str) -> int:
+        return self._managed[name].restarts
+
+    def status(self) -> Dict[str, dict]:
+        out = {}
+        fleet_view = self._scraper.view() if self._scraper else {}
+        for n, m in self._managed.items():
+            out[n] = {
+                "state": m.state,
+                "pid": m.proc.pid if m.proc is not None else None,
+                "rc": m.rc,
+                "restarts": m.restarts,
+                "log": m.log_path,
+            }
+        if fleet_view:
+            out["_telemetry"] = fleet_view
+        return out
+
+    def roles(self, role: Optional[str] = None) -> List[str]:
+        return [n for n, m in self._managed.items()
+                if role is None or m.spec.role == role]
+
+
+# =====================================================================
+# Generic command fan-out (the test_multiprocess / scaling_bench path)
+# =====================================================================
+
+@dataclass
+class ProcResult:
+    name: str
+    rc: Optional[int]
+    output: str
+
+
+def run_command_fleet(cmd: Sequence[str], num_processes: int,
+                      env_extra: Optional[Dict[str, str]] = None,
+                      local_devices: int = 1,
+                      timeout_s: float = 600.0,
+                      logdir: Optional[str] = None) -> List[ProcResult]:
+    """Run ``cmd`` as ``num_processes`` coordinated JAX processes on
+    this host and supervise to completion (no restarts: a rank death
+    is the result under test, not something to heal — jax.distributed
+    jobs cannot re-admit a rank mid-job anyway).
+
+    Derives the whole rendezvous env contract per rank — coordinator
+    address on a fresh port, ``BPS_NUM_PROCESSES`` / ``BPS_PROCESS_ID``,
+    and the virtual CPU device count. CPU collectives are enabled
+    in-process by ``bps.init()`` (gloo; see GlobalState — jax 0.4.37
+    does not read the flag from the env, so the launcher cannot carry
+    it). Returns per-rank (rc, captured output).
+    """
+    port = free_port()
+    specs = []
+    for pid in range(int(num_processes)):
+        env = _inherited_env()
+        env.update({
+            "XLA_FLAGS":
+                f"--xla_force_host_platform_device_count={local_devices}",
+            "JAX_PLATFORMS": "cpu",
+            "BPS_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "BPS_NUM_PROCESSES": str(num_processes),
+            "BPS_PROCESS_ID": str(pid),
+        })
+        env.update(env_extra or {})
+        specs.append(ProcessSpec(
+            name=f"rank{pid}", role="worker", argv=list(cmd), env=env,
+            restartable=False, expect_exit=True))
+    sup = FleetSupervisor(specs, logdir=logdir, max_restarts=0).start()
+    try:
+        sup.wait(timeout_s=timeout_s)
+    finally:
+        sup.drain(timeout_s=10.0)
+    return [ProcResult(n, sup._managed[n].rc, sup.tail(n, 1 << 20))
+            for n in sup.roles()]
+
+
+# =====================================================================
+# One-command local fleet
+# =====================================================================
+
+def run_fleet(manifest: FleetManifest, timeout_s: float = 600.0,
+              logdir: Optional[str] = None,
+              max_restarts: int = 2,
+              kill_after: Optional[Tuple[str, float]] = None) -> dict:
+    """Stand up the manifest's fleet, supervise to completion, drain,
+    and fold every worker's FLEET_RESULT line into one summary.
+    ``kill_after=(role, delay_s)`` arms the fault-injection hook: the
+    named role is SIGKILLed ``delay_s`` after spawn and the restart
+    path heals it (the slow-lane kill test's entry point).
+    """
+    specs = manifest.build()
+    sup = FleetSupervisor(
+        specs, logdir=logdir, max_restarts=max_restarts,
+        scrape_addrs=manifest.server_addrs or None,
+        scrape_sec=1.0 if manifest.server_addrs else 0.0)
+    t0 = time.time()
+    sup.start()
+    killer = None
+    if kill_after is not None:
+        import threading
+        role, delay = kill_after
+        killer = threading.Timer(delay, lambda: sup.kill(role))
+        killer.daemon = True
+        killer.start()
+    try:
+        ok = sup.wait(timeout_s=timeout_s)
+    finally:
+        if killer is not None:
+            killer.cancel()
+        rcs = sup.drain()
+    wall = time.time() - t0
+    results = {}
+    for name in sup.roles("worker"):
+        for line in sup.output_lines(name, "FLEET_RESULT "):
+            try:
+                results[name] = json.loads(line[len("FLEET_RESULT "):])
+            except ValueError:
+                pass
+    return {
+        "ok": ok and all(
+            (rcs.get(n) == 0) for n in sup.roles("worker")),
+        "wall_s": round(wall, 3),
+        "exit_codes": rcs,
+        "restarts": {n: sup.restarts(n) for n in sup.roles()},
+        "events": sup.events,
+        "logdir": sup.logdir,
+        "workers": results,
+        "server_addrs": manifest.server_addrs,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="byteps_tpu.launcher.fleet",
+        description="one-command supervised local training fleet "
+                    "(P pipeline stages x dp replicas x plane shards)")
+    ap.add_argument("--stages", type=int, default=1)
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--virtual", type=int, default=1)
+    ap.add_argument("--micro", type=int, default=4)
+    ap.add_argument("--shards", type=int, default=0)
+    ap.add_argument("--plane-replicas", type=int, default=0)
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--schedule", default="1f1b",
+                    choices=("1f1b", "sequential"))
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--depth", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--timeout", type=float, default=600.0)
+    ap.add_argument("--max-restarts", type=int, default=2)
+    ap.add_argument("--logdir", default=None)
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the derived per-role env/argv manifest "
+                         "and exit (the lift-to-k8s/SSH view)")
+    args = ap.parse_args(argv)
+    man = FleetManifest(
+        stages=args.stages, dp=args.dp, virtual=args.virtual,
+        micro=args.micro, shards=args.shards,
+        plane_replicas=args.plane_replicas, steps=args.steps,
+        schedule=args.schedule, dim=args.dim, depth=args.depth,
+        batch=args.batch, seed=args.seed)
+    if args.dry_run:
+        for spec in man.build():
+            derived = {k: v for k, v in spec.env.items()
+                       if k.startswith("BPS_") or k.startswith("JAX_")}
+            print(json.dumps({"name": spec.name, "role": spec.role,
+                              "argv": spec.argv, "env": derived,
+                              "group": spec.group}))
+        return 0
+    out = run_fleet(man, timeout_s=args.timeout, logdir=args.logdir,
+                    max_restarts=args.max_restarts)
+    for name, res in sorted(out["workers"].items()):
+        print(f"{name:10s} steps={res.get('steps'):>3} "
+              f"samples/sec={res.get('sps', 0):>8.2f} "
+              f"wall={res.get('wall_s', 0):>7.3f}s "
+              f"loss={res.get('last_loss')}")
+    print(json.dumps({k: v for k, v in out.items()
+                      if k not in ("events", "workers")}))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

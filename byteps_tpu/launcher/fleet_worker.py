@@ -1,0 +1,279 @@
+"""Built-in fleet worker: one process of the launcher's P x dp grid.
+
+Spawned by ``byteps_tpu.launcher.fleet`` with the full BPS_* env
+contract (docs/launcher.md has the table); everything here is DERIVED
+from that env — no argv, no shared state, exactly what a k8s pod or
+SSH-launched rank would see.
+
+Two modes (``BPS_FLEET_MODE``):
+
+  - ``train`` (default): the pipeline stage worker. Builds the shared
+    mlp program deterministically from ``BPS_FLEET_SEED``, partitions
+    it into P*V stages (the SAME program every peer builds — the
+    declaration-order determinism the PS keyspace relies on), binds
+    its activation mailbox (a ``PSTransportServer`` on its
+    ``BPS_PP_ACT_ADDRS[rank]`` slot), dials its ring peers, runs
+    ``BPS_FLEET_STEPS`` 1F1B (or interleaved) steps with per-stage DP
+    through the PS plane when dp > 1, and prints one ``FLEET_RESULT``
+    JSON line: per-role throughput, losses (last stage), wire
+    counters. Exit 0 == clean drain.
+  - ``rounds``: the PR-13 elasticity proof ride-along — a plain
+    deterministic PS exchange loop (constant grads, sum must equal
+    dp x value every round) that a supervisor-restarted replacement
+    REJOINS mid-job: its fresh exchange seeds per-key round counters
+    from the server, so it resumes the JOB's round, not round 1
+    (tests/_elastic_ps_worker.py's contract, now supervisor-driven).
+    Prints per-round ``FLEET_STEP`` walls — the kill test's stall
+    accounting reads them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name, "")
+    return int(v) if v else default
+
+
+def _run_rounds() -> int:
+    """PS rounds mode: no jax import needed — pure numpy over TCP."""
+    import numpy as np
+
+    from ..server.ps_mode import PSGradientExchange
+    from ..server.transport import RemotePSBackend
+    from .fleet import wait_for_ports
+
+    dp = _env_int("BPS_NUM_WORKER", 1)
+    steps = _env_int("BPS_FLEET_STEPS", 4)
+    nbytes = _env_int("BPS_FLEET_NBYTES", 1 << 16)
+    wid = _env_int("BPS_WORKER_ID", 0)
+    incarnation = _env_int("BPS_FLEET_INCARNATION", 0)
+    addrs = [a for a in os.environ.get("BPS_SERVER_ADDRS", "").split(",")
+             if a]
+    if not addrs:
+        print("FLEET_ERROR rounds mode needs BPS_SERVER_ADDRS",
+              flush=True)
+        return 2
+    wait_for_ports(addrs, timeout_s=60.0)
+    be = RemotePSBackend(addrs)
+    ex = PSGradientExchange(be, partition_bytes=nbytes // 4)
+    # per-round pacing (simulated compute): gives the kill tests a
+    # window to land a SIGKILL mid-job, and makes the survivor's
+    # per-round walls a meaningful stall measurement
+    pace = float(os.environ.get("BPS_FLEET_STEP_SLEEP", "0") or 0)
+    tree = {"g": np.ones(nbytes // 4, np.float32)}
+    done = 0
+    resumed_at = None
+    while True:
+        t0 = time.time()
+        if pace:
+            time.sleep(pace)
+        out = ex.exchange(tree, name="g")
+        done = ex.completed_rounds()
+        if resumed_at is None:
+            # the round the FIRST exchange landed on: 1 for a fresh
+            # worker, k+1 for a supervisor-restarted replacement (the
+            # per-key server seeding — the PR-13 rejoin proof)
+            resumed_at = done
+        wall = time.time() - t0
+        if not np.allclose(out["g"], float(dp)):
+            print(f"FLEET_ERROR round {done}: sum {out['g'][0]} != {dp}",
+                  flush=True)
+            return 3
+        print("FLEET_STEP " + json.dumps(
+            {"worker": wid, "round": done, "wall_s": round(wall, 4),
+             "incarnation": incarnation}), flush=True)
+        if done >= steps:
+            break
+    be.close()
+    print("FLEET_RESULT " + json.dumps(
+        {"mode": "rounds", "worker": wid, "steps": done,
+         "incarnation": incarnation, "resumed_at": resumed_at}),
+        flush=True)
+    return 0
+
+
+def _run_train() -> int:
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=1")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ..common.naming import NameRegistry
+    from ..models.mlp import mlp_init, mlp_loss
+    from ..pipeline import (ActivationExchange, PipelineStageDriver,
+                            StagePartitioner)
+    from ..pipeline.topology import act_peer_addrs
+    from ..server.engine import PSServer
+    from ..server.ps_mode import PSGradientExchange
+    from ..server.transport import PSTransportServer, RemotePSBackend
+    from .fleet import wait_for_ports
+
+    P = _env_int("BPS_PP_STAGES", 1)
+    V = _env_int("BPS_PP_VIRTUAL", 1)
+    M = _env_int("BPS_PP_MICROBATCH", 1)
+    stage = _env_int("BPS_PP_RANK", 0)
+    dp = _env_int("BPS_NUM_WORKER", 1)
+    replica = _env_int("BPS_WORKER_ID", 0)
+    steps = _env_int("BPS_FLEET_STEPS", 4)
+    dim = _env_int("BPS_FLEET_DIM", 64)
+    depth = _env_int("BPS_FLEET_DEPTH", 8)
+    batch = _env_int("BPS_FLEET_BATCH", 32)
+    seed = _env_int("BPS_FLEET_SEED", 0)
+    schedule = os.environ.get("BPS_FLEET_SCHEDULE", "1f1b")
+    act_addrs = [a for a in os.environ.get("BPS_PP_ACT_ADDRS",
+                                           "").split(",") if a]
+    srv_addrs = [a for a in os.environ.get("BPS_SERVER_ADDRS",
+                                           "").split(",") if a]
+
+    # ---- the shared program: every peer derives the SAME model, data
+    # and partition from the seed — nothing is shipped
+    rng = np.random.RandomState(seed)
+    params = mlp_init(jax.random.PRNGKey(seed), dim, depth)
+    xs = rng.randn(batch, dim).astype(np.float32)
+    full = (jnp.asarray(xs), jnp.asarray(np.tanh(xs)))
+    per = batch // dp
+    mine = tuple(l[replica * per:(replica + 1) * per] for l in full)
+    mb = tuple(l[:per // M] for l in mine)
+    prog = StagePartitioner(P * V).build(mlp_loss, params, mb,
+                                         name="fleet")
+    if prog is None:
+        print(f"FLEET_ERROR partitioner refused {P}x{V} stages for "
+              f"mlp(dim={dim}, depth={depth})", flush=True)
+        return 3
+
+    # BPS_FLEET_SEG_MS: emulated per-segment accelerator compute (the
+    # repo's emulated-NIC idiom applied to compute) — sleep this many
+    # ms per PHYSICAL-stage segment at V=1, scaled by 1/V because a
+    # chunk holds 1/V of a stage's layers. On a shared-core dev box
+    # real matmul time serializes across the fleet's processes and
+    # erases the schedule's overlap; sleep-paced segments make step
+    # wall track the SCHEDULE's critical path — which is exactly what
+    # `bench.py fleet` compares across plain/interleaved arms. Purely
+    # additive: numerics are untouched.
+    seg_ms = float(os.environ.get("BPS_FLEET_SEG_MS", "0") or 0)
+    if seg_ms > 0:
+        pace_s = seg_ms / 1000.0 / V
+
+        def _paced(fn, delay):
+            def run(*a):
+                time.sleep(delay)
+                return fn(*a)
+            return run
+
+        for seg in prog.segments:
+            seg.fn = _paced(seg.fn, pace_s)
+
+    # ---- activation plane: bind my mailbox, dial ring peers
+    engine = act_srv = None
+    peers = {}
+    clients = []
+    if P > 1:
+        my_addr = act_addrs[stage]
+        engine = PSServer(num_workers=1, engine_threads=1)
+        act_srv = PSTransportServer(
+            engine, host=my_addr.rsplit(":", 1)[0],
+            port=int(my_addr.rsplit(":", 1)[1]))
+        store = act_srv.act_store()
+        peer_addrs = act_peer_addrs(stage, act_addrs, V)
+        wait_for_ports(list(peer_addrs.values()), timeout_s=60.0)
+        for p, addr in peer_addrs.items():
+            c = RemotePSBackend([addr], lazy_dial=True)
+            clients.append(c)
+            peers[p] = c
+    else:
+        from ..pipeline.exchange import ActStore
+        store = ActStore()
+    act = ActivationExchange(stage, store, peers=peers or None,
+                             num_phys=P, timeout_ms=120000)
+
+    # ---- gradient plane: per-stage DP sum through the UNCHANGED PS
+    # path (stage-suffixed names; the servers' round gate is dp)
+    ps_ex = backend = None
+    if dp > 1:
+        if not srv_addrs:
+            print("FLEET_ERROR dp>1 needs BPS_SERVER_ADDRS", flush=True)
+            return 2
+        wait_for_ports(srv_addrs, timeout_s=60.0)
+        replicas = _env_int("BPS_PLANE_REPLICAS", 0)
+        if replicas > 0 and len(srv_addrs) > 1:
+            from ..server.plane import PlanePSBackend
+            backend = PlanePSBackend(
+                [RemotePSBackend([a], lazy_dial=True)
+                 for a in srv_addrs],
+                num_workers=dp, replicas=replicas, owns_shards=True,
+                worker_id=replica)
+        else:
+            backend = RemotePSBackend(srv_addrs)
+        ps_ex = PSGradientExchange(backend, registry=NameRegistry())
+
+    drv = PipelineStageDriver(prog, stage, params, optax.adam(1e-2),
+                              act, M, exchange=ps_ex, world=dp,
+                              name="fleet", schedule=schedule,
+                              virtual=V)
+    losses = []
+    walls = []
+    t_all = time.time()
+    for i in range(steps):
+        t0 = time.time()
+        loss = drv.step(mine)
+        walls.append(time.time() - t0)
+        if loss is not None:
+            losses.append(float(np.asarray(loss)))
+        print("FLEET_STEP " + json.dumps(
+            {"stage": stage, "replica": replica, "step": i + 1,
+             "wall_s": round(walls[-1], 4),
+             "loss": losses[-1] if loss is not None else None}),
+            flush=True)
+    wall = time.time() - t_all
+
+    from ..obs.metrics import get_registry
+    reg = get_registry()
+    print("FLEET_RESULT " + json.dumps({
+        "mode": "train", "stage": stage, "replica": replica,
+        "virtual": V, "schedule": schedule, "steps": steps,
+        "wall_s": round(wall, 3),
+        "sps": round(per * steps / wall, 2),
+        "last_loss": losses[-1] if losses else None,
+        "losses": losses,
+        "act_send_bytes": reg.counter("pp/act_send_bytes").value,
+        "act_recv_bytes": reg.counter("pp/act_recv_bytes").value,
+        "microbatches": reg.counter("pp/microbatches").value,
+    }), flush=True)
+
+    # ---- clean drain: my schedule is complete, so every frame
+    # addressed to me was consumed and every frame I owed my peers was
+    # ACKed into their mailboxes before my last step returned — closing
+    # now can strand nobody (docs/launcher.md drain protocol)
+    if ps_ex is not None:
+        ps_ex.close()
+    if backend is not None:
+        backend.close()
+    for c in clients:
+        c.close()
+    if act_srv is not None:
+        act_srv.close()
+    if engine is not None:
+        engine.close()
+    return 0
+
+
+def main() -> int:
+    mode = os.environ.get("BPS_FLEET_MODE", "train").strip() or "train"
+    if mode == "rounds":
+        return _run_rounds()
+    if mode == "train":
+        return _run_train()
+    print(f"FLEET_ERROR unknown BPS_FLEET_MODE={mode!r}", flush=True)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

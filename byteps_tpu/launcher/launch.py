@@ -20,6 +20,9 @@ Usage:
   bpslaunch-tpu [--coordinator HOST:PORT] [--num-processes N]
                 [--process-id I] [--numa] [--server] -- CMD [ARGS...]
   bpslaunch-tpu --hosts h1,h2,... -- CMD [ARGS...]      # SSH fan-out
+  bpslaunch-tpu --fleet [FLEET ARGS...]   # one-command supervised
+                # local fleet (launcher/fleet.py: P stages x dp
+                # replicas x plane shards, restart-on-death)
 """
 
 from __future__ import annotations
@@ -171,6 +174,12 @@ def run_ssh(args, cmd: List[str]) -> int:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    # --fleet delegates everything after the flag to the fleet
+    # orchestrator (its own argparse) — one entry point, two layers
+    args_in = list(sys.argv[1:] if argv is None else argv)
+    if args_in and args_in[0] == "--fleet":
+        from .fleet import main as fleet_main
+        return fleet_main(args_in[1:])
     parser = argparse.ArgumentParser(prog="bpslaunch-tpu", description=__doc__)
     parser.add_argument("--coordinator", help="coordinator HOST:PORT")
     parser.add_argument("--num-processes", type=int)

@@ -20,24 +20,34 @@ Pieces:
   transport (framing, resend, dedup reuse), latency-class frames
   (``sched.CLASS_ACT``) that overtake gradient bursts under
   ``BPS_SCHEDULING_CREDIT``.
-- ``one_f_one_b`` (schedule.py): the per-stage 1F1B schedule driving
-  ``BPS_PP_MICROBATCH`` microbatches so stage k's backward overlaps
-  stage k+1's forward.
+- ``one_f_one_b`` / ``interleaved_one_f_one_b`` (schedule.py): the
+  per-stage 1F1B schedules driving ``BPS_PP_MICROBATCH`` microbatches
+  so stage k's backward overlaps stage k+1's forward; the interleaved
+  form (``BPS_PP_VIRTUAL`` > 1) gives each worker V model chunks of a
+  P*V-stage program so the warmup bubble shrinks ~1/V.
+- ``topology`` helpers (topology.py): virtual-stage placement
+  (``v % P``), chain-vs-ring peer sets, and the launcher's
+  ``BPS_PP_ACT_ADDRS`` per-stage dialing contract.
 - ``PipelineStageDriver`` (driver.py): one stage worker's step loop —
-  recv → segment → send per microbatch, deterministic gradient
-  accumulation, per-stage optimizer, optional per-stage DP exchange.
+  recv → segment → send per microbatch (per chunk when interleaved),
+  deterministic gradient accumulation, per-stage optimizer, optional
+  per-stage DP exchange.
 
 Env contract: ``BPS_PP_STAGES`` / ``BPS_PP_RANK`` /
-``BPS_PP_MICROBATCH`` (docs/pipeline-parallelism.md, docs/env.md).
+``BPS_PP_MICROBATCH`` / ``BPS_PP_VIRTUAL``
+(docs/pipeline-parallelism.md, docs/env.md).
 """
 
 from .driver import PipelineStageDriver, split_microbatches
 from .exchange import ActivationExchange, LocalActPeer
 from .partitioner import PipelineProgram, StagePartitioner
-from .schedule import one_f_one_b, sequential_schedule
+from .schedule import (interleaved_one_f_one_b, one_f_one_b,
+                       sequential_schedule)
+from . import topology
 
 __all__ = [
     "StagePartitioner", "PipelineProgram", "ActivationExchange",
     "LocalActPeer", "PipelineStageDriver", "split_microbatches",
-    "one_f_one_b", "sequential_schedule",
+    "one_f_one_b", "interleaved_one_f_one_b", "sequential_schedule",
+    "topology",
 ]

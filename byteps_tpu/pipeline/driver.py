@@ -36,7 +36,9 @@ import numpy as np
 from ..common.logging import get_logger
 from ..obs.metrics import get_registry, observe_stage
 from .exchange import ActivationExchange  # noqa: F401 — typed surface
-from .schedule import one_f_one_b, sequential_schedule
+from .schedule import (interleaved_one_f_one_b, one_f_one_b,
+                       sequential_schedule)
+from .topology import virtual_stages
 
 log = get_logger()
 
@@ -75,14 +77,16 @@ class PipelineStageDriver:
                  act: ActivationExchange, n_micro: Optional[int] = None,
                  exchange=None, world: int = 1,
                  name: str = "pp", timeline=None,
-                 schedule: str = "1f1b") -> None:
+                 schedule: str = "1f1b",
+                 virtual: Optional[int] = None) -> None:
         import optax  # noqa: F401 — tx is an optax transformation
 
         self.program = program
-        if stage is None or n_micro is None:
-            # env contract: BPS_PP_RANK / BPS_PP_MICROBATCH (via the
-            # live Config when bps.init ran) — the deployment path
-            # where each stage worker is launched with its rank
+        if stage is None or n_micro is None or virtual is None:
+            # env contract: BPS_PP_RANK / BPS_PP_MICROBATCH /
+            # BPS_PP_VIRTUAL (via the live Config when bps.init ran) —
+            # the deployment path where each stage worker is launched
+            # with only its env
             from ..common.config import Config
             from ..common.global_state import GlobalState
             cfg = (GlobalState.get().config
@@ -91,6 +95,17 @@ class PipelineStageDriver:
                 stage = cfg.pp_rank
             if n_micro is None:
                 n_micro = cfg.pp_microbatch
+            if virtual is None:
+                virtual = cfg.pp_virtual
+        self.virtual = max(1, int(virtual))
+        if program.num_stages % self.virtual:
+            raise ValueError(
+                f"program has {program.num_stages} stages, not "
+                f"divisible by BPS_PP_VIRTUAL={self.virtual} — an "
+                f"interleaved driver needs a P*V-stage program")
+        # P physical workers each owning V chunks: virtual stage v runs
+        # on worker v % P (chunk v // P) — the topology module's layout
+        self.phys = program.num_stages // self.virtual
         self.stage = int(stage)
         self.n_micro = int(n_micro)
         self.act = act
@@ -101,23 +116,36 @@ class PipelineStageDriver:
         self.tx = tx
         if schedule not in ("1f1b", "sequential"):
             raise ValueError(f"unknown schedule {schedule!r}")
-        self._sched_fn = (one_f_one_b if schedule == "1f1b"
-                          else sequential_schedule)
-        self._schedule = self._sched_fn(program.num_stages, self.stage,
-                                        self.n_micro)
+        if self.virtual > 1:
+            if schedule != "1f1b":
+                raise ValueError(
+                    "interleaved virtual stages only support the 1f1b "
+                    "schedule (sequential defeats the interleave)")
+            self._schedule = interleaved_one_f_one_b(
+                self.phys, self.stage, self.n_micro, self.virtual)
+        else:
+            fn = (one_f_one_b if schedule == "1f1b"
+                  else sequential_schedule)
+            self._schedule = [(op, m, 0) for op, m in
+                              fn(self.phys, self.stage, self.n_micro)]
 
         if exchange is not None:
             # the PS keyspace contract is DECLARATION ORDER — but stage
             # workers would each declare only their own stage's name,
             # colliding every stage onto declared-key 0. Pre-declare
-            # every stage's name in stage order so all workers' (and
-            # all stages') registries agree, wherever they run.
-            for s in range(program.num_stages):
+            # every PHYSICAL stage's name in stage order so all
+            # workers' (and all stages') registries agree, wherever
+            # they run (a stage's V chunks exchange together under one
+            # name — the PS plane never sees the interleave).
+            for s in range(self.phys):
                 nm = f"{name}-s{s}"
                 if nm not in exchange.registry.declared_names():
                     exchange.registry.declare(nm)
 
-        self.own_leaves = list(program.stage_param_leaves[self.stage])
+        self.chunks = virtual_stages(self.stage, self.phys, self.virtual)
+        self.chunk_leaves = [list(program.stage_param_leaves[vs])
+                             for vs in self.chunks]
+        self.own_leaves = [li for g in self.chunk_leaves for li in g]
         flat = jax.tree_util.tree_leaves(params)
         import jax.numpy as jnp
         # copy, never alias: the apply step donates these buffers, and
@@ -127,15 +155,18 @@ class PipelineStageDriver:
                              for li in self.own_leaves]
         self.opt_state = tx.init(self.params)
         self._apply = jax.jit(self._apply_impl, donate_argnums=(0, 1))
-        self._fwd_idx = program.stage_segment(self.stage, "fwd")
-        self._bwd_idx = program.stage_segment(self.stage, "bwd")
+        self._fwd_idx = [program.stage_segment(vs, "fwd")
+                         for vs in self.chunks]
+        self._bwd_idx = [program.stage_segment(vs, "bwd")
+                         for vs in self.chunks]
         self._seq_base = 0
         self.step_count = 0
         self.last_loss = None
         reg = get_registry()
         self._m_micro = reg.counter("pp/microbatches")
         reg.gauge("pp/stage").set(self.stage)
-        reg.gauge("pp/stages").set(program.num_stages)
+        reg.gauge("pp/stages").set(self.phys)
+        reg.gauge("pp/virtual").set(self.virtual)
 
     def _apply_impl(self, params, opt_state, grads):
         import optax
@@ -158,60 +189,73 @@ class PipelineStageDriver:
                 f"batch has {n_batch_leaves} leaves, program was "
                 f"traced with {len(batch_invars)}")
 
-        envs: Dict[int, Dict] = {}
-        own_pvars = [prog.param_var_of[li] for li in self.own_leaves]
-        fwd_seg = prog.segments[self._fwd_idx]
-        bwd_seg = prog.segments[self._bwd_idx]
-        b_in_fwd = (prog.boundaries[self._fwd_idx - 1]
-                    if self._fwd_idx > 0 else None)
-        b_out_fwd = (prog.boundaries[self._fwd_idx]
-                     if self._fwd_idx < 2 * P - 1 else None)
-        b_in_bwd = (prog.boundaries[self._bwd_idx - 1]
-                    if self._bwd_idx > 0 else None)
-        b_out_bwd = (prog.boundaries[self._bwd_idx]
-                     if self._bwd_idx < 2 * P - 1 else None)
+        # P here is the VIRTUAL stage count (the program's); the
+        # schedule walks (op, microbatch, chunk) triples and each chunk
+        # has its own segment pair + boundary refs. V == 1 is the
+        # degenerate single-chunk case — the original 1F1B loop.
+        envs: Dict[tuple, Dict] = {}
+        chunk_pvars = [[prog.param_var_of[li] for li in g]
+                       for g in self.chunk_leaves]
+        chunk_params: List[List] = []
+        off = 0
+        for g in self.chunk_leaves:
+            chunk_params.append(self.params[off:off + len(g)])
+            off += len(g)
+        fwd_seg = [prog.segments[i] for i in self._fwd_idx]
+        bwd_seg = [prog.segments[i] for i in self._bwd_idx]
 
-        acc: Optional[List] = None
+        def _bnd(i):
+            return prog.boundaries[i] if 0 <= i < 2 * P - 1 else None
+
+        b_in_fwd = [_bnd(i - 1) for i in self._fwd_idx]
+        b_out_fwd = [_bnd(i) for i in self._fwd_idx]
+        b_in_bwd = [_bnd(i - 1) for i in self._bwd_idx]
+        b_out_bwd = [_bnd(i) for i in self._bwd_idx]
+
+        accs: List[Optional[List]] = [None] * self.virtual
         loss_sum = None
         base = self._seq_base
         t_step = time.time()
-        for op, mb in self._schedule:
+        for op, mb, ck in self._schedule:
             seq = base + mb
             if op == "F":
-                env = envs[mb] = dict(prog.const_env)
-                for v, p in zip(own_pvars, self.params):
+                env = envs[(ck, mb)] = dict(prog.const_env)
+                for v, p in zip(chunk_pvars[ck], chunk_params[ck]):
                     env[v] = p
                 env.update(zip(batch_invars,
                                jax.tree_util.tree_leaves(micro[mb])))
-                if b_in_fwd is not None and not b_in_fwd.local:
-                    self.act.recv(b_in_fwd, mb, seq, env)
-                loss_here = self._run_segment(fwd_seg, env, mb,
-                                              "PP_FWD_SEG")
+                if b_in_fwd[ck] is not None and not b_in_fwd[ck].local:
+                    self.act.recv(b_in_fwd[ck], mb, seq, env)
+                loss_here = self._run_segment(fwd_seg[ck], env, mb,
+                                              "PP_FWD_SEG", ck)
                 if loss_here is not None:
                     loss_sum = (loss_here if loss_sum is None
                                 else loss_sum + loss_here)
-                if b_out_fwd is not None and not b_out_fwd.local:
-                    self.act.send(b_out_fwd, mb, seq, env)
+                if b_out_fwd[ck] is not None \
+                        and not b_out_fwd[ck].local:
+                    self.act.send(b_out_fwd[ck], mb, seq, env)
             else:
-                env = envs[mb]
-                if b_in_bwd is not None and not b_in_bwd.local:
-                    self.act.recv(b_in_bwd, mb, seq, env)
-                loss_here = self._run_segment(bwd_seg, env, mb,
-                                              "PP_BWD_SEG")
+                env = envs[(ck, mb)]
+                if b_in_bwd[ck] is not None and not b_in_bwd[ck].local:
+                    self.act.recv(b_in_bwd[ck], mb, seq, env)
+                loss_here = self._run_segment(bwd_seg[ck], env, mb,
+                                              "PP_BWD_SEG", ck)
                 if loss_here is not None:
                     loss_sum = (loss_here if loss_sum is None
                                 else loss_sum + loss_here)
-                if b_out_bwd is not None and not b_out_bwd.local:
-                    self.act.send(b_out_bwd, mb, seq, env)
+                if b_out_bwd[ck] is not None \
+                        and not b_out_bwd[ck].local:
+                    self.act.send(b_out_bwd[ck], mb, seq, env)
                 grads = [prog.grad_value(env, li)
-                         for li in self.own_leaves]
-                acc = (grads if acc is None else
-                       [a + g for a, g in zip(acc, grads)])
-                del envs[mb]          # residuals dead past the backward
+                         for li in self.chunk_leaves[ck]]
+                accs[ck] = (grads if accs[ck] is None else
+                            [a + g for a, g in zip(accs[ck], grads)])
+                del envs[(ck, mb)]    # residuals dead past the backward
                 self._m_micro.inc()
         self._seq_base = base + self.n_micro
         self.step_count += 1
 
+        acc = [g for ck_acc in accs for g in ck_acc]
         grads = [g / self.n_micro for g in acc]
         if self._exchange is not None:
             # per-stage data-parallel sum through the UNCHANGED PS
@@ -232,21 +276,25 @@ class PipelineStageDriver:
         self.last_loss = loss_sum / self.n_micro
         return self.last_loss
 
-    def _run_segment(self, seg, env: Dict, mb: int, stage_name: str):
+    def _run_segment(self, seg, env: Dict, mb: int, stage_name: str,
+                     chunk: int = 0):
         t0 = time.time()
         missing = [v for v in seg.invars if v not in env]
         if missing:
             raise RuntimeError(
-                f"stage {self.stage} segment is missing {len(missing)} "
-                f"env vars for microbatch {mb} — boundary plan bug")
+                f"stage {self.stage} (chunk {chunk}) segment is missing "
+                f"{len(missing)} env vars for microbatch {mb} — "
+                f"boundary plan bug")
         outs = seg.fn(*[env[v] for v in seg.invars])
         jax.block_until_ready(outs)
         env.update(zip(seg.outvars, outs))
         dur = time.time() - t0
         observe_stage(stage_name, dur)
         if self.timeline is not None:
-            self.timeline.record(f"{self.name}/s{self.stage}/mb{mb}",
-                                 stage_name, t0, dur, self.stage)
+            tag = (f"{self.name}/s{self.stage}/mb{mb}"
+                   if self.virtual == 1 else
+                   f"{self.name}/s{self.stage}c{chunk}/mb{mb}")
+            self.timeline.record(tag, stage_name, t0, dur, self.stage)
         return env[self.program.loss_var] if seg.emits_loss else None
 
     # ------------------------------------------------------------ views

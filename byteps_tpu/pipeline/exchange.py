@@ -151,12 +151,22 @@ class ActivationExchange:
                  peer_prev=None, peer_next=None,
                  timeline=None, name: str = "pp",
                  timeout_ms: int = 30000,
-                 codec: Optional[str] = None) -> None:
+                 codec: Optional[str] = None,
+                 peers: Optional[Dict[int, object]] = None,
+                 num_phys: Optional[int] = None) -> None:
         import os
         self.stage = int(stage)
         self.store = store
         self.peer_prev = peer_prev
         self.peer_next = peer_next
+        # ring routing (interleaved virtual stages): ``peers`` maps
+        # PHYSICAL stage -> push handle and ``num_phys`` folds a
+        # boundary's VIRTUAL dst stage onto the ring (dst % P) — the
+        # chunk boundaries wrap stage P-1 back to stage 0, which the
+        # chain-shaped prev/next pair cannot express. When ``peers``
+        # is None the legacy prev/next routing is used unchanged.
+        self._peers = dict(peers) if peers is not None else None
+        self._num_phys = int(num_phys) if num_phys else None
         self.timeline = timeline
         self.name = name
         self.timeout_ms = int(timeout_ms)
@@ -195,6 +205,17 @@ class ActivationExchange:
     # -------------------------------------------------------- data path
 
     def _peer_for(self, boundary) -> object:
+        if self._peers is not None:
+            dst = boundary.dst_stage
+            if self._num_phys:
+                dst = dst % self._num_phys
+            peer = self._peers.get(dst)
+            if peer is None:
+                raise RuntimeError(
+                    f"stage {self.stage} has no peer handle for "
+                    f"physical stage {dst} (boundary {boundary.index} "
+                    f"-> virtual stage {boundary.dst_stage})")
+            return peer
         peer = (self.peer_next if boundary.dst_stage > self.stage
                 else self.peer_prev)
         if peer is None:

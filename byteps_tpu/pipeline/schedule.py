@@ -24,7 +24,8 @@ from __future__ import annotations
 
 from typing import List, Tuple
 
-Op = Tuple[str, int]    # ("F" | "B", microbatch index)
+Op = Tuple[str, int]        # ("F" | "B", microbatch index)
+VOp = Tuple[str, int, int]  # ("F" | "B", microbatch index, chunk index)
 
 
 def one_f_one_b(num_stages: int, stage: int, n_micro: int) -> List[Op]:
@@ -46,6 +47,61 @@ def one_f_one_b(num_stages: int, stage: int, n_micro: int) -> List[Op]:
     while nb < n_micro:
         sched.append(("B", nb))
         nb += 1
+    return sched
+
+
+def interleaved_one_f_one_b(num_stages: int, stage: int, n_micro: int,
+                            virtual: int) -> List[VOp]:
+    """Interleaved (virtual-stage) 1F1B: physical stage ``stage`` of
+    ``num_stages`` owns ``virtual`` model chunks (chunk c = virtual
+    stage ``c * num_stages + stage``), so each microbatch visits this
+    worker V times and the warmup bubble shrinks by ~1/V (Megatron
+    interleaved schedule, arXiv 2104.04473; the MPMD analog of
+    arXiv 2412.14374's virtual-stage interleaving).
+
+    Op order is the standard interleaved layout over the virtual op
+    counter: microbatches advance through chunks in groups of
+    ``num_stages``, warmup depth ``2*(P-1-stage) + (V-1)*P``, then
+    1F1B steady state, then the backward drain. Per chunk, backwards
+    still run in microbatch order — the grad-accumulation determinism
+    the parity contracts rely on. Requires ``n_micro %% num_stages ==
+    0`` (the layout's group size); refused loudly otherwise.
+
+    Sends never block (the activation mailbox buffers), so any per-rank
+    order consistent with the cross-rank data dependencies is
+    deadlock-free; this one additionally keeps at most P microbatches
+    in flight per chunk.
+    """
+    P, V, M = int(num_stages), int(virtual), int(n_micro)
+    if not 0 <= stage < P:
+        raise ValueError(f"stage {stage} out of range for {P} stages")
+    if V < 1:
+        raise ValueError("virtual must be >= 1")
+    if V == 1:
+        return [(op, m, 0) for op, m in one_f_one_b(P, stage, M)]
+    if M < 1:
+        raise ValueError("need at least one microbatch")
+    if M % P:
+        raise ValueError(
+            f"interleaved 1F1B needs n_micro divisible by the stage "
+            f"count: {M} % {P} != 0 (the virtual-stage layout walks "
+            f"microbatches in groups of P)")
+    total = M * V
+
+    def fwd(i: int) -> VOp:
+        return ("F", (i // (P * V)) * P + i % P, (i % (P * V)) // P)
+
+    def bwd(j: int) -> VOp:
+        return ("B", (j // (P * V)) * P + j % P,
+                V - 1 - (j % (P * V)) // P)
+
+    warmup = min(2 * (P - 1 - stage) + (V - 1) * P, total)
+    sched: List[VOp] = [fwd(i) for i in range(warmup)]
+    for k in range(total - warmup):
+        sched.append(fwd(warmup + k))
+        sched.append(bwd(k))
+    for k in range(total - warmup, total):
+        sched.append(bwd(k))
     return sched
 
 

@@ -1,0 +1,89 @@
+"""Stage-count-P placement/topology helpers for the MPMD pipeline.
+
+One place answers every "who runs what, who talks to whom" question
+the P-stage (optionally interleaved) pipeline raises, so the driver,
+the activation exchange, and the fleet launcher derive the SAME ring
+from the same two integers instead of re-implementing modular
+arithmetic three ways:
+
+  - virtual stage ``v`` of a ``P x V`` program runs on physical stage
+    ``v % P`` (chunk ``v // P``) — the round-robin layout interleaved
+    1F1B assumes (each microbatch visits every worker V times);
+  - with V == 1 the wire topology is a CHAIN (stage s dials s-1 and
+    s+1, the ends dial one neighbor); with V > 1 it closes into a RING
+    (stage P-1's chunk-boundary forward lands on stage 0), so every
+    stage dials both ring neighbors;
+  - the launcher's per-role env contract (BPS_PP_ACT_ADDRS) is an
+    ordered list of every stage's activation-mailbox address; each
+    worker picks its peers with ``act_peer_stages`` and dials only
+    those.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+
+def phys_stage(virtual_stage: int, num_phys: int) -> int:
+    """Physical stage that runs virtual stage ``virtual_stage``."""
+    return int(virtual_stage) % int(num_phys)
+
+
+def chunk_of(virtual_stage: int, num_phys: int) -> int:
+    """Which of its owner's chunks virtual stage ``virtual_stage`` is."""
+    return int(virtual_stage) // int(num_phys)
+
+
+def virtual_stages(stage: int, num_phys: int, virtual: int) -> List[int]:
+    """The virtual stage ids physical stage ``stage`` owns, chunk
+    order — ``[stage, stage + P, ...]``."""
+    P = int(num_phys)
+    return [int(stage) + c * P for c in range(int(virtual))]
+
+
+def ring_neighbors(stage: int, num_phys: int) -> Tuple[int, int]:
+    """(prev, next) on the stage ring, with wraparound."""
+    P = int(num_phys)
+    s = int(stage)
+    return ((s - 1) % P, (s + 1) % P)
+
+
+def act_peer_stages(stage: int, num_phys: int, virtual: int) -> List[int]:
+    """Physical stages ``stage`` must be able to SEND activations to.
+
+    V == 1: the classic chain — forward boundaries go to ``stage+1``,
+    activation-grad boundaries to ``stage-1``; the ends have one peer.
+    V > 1: the chunk boundaries wrap (virtual P-1 -> P lands back on
+    stage 0), so both ring neighbors, always. P == 1 needs no peers.
+    """
+    P = int(num_phys)
+    if P <= 1:
+        return []
+    s = int(stage)
+    if int(virtual) <= 1:
+        return [p for p in (s - 1, s + 1) if 0 <= p < P]
+    prev, nxt = ring_neighbors(s, P)
+    return sorted({prev, nxt})
+
+
+def act_peer_addrs(stage: int, addrs: Sequence[str],
+                   virtual: int) -> Dict[int, str]:
+    """{peer physical stage: mailbox addr} this stage must dial, from
+    the ordered BPS_PP_ACT_ADDRS list (index == physical stage)."""
+    P = len(addrs)
+    return {p: addrs[p]
+            for p in act_peer_stages(stage, P, virtual)}
+
+
+def validate_topology(num_phys: int, virtual: int, n_micro: int) -> None:
+    """The placement preconditions, checked once and loudly (the same
+    rules the schedule/partitioner enforce piecemeal)."""
+    P, V, M = int(num_phys), int(virtual), int(n_micro)
+    if P < 1:
+        raise ValueError("need at least one stage")
+    if V < 1:
+        raise ValueError("virtual must be >= 1")
+    if V > 1 and M % P:
+        raise ValueError(
+            f"interleaved schedule needs n_micro % stages == 0 "
+            f"(got {M} % {P})")

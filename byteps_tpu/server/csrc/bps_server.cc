@@ -704,9 +704,27 @@ class Server {
     }
     uint64_t want = want_round == 0 ? (ks->round > 0 ? ks->round : 1)
                                     : want_round;
+#if defined(__SANITIZE_THREAD__)
+    // TSAN builds only: gcc 10's libtsan does not intercept
+    // pthread_cond_clockwait (GCC PR sanitizer/97868, fixed in gcc 11),
+    // which libstdc++ uses for every STEADY-clock timed wait on
+    // glibc >= 2.30. The un-instrumented wait releases/reacquires the
+    // mutex invisibly, corrupting tsan's lock shadow — the stress
+    // driver then reports impossible "double lock of a mutex" and
+    // data races where two threads both "hold" the same mutex. Route
+    // the wait through the REALTIME clock (pthread_cond_timedwait,
+    // which this libtsan does intercept); production builds keep the
+    // steady clock so a wall-clock jump cannot stretch pull timeouts.
+    bool ok = ks->cv.wait_until(lk,
+                                std::chrono::system_clock::now() +
+                                    std::chrono::milliseconds(timeout_ms),
+                                [&] { return dying_.load() ||
+                                             ks->round >= want; });
+#else
     bool ok = ks->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
                               [&] { return dying_.load() ||
                                            ks->round >= want; });
+#endif
     if (dying_.load()) return -5;  // woken by the destructor
     if (!ok) return -2;  // timeout
     std::memcpy(dst, ks->merged.data(), nbytes);

@@ -1336,6 +1336,16 @@ class PSGradientExchange:
         docstring). Returns the summed tree."""
         return self._exchange_impl(tree, name, detach=False)
 
+    def completed_rounds(self) -> int:
+        """Rounds this exchange has COMPLETED — the max per-key round
+        counter (0 before any exchange). After a rejoin the counters
+        were seeded from the server, so a restarted worker reads how
+        far the JOB is, not how far this process got: the fleet
+        supervisor's restart path derives "steps remaining" from this
+        (docs/launcher.md)."""
+        with self._key_rounds_lock:
+            return max(self._key_rounds.values(), default=0)
+
     def exchange_async(self, tree, name: Optional[str] = None):
         """Like ``exchange`` but returns as soon as every bucket's PUSH
         is submitted to the pipeline executors; call ``.result()`` on

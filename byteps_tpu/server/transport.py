@@ -1260,6 +1260,7 @@ class RemotePSBackend:
                 vnodes=int(self._placement.get("vnodes") or 0)
                 or DEFAULT_VNODES)
         self.async_mode = async_mode
+        self._dead = False      # set by close(); aborts redial loops
         self.reconnect_secs = (
             float(_os.environ.get("BPS_RECONNECT_SECS", "30"))
             if reconnect_secs is None else reconnect_secs)
@@ -1399,6 +1400,10 @@ class RemotePSBackend:
         get_registry().counter("transport/reconnects").inc()
         delay = 0.1
         while True:
+            if self._dead:
+                raise ConnectionError(
+                    f"PS backend closed while reconnecting to "
+                    f"{':'.join(self._addrs[i])}")
             try:
                 old_sock = ch.sock
                 ch.sock = self._dial(i)
@@ -2161,6 +2166,15 @@ class RemotePSBackend:
 
     def close(self) -> None:
         import queue as _queue
+        # flag FIRST: an op thread sitting in _reconnect's redial loop
+        # holds its channel outside the pool, so the drain below never
+        # reaches it — without the flag it would keep dialing the dead
+        # address for up to reconnect_secs AFTER close. A zombie dialer
+        # is not just waste: the kernel recycles the dead server's port
+        # (sequential ephemeral allocation), and a successful redial
+        # sprays init-replay frames at whatever now owns it — observed
+        # aborting an unrelated process's gloo listener mid-handshake.
+        self._dead = True
         if self._stripe_exec is not None:
             self._stripe_exec.shutdown(wait=True)
             self._stripe_exec = None

@@ -35,8 +35,6 @@ import argparse
 import gc
 import json
 import os
-import socket
-import subprocess
 import sys
 import time
 
@@ -90,12 +88,6 @@ def measure(n_dev: int, model: str, per_replica_batch: int, seq: int,
 
 # --------------------------------------------------- multi-process mode
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
 def mp_worker() -> None:
     """One process of an N-process weak-scaling run (spawned by
     run_multiprocess; BPS_* rendezvous env is already set)."""
@@ -131,53 +123,37 @@ def mp_worker() -> None:
 
 def run_multiprocess(nproc: int, model: str, prb: int, seq: int, iters: int,
                      local_devices: int = 1, timeout: int = 600) -> float:
-    """Spawn ``nproc`` real processes; returns global samples/sec."""
-    port = _free_port()
-    env_base = dict(
-        os.environ,
-        XLA_FLAGS=f"--xla_force_host_platform_device_count={local_devices}",
-        JAX_PLATFORMS="cpu",
-        BPS_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
-        BPS_NUM_PROCESSES=str(nproc),
-        BPS_SCALING_MODEL=model,
-        BPS_SCALING_PRB=str(prb),
-        BPS_SCALING_SEQ=str(seq),
-        BPS_SCALING_ITERS=str(iters),
-        BPS_SCALING_LOCAL_DEVICES=str(local_devices),
-        **{_MP_ENV: "1"},
-    )
-    procs = []
-    try:
-        for pid in range(nproc):
-            env = dict(env_base, BPS_PROCESS_ID=str(pid))
-            procs.append(subprocess.Popen(
-                [sys.executable, os.path.abspath(__file__)], env=env,
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-        outs = []
-        for p in procs:
-            try:
-                out, _ = p.communicate(timeout=timeout)
-            except subprocess.TimeoutExpired:
-                for q in procs:
-                    q.kill()
-                out, _ = p.communicate()
-            outs.append(out)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for pid, (p, out) in enumerate(zip(procs, outs)):
-        if p.returncode != 0:
+    """Spawn ``nproc`` real processes through the launcher's supervised
+    command fleet (launcher/fleet.py derives the coordinator/rank env
+    and captures per-rank output); returns global samples/sec."""
+    from byteps_tpu.launcher.fleet import run_command_fleet
+
+    results = run_command_fleet(
+        [sys.executable, os.path.abspath(__file__)],
+        num_processes=nproc, local_devices=local_devices,
+        timeout_s=timeout,
+        env_extra={
+            _MP_ENV: "1",
+            "BPS_SCALING_MODEL": model,
+            "BPS_SCALING_PRB": str(prb),
+            "BPS_SCALING_SEQ": str(seq),
+            "BPS_SCALING_ITERS": str(iters),
+            "BPS_SCALING_LOCAL_DEVICES": str(local_devices),
+        })
+    for res in results:
+        if res.rc != 0:
             raise RuntimeError(
-                f"scaling worker {pid}/{nproc} failed:\n{out[-3000:]}")
-    for line in outs[0].splitlines():
+                f"scaling worker {res.name}/{nproc} failed:\n"
+                f"{res.output[-3000:]}")
+    for line in results[0].output.splitlines():
         try:
             rec = json.loads(line)
         except ValueError:
             continue
         if rec.get("mp_result"):
             return float(rec["sps"])
-    raise RuntimeError(f"no result line from process 0:\n{outs[0][-2000:]}")
+    raise RuntimeError(
+        f"no result line from rank 0:\n{results[0].output[-2000:]}")
 
 
 def _report(rows, model: str, tag: str) -> None:

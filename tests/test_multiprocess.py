@@ -1,53 +1,52 @@
 """Two real JAX processes over a localhost coordinator — the analog of
 the reference's meta_test.py strategy (SURVEY §4: same binaries, real
-rendezvous/collectives, one machine, no cluster)."""
+rendezvous/collectives, one machine, no cluster).
+
+Both tests drive the launcher's command-fleet path
+(``launcher.fleet.run_command_fleet``): the coordinator/rank env
+contract is DERIVED, the processes are supervised, and per-rank output
+is captured per role — no hand-rolled Popen choreography.
+
+Root cause of the long-standing failures here (fixed in
+``GlobalState._enable_cpu_collectives``): jaxlib's CPU client defaults
+to ``collectives=none``, so every cross-process computation died with
+"Multiprocess computations aren't implemented on the CPU backend".
+jax 0.4.37 ships a gloo implementation behind the
+``jax_cpu_collectives_implementation`` config, which this jax does NOT
+read from the environment — ``bps.init()`` now enables it in-process,
+before the first backend client exists.
+"""
 
 import os
-import socket
-import subprocess
 import sys
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_two_process_training_localhost():
-    port = _free_port()
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    worker = os.path.join(root, "tests", "_mp_worker.py")
-    procs = []
-    try:
-        for pid in (0, 1):
-            env = dict(
-                os.environ,
-                XLA_FLAGS="--xla_force_host_platform_device_count=2",
-                JAX_PLATFORMS="cpu",
-                BPS_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
-                BPS_NUM_PROCESSES="2",
-                BPS_PROCESS_ID=str(pid),
-            )
-            procs.append(subprocess.Popen(
-                [sys.executable, worker], env=env,
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-        outs = []
-        for p in procs:
-            try:
-                out, _ = p.communicate(timeout=240)
-            except subprocess.TimeoutExpired:
-                for q in procs:          # kill BOTH, then salvage output
-                    q.kill()
-                out, _ = p.communicate()
-            outs.append(out)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for pid, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
-        assert "MP_WORKER_OK" in out, out[-2000:]
+    from byteps_tpu.launcher.fleet import run_command_fleet
+
+    worker = os.path.join(ROOT, "tests", "_mp_worker.py")
+    for attempt in (1, 2):
+        results = run_command_fleet([sys.executable, worker],
+                                    num_processes=2, local_devices=2,
+                                    timeout_s=240)
+        # One retry for a SUITE-ENVIRONMENT hazard, not a code path:
+        # gloo aborts (SIGABRT, "op.preamble.length <= op.nbytes")
+        # when a foreign frame hits a rank's pair listener during
+        # init — a lingering reconnect dialer from an earlier TCP test
+        # in this pytest process can reach a kernel-recycled ephemeral
+        # port that now belongs to gloo. A rerun gets fresh ports; a
+        # REAL failure reproduces and is reported.
+        if attempt == 1 and any(
+                r.rc == -6 and "gloo" in r.output for r in results):
+            continue
+        break
+    assert len(results) == 2
+    for res in results:
+        assert res.rc == 0, f"{res.name} failed:\n{res.output[-4000:]}"
+        assert "MP_WORKER_OK" in res.output, res.output[-2000:]
 
 
 def test_multiprocess_weak_scaling_2_and_4_procs():
@@ -58,11 +57,10 @@ def test_multiprocess_weak_scaling_2_and_4_procs():
     the assertion is that the multi-process path works end to end.)"""
     import importlib.util
 
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     spec = importlib.util.spec_from_file_location(
-        "scaling_bench", os.path.join(root, "examples", "scaling_bench.py"))
+        "scaling_bench", os.path.join(ROOT, "examples", "scaling_bench.py"))
     sb = importlib.util.module_from_spec(spec)
-    sys.path.insert(0, os.path.join(root, "examples"))
+    sys.path.insert(0, os.path.join(ROOT, "examples"))
     try:
         spec.loader.exec_module(sb)
         for n in (2, 4):
@@ -70,4 +68,4 @@ def test_multiprocess_weak_scaling_2_and_4_procs():
                                       iters=2, timeout=420)
             assert sps > 0, (n, sps)
     finally:
-        sys.path.remove(os.path.join(root, "examples"))
+        sys.path.remove(os.path.join(ROOT, "examples"))

@@ -753,6 +753,123 @@ def test_split_microbatches_refuses_ragged():
         split_microbatches((np.zeros((7, 3)),), 2)
 
 
+# ===================================================================
+# Interleaved (virtual-stage) 1F1B for the MPMD driver (ISSUE 15):
+# schedule invariants, the topology helpers the launcher derives its
+# wiring from, and the P=2 x V=2 in-process parity contract.
+# ===================================================================
+
+from byteps_tpu.pipeline import interleaved_one_f_one_b
+from byteps_tpu.pipeline import topology as ppt
+
+
+def test_interleaved_schedule_invariants():
+    """Every (microbatch, chunk) pair runs F and B exactly once; per
+    chunk the backwards run in microbatch order (the grad-accumulation
+    determinism the parity contracts rely on); V=1 degenerates to the
+    plain 1F1B schedule; the warmup is 2*(P-1-stage) + (V-1)*P deep."""
+    for P in (2, 4):
+        for V in (2, 3):
+            M = 2 * P
+            for s in range(P):
+                sched = interleaved_one_f_one_b(P, s, M, V)
+                fs = [(m, c) for op, m, c in sched if op == "F"]
+                bs = [(m, c) for op, m, c in sched if op == "B"]
+                want = {(m, c) for m in range(M) for c in range(V)}
+                assert set(fs) == want and len(fs) == M * V
+                assert set(bs) == want and len(bs) == M * V
+                for c in range(V):
+                    assert [m for m, cc in bs if cc == c] \
+                        == list(range(M))
+                # forwards before the first backward == warmup depth
+                # (+1 for the steady-state F that precedes each B),
+                # capped by the total op count
+                first_b = next(i for i, (op, _, _) in enumerate(sched)
+                               if op == "B")
+                assert first_b == min(2 * (P - 1 - s) + (V - 1) * P + 1,
+                                      M * V)
+    # V=1 == the plain schedule with a zero chunk index
+    for s in range(2):
+        assert interleaved_one_f_one_b(2, s, 4, 1) \
+            == [(op, m, 0) for op, m in one_f_one_b(2, s, 4)]
+    # the layout walks microbatches in groups of P: M % P refused
+    with pytest.raises(ValueError, match="divisible"):
+        interleaved_one_f_one_b(4, 0, 6, 2)
+
+
+def test_topology_helpers():
+    """virtual stage v runs on phys v % P (chunk v // P); V=1 wires a
+    CHAIN (ends have one peer), V>1 closes the RING (chunk boundaries
+    wrap P-1 -> 0); the launcher's addr list indexes by phys stage."""
+    assert [ppt.phys_stage(v, 4) for v in range(8)] \
+        == [0, 1, 2, 3, 0, 1, 2, 3]
+    assert [ppt.chunk_of(v, 4) for v in range(8)] \
+        == [0, 0, 0, 0, 1, 1, 1, 1]
+    assert ppt.virtual_stages(1, 4, 2) == [1, 5]
+    assert ppt.act_peer_stages(0, 4, 1) == [1]          # chain end
+    assert ppt.act_peer_stages(2, 4, 1) == [1, 3]       # chain middle
+    assert ppt.act_peer_stages(0, 4, 2) == [1, 3]       # ring wraps
+    assert ppt.act_peer_stages(0, 1, 2) == []           # P=1: no wire
+    assert ppt.act_peer_addrs(0, ["a:1", "b:2"], 2) == {1: "b:2"}
+    with pytest.raises(ValueError, match="n_micro % stages"):
+        ppt.validate_topology(4, 2, 6)
+
+
+def test_pipeline_interleaved_v2_matches_fused_bitwise():
+    """ACCEPTANCE (ISSUE 15): the interleaved driver — 2 physical
+    stages each owning 2 chunks of a 4-stage program, ring-routed
+    activations — matches the fused microbatched reference BITWISE
+    (losses and every leaf) over several optimizer steps, exactly like
+    the plain 1F1B parity contract."""
+    import optax
+    params, full, mb = _mlp_case(micro=4)
+    prog = StagePartitioner(4).build(mlp_loss, params, mb,
+                                     name="ileave")
+    assert prog is not None
+    stores = [ActStore(), ActStore()]
+    acts = [ActivationExchange(0, stores[0],
+                               peers={1: LocalActPeer(stores[1])},
+                               num_phys=2, timeout_ms=15000),
+            ActivationExchange(1, stores[1],
+                               peers={0: LocalActPeer(stores[0])},
+                               num_phys=2, timeout_ms=15000)]
+    tx = optax.adam(1e-2)
+    drv = [PipelineStageDriver(prog, s, params, tx, acts[s], 4,
+                               virtual=2) for s in (0, 1)]
+    # each phys stage owns its round-robin chunks' leaves
+    for s in (0, 1):
+        want = [li for v in (s, s + 2)
+                for li in prog.stage_param_leaves[v]]
+        assert drv[s].own_leaves == want
+    steps = 3
+    results = _run_stages(drv, full, steps)
+    want_losses, want_leaves = _parity_reference(prog, params, full, 4,
+                                                 tx, steps)
+    got = [np.asarray(l) for l in results[1]]   # loss lands on phys 1
+    assert len(got) == steps
+    for a, b in zip(got, want_losses):
+        assert np.array_equal(a, b)
+    for s in (0, 1):
+        for li, val in drv[s].stage_params_tree().items():
+            assert np.array_equal(val, np.asarray(want_leaves[li]))
+
+
+def test_interleaved_driver_refusals():
+    """A program not divisible by V, or sequential + virtual, refuses
+    loudly at construction — never a silently wrong layout."""
+    import optax
+    params, full, mb = _mlp_case(micro=4)
+    prog3 = StagePartitioner(3).build(mlp_loss, params, mb, name="odd")
+    assert prog3 is not None
+    act = ActivationExchange(0, ActStore(), timeout_ms=1000)
+    with pytest.raises(ValueError, match="divisible"):
+        PipelineStageDriver(prog3, 0, params, None, act, 4, virtual=2)
+    prog4 = StagePartitioner(4).build(mlp_loss, params, mb, name="seq4")
+    with pytest.raises(ValueError, match="sequential"):
+        PipelineStageDriver(prog4, 0, params, optax.adam(1e-2), act, 4,
+                            schedule="sequential", virtual=2)
+
+
 def _transformer_pp_parity(loss_fn, params, full, micro, name):
     """Shared slow-lane harness: 2-stage x `micro`-microbatch pipeline
     vs the fused microbatched reference, under the grad-exactness

@@ -324,7 +324,18 @@ def test_native_server_tsan_stress():
     reference: SURVEY §5 'Race detection: none in-tree'): concurrent
     pushers racing COPY_FIRST/SUM_RECV, round-blocked pulls racing
     publication, probes racing engines, shutdown racing in-flight calls.
-    TSAN exits non-zero on any race; the driver checks sums too."""
+    TSAN exits non-zero on any race; the driver checks sums too.
+
+    History: this failed for several PRs with ~60 "double lock of a
+    mutex" warnings plus data races where two threads both "held" the
+    same mutex — physically impossible reports. Root cause: gcc 10's
+    libtsan does not intercept pthread_cond_clockwait (GCC PR
+    sanitizer/97868, fixed in gcc 11), which libstdc++ uses for every
+    STEADY-clock cv wait on glibc >= 2.30, so the waiter's invisible
+    unlock/relock corrupted tsan's lock shadow. Fixed at the source:
+    Server::Pull's timed wait routes through the REALTIME clock
+    (pthread_cond_timedwait, intercepted) under __SANITIZE_THREAD__
+    only — see bps_server.cc. Zero warnings since."""
     import os
     import shutil
     import subprocess
