@@ -14,7 +14,9 @@ exchange, holding
     residual each compress reads is exactly the previous committed
     round's — and a round that DIES between push and pull never
     commits, leaving the EF state consistent for the retry instead of
-    double-counting the dead round's error.
+    double-counting the dead round's error. (Compress-active keys pin
+    ``BPS_MAX_LAG=1``, so this two-round window holds even when the
+    rest of the fleet runs bounded-stale — docs/admission.md.)
 
 Levels are PINNED PER ROUND: the exchange snapshots ``level_of`` for
 every bucket when the round opens, and both the push and the pull of

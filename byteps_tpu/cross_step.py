@@ -23,11 +23,13 @@ EXACT sync-SGD semantics:
     segment gated on the readiness of exactly the param leaves it
     reads (``PS_XSTEP_GATE`` timeline spans measure the stall);
   - the exchange admits step k+1's pushes while step k's straggler
-    pulls are outstanding (two-round in-flight window — per-key
-    admission in ``PSGradientExchange`` keeps the single-published-
-    round server exact), and landed buckets are PULLED by next-step
-    first-use priority, so the input-side layers fwd(k+1) needs first
-    are applied first instead of last.
+    pulls are outstanding (the admission plane's per-key KeyGate —
+    depth 1 is the classic two-round in-flight window that keeps the
+    single-published-round server exact; ``BPS_MAX_LAG=K`` deepens it
+    to K rounds with server-side round versioning, docs/admission.md),
+    and landed buckets are PULLED by next-step first-use priority, so
+    the input-side layers fwd(k+1) needs first are applied first
+    instead of last.
 
 Bit-exactness argument: a segment of step k+1 reads a param leaf only
 after that leaf's step-k apply was dispatched (gate) and never after
@@ -82,6 +84,16 @@ class CrossStepDriver:
         # restarting at 0 would let later gates pass against stale
         # installs). Unsharded trainers start at 0, unchanged.
         self._epoch = getattr(trainer, "_sharded_epoch", 0)
+        # bounded staleness (docs/admission.md): the segment gate for
+        # step e waits on epoch plane.gate_round(e) = e - K instead of
+        # e - 1, so up to K rounds ride the PS concurrently and a
+        # straggler costs lag, not wall-clock. K=1 (the default) is the
+        # classic gate, bit-for-bit. The APPLY ordering below is NOT
+        # relaxed — tails still apply in step order; only the forward's
+        # read of the weights may trail by K steps.
+        plane = getattr(self._ex, "plane", None)
+        self._gate_round = (plane.gate_round if plane is not None
+                            else lambda e: e - 1)
         self._tails: List[threading.Thread] = []
         self._err = None             # (exc, applied_groups, epoch)
         self._err_lock = threading.Lock()
@@ -182,7 +194,8 @@ class CrossStepDriver:
         chunked = self._chunked
         # pipeline health gauges: how many straggler tails are alive,
         # and how far the slowest leaf's applied epoch lags the step
-        # counter (steady-state 1; growing lag = the tail is losing)
+        # counter (steady-state 1, up to K under BPS_MAX_LAG; lag
+        # growing past K = the tail is losing)
         reg = get_registry()
         reg.gauge("xstep/tails_in_flight").set(len(self._tails) + 1)
         reg.gauge("xstep/epoch_lag").set(
@@ -204,7 +217,7 @@ class CrossStepDriver:
                 return
             t0 = time.time()
             chunked.wait_epoch(
-                leaf_ids, e - 1,
+                leaf_ids, self._gate_round(e),
                 should_abort=lambda: self._err is not None)
             self._check_err()
             observe_stage("PS_XSTEP_GATE", time.time() - t0)

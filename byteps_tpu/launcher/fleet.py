@@ -66,6 +66,28 @@ def free_port(host: str = "127.0.0.1") -> int:
         return s.getsockname()[1]
 
 
+class PortLease:
+    """A held-open port reservation: bind an ephemeral port with
+    SO_REUSEPORT and KEEP the socket open for the lease's lifetime.
+    ``free_port``'s bind-close leaves a window where the kernel can
+    hand the same ephemeral port to anyone — including a lingering
+    reconnect dialer from an earlier test in the same process, whose
+    foreign frame then SIGABRTs gloo's pair listener mid-init. The
+    lease socket never listens (no backlog, no accepts), so it eats no
+    traffic; the real server (gRPC/gloo both set SO_REUSEPORT on
+    Linux) binds alongside it, and the kernel won't recycle a port
+    that still has a live bound socket."""
+
+    def __init__(self, host: str = "127.0.0.1"):
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        self._sock.bind((host, 0))
+        self.port: int = self._sock.getsockname()[1]
+
+    def close(self) -> None:
+        self._sock.close()
+
+
 def wait_for_ports(addrs: Sequence[str], timeout_s: float = 30.0,
                    interval_s: float = 0.05) -> None:
     """Block until every ``host:port`` accepts a TCP connect — the
@@ -159,6 +181,11 @@ class FleetManifest:
     host: str = "127.0.0.1"
     scheduling_credit: int = 0
     extra_env: Dict[str, str] = field(default_factory=dict)
+    # targeted overrides: {selector: {ENV: val}} where selector is a
+    # role class ("worker"/"server") or one process name ("w-s0r1") —
+    # name beats class. How a bench arm makes exactly ONE replica a
+    # straggler (bench.py ps_lag) without touching its peers.
+    role_env: Dict[str, Dict[str, str]] = field(default_factory=dict)
     # filled by build()
     server_addrs: List[str] = field(default_factory=list)
     act_addrs: List[List[str]] = field(default_factory=list)
@@ -219,6 +246,10 @@ class FleetManifest:
                     # DP fleets (stages == 1) restart singly — the
                     # PR-13 per-key reseed path.
                     group=(f"r{r}" if self.stages > 1 else None)))
+        for sp in specs:
+            for sel in (sp.role, sp.name):    # name wins over class
+                if sel in self.role_env:
+                    sp.env.update(self.role_env[sel])
         return specs
 
     # ----------------------------------------------------- env contracts
@@ -602,7 +633,8 @@ def run_command_fleet(cmd: Sequence[str], num_processes: int,
     does not read the flag from the env, so the launcher cannot carry
     it). Returns per-rank (rc, captured output).
     """
-    port = free_port()
+    lease = PortLease()       # held open: port can't be recycled under us
+    port = lease.port
     specs = []
     for pid in range(int(num_processes)):
         env = _inherited_env()
@@ -623,6 +655,7 @@ def run_command_fleet(cmd: Sequence[str], num_processes: int,
         sup.wait(timeout_s=timeout_s)
     finally:
         sup.drain(timeout_s=10.0)
+        lease.close()
     return [ProcResult(n, sup._managed[n].rc, sup.tail(n, 1 << 20))
             for n in sup.roles()]
 

@@ -19,7 +19,9 @@ Two modes (``BPS_FLEET_MODE``):
     counters. Exit 0 == clean drain.
   - ``rounds``: the PR-13 elasticity proof ride-along — a plain
     deterministic PS exchange loop (constant grads, sum must equal
-    dp x value every round) that a supervisor-restarted replacement
+    dp x value every round — relaxed to a uniform 1..dp under
+    ``BPS_MAX_LAG>1``, where sealed rounds carry fewer contributions)
+    that a supervisor-restarted replacement
     REJOINS mid-job: its fresh exchange seeds per-key round counters
     from the server, so it resumes the JOB's round, not round 1
     (tests/_elastic_ps_worker.py's contract, now supervisor-driven).
@@ -52,6 +54,7 @@ def _run_rounds() -> int:
     steps = _env_int("BPS_FLEET_STEPS", 4)
     nbytes = _env_int("BPS_FLEET_NBYTES", 1 << 16)
     wid = _env_int("BPS_WORKER_ID", 0)
+    max_lag = _env_int("BPS_MAX_LAG", 1)
     incarnation = _env_int("BPS_FLEET_INCARNATION", 0)
     addrs = [a for a in os.environ.get("BPS_SERVER_ADDRS", "").split(",")
              if a]
@@ -64,8 +67,12 @@ def _run_rounds() -> int:
     ex = PSGradientExchange(be, partition_bytes=nbytes // 4)
     # per-round pacing (simulated compute): gives the kill tests a
     # window to land a SIGKILL mid-job, and makes the survivor's
-    # per-round walls a meaningful stall measurement
-    pace = float(os.environ.get("BPS_FLEET_STEP_SLEEP", "0") or 0)
+    # per-round walls a meaningful stall measurement. BPS_FLEET_SEG_MS
+    # (the train mode's emulated-compute knob) adds on top — the
+    # ps_lag bench sets it on ONE worker via the manifest's role_env
+    # to make that worker the straggler.
+    pace = (float(os.environ.get("BPS_FLEET_STEP_SLEEP", "0") or 0)
+            + float(os.environ.get("BPS_FLEET_SEG_MS", "0") or 0) / 1e3)
     tree = {"g": np.ones(nbytes // 4, np.float32)}
     done = 0
     resumed_at = None
@@ -81,9 +88,24 @@ def _run_rounds() -> int:
             # per-key server seeding — the PR-13 rejoin proof)
             resumed_at = done
         wall = time.time() - t0
-        if not np.allclose(out["g"], float(dp)):
-            print(f"FLEET_ERROR round {done}: sum {out['g'][0]} != {dp}",
-                  flush=True)
+        if max_lag > 1:
+            # bounded staleness: a sealed round publishes WITHOUT some
+            # workers (they late-fold into a later round, which then
+            # carries their push twice — once late, once current), and
+            # each PARTITION seals independently. The per-round relaxed
+            # contract is: every element is a whole contribution count
+            # in [1, dp*max_lag]; exactly-once delivery ACROSS rounds
+            # is the store's conservation invariant, asserted in
+            # tests/test_admission.py (docs/admission.md)
+            g = out["g"]
+            ok = bool(np.all((g >= 1 - 1e-6)
+                             & (g <= dp * max_lag + 1e-6))
+                      and np.allclose(g, np.round(g)))
+        else:
+            ok = bool(np.allclose(out["g"], float(dp)))
+        if not ok:
+            print(f"FLEET_ERROR round {done}: sum {out['g'][0]} != {dp}"
+                  f" (max_lag={max_lag})", flush=True)
             return 3
         print("FLEET_STEP " + json.dumps(
             {"worker": wid, "round": done, "wall_s": round(wall, 4),

@@ -24,6 +24,11 @@ and each segment is attributed to a category:
   straggler      merge-wait on a slow worker's push: pull span ∩
                  [first arrival, num_workers-th arrival], blamed on
                  the LAST arrival's worker id
+  absorbed       bounded-staleness carve (BPS_MAX_LAG>1): a SEALED
+                 round's grace wait, plus the merge-wait the seal
+                 AVOIDED — the missing worker's eventual arrival
+                 minus the sealed serve. At K=1 no round ever seals,
+                 so this is always zero and straggler keeps the blame
   admission      the cross-step per-key admission gate (PS_XSTEP_GATE)
   credit         wire-scheduler credit wait carved out of push spans
   apply          optimizer apply
@@ -99,7 +104,9 @@ def _server_index(server_spans, t0_s: float) -> Dict[Tuple, dict]:
         win = {"first": (first - t0_s) * 1e6,
                "complete": (None if complete is None
                             else (complete - t0_s) * 1e6),
-               "serve_end": None, "blame": None}
+               "serve_end": None, "blame": None,
+               "sealed": bool(r.get("sealed")),
+               "missing": tuple(r.get("missing") or ())}
         serves = r.get("serves") or ()
         if serves:
             s0 = min(serves, key=lambda s: s["t"])
@@ -146,7 +153,12 @@ def _attribute_segment(s: _Span, a: float, b: float, srv: Dict,
             complete = win["complete"]
             if complete is not None:
                 strag = _overlap(a, b, first, complete)
-                if strag > 0:
+                if strag > 0 and win.get("sealed"):
+                    # the round published WITHOUT the missing worker:
+                    # this chain time is the bounded-staleness grace,
+                    # not a merge-wait on anyone — no straggler blame
+                    _add(seg, "absorbed", strag)
+                elif strag > 0:
                     _add(seg, "straggler", strag)
                     if win["blame"] is not None:
                         blame[win["blame"]] = \
@@ -239,6 +251,42 @@ def attribute(events: List[dict], server_spans: Optional[List[dict]] = None,
         # sum to the window and fracs cannot silently skew toward
         # whatever the walked tail contained
         _add(cats, "gap", cursor - t_start)
+    # Bounded-staleness credit (BPS_MAX_LAG>1): a sealed round's pull
+    # returns fast and LEAVES the blocking chain, so the wait it
+    # avoided is invisible to the backward sweep. Sweep ALL of this
+    # step's PS_PULL spans: for each sealed round, the absorbed wait is
+    # the missing worker's eventual arrival (its late push, whichever
+    # round it folded into) minus the sealed serve — exactly the
+    # merge-wait K=1 would have put on the chain as `straggler`. At
+    # K=1 no record is ever sealed and this pass contributes nothing.
+    absorbed: Dict[int, float] = {}
+    arr_by: Dict[Tuple[int, int], List[float]] = {}
+    if any(w.get("sealed") for w in srv.values()):
+        for r in server_spans or ():
+            k = int(r.get("key", 0))
+            for a in r.get("arrivals") or ():
+                if a.get("t") is not None:
+                    arr_by.setdefault((k, int(a.get("w", 0))), []).append(
+                        (float(a["t"]) - t0) * 1e6)
+        for ts in arr_by.values():
+            ts.sort()
+        seen_sealed = set()
+        for s in spans:
+            if s.stage != "PS_PULL" or s.round is None:
+                continue
+            kr = (s.key, int(s.round))
+            win = srv.get(kr)
+            if win is None or not win["sealed"] or kr in seen_sealed:
+                continue
+            seen_sealed.add(kr)
+            end = win["serve_end"] or win["complete"] or win["first"]
+            for m in win["missing"]:
+                later = next((t for t in arr_by.get((s.key, int(m)), ())
+                              if t > end), None)
+                if later is not None:
+                    absorbed[int(m)] = absorbed.get(int(m), 0.0) \
+                        + (later - end)
+                    _add(cats, "absorbed", later - end)
     total_us = t_end - t_start
     res = {
         "schema": SCHEMA, "step": step,
@@ -261,6 +309,11 @@ def attribute(events: List[dict], server_spans: Optional[List[dict]] = None,
         res["straggler"] = {"worker": w, "wait_s": round(us / 1e6, 6),
                             "by_worker": {str(k): round(v / 1e6, 6)
                                           for k, v in blame.items()}}
+    if absorbed:
+        w, us = max(absorbed.items(), key=lambda kv: kv[1])
+        res["absorbed"] = {"worker": w, "wait_s": round(us / 1e6, 6),
+                           "by_worker": {str(k): round(v / 1e6, 6)
+                                         for k, v in absorbed.items()}}
     return res
 
 
@@ -269,6 +322,7 @@ def merge_results(results: List[dict]) -> dict:
     CLI's and bench rigs' per-run summary)."""
     cats: Dict[str, float] = {}
     blame: Dict[str, float] = {}
+    absorbed: Dict[str, float] = {}
     total = 0.0
     for r in results:
         if not r:
@@ -279,6 +333,9 @@ def merge_results(results: List[dict]) -> dict:
         for w, s in ((r.get("straggler") or {}).get("by_worker")
                      or {}).items():
             blame[w] = blame.get(w, 0.0) + s
+        for w, s in ((r.get("absorbed") or {}).get("by_worker")
+                     or {}).items():
+            absorbed[w] = absorbed.get(w, 0.0) + s
     out = {"schema": SCHEMA, "steps": sum(1 for r in results if r),
            "window_s": round(total, 6),
            "categories": {c: round(s, 6) for c, s in sorted(cats.items())},
@@ -290,6 +347,11 @@ def merge_results(results: List[dict]) -> dict:
         out["straggler"] = {"worker": int(w), "wait_s": round(s, 6),
                             "by_worker": {k: round(v, 6)
                                           for k, v in blame.items()}}
+    if absorbed:
+        w, s = max(absorbed.items(), key=lambda kv: kv[1])
+        out["absorbed"] = {"worker": int(w), "wait_s": round(s, 6),
+                           "by_worker": {k: round(v, 6)
+                                         for k, v in absorbed.items()}}
     return out
 
 
@@ -365,6 +427,11 @@ def format_report(per_step: List[dict], agg: dict,
     if strag:
         lines.append(f"  == straggler: worker {strag['worker']:#x} "
                      f"({strag['wait_s'] * 1e3:.1f}ms merge-wait)")
+    absd = agg.get("absorbed")
+    if absd:
+        lines.append(f"  == absorbed: worker {absd['worker']:#x} "
+                     f"({absd['wait_s'] * 1e3:.1f}ms merge-wait absorbed "
+                     f"by bounded staleness)")
     return "\n".join(lines)
 
 

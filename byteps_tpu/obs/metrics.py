@@ -101,7 +101,16 @@ PP_GAUGES: Tuple[str, ...] = ("pp/stage", "pp/stages",
 # crit/steps counts attributed steps.
 CRIT_CATEGORIES: Tuple[str, ...] = (
     "compute", "d2h", "host", "wire", "server_queue", "straggler",
-    "admission", "credit", "h2d", "apply", "gap", "other")
+    "absorbed", "admission", "credit", "h2d", "apply", "gap", "other")
+
+# Bounded-staleness admission (server/admission.py StaleStore):
+# stale-serve / barrier decisions and the lag budget actually used —
+# pre-registered so the Prometheus export names the lag plane's
+# families before the first sealed round (all-zero at K=1)
+LAG_COUNTERS: Tuple[str, ...] = (
+    "lag/stale_serves", "lag/barrier_falls", "lag/late_folds",
+    "lag/evicted_serves")
+LAG_GAUGES: Tuple[str, ...] = ("lag/max_streak",)
 
 # ONE truthiness rule shared with Config (BPS_STATS must resolve
 # identically whether read here or through Config.stats_on)
@@ -322,6 +331,10 @@ class MetricsRegistry:
             self.gauge(f"crit/{c}_s")
             self.gauge(f"crit/{c}_frac")
         self.counter("crit/steps")
+        for c in LAG_COUNTERS:
+            self.counter(c)
+        for g in LAG_GAUGES:
+            self.gauge(g)
 
     def _get(self, name: str, cls, *args):
         m = self._metrics.get(name)

@@ -112,22 +112,47 @@ class ServerSpanRing:
                 self._rounds.popitem(last=False)
         return rec
 
-    def note_arrival(self, key: int, wid: int, nbytes: int) -> None:
+    def note_arrival(self, key: int, wid: int, nbytes: int,
+                     rnd: Optional[int] = None) -> None:
         """One APPLIED push landed for ``key`` (dedup duplicates are the
-        caller's job to filter — ``_apply_push_once`` reports them)."""
+        caller's job to filter — ``_apply_push_once`` reports them).
+        The round is count-derived by default (classic path: every
+        round sees exactly ``num_workers`` arrivals); lag-managed keys
+        pass ``rnd`` explicitly, because sealing breaks the count
+        invariant (a sealed round has fewer arrivals, its late
+        stragglers fold into a later one)."""
         if not self.enabled:
             return
         t = time.time()
         with self._lock:
-            n = self._counts.get(key, 0) + 1
-            self._counts[key] = n
-            rnd = (n - 1) // self.num_workers + 1
-            rec = self._rec(key, rnd)
+            if rnd is None:
+                n = self._counts.get(key, 0) + 1
+                self._counts[key] = n
+                rnd = (n - 1) // self.num_workers + 1
+            rec = self._rec(key, int(rnd))
             if rec["first_t"] is None:
                 rec["first_t"] = t
             rec["arrivals"].append({"w": int(wid), "t": t,
                                     "b": int(nbytes)})
             if len(rec["arrivals"]) >= self.num_workers:
+                rec["complete_t"] = t
+
+    def note_seal(self, key: int, rnd: int, missing) -> None:
+        """Round (key, rnd) published WITHOUT ``missing`` workers'
+        gradients (bounded-staleness seal): mark the record so the
+        critical-path analyzer attributes its skew as ``absorbed``
+        rather than ``straggler``, and close its completion clock —
+        arrivals stopped counting toward this round at the seal."""
+        if not self.enabled:
+            return
+        t = time.time()
+        with self._lock:
+            rec = self._rec(key, int(rnd))
+            rec["sealed"] = True
+            rec["missing"] = sorted(int(m) for m in missing)
+            if rec["first_t"] is None:
+                rec["first_t"] = t
+            if rec["complete_t"] is None:
                 rec["complete_t"] = t
 
     def note_serve(self, key: int, rnd: int, t0: float,

@@ -395,6 +395,12 @@ class HostPSBackend:
         # are served as payload bytes, no dense decode through the
         # engine. Lazy: plain deployments never allocate it.
         self._homog = None
+        # bounded-staleness round store (server/admission.StaleStore):
+        # keys declared via declare_lag have their rounds versioned and
+        # served under the K-lag contract instead of the native
+        # complete-count engine. Lazy like _homog: K=1 deployments
+        # never allocate it and stay bit-identical.
+        self._stale = None
         self.hash_fn = hash_fn
         from ..common.naming import check_mixed_mode_enabled, placement_from_env
         check_mixed_mode_enabled(hash_fn)
@@ -644,10 +650,52 @@ class HostPSBackend:
         Fused-managed keys answer from the homog store — its counter IS
         the key's round authority (in-process migration never moves it,
         so no base applies)."""
+        if self._stale is not None and self._stale.managed(key):
+            return self._stale.round(key)
         if self._homog_managed(key):
             return self._homog.round(key)
         return (self._round_base.get(key, 0)
                 + int(self._shard(key).round(key)))
+
+    # --------------------------------------- bounded staleness (K>1)
+
+    def declare_lag(self, key: int, max_lag: int) -> None:
+        """Hand ``key``'s rounds to the bounded-staleness store with
+        bound ``max_lag`` (idempotent; conflicting K is a loud error).
+        The key must be init_key'd first — the store snapshots its
+        size/dtype from the declaration. The native engine keeps the
+        key's dense store (async pulls, raw clients) but versioned
+        rounds are served exclusively from the StaleStore."""
+        meta = self._key_meta.get(key)
+        if meta is None:
+            raise KeyError(f"declare_lag({key}) before init_key")
+        nbytes, dtype = meta[0], meta[1]
+        if self._stale is None:
+            from .admission import StaleStore
+            self._stale = StaleStore(self.num_workers, spans=self.spans)
+        self._stale.declare(key, nbytes // np.dtype(dtype).itemsize,
+                            dtype, max_lag)
+
+    def push_lag(self, key: int, worker: int, rnd: int,
+                 data: np.ndarray) -> None:
+        """Versioned-round push: fold ``worker``'s round-``rnd``
+        gradient (or late-fold it into the open round — the arrival is
+        recorded against the round it actually landed in, so the span
+        ring's (key, round) joins stay truthful under sealing)."""
+        tgt = self._stale.push(key, worker, rnd, data)
+        self.spans.note_arrival(key, int(worker), data.nbytes, rnd=tgt)
+
+    def pull_lag(self, key: int, worker: int, rnd: int,
+                 out: np.ndarray, timeout_ms: int = 30000) -> int:
+        """Versioned-round pull; returns the verdict flags
+        (admission.LAG_COMPLETE / LAG_STALE / LAG_BARRIER)."""
+        import time
+        t0 = time.time()
+        flags = self._stale.pull(key, worker, rnd, out, timeout_ms)
+        dur = time.time() - t0
+        self._m_pull_wait.observe(dur)
+        self.spans.note_serve(key, rnd, t0, dur)
+        return flags
 
     def migrate_key(self, key: int, dst: int) -> int:
         """Move ``key``'s store to shard ``dst`` at a round boundary:

@@ -136,6 +136,11 @@ class PlanePSBackend:
         # new shard's sum)
         self._replayed: Dict[int, int] = {}
         self._logged: Dict[int, int] = {}
+        # bounded-staleness contract (key -> K), replayed on failover:
+        # the promoted shard's fresh StaleStore relearns the bound and
+        # its adopt rule resyncs to the live round on the first push
+        # (docs/admission.md failure matrix)
+        self._lag_contract: Dict[int, int] = {}
         # keys being migrated right now: push must not slip a new round
         # onto the OLD primary between migrate_key's drain check and
         # the routing switch (that round would be silently lost)
@@ -333,6 +338,9 @@ class PlanePSBackend:
                         nbytes, dtype, init, compression = meta
                         self._init_on(dst, key, nbytes, dtype, init,
                                       compression)
+                    lagk = self._lag_contract.get(key)
+                    if lagk is not None:
+                        self._shards[dst].declare_lag(key, lagk)
                     # the new primary WAS the key's backup (ring
                     # successor), so the forward log is already local to
                     # it; its store counts rounds from 0 → re-base onto
@@ -766,6 +774,32 @@ class PlanePSBackend:
     def round(self, key: int) -> int:
         base = self._round_base.get(key, 0)
         return base + int(self._run(key, lambda sh, i: sh.round(key)))
+
+    # Bounded-staleness plane surface (server/admission.py StaleStore):
+    # lag ops route like any dense op — primary shard, one failover
+    # retry. The contract itself is the only replayed state: a promoted
+    # shard's fresh store re-learns K (fail_shard) and its adopt rule
+    # resyncs to the live round on the first push, so no per-round lag
+    # state rides the replica log.
+
+    def declare_lag(self, key: int, max_lag: int) -> None:
+        if not all(hasattr(sh, "declare_lag") for sh in self._shards):
+            raise ValueError(
+                "BPS_MAX_LAG>1 needs lag-capable plane shards "
+                "(declare_lag/push_lag/pull_lag) on every shard — a "
+                "failover can land the key on any of them")
+        self._run(key, lambda sh, i: sh.declare_lag(key, int(max_lag)))
+        with self._lock:
+            self._lag_contract[key] = int(max_lag)
+
+    def push_lag(self, key: int, worker: int, rnd: int,
+                 data: np.ndarray) -> None:
+        self._run(key, lambda sh, i: sh.push_lag(key, worker, rnd, data))
+
+    def pull_lag(self, key: int, worker: int, rnd: int, out: np.ndarray,
+                 timeout_ms: int = 30000) -> int:
+        return int(self._run(key, lambda sh, i: sh.pull_lag(
+            key, worker, rnd, out, timeout_ms)))
 
     def _check_fused_shards(self) -> None:
         """Refuse fused ops EARLY on a plane with any shard that cannot

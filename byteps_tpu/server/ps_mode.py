@@ -22,10 +22,8 @@ Two modes, mirroring the reference's two PS deployments:
 
 from __future__ import annotations
 
-import heapq
 import os
 import threading
-from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
@@ -36,6 +34,7 @@ from ..common.naming import NameRegistry
 from ..common.partition import LeafSpec, plan_buckets
 from ..obs import flight
 from ..obs.metrics import get_registry, observe_stage
+from .admission import LAG_BARRIER, AdmissionPlane
 from .engine import HostPSBackend
 
 
@@ -413,15 +412,19 @@ class _Round:
     def submit_bucket(self, idx: int) -> None:
         """Queue bucket ``idx``'s pack+push; its pull is enqueued into
         the exchange's priority scheduler when the push lands. The push
-        is ADMITTED per PS key: with two rounds in flight (cross-step),
-        round k+1's push for a key waits until round k's pull of that
-        key completed — the server publishes one round per key at a
-        time, so an earlier push would overwrite the merge a straggler
-        pull still needs (torn assembly)."""
+        is ADMITTED per PS key by the admission plane's KeyGate: at
+        K=1 (two rounds in flight, cross-step) round k+1's push for a
+        key waits until round k's pull of that key completed — the
+        server publishes one round per key at a time, so an earlier
+        push would overwrite the merge a straggler pull still needs
+        (torn assembly). Under ``BPS_MAX_LAG=K`` the gate is a
+        counting semaphore of depth K and the server versions rounds
+        (docs/admission.md), so up to K+1 rounds overlap per key."""
         ex = self.ex
         pskey, _ = self.keyed[idx]
-        ex._admit_key(pskey, lambda: ex._push_ex.submit(self._push_task,
-                                                        idx))
+        ex.plane.gate.admit(pskey,
+                            lambda: ex._push_ex.submit(self._push_task,
+                                                       idx))
 
     def _push_task(self, idx: int) -> None:
         pskey, _ = self.keyed[idx]
@@ -430,7 +433,7 @@ class _Round:
             buf = self.push_one(idx)
         except BaseException as e:   # noqa: BLE001 — relayed to consumers
             self.bucket_state[idx] = "failed"
-            self.ex._release_key(pskey)
+            self.ex.plane.gate.release(pskey)
             if skip:
                 self._skip_finished(e)
             else:
@@ -480,7 +483,7 @@ class _Round:
             plane.commit(pskey, self.rounds[idx])
         self.bucket_state[idx] = "param_done"
         ex._mark_progress()
-        ex._release_key(pskey)
+        ex.plane.gate.release(pskey)
         self._skip_finished(None)
 
     def _skip_finished(self, exc: Optional[BaseException]) -> None:
@@ -630,7 +633,9 @@ class PSGradientExchange:
                  min_compress_bytes: int = 65536,
                  pipeline_depth: Optional[int] = None,
                  watchdog_sec: Optional[float] = None,
-                 compress: Optional[str] = None) -> None:
+                 compress: Optional[str] = None,
+                 max_lag: Optional[int] = None,
+                 worker_id: Optional[int] = None) -> None:
         self.backend = backend
         self.partition_bytes = partition_bytes
         self.registry = registry or NameRegistry()
@@ -688,19 +693,23 @@ class PSGradientExchange:
         self._push_ex: Optional[ThreadPoolExecutor] = None
         self._pull_ex: Optional[ThreadPoolExecutor] = None
         self._ex_lock = threading.Lock()
-        # two-round in-flight window (cross-step): per-key admission —
-        # a key with a pushed-but-not-yet-pulled bucket holds later
-        # rounds' pushes for the SAME key in a FIFO until its pull
-        # completes (the server publishes one round per key at a time)
-        self._key_lock = threading.Lock()
-        self._key_busy: set = set()
-        self._key_waiters: Dict[int, deque] = {}
-        # landed-bucket pull scheduler: a min-heap ordered by (round
-        # age, next-step first-use priority) — see _Round.pull_prio
-        self._pull_heap: List = []
-        self._pull_heap_lock = threading.Lock()
-        self._pull_seq = 0
-        self._round_seq = 0
+        # unified admission plane (server/admission.py): owns the
+        # per-key push gate (depth K — a key with K pushed-but-unpulled
+        # rounds holds later pushes in a per-key FIFO), the
+        # landed-bucket pull priority queue, and — via the process
+        # global — the two-class wire send scheduler. K=1 (the default)
+        # is the classic two-rounds-in-flight cross-step window; K>1
+        # routes dense rounds through the server's bounded-staleness
+        # store (BPS_MAX_LAG / push_lag / pull_lag).
+        self.plane = AdmissionPlane(max_lag=max_lag, worker_id=worker_id)
+        if self.plane.max_lag > 1 and not hasattr(backend, "push_lag"):
+            # config-time capability check, mirroring the compression
+            # plane's: a backend without the versioned-round surface
+            # would silently train at K=1 while the worker runs ahead
+            raise ValueError(
+                f"BPS_MAX_LAG={self.plane.max_lag} needs a backend "
+                f"with declare_lag/push_lag/pull_lag; "
+                f"{type(backend).__name__} has none")
         # per-PS-key worker compressor chain (momentum→ef→codec) — holds
         # EF error / momentum state, so it outlives the plan cache entry
         # (reference: per-partition compressor_list in BPSContext,
@@ -725,8 +734,6 @@ class PSGradientExchange:
         self._m_d2h_bytes = reg.counter("ps/d2h_bytes")
         self._m_buckets = reg.counter("ps/buckets_completed")
         self._m_rounds = reg.gauge("ps/rounds_in_flight")
-        self._m_adm_wait = reg.histogram("ps/admission_wait_s")
-        self._m_adm_defer = reg.counter("ps/admission_deferred")
         import time as _time
         # MONOTONIC: an NTP step on the wall clock must neither fake a
         # stall nor hide one (the watchdog diffs this against its own
@@ -826,12 +833,8 @@ class PSGradientExchange:
                 "skips_left": r._skips_left,
                 "buckets": buckets,
             })
-        with self._key_lock:
-            adm = {"busy": sorted(self._key_busy),
-                   "waiters": {k: len(v)
-                               for k, v in self._key_waiters.items()}}
         return {"in_flight": self.in_flight_buckets(),
-                "rounds": rounds, "admission": adm}
+                "rounds": rounds, "admission": self.plane.gate.state()}
 
     def _ensure_watchdog(self) -> None:
         if self._watchdog is not None or self._watchdog_sec <= 0:
@@ -904,8 +907,16 @@ class PSGradientExchange:
             if pskey not in self._d2h_layer:
                 self._d2h_layer[pskey] = get_registry().counter(
                     f"ps/d2h_bytes/{decl_name}.{b.index}")
+        if self.plane.max_lag > 1:
+            # bounded staleness covers the DENSE path only: compressed
+            # chains and fused-plane keys keep their classic one-round
+            # stores (their codecs assume complete sums), so they stay
+            # at the K=1 contract while dense keys absorb stragglers
+            for pskey, b in keyed:
+                if self._lag_routes(pskey):
+                    self.backend.declare_lag(pskey, self.plane.max_lag)
         if hasattr(self.backend, "set_send_priority"):
-            # two-class wire scheduler (server/sched.py): gradient
+            # two-class wire scheduler (admission plane): gradient
             # frames carry reverse-FIRST-USE priority — the bucket
             # holding the earliest-declared (input-side) leaves sends
             # first under BPS_SCHEDULING_CREDIT, the same order the
@@ -993,34 +1004,24 @@ class PSGradientExchange:
         return nxt
 
     def _next_round_seq(self) -> int:
-        with self._pull_heap_lock:
-            self._round_seq += 1
-            return self._round_seq
+        return self.plane.pulls.next_round_seq()
 
     # ------------------------------------------------ pull scheduling
     #
     # Pushes keep backward-completion order (bucket 0 = output-side
     # layers, available first), but pulls drain by NEXT-STEP FIRST-USE
-    # priority: among landed buckets, the one holding the earliest-
-    # declared (input-side) leaves is pulled first, because those
-    # params gate fwd(k+1)'s first gated segment. Without this, the
-    # reverse-packed plan applies the input layers LAST and the
-    # cross-step overlap window collapses to zero.
+    # priority — the plane's PullQueue (see admission.PullQueue for the
+    # why of that ordering).
 
     def _enqueue_pull(self, rnd: "_Round", idx: int, buf) -> None:
-        with self._pull_heap_lock:
-            seq = self._pull_seq
-            self._pull_seq += 1
-            heapq.heappush(self._pull_heap,
-                           (rnd.round_seq, rnd.pull_prio[idx], seq,
-                            rnd, idx, buf))
+        self.plane.pulls.put(rnd.round_seq, rnd.pull_prio[idx],
+                             (rnd, idx, buf))
         self._pull_ex.submit(self._pull_next)
 
     def _pull_next(self) -> None:
         """One pull slot: drain the highest-priority landed bucket
         (not necessarily the one whose push scheduled this slot)."""
-        with self._pull_heap_lock:
-            _, _, _, rnd, idx, buf = heapq.heappop(self._pull_heap)
+        rnd, idx, buf = self.plane.pulls.pop()
         pskey, _ = rnd.keyed[idx]
         exc: Optional[BaseException] = None
         try:
@@ -1038,49 +1039,8 @@ class PSGradientExchange:
                                f"round={rnd.rounds[idx]}: "
                                f"{type(e).__name__}: {e}")
         finally:
-            self._release_key(pskey)
+            self.plane.gate.release(pskey)
             rnd._pull_finished(exc)
-
-    # ------------------------------------------------ per-key admission
-
-    def _admit_key(self, pskey: int, submit) -> None:
-        """Run ``submit`` now if ``pskey`` has no pushed-but-unpulled
-        bucket in flight, else defer it until that bucket's pull
-        completes (FIFO per key, so rounds stay ordered on the wire).
-        Deferred admissions are counted and their wait timed — the
-        admission gate is where a lost pull turns into a silent wedge,
-        so its depth/latency are first-class signals."""
-        with self._key_lock:
-            if pskey in self._key_busy:
-                import time
-                self._m_adm_defer.inc()
-                t0 = time.time()
-
-                def deferred(submit=submit, t0=t0):
-                    wait = time.time() - t0
-                    self._m_adm_wait.observe(wait)
-                    flight.record("admit", key=pskey,
-                                  detail=f"deferred {wait:.3f}s")
-                    submit()
-
-                self._key_waiters.setdefault(pskey,
-                                             deque()).append(deferred)
-                return
-            self._key_busy.add(pskey)
-        flight.record("admit", key=pskey)
-        submit()
-
-    def _release_key(self, pskey: int) -> None:
-        with self._key_lock:
-            waiters = self._key_waiters.get(pskey)
-            if waiters:
-                submit = waiters.popleft()
-                if not waiters:
-                    del self._key_waiters[pskey]
-            else:
-                self._key_busy.discard(pskey)
-                return
-        submit()                     # key stays busy for the successor
 
     def _routed(self, rnd, op) -> None:
         """Run ``op(epoch)`` under the round's placement-epoch tag.
@@ -1096,6 +1056,24 @@ class PSGradientExchange:
         except WrongEpoch:
             rnd.route_epoch = self.backend.placement_epoch()
             return op(rnd.route_epoch)
+
+    def _lag_routes(self, pskey: int) -> bool:
+        """Does ``pskey`` ride the bounded-staleness path? Only with
+        K>1, and only dense keys (see the _plan declaration note)."""
+        return (self.plane.max_lag > 1
+                and pskey not in self._chains
+                and (self._cplane is None
+                     or not self._cplane.active(pskey)))
+
+    def _lag_verdict(self, pskey: int, rnd_num: int, flags: int) -> None:
+        """Worker-side note of the server's serve verdict (the server
+        records the DECISION; this names what this worker observed)."""
+        if flags and flight.get_recorder().enabled:
+            verdict = ("barrier" if flags & LAG_BARRIER
+                       else "stale")
+            flight.record("lag_admit",
+                          detail=f"verdict={verdict} key={pskey} "
+                                 f"round={rnd_num} (served)")
 
     def _round_level(self, rnd, idx: int) -> int:
         """The codec level this round's decision trace pinned for
@@ -1250,6 +1228,14 @@ class PSGradientExchange:
             plane.note_dense_push(pskey, buf.nbytes)
             buf = plane.fold_residual(pskey, buf, round_tag)
         self._m_push_bytes.inc(buf.nbytes)
+        if (rnd is not None and idx is not None
+                and self._lag_routes(pskey)):
+            # versioned-round push: the server folds it into round
+            # rounds[idx] (or the open round, if that one already
+            # sealed without us — the late-fold contract)
+            self.backend.push_lag(pskey, self.plane.worker_id,
+                                  rnd.rounds[idx], buf)
+            return
         self._routed(rnd, lambda epoch:
                      self.backend.push(pskey, buf, epoch=epoch)
                      if epoch is not None
@@ -1316,6 +1302,13 @@ class PSGradientExchange:
                 self._record(rnd.decl_name, "PS_DECOMPRESS", pskey,
                              t0, step=rnd.step_tag)
                 return merged
+        if rnd_num and self._lag_routes(pskey):
+            flags = self.backend.pull_lag(pskey, self.plane.worker_id,
+                                          rnd_num, buf)
+            self._lag_verdict(pskey, rnd_num, flags)
+            self._m_pull_bytes.inc(buf.nbytes)
+            self._pull_layer_inc(pskey, buf.nbytes)
+            return buf
         self._routed(rnd, lambda epoch:
                      self.backend.pull(pskey, buf, round=rnd_num,
                                        epoch=epoch)

@@ -1,224 +1,34 @@
-"""Two-class priority scheduler for the transport's send path.
+"""Compatibility shim: the two-class wire send scheduler moved into
+the unified admission plane (``server/admission.py``), which owns
+every "may this byte proceed" decision — the per-key push gate, the
+pull priority queue, the wire credit gate, and the bounded-staleness
+round store. Importers of ``server.sched`` keep working; the class,
+trace shape, metrics, and ``send_admit`` flight events are unchanged.
 
-BytePS's core loops never write a tensor to the wire unscheduled:
-every partition enters a priority queue (``scheduled_queue.cc:82-146``,
-priority = reverse declaration order so the NEXT forward's first layers
-jump the line) and a byte CREDIT caps how much may be in flight at
-once (``BYTEPS_SCHEDULING_CREDIT``, scheduled_queue.cc:35-45) — that
-is what lets a small, late, latency-critical frame overtake a
-bandwidth burst already queued. We reproduced the 12-stage pipeline
-but, with one traffic class (gradients), never needed the scheduler.
-
-Pipeline parallelism adds the second class: activations /
-activation-grads (``OP_ACT_PUSH``) are LATENCY-sensitive — a stage
-blocks until they arrive — while gradient pushes are BANDWIDTH-heavy
-and deadline-free until the next step's first use. ``SendScheduler``
-is the wire-admission gate both classes pass through before their
-bytes touch a socket:
-
-- entries are ordered ``(priority desc, key asc, fifo)`` — the
-  reference's ``scheduled_queue`` comparator;
-- ``CLASS_ACT`` frames carry a large priority base so they always
-  outrank ``CLASS_GRAD``; within grads, the exchange assigns
-  reverse-FIRST-USE priorities (input-side buckets first — the same
-  order its cross-step pull heap drains, so the send and pull sides
-  agree on who is urgent);
-- ``BPS_SCHEDULING_CREDIT`` bytes may be in flight at once (one frame
-  is always admitted even if larger than the whole credit, so a giant
-  bucket cannot deadlock). While a burst holds the credit, later
-  frames QUEUE — and queued order is priority order, which is exactly
-  when an activation overtakes.
-
-The queue is per egress endpoint in spirit; in this process model all
-of a worker's connections share one host NIC, so the scheduler is
-process-global (``current()``) and every client (gradient backends,
-activation exchanges) routes sends through the same instance — the
-reference's per-connection queues collapse to one when the bottleneck
-is the shared NIC. With the credit at 0 (default) the scheduler is
-inert: sends are admitted immediately and nothing queues.
-
-Every admission is recorded in a bounded trace (class, key, priority,
-enqueue/admit sequence numbers, wait) — the "scheduler trace" the
-tests and ``bench.py pp`` assert overtakes from — plus registry
-metrics (``sched/admitted_act``, ``sched/admitted_grad``,
-``sched/overtakes``, ``sched/credit_wait_s``).
+``configure()`` / ``current()`` delegate to the plane's process-global
+instance, so mixing old and new import paths still yields ONE
+scheduler per process.
 """
 
 from __future__ import annotations
 
-import heapq
-import itertools
-import os
-import threading
-import time
-from collections import deque
-from typing import List, Optional
+from typing import Optional
 
-from ..obs.metrics import get_registry
-
-CLASS_GRAD = 0
-CLASS_ACT = 1
-
-# CLASS_ACT priority base: any activation outranks any gradient bucket
-# (grad priorities are leaf-count-bounded, far below this)
-ACT_PRIO_BASE = 1 << 20
-
-# frames at or below this ride free (request headers, acks, control
-# ops) — same reasoning as throttle.Nic.SMALL_FRAME: scheduling tiny
-# frames buys nothing and a queued ack would stall the very pipeline
-# the scheduler exists to keep busy
-MIN_SCHED_BYTES = 4096
-
-
-class _Ticket:
-    __slots__ = ("klass", "prio", "key", "nbytes", "seq", "t_enq")
-
-    def __init__(self, klass: int, prio: int, key: int, nbytes: int,
-                 seq: int) -> None:
-        self.klass = klass
-        self.prio = prio
-        self.key = key
-        self.nbytes = int(nbytes)
-        self.seq = seq
-        self.t_enq = time.monotonic()
-
-    def order(self):
-        """Heap key: priority DESC, key ASC, then FIFO — the BytePS
-        ``scheduled_queue`` comparator."""
-        eff = self.prio + (ACT_PRIO_BASE if self.klass == CLASS_ACT else 0)
-        return (-eff, self.key, self.seq)
-
-
-class SendScheduler:
-    """Wire-admission gate: ``acquire`` blocks until the frame is the
-    highest-priority queued entry AND byte credit is available;
-    ``release`` returns the credit once the bytes left this host
-    (the transport releases after the frame's roundtrip send — with a
-    paced/throttled socket that spans the frame's true wire time, the
-    closest host-side analogue of the reference's ack-released
-    credits)."""
-
-    def __init__(self, credit_bytes: int, trace_cap: int = 4096) -> None:
-        self.credit = int(credit_bytes)
-        self._cv = threading.Condition()
-        self._heap: List[tuple] = []          # (order, ticket)
-        self._seq = itertools.count(1)
-        self._inflight = 0
-        self._trace: deque = deque(maxlen=trace_cap)
-        self._admit_seq = 0
-        reg = get_registry()
-        self._m_act = reg.counter("sched/admitted_act")
-        self._m_grad = reg.counter("sched/admitted_grad")
-        self._m_overtakes = reg.counter("sched/overtakes")
-        self._m_wait = reg.histogram("sched/credit_wait_s")
-        self._g_inflight = reg.gauge("sched/inflight_bytes")
-
-    # ------------------------------------------------------------ gate
-
-    def acquire(self, klass: int, prio: int, key: int,
-                nbytes: int) -> Optional[_Ticket]:
-        """Block until this frame may be written. Returns the ticket to
-        pass to ``release`` (None for frames below the scheduling
-        floor — nothing to release)."""
-        if nbytes <= MIN_SCHED_BYTES:
-            return None
-        t = _Ticket(klass, prio, key, nbytes, next(self._seq))
-        entry = (t.order(), t)
-        with self._cv:
-            heapq.heappush(self._heap, entry)
-            while not (self._heap[0] is entry
-                       and (self._inflight == 0
-                            or self._inflight + t.nbytes <= self.credit)):
-                self._cv.wait(1.0)
-            heapq.heappop(self._heap)
-            self._inflight += t.nbytes
-            self._g_inflight.set(self._inflight)
-            self._admit_seq += 1
-            # an overtake: some entry enqueued BEFORE us is still
-            # queued — we jumped the line on priority
-            overtook = any(e[1].seq < t.seq for e in self._heap)
-            waited = time.monotonic() - t.t_enq
-            self._trace.append({
-                "class": "act" if klass == CLASS_ACT else "grad",
-                "key": key, "prio": prio, "nbytes": t.nbytes,
-                "enq_seq": t.seq, "admit_seq": self._admit_seq,
-                "wait_s": waited, "overtook": overtook,
-                # wall-clock ADMIT stamp: the credit wait occupied
-                # [t - wait_s, t] — the interval the critical-path
-                # analyzer subtracts out of PS_PUSH spans as "credit"
-                "t": time.time(),
-            })
-        (self._m_act if klass == CLASS_ACT else self._m_grad).inc()
-        if overtook:
-            self._m_overtakes.inc()
-        self._m_wait.observe(waited)
-        # flight-recorder send-admission event, KEY-LESS like the codec
-        # decisions (obs/flight.py): the admission ordering is context
-        # for EVERY key's postmortem — a frame that waited did so
-        # because of some OTHER key's burst, so filtering it out of
-        # that key's dump would hide exactly the why. The enabled check
-        # comes FIRST: with the recorder off the per-frame cost must
-        # stay one attribute read, not an f-string build.
-        from ..obs import flight
-        if flight.get_recorder().enabled:
-            flight.record(
-                "send_admit", nbytes=t.nbytes,
-                detail=f"class={'act' if klass == CLASS_ACT else 'grad'} "
-                       f"key={key} prio={prio} wait_ms={waited * 1e3:.1f} "
-                       f"overtook={overtook}")
-        return t
-
-    def release(self, ticket: Optional[_Ticket]) -> None:
-        if ticket is None:
-            return
-        with self._cv:
-            self._inflight -= ticket.nbytes
-            self._g_inflight.set(self._inflight)
-            self._cv.notify_all()
-
-    # ------------------------------------------------------------ views
-
-    def trace(self) -> List[dict]:
-        """Admission records, oldest first (bounded window)."""
-        with self._cv:
-            return list(self._trace)
-
-    def queued(self) -> int:
-        with self._cv:
-            return len(self._heap)
-
-    def inflight(self) -> int:
-        return self._inflight
-
-
-# ---------------------------------------------------------------- global
-
-_lock = threading.Lock()
-_current: Optional[SendScheduler] = None
-_configured = False
+from .admission import (     # noqa: F401 — re-exported surface
+    ACT_PRIO_BASE,
+    CLASS_ACT,
+    CLASS_GRAD,
+    MIN_SCHED_BYTES,
+    SendScheduler,
+    _Ticket,
+    configure_send,
+    send_scheduler,
+)
 
 
 def configure(credit_bytes: Optional[int] = None) -> Optional[SendScheduler]:
-    """(Re)build the process-global scheduler. ``None`` re-reads
-    ``BPS_SCHEDULING_CREDIT`` (``BYTEPS_SCHEDULING_CREDIT`` accepted);
-    credit <= 0 disables. Called by ``bps.init`` so the env contract
-    matches every other knob; tests call it directly between arms."""
-    global _current, _configured
-    if credit_bytes is None:
-        credit_bytes = int(
-            os.environ.get("BPS_SCHEDULING_CREDIT",
-                           os.environ.get("BYTEPS_SCHEDULING_CREDIT", "0"))
-            or 0)
-    with _lock:
-        _current = SendScheduler(credit_bytes) if credit_bytes > 0 else None
-        _configured = True
-        return _current
+    return configure_send(credit_bytes)
 
 
 def current() -> Optional[SendScheduler]:
-    """The process-global scheduler, or None when disabled. First call
-    resolves from the env so directly-constructed transports (tests,
-    scripts without ``bps.init``) honor ``BPS_SCHEDULING_CREDIT``."""
-    if not _configured:
-        configure()
-    return _current
+    return send_scheduler()

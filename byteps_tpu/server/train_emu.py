@@ -403,6 +403,11 @@ def run_training(mode: str, n_workers: int, rate: float,
     # the shm/IPC data planes bypass the throttled sockets — pin off
     for k in ("BPS_ENABLE_SHM", "BPS_ENABLE_IPC", "BYTEPS_ENABLE_IPC"):
         env.pop(k, None)
+    # a peer's FIRST push can sit behind its interpreter/torch startup
+    # for tens of seconds on a contended CI box, and the 30 s pull
+    # default then fails a correctness rig on liveness grounds (seen
+    # as a rare [cb] suite flake) — widen it; inherited values win
+    env.setdefault("BPS_PULL_TIMEOUT_MS", "120000")
     # ~32 KB buckets: the torch path's per-PARAM exchanges otherwise
     # ride 256 KB buckets whose coarse frames pace poorly under
     # contended token buckets AND delay each round's completion —
@@ -474,10 +479,16 @@ def run_training(mode: str, n_workers: int, rate: float,
         for be in backends:
             be.close()
     results = []
+    # report EVERY failed worker: a pull-timeout in worker 0 is usually
+    # the SYMPTOM of worker 1 dying/stalling before its push — raising
+    # on the first rank alone hides the root cause's traceback
+    failed = [(wid, out) for wid, (p, out) in enumerate(zip(procs, outs))
+              if p.returncode != 0]
+    if failed:
+        raise RuntimeError("\n\n".join(
+            f"{mode} worker {wid} failed:\n{out[-3000:]}"
+            for wid, out in failed))
     for wid, (p, out) in enumerate(zip(procs, outs)):
-        if p.returncode != 0:
-            raise RuntimeError(
-                f"{mode} worker {wid} failed:\n{out[-3000:]}")
         line = [ln for ln in out.splitlines()
                 if ln.startswith("TRAIN_EMU_RESULT ")]
         if not line:

@@ -39,6 +39,7 @@ client with the same interface as ``HostPSBackend`` (including
 
 from __future__ import annotations
 
+import os
 import socket
 import struct
 import threading
@@ -173,7 +174,16 @@ OP_PARAM_SEQ = 24
 # as OP_STATS: no payload, reuse-safe, NEVER credit-gated, scraped on
 # the dedicated stats channel so a wedged data plane cannot starve it.
 OP_TRACE = 25
+# Bounded staleness (server/admission.StaleStore, docs/admission.md):
+# OP_LAG_DECL declares a key's K bound (rnd = K); it is replayed on
+# reconnect like inits — the failover contract — so a replacement
+# server relearns every key's bound before the first versioned frame.
+# OP_PUSH_LAG / OP_PULL_LAG carry ``rnd = worker_id << 48 | round``
+# (48 bits of round, 16 of worker). The pull response prefixes one
+# verdict byte (admission.LAG_* flags) to the dense payload.
+OP_LAG_DECL, OP_PUSH_LAG, OP_PULL_LAG = 26, 27, 28
 _PART = struct.Struct("!IIHHQ")  # offset, part_len, part_idx, nparts, nonce
+_LAG_ROUND_MASK = (1 << 48) - 1
 ST_OK, ST_ERR, ST_TIMEOUT, ST_GONE = 0, 1, 2, 3
 
 
@@ -381,7 +391,8 @@ _REUSE_SAFE_OPS = frozenset(
      OP_PUSH_F,      # wire.decode materializes (or the engine copies
                      # the dense view) before the handler returns
      OP_ACT_PUSH,    # ActStore.put copies via bytes() synchronously
-     OP_PARAM_PUT})  # ParamStore.put copies via bytes() synchronously
+     OP_PARAM_PUT,   # ParamStore.put copies via bytes() synchronously
+     OP_PUSH_LAG})   # StaleStore.push folds (+=) before returning
 
 
 def _recv_req(sock: socket.socket, rholder: Optional[list] = None):
@@ -542,6 +553,10 @@ class PSTransportServer:
         self._stripe_sweep_at = 0.0
         self._push_lock = threading.Lock()
         self._push_cv = threading.Condition(self._push_lock)
+        # bounded-staleness store for RAW backends (see the lag-op
+        # helpers below) — lazy, K=1 deployments never allocate it
+        self._stale = None
+        self._stale_lock = threading.Lock()
         self._dedup_ttl = float(_os.environ.get(
             "BPS_PUSH_DEDUP_TTL_SECS", "600"))
         self._dedup_sweep_at = 0.0
@@ -728,7 +743,13 @@ class PSTransportServer:
                     key, rnd, plen_rs)
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_ROUND:
-                rv = struct.pack("!Q", int(self._fb.round(key)))
+                # a transport-owned StaleStore (raw-engine fallback)
+                # versions the key's rounds itself — the elastic-rejoin
+                # resync must see ITS counter, not the engine's zeros
+                if self._stale is not None and self._stale.managed(key):
+                    rv = struct.pack("!Q", int(self._stale.round(key)))
+                else:
+                    rv = struct.pack("!Q", int(self._fb.round(key)))
                 conn.sendall(_RSP.pack(ST_OK, len(rv)) + rv)
             elif op == OP_PUSH_SHM:
                 view = self._shm.view(bytes(payload).decode(), int(nbytes))
@@ -883,6 +904,31 @@ class PSTransportServer:
                 body = _json.dumps(self.trace_payload()).encode()
                 conn.sendall(_RSP.pack(ST_OK, len(body)))
                 conn.sendall(body)
+            elif op == OP_LAG_DECL:
+                self._lag_declare(key, int(rnd))
+                conn.sendall(_RSP.pack(ST_OK, 0))
+            elif op == OP_PUSH_LAG:
+                w, r = int(rnd) >> 48, int(rnd) & _LAG_ROUND_MASK
+                arr = np.frombuffer(payload, dtype=dtype)
+                meta = self._key_meta.get(key)
+                if meta is not None and meta[1] != dtype:
+                    arr = arr.astype(meta[1])
+                # the packed rnd doubles as the dedup token: ident
+                # becomes (key, worker<<16), seq the round — exactly
+                # one fold per (worker, round) across reconnect retries
+                self._apply_push_once(
+                    key, rnd, lambda: self._lag_push(key, w, r, arr,
+                                                     len(payload)))
+                conn.sendall(_RSP.pack(ST_OK, 0))
+            elif op == OP_PULL_LAG:
+                w, r = int(rnd) >> 48, int(rnd) & _LAG_ROUND_MASK
+                out = np.empty(int(nbytes) // np.dtype(dtype).itemsize,
+                               dtype=dtype)
+                flags = self._lag_pull(key, w, r, out,
+                                       int(timeout) or 30000)
+                conn.sendall(_RSP.pack(ST_OK, 1 + out.nbytes)
+                             + bytes([flags & 0xFF]))
+                conn.sendall(_as_bytes(out))
             elif op == OP_PULL_C:
                 from .compressed import compressed_pull
                 buf = compressed_pull(self.compressed, self.backend, key,
@@ -927,6 +973,56 @@ class PSTransportServer:
         midpoints against its own send/recv stamps). Reads only
         already-published state, like ``stats_payload``."""
         return self.spans.payload(now=self._trace_now())
+
+    # ------------------------------------------ bounded staleness ops
+    #
+    # A backend with its own lag surface (HostPSBackend) serves the
+    # versioned rounds itself; a RAW engine (PSServer) gets a
+    # transport-owned StaleStore — the FusedFront pattern, applied to
+    # the K-lag contract so every deployment speaks it.
+
+    def _lag_local(self):
+        if self._stale is None:
+            with self._stale_lock:
+                if self._stale is None:
+                    from .admission import StaleStore
+                    self._stale = StaleStore(
+                        getattr(self.backend, "num_workers", 1),
+                        spans=self.spans)
+        return self._stale
+
+    def _lag_declare(self, key: int, max_lag: int) -> None:
+        if hasattr(self.backend, "declare_lag"):
+            self.backend.declare_lag(key, max_lag)
+            return
+        meta = self._key_meta.get(key)
+        if meta is None:
+            raise KeyError(f"declare_lag({key}) before init")
+        nbytes, dtype = meta
+        self._lag_local().declare(
+            key, nbytes // np.dtype(dtype).itemsize, dtype, max_lag)
+
+    def _lag_push(self, key: int, worker: int, rnd: int,
+                  arr: np.ndarray, wire_bytes: int) -> None:
+        if hasattr(self.backend, "push_lag"):
+            self.backend.push_lag(key, worker, rnd, arr)
+            return
+        tgt = self._lag_local().push(key, worker, rnd, arr)
+        if self._own_spans:
+            self.spans.note_arrival(key, worker, wire_bytes, rnd=tgt)
+
+    def _lag_pull(self, key: int, worker: int, rnd: int,
+                  out: np.ndarray, timeout_ms: int) -> int:
+        import time
+        if hasattr(self.backend, "pull_lag"):
+            return int(self.backend.pull_lag(key, worker, rnd, out,
+                                             timeout_ms))
+        t0 = time.time()
+        flags = self._lag_local().pull(key, worker, rnd, out, timeout_ms)
+        self._m_merge_wait.observe(time.time() - t0)
+        if self._own_spans:
+            self.spans.note_serve(key, rnd, t0, time.time() - t0)
+        return int(flags)
 
     def _replica_store(self):
         if self._replica is None:
@@ -1306,6 +1402,11 @@ class RemotePSBackend:
         self._placed: set = set()
         # init_key replay log per shard index: key -> args
         self._inits: List[Dict[int, tuple]] = [dict() for _ in addrs]
+        # bounded-staleness contract replay log (docs/admission.md):
+        # key -> K per shard. A restarted server has an empty StaleStore
+        # — without the re-declaration its first post-reconnect push
+        # would be rejected and the worker's lag budget silently lost
+        self._lag_decls: List[Dict[int, int]] = [dict() for _ in addrs]
         # DEDICATED telemetry channel per shard (OP_STATS, obs/fleet):
         # scrapes must not draw from the data-plane pools — when every
         # pooled channel is parked on a round-blocked pull (the wedged
@@ -1425,6 +1526,11 @@ class RemotePSBackend:
                              len(self._inits[i]))
         for args in self._inits[i].values():
             self._send_init(ch.sock, *args)
+        # replay the K-lag contract after the inits (declare_lag needs
+        # the key's meta present server-side)
+        for k, lag in self._lag_decls[i].items():
+            self._roundtrip(ch.sock, OP_LAG_DECL, k, int(lag), 0, 0,
+                            "uint8", None)
 
     def _send_init(self, sock, key, nbytes, dtype, init, compression,
                    fused=False):
@@ -1509,7 +1615,8 @@ class RemotePSBackend:
     # merged-round-sized payload — unscheduled it would saturate the
     # NIC outside the credit and nothing could overtake it
     _SCHED_GRAD_OPS = frozenset({OP_PUSH, OP_PUSH_C, OP_PUSH_RS,
-                                 OP_PUSH_PART, OP_PUSH_F, OP_REPL_PUT})
+                                 OP_PUSH_PART, OP_PUSH_F, OP_REPL_PUT,
+                                 OP_PUSH_LAG})
 
     def _rpc(self, op: int, key: int, rnd: int, nbytes: int,
              timeout_ms: int, dtype: str, payload: Optional[memoryview],
@@ -1850,7 +1957,14 @@ class RemotePSBackend:
                         f"(sliced waits)") from None
 
     def pull(self, key: int, out: np.ndarray, round: int = 0,
-             timeout_ms: int = 30000) -> None:
+             timeout_ms: Optional[int] = None) -> None:
+        if timeout_ms is None:
+            # the default is a liveness diagnostic, not a correctness
+            # bound — BPS_PULL_TIMEOUT_MS lets contended CI boxes (where
+            # a peer's first round can sit behind interpreter startup
+            # for tens of seconds) widen it without touching prod
+            timeout_ms = int(os.environ.get(
+                "BPS_PULL_TIMEOUT_MS", "30000") or 30000)
         plan = self._stripe_plans.get(key)
         if plan is not None and not out.flags["C_CONTIGUOUS"]:
             # a striped key's data lives ONLY in the sub-keys — falling
@@ -2026,6 +2140,45 @@ class RemotePSBackend:
                 for _, _, skey in plan)
         data = self._rpc(OP_ROUND, key, 0, 0, 0, "uint8", None)
         return struct.unpack("!Q", data)[0]
+
+    # Bounded-staleness client (server/admission.py StaleStore,
+    # docs/admission.md): the K-lag contract is declared once per key,
+    # pushes/pulls carry (worker, round) packed in the frame's round
+    # field, and a pull's reply leads with one verdict byte
+    # (LAG_COMPLETE / LAG_STALE / LAG_BARRIER) before the dense sum.
+
+    def declare_lag(self, key: int, max_lag: int) -> None:
+        """Declare ``key``'s staleness bound K; recorded for replay so
+        a restarted server relearns the contract on reconnect."""
+        self._rpc(OP_LAG_DECL, key, int(max_lag), 0, 0, "uint8", None)
+        self._lag_decls[self._shard(key)][key] = int(max_lag)
+
+    def push_lag(self, key: int, worker: int, rnd: int,
+                 data: np.ndarray) -> None:
+        """Versioned push into ``key``'s round ``rnd``. The packed
+        round field doubles as the server's dedup token — ident
+        (key, worker<<16), seq rnd — so a reconnect retry of the same
+        (worker, round) folds exactly once."""
+        packed = (int(worker) << 48) | (int(rnd) & _LAG_ROUND_MASK)
+        self._rpc(OP_PUSH_LAG, key, packed, 0, 0, str(data.dtype),
+                  _as_bytes(data))
+
+    def pull_lag(self, key: int, worker: int, rnd: int, out: np.ndarray,
+                 timeout_ms: int = 30000) -> int:
+        """Pull round ``rnd``'s published sum; returns the verdict
+        flags. Barrier waits are sliced like dense pulls so connection
+        churn cannot silently re-arm the full server-side wait."""
+        packed = (int(worker) << 48) | (int(rnd) & _LAG_ROUND_MASK)
+
+        def attempt(slice_ms: int) -> int:
+            data = self._rpc(OP_PULL_LAG, key, packed, out.nbytes,
+                             slice_ms, str(out.dtype), None)
+            np.copyto(out, np.frombuffer(data[1:], dtype=out.dtype)
+                      .reshape(out.shape))
+            return data[0]
+
+        return self._sliced_pull(attempt, timeout_ms,
+                                 f"pull_lag({key}) round={rnd}")
 
     # Replica-log client (server plane primary-backup replication,
     # docs/server-plane.md): the plane backend wraps SINGLE-address

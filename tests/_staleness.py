@@ -1,0 +1,167 @@
+"""Shared convergence harnesses for the PS relaxed-consistency tests:
+the async-SGD machinery (weight-delta push, no barrier) and the
+bounded-staleness sync driver (BPS_MAX_LAG=K round pipelining, the
+admission plane's stale-serve/barrier path — docs/admission.md). Both
+train the same seeded linear-regression task so the K=1 / K>1 / async
+endpoints are directly comparable."""
+
+import threading
+import time
+
+import numpy as np
+
+TRUE_W_SEED, STEPS, LR = 2, 300, 0.05
+
+
+def true_weights():
+    return np.random.RandomState(TRUE_W_SEED).randn(8).astype(np.float32)
+
+
+# ------------------------------------------------------------- async
+
+
+def run_async_convergence(workers, applied_rounds, atol=0.05):
+    """Drive ``workers`` (AsyncPSWorker list) concurrently on the same
+    linear-regression task; assert the shared weights converge.
+
+    ``applied_rounds()`` must return how many async pushes the engine has
+    APPLIED (push RPCs ack at enqueue) — polled instead of sleeping so a
+    slow engine thread can't turn into a flaky stale read.
+    """
+    import jax
+
+    true_w = true_weights()
+
+    def loss_fn(w, batch):
+        x, y = batch
+        return ((x @ w - y) ** 2).mean()
+
+    grad_fn = jax.jit(jax.grad(loss_fn))
+    errors = []
+
+    def run(widx):
+        try:
+            wrng = np.random.RandomState(10 + widx)
+            for _ in range(STEPS):
+                w = np.asarray(workers[widx].pull_weights())
+                x = wrng.randn(16, 8).astype(np.float32)
+                y = x @ true_w
+                g = np.asarray(grad_fn(w, (x, y)))
+                workers[widx].push_delta(w - LR * g, w)
+        except Exception as e:  # propagate into the main thread
+            errors.append(e)
+
+    ts = [threading.Thread(target=run, args=(i,))
+          for i in range(len(workers))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errors:
+        raise errors[0]
+    want = STEPS * len(workers)
+    deadline = time.time() + 30
+    while applied_rounds() < want and time.time() < deadline:
+        time.sleep(0.01)
+    assert applied_rounds() >= want, "engine never drained the deltas"
+    final = np.asarray(workers[0].pull_weights())
+    np.testing.assert_allclose(final, true_w, atol=atol)
+
+
+def make_workers(backend_factory, n=2):
+    """(seed_backend, worker_backends, workers): seed initializes the
+    store; each worker gets its own backend connection."""
+    from byteps_tpu.server.ps_mode import AsyncPSWorker
+
+    w0 = np.zeros(8, np.float32)
+    seed_be = backend_factory()
+    AsyncPSWorker(seed_be, w0, init_store=True)
+    worker_bes = [backend_factory() for _ in range(n)]
+    workers = [AsyncPSWorker(be, w0, init_store=False) for be in worker_bes]
+    return seed_be, worker_bes, workers
+
+
+# ------------------------------------------------- bounded staleness
+
+
+def run_lag_convergence(K, steps=STEPS, slow_ms=0.0, slow_window=None,
+                        atol=0.15, grace_ms=2.0, n_workers=2):
+    """Sync exchange workers over one in-process backend at staleness
+    bound ``K``; returns each worker's final weights (all asserted
+    close to the true solution).
+
+    ``slow_ms`` delays worker ``n_workers-1`` per step — over all
+    steps, or only inside ``slow_window=(lo, hi)`` (a TRANSIENT
+    straggler). At K>1 the fast worker's pulls SEAL rounds
+    (stale-serve) and the slow worker's pushes late-fold — every
+    gradient still lands exactly once, which is why convergence holds.
+    Keep the skew transient here: fold-and-mark deliberately bounds a
+    slow worker's CONTRIBUTION gap, not its clock gap, so a permanent
+    straggler trades gradient staleness (accuracy noise at fixed LR)
+    for full-speed peers — the throughput bench's territory, not a
+    fixed-tolerance convergence assert's (docs/admission.md)."""
+    import os
+
+    from byteps_tpu.common.naming import NameRegistry
+    from byteps_tpu.server.engine import HostPSBackend
+    from byteps_tpu.server.ps_mode import PSGradientExchange
+
+    # a small seal grace so ordinary thread jitter completes rounds
+    # instead of sealing them (grace 0 would seal on every scheduling
+    # hiccup — legal, but it turns the symmetric baseline noisy)
+    prev_grace = os.environ.get("BPS_LAG_GRACE_MS")
+    os.environ["BPS_LAG_GRACE_MS"] = str(grace_ms)
+    true_w = true_weights()
+    be = HostPSBackend(num_servers=1, num_workers=n_workers,
+                       engine_threads=2)
+    reg = NameRegistry()
+    exs = [PSGradientExchange(be, partition_bytes=4096, registry=reg,
+                              max_lag=K, worker_id=w)
+           for w in range(n_workers)]
+    ws = [np.zeros(8, np.float32) for _ in range(n_workers)]
+    errors = []
+
+    def run(widx):
+        try:
+            wrng = np.random.RandomState(10 + widx)
+            for s in range(steps):
+                x = wrng.randn(16, 8).astype(np.float32)
+                y = x @ true_w
+                g = ((2.0 / 16) * x.T @ (x @ ws[widx] - y)).astype(
+                    np.float32)
+                out = exs[widx].exchange({"g": g})
+                ws[widx] = (ws[widx]
+                            - LR * np.asarray(out["g"]) / n_workers)
+                if (slow_ms and widx == n_workers - 1
+                        and (slow_window is None
+                             or slow_window[0] <= s < slow_window[1])):
+                    time.sleep(slow_ms / 1e3)
+        except Exception as e:  # propagate into the main thread
+            errors.append(e)
+
+    # pre-plan on one worker, share (the shared-backend idiom — avoids
+    # double init_key racing); the plan also declares the lag contract
+    exs[0]._plan({"g": ws[0]}, None)
+    for ex in exs[1:]:
+        ex._plans = exs[0]._plans
+    ts = [threading.Thread(target=run, args=(i,))
+          for i in range(n_workers)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    try:
+        if errors:
+            raise errors[0]
+        for w in range(n_workers):
+            np.testing.assert_allclose(ws[w], true_w, atol=atol,
+                                       err_msg=f"worker {w} (K={K})")
+    finally:
+        for ex in exs:
+            ex.close()
+        be.close()
+        if prev_grace is None:
+            os.environ.pop("BPS_LAG_GRACE_MS", None)
+        else:
+            os.environ["BPS_LAG_GRACE_MS"] = prev_grace
+    return ws

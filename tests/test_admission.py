@@ -1,0 +1,288 @@
+"""Property suite for the unified admission plane
+(byteps_tpu/server/admission.py): the K=1 path must admit exactly the
+sequences the components it absorbed admitted (per-key gate, pull
+priority heap, wire send scheduler), no key may ever exceed its
+declared lag bound, and the barrier fallback must drain the in-flight
+round before publishing. Plus the convergence matrix at K∈{1,2,4}."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.server.admission import (
+    LAG_BARRIER,
+    LAG_COMPLETE,
+    LAG_STALE,
+    AdmissionPlane,
+    KeyGate,
+    PullQueue,
+    StaleStore,
+)
+
+
+# ------------------------------------------------- K=1 golden replay
+
+
+def test_keygate_depth1_replays_classic_gate():
+    """Depth-1 KeyGate against the old ``_admit_key`` golden: same-key
+    submissions serialize FIFO, distinct keys run concurrently, and a
+    release hands the slot to the oldest waiter."""
+    gate = KeyGate(depth=1)
+    order = []
+    # scripted arrival sequence: a1, a2, b1, a3, release a (x3), b rel.
+    gate.admit(1, lambda: order.append("a1"))       # runs
+    gate.admit(1, lambda: order.append("a2"))       # defers
+    gate.admit(2, lambda: order.append("b1"))       # distinct key: runs
+    gate.admit(1, lambda: order.append("a3"))       # defers behind a2
+    assert order == ["a1", "b1"]
+    st = gate.state()
+    assert st["busy"] == [1, 2]
+    assert st["waiters"] == {1: 2}
+    gate.release(1)                                 # a2 takes the slot
+    assert order == ["a1", "b1", "a2"]
+    gate.release(1)                                 # a3 takes the slot
+    gate.release(1)
+    gate.release(2)
+    assert order == ["a1", "b1", "a2", "a3"]        # exact golden order
+    st = gate.state()
+    assert st["busy"] == [] and st["waiters"] == {}
+
+
+def test_keygate_depth_k_is_counting_semaphore():
+    gate = KeyGate(depth=2)
+    order = []
+    gate.admit(1, lambda: order.append("r1"))
+    gate.admit(1, lambda: order.append("r2"))       # second slot: runs
+    gate.admit(1, lambda: order.append("r3"))       # defers
+    assert order == ["r1", "r2"]
+    gate.release(1)
+    assert order == ["r1", "r2", "r3"]
+    gate.release(1)
+    gate.release(1)
+    assert gate.state() == {"busy": [], "waiters": {}}
+
+
+def test_pullqueue_replays_classic_heap_order():
+    """The pull queue must pop in the old 6-tuple heap order: round_seq
+    first (older exchange rounds before newer), then pull priority,
+    then enqueue order."""
+    q = PullQueue()
+    s1 = q.next_round_seq()
+    s2 = q.next_round_seq()
+    assert s2 > s1
+    q.put(s2, 0, "late-round-hi")
+    q.put(s1, 5, "early-round-lo")
+    q.put(s1, 1, "early-round-hi")
+    q.put(s1, 1, "early-round-hi-2")    # same prio: enqueue order
+    assert len(q) == 4
+    got = [q.pop() for _ in range(4)]
+    assert got == ["early-round-hi", "early-round-hi-2",
+                   "early-round-lo", "late-round-hi"]
+
+
+def test_plane_k1_defaults_match_classic(monkeypatch):
+    monkeypatch.delenv("BPS_MAX_LAG", raising=False)
+    plane = AdmissionPlane()
+    assert plane.max_lag == 1
+    assert plane.gate.depth == 1
+    assert plane.gate_round(7) == 6        # the classic e-1 xstep gate
+    monkeypatch.setenv("BPS_MAX_LAG", "4")
+    monkeypatch.setenv("BPS_WORKER_ID", "3")
+    plane = AdmissionPlane()
+    assert plane.max_lag == 4 and plane.worker_id == 3
+    assert plane.gate_round(7) == 3
+
+
+def test_exchange_k1_never_routes_lag():
+    """K=1 must keep the classic dense path bit-for-bit: the exchange
+    routes nothing through the StaleStore and never declares a lag
+    contract."""
+    from byteps_tpu.server.engine import HostPSBackend
+    from byteps_tpu.server.ps_mode import PSGradientExchange
+
+    be = HostPSBackend(num_servers=1, num_workers=1, engine_threads=1)
+    try:
+        ex = PSGradientExchange(be, partition_bytes=4096)
+        out = ex.exchange({"g": np.ones(32, np.float32)})
+        np.testing.assert_allclose(np.asarray(out["g"]), 1.0)
+        assert be._stale is None           # lazy store never allocated
+        ex.close()
+    finally:
+        be.close()
+
+
+# --------------------------------------------- lag-bound invariant
+
+
+def test_stale_store_never_exceeds_declared_lag():
+    """Randomized paces: across every publish, no worker's miss streak
+    may reach K (the declared bound), and every pushed gradient must
+    land in exactly one published round (sum conservation)."""
+    K, workers, rounds = 3, 3, 40
+    store = StaleStore(num_workers=workers)
+    store.declare(0, 8, "float32", K)
+    rng = np.random.RandomState(0)
+    paces = [0.0, 0.002 * rng.rand(), 0.004 * rng.rand()]
+    pulled = np.zeros(8, np.float64)
+    pulled_lock = threading.Lock()
+    errors = []
+
+    def run(w):
+        try:
+            out = np.zeros(8, np.float32)
+            for r in range(1, rounds + 1):
+                store.push(0, w, r, np.full(8, 1.0, np.float32))
+                flags = store.pull(0, w, r, out, timeout_ms=20000)
+                assert flags in (LAG_COMPLETE, LAG_STALE, LAG_BARRIER,
+                                 LAG_STALE | LAG_BARRIER)
+                if w == 0:      # one designated accountant per round
+                    with pulled_lock:
+                        pulled[:] += out
+                streaks = store.streaks(0)
+                assert max(streaks) <= K - 1, \
+                    f"round {r}: streaks {streaks} exceed K-1={K - 1}"
+                if paces[w]:
+                    time.sleep(paces[w])
+        except Exception as e:  # propagate into the main thread
+            errors.append(e)
+
+    ts = [threading.Thread(target=run, args=(w,)) for w in range(workers)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errors:
+        raise errors[0]
+    # conservation: worker 0 pulled rounds 1..R exactly once; together
+    # with the still-open accumulators (late folds past R) every one of
+    # the workers*rounds unit gradients landed exactly once
+    st = store._keys[0]
+    open_total = float(sum(a.sum() for a in st.acc.values()))
+    total = float(pulled.sum()) + open_total
+    assert total == pytest.approx(workers * rounds * 8.0), \
+        (pulled.sum(), open_total)
+
+
+def test_k1_store_is_strictly_synchronous():
+    """K=1 makes the seal condition unsatisfiable: a pull with any
+    missing worker blocks to its deadline (classic sync semantics)."""
+    store = StaleStore(num_workers=2)
+    store.declare(0, 4, "float32", 1)
+    out = np.zeros(4, np.float32)
+    store.push(0, 0, 1, np.ones(4, np.float32))
+    with pytest.raises(TimeoutError):
+        store.pull(0, 0, 1, out, timeout_ms=200)
+    store.push(0, 1, 1, np.ones(4, np.float32))
+    assert store.pull(0, 0, 1, out) == LAG_COMPLETE
+    np.testing.assert_allclose(out, 2.0)
+    assert store.streaks(0) == [0, 0]
+
+
+def test_conflicting_lag_declaration_is_loud():
+    store = StaleStore(num_workers=2)
+    store.declare(0, 4, "float32", 2)
+    store.declare(0, 4, "float32", 2)          # idempotent
+    with pytest.raises(ValueError, match="disagree on BPS_MAX_LAG"):
+        store.declare(0, 4, "float32", 3)
+
+
+# ------------------------------------------------- barrier semantics
+
+
+def test_barrier_drains_inflight_round_before_publishing(monkeypatch):
+    """2 workers, K=2: A seals round 1 without B, so B's streak hits
+    the bound — A's pull of round 2 must BARRIER until B's (late)
+    round-1 push folds in, and the published round-2 sum must include
+    B's gradient (the drain, not a drop)."""
+    monkeypatch.delenv("BPS_LAG_GRACE_MS", raising=False)
+    store = StaleStore(num_workers=2)
+    store.declare(0, 4, "float32", 2)
+    out = np.zeros(4, np.float32)
+    store.push(0, 0, 1, np.ones(4, np.float32))
+    flags = store.pull(0, 0, 1, out)           # grace 0: seals at once
+    assert flags == LAG_STALE
+    np.testing.assert_allclose(out, 1.0)       # B's grad absent
+    assert store.streaks(0) == [0, 1]          # B at the bound
+
+    res = {}
+    store.push(0, 0, 2, np.ones(4, np.float32))
+
+    def puller():
+        o = np.zeros(4, np.float32)
+        res["flags"] = store.pull(0, 0, 2, o, timeout_ms=15000)
+        res["out"] = o
+
+    t = threading.Thread(target=puller)
+    t.start()
+    time.sleep(0.3)
+    assert t.is_alive(), "pull must barrier while B is at the bound"
+    store.push(0, 1, 1, np.full(4, 2.0, np.float32))   # late: folds to 2
+    t.join(10)
+    assert not t.is_alive()
+    assert res["flags"] & LAG_BARRIER
+    np.testing.assert_allclose(res["out"], 3.0)   # drained, not dropped
+    assert store.streaks(0) == [0, 0]
+
+
+def test_evicted_round_serves_newest_published():
+    """A worker beyond the retention window is served the newest
+    published sum (flagged stale) instead of an error — its pushes
+    late-fold, so nothing is lost; only its read goes fresh."""
+    K = 2
+    store = StaleStore(num_workers=1)     # single worker: every round
+    store.declare(0, 4, "float32", K)     # publishes complete
+    out = np.zeros(4, np.float32)
+    rounds = 2 * K + 4 + 10
+    for r in range(1, rounds + 1):
+        store.push(0, 0, r, np.full(4, float(r), np.float32))
+        store.pull(0, 0, r, out)
+    before = store._m_evicted.value
+    flags = store.pull(0, 0, 1, out)      # long evicted
+    assert flags & LAG_STALE
+    np.testing.assert_allclose(out, float(rounds))    # newest snapshot
+    assert store._m_evicted.value == before + 1
+
+
+def test_rejoin_adopts_live_round():
+    """A fresh store (server failover / elastic rejoin) seeing its
+    first push at round r adopts r-1 as its head instead of stalling
+    the fleet back to round 1."""
+    store = StaleStore(num_workers=2)
+    store.declare(0, 4, "float32", 2)
+    out = np.zeros(4, np.float32)
+    store.push(0, 0, 57, np.ones(4, np.float32))
+    store.push(0, 1, 57, np.ones(4, np.float32))
+    assert store.round(0) == 56
+    assert store.pull(0, 0, 57, out) == LAG_COMPLETE
+    np.testing.assert_allclose(out, 2.0)
+    assert store.round(0) == 57
+
+
+# ------------------------------------------------ convergence matrix
+
+
+@pytest.mark.parametrize("K", [1, 2, 4])
+def test_lag_convergence_matrix(K):
+    """Linear-regression convergence with K rounds in flight; all
+    workers must land on the true weights, and (published sums being
+    immutable snapshots) on identical replicas of each other."""
+    from _staleness import run_lag_convergence
+
+    ws = run_lag_convergence(K)
+    np.testing.assert_allclose(ws[0], ws[1], atol=1e-5)
+
+
+def test_lag_convergence_transient_straggler():
+    """A transient straggler (30 slow steps) at K=2: rounds seal and
+    late-fold while it lags, convergence is unaffected."""
+    from byteps_tpu.obs.metrics import get_registry
+    from _staleness import run_lag_convergence
+
+    reg = get_registry()
+    stale0 = reg.counter("lag/stale_serves").value
+    late0 = reg.counter("lag/late_folds").value
+    run_lag_convergence(2, slow_ms=6.0, slow_window=(100, 130))
+    assert reg.counter("lag/stale_serves").value > stale0
+    assert reg.counter("lag/late_folds").value > late0

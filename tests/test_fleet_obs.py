@@ -605,7 +605,18 @@ def test_prometheus_text_golden():
     reg.gauge("fleet/s1/server/engine_queue_depth").set(7)
     reg.gauge("crit/wire_frac").set(0.62)
     reg.gauge("fleet/s0/clock_offset_s").set(0.003)
+    # bounded-staleness families (docs/admission.md): absorbed critpath
+    # verdict + the lag decision counters/streak gauge
+    reg.gauge("crit/absorbed_frac").set(0.11)
+    reg.gauge("crit/absorbed_s").set(0.8)
+    reg.counter("lag/stale_serves").inc(4)
+    reg.counter("lag/barrier_falls").inc(1)
+    reg.gauge("lag/max_streak").set(1)
     golden = "\n".join([
+        '# TYPE bps_crit_absorbed_frac gauge',
+        'bps_crit_absorbed_frac 0.11',
+        '# TYPE bps_crit_absorbed_s gauge',
+        'bps_crit_absorbed_s 0.8',
         '# TYPE bps_crit_wire_frac gauge',
         'bps_crit_wire_frac 0.62',
         '# TYPE bps_fleet_clock_offset_s gauge',
@@ -613,6 +624,12 @@ def test_prometheus_text_golden():
         '# TYPE bps_fleet_server_engine_queue_depth gauge',
         'bps_fleet_server_engine_queue_depth{shard="s0"} 2',
         'bps_fleet_server_engine_queue_depth{shard="s1"} 7',
+        '# TYPE bps_lag_barrier_falls_total counter',
+        'bps_lag_barrier_falls_total 1',
+        '# TYPE bps_lag_max_streak gauge',
+        'bps_lag_max_streak 1',
+        '# TYPE bps_lag_stale_serves_total counter',
+        'bps_lag_stale_serves_total 4',
         '# TYPE bps_plane_epoch gauge',
         'bps_plane_epoch 3',
         '# TYPE bps_ps_push_bytes_total counter',

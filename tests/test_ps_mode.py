@@ -90,7 +90,7 @@ def test_native_pack_matches_numpy_pack():
 def test_async_workers_converge():
     """Two async workers train the same linear model without a barrier;
     the shared weights must still converge (async-SGD semantics)."""
-    from _async_sgd import make_workers, run_async_convergence
+    from _staleness import make_workers, run_async_convergence
 
     be = HostPSBackend(num_servers=1, num_workers=2, engine_threads=1,
                        async_mode=True)
@@ -167,7 +167,7 @@ def test_async_bf16_delta_wire(monkeypatch):
     at half width, the fp32 store upcasts, training still converges
     (VERDICT r2 #7)."""
     monkeypatch.setenv("BPS_ASYNC_WIRE_DTYPE", "bfloat16")
-    from _async_sgd import make_workers, run_async_convergence
+    from _staleness import make_workers, run_async_convergence
 
     be = HostPSBackend(num_servers=1, num_workers=2, engine_threads=1,
                        async_mode=True)

@@ -31,10 +31,15 @@ def model_bytes(mode: str, n: int) -> float:
     return float(GRAD_BYTES)
 
 
-# the 16-process fleets take minutes each on a shared-core box — slow
-# lane (the tier-1 wire-bytes contract stays covered at N=8)
+# the 8/16-process fleets cost ~1 min+ each on a shared-core box
+# (dominated by spawning N interpreters, not by the byte accounting) —
+# slow lane. The tier-1 wire-bytes contract stays covered at N=4,
+# where the model already separates the modes (ring 1.5G vs ps G) and
+# the ratios are just as tight.
 @pytest.mark.parametrize("mode,n", [
-    ("ring", 8), ("ps", 8),
+    ("ring", 4), ("ps", 4),
+    pytest.param("ring", 8, marks=pytest.mark.slow),
+    pytest.param("ps", 8, marks=pytest.mark.slow),
     pytest.param("ps", 16, marks=pytest.mark.slow),
 ])
 def test_wire_bytes_match_scaling_model(mode, n):

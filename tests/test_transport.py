@@ -332,7 +332,7 @@ def test_async_ps_over_wire_converges():
     """Async-SGD (weight-delta push, no barrier) with workers talking to
     the engine over TCP — the reference's BYTEPS_ENABLE_ASYNC mode in
     its networked deployment shape."""
-    from _async_sgd import make_workers, run_async_convergence
+    from _staleness import make_workers, run_async_convergence
 
     be = PSServer(num_workers=2, engine_threads=1, async_mode=True)
     srv = PSTransportServer(be, host="127.0.0.1")
