@@ -2123,6 +2123,102 @@ def ps_lag_breakdown(steps: int = 40, skip: int = 6,
     }
 
 
+def ps_hier_breakdown(steps: int = 24, skip: int = 4,
+                      nbytes: int = 1 << 21,
+                      rate: float = 40e6) -> dict:
+    """THE HEADLINE RIG (ISSUE 17): hierarchical intra-host aggregation
+    on REAL OS processes — two rounds-mode fleets at dp=4 over 2 server
+    shards whose NICs are throttled to ``rate`` bytes/sec
+    (BPS_NIC_RATE via role_env, so the cross-host link is the
+    bottleneck), one flat (local_size=1: every worker pushes its full
+    grad to the remote shards) and one hierarchical (local_size=2: each
+    2-worker "host" folds locally in its agg process, which alone
+    pushes ONE host-sum upstream — launcher/hier_agg.py).
+
+    Measured:
+      - cross-host push bytes: the flat arm's workers' ``ps/push_bytes``
+        (their push traffic IS the cross-host traffic) vs the hier
+        arm's aggs' ``ps/remote_push_bytes`` (the workers' pushes stop
+        at the local hop). Asserted ≤ 0.55× — the arithmetic is
+        dense/local_size = 0.5×, the slack absorbs framing.
+      - step wall: median FLEET_STEP wall (warmup skipped), asserted
+        ≥ 1.3× faster hierarchical — the remote NIC moves half the
+        bytes per round in each direction.
+      - bitwise parity: per-(worker, round) crc32 digests of the pulled
+        sums (BPS_FLEET_GRAD=dyadic — sums exact in fp32, so flat
+        per-worker association and hier sum-of-host-sums must agree to
+        the byte) asserted identical across arms.
+    """
+    import statistics
+
+    from byteps_tpu.launcher.fleet import FleetManifest, run_fleet
+
+    def run_arm(local_size):
+        man = FleetManifest(
+            stages=1, dp=4, shards=2, steps=steps,
+            local_size=local_size,
+            extra_env={
+                "BPS_FLEET_MODE": "rounds",
+                "BPS_FLEET_NBYTES": str(nbytes),
+                "BPS_FLEET_GRAD": "dyadic"},
+            # throttle ONLY the remote shards: the emulated cross-host
+            # link. The local hop (worker→agg loopback) stays at host
+            # speed — that asymmetry is the regime hierarchical
+            # aggregation exists for.
+            role_env={"srv0": {"BPS_NIC_RATE": str(rate)},
+                      "srv1": {"BPS_NIC_RATE": str(rate)}})
+        out = run_fleet(man, timeout_s=600, max_restarts=0)
+        if not out["ok"]:
+            raise RuntimeError(
+                f"ps_hier arm local_size={local_size} failed: "
+                f"{out['exit_codes']} (logs: {out['logdir']})")
+        walls = []
+        with open(os.path.join(out["logdir"], "w-s0r0.log"), "r",
+                  errors="replace") as f:
+            for line in f:
+                if line.startswith("FLEET_STEP "):
+                    walls.append(
+                        json.loads(line[len("FLEET_STEP "):])["wall_s"])
+        assert len(walls) > skip, f"worker logged {len(walls)} rounds"
+        digests = {n: r["digests"] for n, r in out["workers"].items()}
+        if local_size > 1:
+            cross = sum(a["remote_push_bytes"]
+                        for a in out["aggs"].values())
+            assert out["aggs"], "hier arm spawned no agg roles"
+        else:
+            cross = sum(r["push_bytes"] for r in out["workers"].values())
+        return {"wall": statistics.median(walls[skip:]),
+                "cross_bytes": cross, "digests": digests}
+
+    flat = run_arm(1)
+    hier = run_arm(2)
+
+    assert flat["digests"] == hier["digests"], (
+        "hier arm is not bitwise-identical to flat: "
+        f"{flat['digests']} vs {hier['digests']}")
+    byte_ratio = hier["cross_bytes"] / flat["cross_bytes"]
+    assert byte_ratio <= 0.55, (
+        f"hier cross-host bytes must be ≈ dense/local_size: "
+        f"{hier['cross_bytes']} vs flat {flat['cross_bytes']} "
+        f"({byte_ratio:.3f}x > 0.55)")
+    speedup = flat["wall"] / hier["wall"]
+    assert speedup >= 1.3, (
+        f"hier must win the wire-bound step: flat {flat['wall']}s vs "
+        f"hier {hier['wall']}s ({speedup:.2f}x < 1.3)")
+    return {
+        "shape": {"dp": 4, "local_size": 2, "shards": 2,
+                  "steps": steps, "skip": skip, "nbytes": nbytes,
+                  "nic_rate": rate},
+        "step_wall_median_s": {"flat": round(flat["wall"], 4),
+                               "hier": round(hier["wall"], 4)},
+        "speedup": round(speedup, 3),
+        "cross_host_push_bytes": {"flat": flat["cross_bytes"],
+                                  "hier": hier["cross_bytes"]},
+        "byte_ratio": round(byte_ratio, 4),
+        "bitwise_parity": True,
+    }
+
+
 _BREAKDOWNS = {
     "ps_tail": lambda: ps_tail_breakdown(),
     "ps_head": lambda: ps_head_breakdown(),
@@ -2136,6 +2232,7 @@ _BREAKDOWNS = {
     "ps_elastic": lambda: ps_elastic_breakdown(),
     "fleet": lambda: fleet_breakdown(),
     "ps_lag": lambda: ps_lag_breakdown(),
+    "ps_hier": lambda: ps_hier_breakdown(),
 }
 
 

@@ -124,7 +124,82 @@ _TOPOLOGY_KEYS = frozenset({
     "BPS_SERVER_ADDRS", "BPS_SERVER_PORT", "BPS_PLANE_REPLICAS",
     "BPS_PP_STAGES", "BPS_PP_RANK", "BPS_PP_MICROBATCH",
     "BPS_PP_VIRTUAL", "BPS_PP_ACT_ADDRS",
+    "BPS_HIER_UPSTREAM_ADDRS", "BPS_HIER_HOST_ID",
 })
+
+
+# ------------------------------------------------------------ shm sweep
+
+_SHM_DIR = "/dev/shm"
+_SHM_PREFIX = "bps-shm-"
+
+
+def _live_shm_names() -> set:
+    """Names of bps shm segments currently mapped by any live process
+    this uid can inspect via /proc/*/maps — which is exactly the set of
+    processes that could hold a mapping of our 0600 segments."""
+    live = set()
+    try:
+        pids = [p for p in os.listdir("/proc") if p.isdigit()]
+    except OSError:
+        return live
+    for pid in pids:
+        try:
+            with open(f"/proc/{pid}/maps") as f:
+                for line in f:
+                    i = line.find(_SHM_DIR + "/" + _SHM_PREFIX)
+                    if i < 0:
+                        continue
+                    path = line[i:].strip()
+                    if path.endswith(" (deleted)"):
+                        path = path[:-len(" (deleted)")]
+                    live.add(os.path.basename(path))
+        except OSError:
+            continue      # raced an exit, or not ours to read
+    return live
+
+
+def sweep_stale_shm(grace_s: float = 5.0) -> List[str]:
+    """Unlink ``/dev/shm/bps-shm-*`` segments stranded by SIGKILLed
+    processes (the hazard transport.py documents on ``_PosixShm``: the
+    worker normally unlinks its own segments, so only an unclean death
+    leaves one behind). The supervisor runs this on every role restart
+    and at drain, so a long-lived fleet's kill/heal churn cannot leak
+    host shm. Returns the swept names.
+
+    A segment is swept only when it is (a) owned by this uid, (b)
+    older than ``grace_s`` — a just-created segment's open→mmap window
+    must not race the sweep — and (c) mapped by NO live process: a
+    running worker's own mapping, or a server's ``_ShmCache``
+    attachment, protects it (unlinking under a live mapping would be
+    harmless to the mapping itself but would break the server's next
+    attach-by-name)."""
+    swept: List[str] = []
+    try:
+        names = [n for n in os.listdir(_SHM_DIR)
+                 if n.startswith(_SHM_PREFIX)]
+    except OSError:
+        return swept
+    if not names:
+        return swept
+    live = _live_shm_names()
+    now = time.time()
+    uid = os.getuid()
+    for name in names:
+        path = os.path.join(_SHM_DIR, name)
+        try:
+            st = os.stat(path)
+        except OSError:
+            continue
+        if (st.st_uid != uid or name in live
+                or now - max(st.st_ctime, st.st_mtime) < grace_s):
+            continue
+        try:
+            os.unlink(path)
+            swept.append(name)
+        except OSError:
+            pass
+    return swept
 
 
 def _inherited_env() -> Dict[str, str]:
@@ -171,6 +246,13 @@ class FleetManifest:
     micro: int = 4                 # microbatches per step
     shards: int = 0                # 0 = auto: servers only when needed
     plane_replicas: int = 0
+    # hierarchical aggregation (server/hier.py): replicas are grouped
+    # into "hosts" of local_size; each host gets a local aggregator
+    # role its workers push/pull against, and only the host SUM rides
+    # the cross-host wire to the shards (whose round gate becomes
+    # dp // local_size hosts). Gated by BPS_HIER_AGG on/off/auto —
+    # local_size == 1 derives a manifest byte-identical to the flat one.
+    local_size: int = 1
     steps: int = 4
     schedule: str = "1f1b"
     # training spec (the built-in mlp fleet worker)
@@ -189,6 +271,7 @@ class FleetManifest:
     # filled by build()
     server_addrs: List[str] = field(default_factory=list)
     act_addrs: List[List[str]] = field(default_factory=list)
+    agg_addrs: List[str] = field(default_factory=list)
 
     def needs_servers(self) -> bool:
         return self.dp > 1 or self.shards > 0
@@ -209,6 +292,15 @@ class FleetManifest:
                 f"divisible by micro {self.micro}")
         if self.plane_replicas > 0 and self.shards < 2:
             raise ValueError("plane replication needs shards >= 2")
+        if self.local_size > 1:
+            if self.dp % self.local_size:
+                raise ValueError(
+                    f"dp {self.dp} not divisible by local_size "
+                    f"{self.local_size} (hosts must be uniform — the "
+                    "shards' round gate counts hosts)")
+            if not self.needs_servers():
+                raise ValueError("local_size > 1 needs a server plane "
+                                 "(there is no remote tier to shrink)")
 
     # ------------------------------------------------------------ build
 
@@ -217,6 +309,12 @@ class FleetManifest:
         specs: List[ProcessSpec] = []
         nshards = self.shards if self.shards > 0 else (
             1 if self.needs_servers() else 0)
+        # decide the tier shape BEFORE any env contract is derived —
+        # the SERVERS' round gate depends on it (hosts, not workers)
+        self._use_hier = False
+        if self.local_size > 1 and nshards > 0:
+            from ..server.hier import hier_enabled
+            self._use_hier = hier_enabled(self.local_size)
         self.server_addrs = []
         for i in range(nshards):
             port = free_port(self.host)
@@ -227,6 +325,21 @@ class FleetManifest:
                       "--server"],
                 env=self._server_env(port),
                 restartable=True, expect_exit=False))
+        # hierarchical tier: one local aggregator per host group of
+        # local_size replicas — its workers' whole PS plane IS this
+        # endpoint (one addr → every key client-shards to it), and it
+        # alone speaks to the real shards
+        self.agg_addrs = []
+        if self._use_hier:
+            for h in range(self.dp // self.local_size):
+                port = free_port(self.host)
+                self.agg_addrs.append(f"{self.host}:{port}")
+                specs.append(ProcessSpec(
+                    name=f"agg{h}", role="agg",
+                    argv=[sys.executable, "-m",
+                          "byteps_tpu.launcher.hier_agg"],
+                    env=self._agg_env(h, port),
+                    restartable=True, expect_exit=False))
         # one activation mailbox per (replica, stage); replica-private
         # rings — activations never cross replicas
         self.act_addrs = [[f"{self.host}:{free_port(self.host)}"
@@ -270,8 +383,15 @@ class FleetManifest:
             "BPS_SERVER_PORT": str(port),
             # round gate: each PS key is pushed by the dp replicas of
             # ONE stage (stage-suffixed declaration names keep stages
-            # disjoint in the keyspace)
-            "BPS_NUM_WORKER": str(self.dp),
+            # disjoint in the keyspace). Under the hierarchical tier
+            # the shard sees one logical contribution per HOST seal —
+            # a host sum already carries local_size worker gradients —
+            # so the gate counts hosts (the see-through contract:
+            # engine rounds, StaleStore counts, span arrivals all stay
+            # exact at host granularity, docs/server-plane.md)
+            "BPS_NUM_WORKER": str(
+                self.dp // self.local_size
+                if getattr(self, "_use_hier", False) else self.dp),
             "BPS_SERVER_ENGINE_THREAD":
                 env.get("BPS_SERVER_ENGINE_THREAD", "2"),
         })
@@ -302,6 +422,26 @@ class FleetManifest:
             env["BPS_SERVER_ADDRS"] = ",".join(self.server_addrs)
             if self.plane_replicas > 0:
                 env["BPS_PLANE_REPLICAS"] = str(self.plane_replicas)
+        if getattr(self, "_use_hier", False) and self.agg_addrs:
+            # the worker's whole PS plane is its host's aggregator:
+            # one addr, so every key client-shards to it; the agg's
+            # upstream client re-shards with the same hash, preserving
+            # flat-mode key placement across the real shards
+            host = replica // self.local_size
+            env["BPS_SERVER_ADDRS"] = self.agg_addrs[host]
+            env["BPS_LOCAL_SIZE"] = str(self.local_size)
+            env["BPS_LOCAL_RANK"] = str(replica % self.local_size)
+        return env
+
+    def _agg_env(self, host_id: int, port: int) -> Dict[str, str]:
+        env = self._base_env()
+        env.update({
+            "BPS_ROLE": "agg",
+            "BPS_SERVER_PORT": str(port),
+            "BPS_LOCAL_SIZE": str(self.local_size),
+            "BPS_HIER_HOST_ID": str(host_id),
+            "BPS_HIER_UPSTREAM_ADDRS": ",".join(self.server_addrs),
+        })
         return env
 
 
@@ -360,6 +500,8 @@ class FleetSupervisor:
             for s in specs}
         self.max_restarts = int(max_restarts)
         self.backoff_s = float(backoff_s)
+        self._shm_grace_s = float(os.environ.get(
+            "BPS_SHM_SWEEP_GRACE_S", "5"))
         self.events: List[dict] = []
         self._on_event = on_event
         self._scraper = None
@@ -461,8 +603,17 @@ class FleetSupervisor:
             return
         m.restarts += 1
         self._event(m.spec.name, "restarting", attempt=m.restarts)
+        self._sweep_shm(m.spec.name)
         time.sleep(self.backoff_s)
         self._spawn(m)
+
+    def _sweep_shm(self, role: str) -> None:
+        """Reclaim shm stranded by a SIGKILLed incarnation before its
+        replacement spawns (and at drain) — liveness-checked, so any
+        OTHER role's segments survive untouched."""
+        swept = sweep_stale_shm(grace_s=self._shm_grace_s)
+        if swept:
+            self._event(role, "shm_swept", segments=swept)
 
     def _restart_group(self, group: str, dead: List[_Managed]) -> None:
         """Co-restart: terminate every still-running member (their
@@ -484,6 +635,7 @@ class FleetSupervisor:
                     and m.proc.poll() is None:
                 self._terminate(m, kill_after=5.0)
             self._close_log(m)
+        self._sweep_shm(dead[0].spec.name)
         time.sleep(self.backoff_s)
         for m in members:
             if m.state in ("running", "done"):
@@ -540,6 +692,7 @@ class FleetSupervisor:
             self._scrape_backend = None
         for m in self._managed.values():
             self._close_log(m)
+        self._sweep_shm("fleet")
         return {n: m.rc for n, m in self._managed.items()}
 
     def _terminate(self, m: _Managed, kill_after: float) -> None:
@@ -702,6 +855,13 @@ def run_fleet(manifest: FleetManifest, timeout_s: float = 600.0,
                 results[name] = json.loads(line[len("FLEET_RESULT "):])
             except ValueError:
                 pass
+    aggs = {}
+    for name in sup.roles("agg"):
+        for line in sup.output_lines(name, "AGG_RESULT "):
+            try:
+                aggs[name] = json.loads(line[len("AGG_RESULT "):])
+            except ValueError:
+                pass
     return {
         "ok": ok and all(
             (rcs.get(n) == 0) for n in sup.roles("worker")),
@@ -711,7 +871,9 @@ def run_fleet(manifest: FleetManifest, timeout_s: float = 600.0,
         "events": sup.events,
         "logdir": sup.logdir,
         "workers": results,
+        "aggs": aggs,
         "server_addrs": manifest.server_addrs,
+        "agg_addrs": manifest.agg_addrs,
     }
 
 
@@ -726,6 +888,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--micro", type=int, default=4)
     ap.add_argument("--shards", type=int, default=0)
     ap.add_argument("--plane-replicas", type=int, default=0)
+    ap.add_argument("--local-size", type=int, default=1,
+                    help="workers per emulated host; >1 inserts a "
+                         "per-host local aggregator tier (BPS_HIER_AGG)")
     ap.add_argument("--steps", type=int, default=4)
     ap.add_argument("--schedule", default="1f1b",
                     choices=("1f1b", "sequential"))
@@ -745,7 +910,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         micro=args.micro, shards=args.shards,
         plane_replicas=args.plane_replicas, steps=args.steps,
         schedule=args.schedule, dim=args.dim, depth=args.depth,
-        batch=args.batch, seed=args.seed)
+        batch=args.batch, seed=args.seed, local_size=args.local_size)
     if args.dry_run:
         for spec in man.build():
             derived = {k: v for k, v in spec.env.items()

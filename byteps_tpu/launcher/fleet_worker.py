@@ -44,6 +44,8 @@ def _env_int(name: str, default: int) -> int:
 
 def _run_rounds() -> int:
     """PS rounds mode: no jax import needed — pure numpy over TCP."""
+    import zlib
+
     import numpy as np
 
     from ..server.ps_mode import PSGradientExchange
@@ -56,6 +58,7 @@ def _run_rounds() -> int:
     wid = _env_int("BPS_WORKER_ID", 0)
     max_lag = _env_int("BPS_MAX_LAG", 1)
     incarnation = _env_int("BPS_FLEET_INCARNATION", 0)
+    grad_mode = os.environ.get("BPS_FLEET_GRAD", "ones").strip() or "ones"
     addrs = [a for a in os.environ.get("BPS_SERVER_ADDRS", "").split(",")
              if a]
     if not addrs:
@@ -73,13 +76,30 @@ def _run_rounds() -> int:
     # to make that worker the straggler.
     pace = (float(os.environ.get("BPS_FLEET_STEP_SLEEP", "0") or 0)
             + float(os.environ.get("BPS_FLEET_SEG_MS", "0") or 0) / 1e3)
-    tree = {"g": np.ones(nbytes // 4, np.float32)}
+    # grad_mode="dyadic": per-(worker, round, element) gradients drawn
+    # from the dyadic rationals k/1024, k ∈ [-512, 512) — sums of ≤ dp
+    # such values are EXACT in float32, so any association order (flat
+    # per-worker sum vs hierarchical host-sum-of-sums) yields bitwise
+    # identical results. The ps_hier bench's parity assertion compares
+    # the crc32 digests across arms. Round-prediction assumes no
+    # restarts, so dyadic mode is for parity benches, not kill tests.
+    n_elems = nbytes // 4
+    idx = np.arange(n_elems, dtype=np.int64)
+
+    def dyadic(w: int, r: int) -> "np.ndarray":
+        k = (idx * 37 + w * 1009 + r * 2003) % 1024
+        return ((k - 512) / 1024.0).astype(np.float32)
+
+    tree = {"g": np.ones(n_elems, np.float32)}
     done = 0
     resumed_at = None
+    digests = []
     while True:
         t0 = time.time()
         if pace:
             time.sleep(pace)
+        if grad_mode == "dyadic":
+            tree = {"g": dyadic(wid, done + 1)}
         out = ex.exchange(tree, name="g")
         done = ex.completed_rounds()
         if resumed_at is None:
@@ -88,7 +108,12 @@ def _run_rounds() -> int:
             # per-key server seeding — the PR-13 rejoin proof)
             resumed_at = done
         wall = time.time() - t0
-        if max_lag > 1:
+        if grad_mode == "dyadic" and max_lag <= 1:
+            expect = np.zeros(n_elems, np.float32)
+            for w in range(dp):
+                expect += dyadic(w, done)
+            ok = bool(np.array_equal(out["g"], expect))
+        elif max_lag > 1:
             # bounded staleness: a sealed round publishes WITHOUT some
             # workers (they late-fold into a later round, which then
             # carries their push twice — once late, once current), and
@@ -105,17 +130,27 @@ def _run_rounds() -> int:
             ok = bool(np.allclose(out["g"], float(dp)))
         if not ok:
             print(f"FLEET_ERROR round {done}: sum {out['g'][0]} != {dp}"
-                  f" (max_lag={max_lag})", flush=True)
+                  f" (max_lag={max_lag}, grad={grad_mode})", flush=True)
             return 3
+        # digest of the pulled sum: the arm-vs-arm bitwise-parity
+        # evidence (two arms agree per (worker, round) iff the summed
+        # float32 payloads are byte-identical)
+        digest = zlib.crc32(out["g"].tobytes()) & 0xFFFFFFFF
+        digests.append(digest)
         print("FLEET_STEP " + json.dumps(
             {"worker": wid, "round": done, "wall_s": round(wall, 4),
-             "incarnation": incarnation}), flush=True)
+             "incarnation": incarnation, "digest": digest}), flush=True)
         if done >= steps:
             break
     be.close()
+    from ..obs.metrics import get_registry
+    reg = get_registry()
     print("FLEET_RESULT " + json.dumps(
         {"mode": "rounds", "worker": wid, "steps": done,
-         "incarnation": incarnation, "resumed_at": resumed_at}),
+         "incarnation": incarnation, "resumed_at": resumed_at,
+         "push_bytes": int(reg.counter("ps/push_bytes").value),
+         "pull_bytes": int(reg.counter("ps/pull_bytes").value),
+         "digests": digests}),
         flush=True)
     return 0
 

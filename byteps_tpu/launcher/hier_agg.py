@@ -1,0 +1,72 @@
+"""Entrypoint for the ``agg`` fleet role: one per-host local
+aggregator.
+
+The process is a thin sandwich: a ``PSTransportServer`` facing the
+host's ``local_size`` workers (they speak the ordinary wire protocol —
+shm fast path included, since agg and workers share the "host"), with a
+``LocalAggBackend`` behind it that folds the local pushes and forwards
+ONE host-sum per key/round to the remote plane over a plain
+``RemotePSBackend`` client. Cross-host bytes ≈ dense / local_size;
+see server/hier.py for the accounting contract.
+
+On SIGTERM (the supervisor's drain) it prints one ``AGG_RESULT`` JSON
+line carrying the local/remote byte counters, which ``run_fleet``
+scrapes into the summary's ``aggs`` dict — that line is the
+measurement the ps_hier bench's cross-host-bytes assertion reads.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+from ..server.hier import LocalAggBackend
+from ..server.transport import PSTransportServer, RemotePSBackend
+from .fleet import wait_for_ports
+
+
+def main() -> int:
+    env = os.environ
+    upstream = [a for a in env.get(
+        "BPS_HIER_UPSTREAM_ADDRS", "").split(",") if a]
+    if not upstream:
+        print("AGG_ERROR no BPS_HIER_UPSTREAM_ADDRS", file=sys.stderr,
+              flush=True)
+        return 2
+    local_size = int(env.get("BPS_LOCAL_SIZE", "1"))
+    host_id = int(env.get("BPS_HIER_HOST_ID", "0"))
+    port = int(env.get("BPS_SERVER_PORT", "0"))
+
+    wait_for_ports(upstream)
+    be = RemotePSBackend(upstream)
+    agg = LocalAggBackend(be, local_size, host_id=host_id)
+    tsrv = PSTransportServer(agg, port=port)
+    print(f"[hier-agg] host {host_id} up on :{tsrv.port} "
+          f"(local_size={local_size}, upstream={len(upstream)} shards)",
+          file=sys.stderr, flush=True)
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+    # the counters line must go out BEFORE teardown: drain() gives the
+    # process a bounded grace window and the bench needs this line
+    print("AGG_RESULT " + json.dumps({
+        "host": host_id,
+        "local_size": local_size,
+        "local_agg_bytes": int(agg.m_local_bytes.value),
+        "remote_push_bytes": int(agg.m_remote_bytes.value),
+    }), flush=True)
+    tsrv.close()
+    agg.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -105,9 +105,19 @@ def run_local(args, cmd: List[str]) -> int:
             meta = restore_snapshot(srv, snap)
             print(f"[bpslaunch-tpu] restored {len(meta)} PS keys from "
                   f"{snap}", file=sys.stderr)
+        # optional emulated-NIC throttle on this server endpoint
+        # (BPS_NIC_RATE bytes/sec + BPS_NIC_LATENCY_S per frame): the
+        # wire-bound fleet benches (bench.py ps_hier) constrain the
+        # cross-host link here, where real processes meet real sockets
+        nic = None
+        rate = float(env.get("BPS_NIC_RATE", "0") or 0)
+        if rate > 0:
+            from ..server.throttle import Nic
+            nic = Nic(rate,
+                      latency=float(env.get("BPS_NIC_LATENCY_S", "0") or 0))
         tsrv = PSTransportServer(srv,
                                  port=int(env.get("BPS_SERVER_PORT", "9090")),
-                                 key_meta=meta)
+                                 key_meta=meta, nic=nic)
         print(f"[bpslaunch-tpu] server up on :{tsrv.port} (workers={n}); "
               "Ctrl-C to stop", file=sys.stderr)
         stop = []

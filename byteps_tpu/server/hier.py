@@ -1,0 +1,362 @@
+"""Hierarchical intra-host aggregation: the local tier of a two-tier
+PS plane (reference: BytePS's intra-node reduce before the NIC —
+PAPER.md's ~2x bottleneck-utilization claim rests on never shipping a
+byte across hosts that a host-local sum could have absorbed).
+
+``LocalAggBackend`` sits behind an ordinary ``PSTransportServer`` on
+each host: the ``local_size`` colocated workers push/pull against it
+over loopback/UDS/shm exactly as they would against a remote shard
+(same frames, same dedup, same reconnect machinery — the local hop is
+a full PS endpoint, not a side channel), it folds each key's
+``local_size`` gradients into ONE host sum, and only that sum rides
+the cross-host wire to the remote plane shards via a single upstream
+``RemotePSBackend`` client. After the remote round completes, ONE
+upstream pull feeds every local worker's pull (fan-out staging, the
+OP_PULL_PART pattern) — so cross-host bytes are dense/``local_size``
+in BOTH directions.
+
+Accounting sees through the tier by construction:
+
+- remote shards run with ``num_workers = hosts`` (one logical
+  contribution per host-seal), so engine round gates, ``StaleStore``
+  round counts, and span per-worker arrivals all stay exact —
+  a host's seal IS ``local_size`` worker contributions;
+- the K-lag contract (docs/admission.md) is spoken at host
+  granularity: the agg folds per (key, round) and pushes/pulls
+  upstream as worker id ``host_id``, so staleness bounds, grace
+  seals, and late-folds count hosts;
+- fused/compressed keys ride the PR-11 decode-free path locally too:
+  codec-homogeneous payloads merge in a host-local ``FusedSumStore``
+  and the re-encoded host sum is pushed upstream still compressed —
+  the lossless local_size reduction composes multiplicatively with
+  the lossy codec one.
+
+Observability: ``ps/local_agg_bytes`` (bytes arriving over the local
+hop) vs ``ps/remote_push_bytes`` (bytes this host actually put on the
+cross-host wire) make the tier's reduction auditable per process, and
+every seal decision is flight-recorded KEY-LESS so any postmortem can
+distinguish a slow local hop from a slow remote one.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..obs import flight
+from ..obs.metrics import get_registry
+
+
+def hier_enabled(local_size: int) -> bool:
+    """The BPS_HIER_AGG knob: ``on`` forces the tier (invalid below 2
+    workers/host — there is nothing to fold), ``off`` disables it even
+    when the topology has one, ``auto`` (default) enables it exactly
+    when a host groups more than one worker."""
+    mode = os.environ.get("BPS_HIER_AGG", "auto").strip().lower()
+    if mode in ("off", "0", "false", "no"):
+        return False
+    if mode in ("on", "1", "true", "yes"):
+        if local_size < 2:
+            raise ValueError(
+                f"BPS_HIER_AGG=on with local_size={local_size}: the "
+                "local tier needs >=2 workers per host to fold")
+        return True
+    return local_size > 1
+
+
+_STAGE_TTL_SECS = 120.0
+
+
+class _KeyState:
+    """Per-key local fold: the host's partial sum for the round in
+    flight. Count-based like the engine (local_size arrivals = one
+    seal) — the same round semantics the flat path has, shifted one
+    tier down."""
+
+    __slots__ = ("nbytes", "dtype", "acc", "arrived", "sealed", "lock")
+
+    def __init__(self, nbytes: int, dtype: str) -> None:
+        self.nbytes = int(nbytes)
+        self.dtype = dtype
+        self.acc: Optional[np.ndarray] = None
+        self.arrived = 0
+        self.sealed = 0          # local rounds sealed (pushed upstream)
+        self.lock = threading.Lock()
+
+
+class LocalAggBackend:
+    """The per-host local aggregator backend (see module docstring).
+
+    Satisfies the full backend surface ``PSTransportServer`` consumes —
+    dense (push/pull/round), fused (push_fused/pull_fused), and K-lag
+    (declare_lag/push_lag/pull_lag) — so the front transport needs no
+    hierarchical special-casing at all."""
+
+    def __init__(self, upstream, local_size: int, host_id: int = 0) -> None:
+        self.upstream = upstream
+        self.num_workers = int(local_size)   # the transport's gate size
+        self.host_id = int(host_id)
+        self._keys: Dict[int, _KeyState] = {}
+        self._keys_lock = threading.Lock()
+        self._inited: set = set()
+        # fan-out staging: ONE upstream fetch per (key, round[, codec])
+        # feeds every local puller — the OP_PULL_PART stage pattern.
+        # TTL-swept so a worker dying mid-pull can't strand stages.
+        self._stages: Dict[Tuple, Dict] = {}
+        self._stage_lock = threading.Lock()
+        self._stage_sweep_at = 0.0
+        # K-lag local folds: (key, round) -> [acc, arrived]; several
+        # rounds coexist (that is what the lag bound buys)
+        self._lag_acc: Dict[Tuple[int, int], list] = {}
+        self._lag_declared: Dict[int, int] = {}
+        # local fused store: codec-homogeneous host merge, decode-free
+        from .homog import FusedSumStore
+        self._fstore = FusedSumStore(self.num_workers)
+        reg = get_registry()
+        self.m_local_bytes = reg.counter("ps/local_agg_bytes")
+        self.m_remote_bytes = reg.counter("ps/remote_push_bytes")
+
+    # ------------------------------------------------------------ dense
+
+    def init_key(self, key: int, nbytes: int, dtype: str = "float32",
+                 init: Optional[np.ndarray] = None,
+                 fused: bool = False) -> None:
+        key = int(key)
+        with self._keys_lock:
+            st = self._keys.get(key)
+            if st is None or (st.nbytes, st.dtype) != (int(nbytes), dtype):
+                self._keys[key] = _KeyState(nbytes, dtype)
+            first = key not in self._inited
+            self._inited.add(key)
+        if fused:
+            from .homog import homog_enabled
+            if homog_enabled():
+                self._fstore.init_key(key, nbytes, dtype, init)
+        # every local worker INITs; forward once — the upstream client
+        # keeps an init replay log per key and the remote store is
+        # first-wins anyway, so duplicate fan-up is pure wire noise
+        if first:
+            self.upstream.init_key(key, nbytes, dtype, init=init,
+                                   fused=fused)
+
+    def _state(self, key: int) -> _KeyState:
+        st = self._keys.get(int(key))
+        if st is None:
+            raise KeyError(f"push/pull({key}) before init")
+        return st
+
+    def push(self, key: int, data: np.ndarray) -> None:
+        """Local fold; the ``local_size``-th arrival SEALS the host
+        round and pushes the one host sum upstream (the only dense
+        bytes that ever cross hosts)."""
+        st = self._state(key)
+        self.m_local_bytes.inc(int(data.nbytes))
+        with st.lock:
+            if st.acc is None:
+                st.acc = np.array(data, dtype=st.dtype, copy=True)
+                st.arrived = 1
+            else:
+                st.acc += data.astype(st.dtype, copy=False)
+                st.arrived += 1
+            if st.arrived < self.num_workers:
+                return
+            host_sum, st.acc, st.arrived = st.acc, None, 0
+            st.sealed += 1
+            rnd = st.sealed
+        t0 = time.time()
+        self.upstream.push(key, host_sum)
+        self.m_remote_bytes.inc(int(host_sum.nbytes))
+        # key-less by design: seal events are context for EVERY key's
+        # postmortem (slow local hop vs slow remote hop)
+        flight.record("hier_seal", round=rnd, nbytes=int(host_sum.nbytes),
+                      detail=f"dense fanin={self.num_workers} "
+                             f"up_ms={(time.time() - t0) * 1e3:.1f}")
+
+    # ------------------------------------------------ fan-out staging
+
+    def _sweep_stages(self, now: float) -> None:
+        if now < self._stage_sweep_at:
+            return
+        self._stage_sweep_at = now + 30.0
+        cutoff = now - _STAGE_TTL_SECS
+        for k in [k for k, st in self._stages.items()
+                  if st["t"] < cutoff and st["ev"].is_set()]:
+            del self._stages[k]
+
+    def _staged_fetch(self, stage_key: Tuple, fetch, timeout_ms: int):
+        """ONE upstream fetch per stage key, fanned out to every local
+        caller. The first caller runs ``fetch`` in its own connection
+        thread; the other ``local_size - 1`` wait on the event. An
+        errored fetch is served to current waiters and the stage popped
+        immediately so the next retry slice re-fetches; a successful
+        stage lives until ``local_size`` callers were served (or TTL)."""
+        now = time.time()
+        with self._stage_lock:
+            self._sweep_stages(now)
+            st = self._stages.get(stage_key)
+            if st is None:
+                st = {"ev": threading.Event(), "data": None, "err": None,
+                      "served": 0, "t": now}
+                self._stages[stage_key] = st
+                first = True
+            else:
+                st["t"] = now
+                first = False
+        if first:
+            try:
+                st["data"] = fetch()
+            except Exception as e:  # noqa: BLE001 — relayed to callers
+                st["err"] = e
+            finally:
+                st["ev"].set()
+        if not st["ev"].wait(timeout=(int(timeout_ms) or 30000) / 1e3 + 5):
+            # fetch still in flight: retryable, and NOT served — a
+            # premature served count could pop the stage under it
+            raise TimeoutError(
+                f"hier fetch {stage_key} did not resolve in time")
+        with self._stage_lock:
+            if st["err"] is not None:
+                self._stages.pop(stage_key, None)
+            else:
+                st["served"] += 1
+                if st["served"] >= self.num_workers:
+                    self._stages.pop(stage_key, None)
+        if st["err"] is not None:
+            raise st["err"]
+        return st["data"]
+
+    def pull(self, key: int, out: np.ndarray, round: int = 0,
+             timeout_ms: int = 30000) -> None:
+        key = int(key)
+        if not round:
+            # async/snapshot pull of "latest": no round to stage on —
+            # forward per caller (rare control-plane path)
+            self.upstream.pull(key, out, round=0, timeout_ms=timeout_ms)
+            return
+
+        def fetch():
+            buf = np.empty_like(out)
+            self.upstream.pull(key, buf, round=int(round),
+                               timeout_ms=int(timeout_ms) or 30000)
+            return buf
+
+        data = self._staged_fetch((key, int(round)), fetch, timeout_ms)
+        np.copyto(out, data)
+
+    def round(self, key: int) -> int:
+        """GLOBAL rounds (host seals advance them 1:1 with worker
+        rounds), so elastic rejoin reseeds from the same counter the
+        flat path would."""
+        return int(self.upstream.round(int(key)))
+
+    # ------------------------------------------------------------ fused
+
+    def push_fused(self, key: int, payload) -> None:
+        key = int(key)
+        self.m_local_bytes.inc(len(payload))
+        if self._fstore.managed(key):
+            from ..compress import wire
+            cid = wire.peek(payload)[0]
+            before = self._fstore.round(key)
+            self._fstore.ingest(key, payload)
+            after = self._fstore.round(key)
+            # the local_size-th homogeneous payload sealed round(s):
+            # re-encode the host merge at the SAME codec and push it
+            # upstream still compressed (lossless x lossy composition)
+            for r in range(before + 1, after + 1):
+                merged = self._fstore.pull_payload(
+                    key, cid, r, timeout_ms=5000, div=wire.TOPK_DIV)
+                self.upstream.push_fused(key, merged)
+                self.m_remote_bytes.inc(len(merged))
+                flight.record("hier_seal", round=r, nbytes=len(merged),
+                              detail=f"fused cid={cid} "
+                                     f"fanin={self.num_workers}")
+            return
+        # unmanaged fused push: decode once locally, ride the dense fold
+        from ..compress import wire
+        st = self._state(key)
+        dense = wire.decode_for_store(payload, (st.nbytes, st.dtype))
+        self.push(key, dense)
+
+    def pull_fused(self, key: int, nbytes: int, dtype: str, codec: int,
+                   round: int = 0, timeout_ms: int = 30000,
+                   div: Optional[int] = None) -> bytes:
+        key = int(key)
+        fetch = lambda: self.upstream.pull_fused(  # noqa: E731
+            key, int(nbytes), dtype, int(codec), round=int(round),
+            timeout_ms=int(timeout_ms) or 30000, div=div)
+        if not round:
+            return fetch()
+        return self._staged_fetch((key, int(round), int(codec), div),
+                                  fetch, timeout_ms)
+
+    def drop_cached(self, key: int) -> None:
+        """New tenancy of the key (migration re-init): cached fused
+        stages for recurring round numbers must not alias."""
+        with self._stage_lock:
+            for k in [k for k in self._stages if k[0] == int(key)]:
+                del self._stages[k]
+
+    # ----------------------------------------------------------- K-lag
+
+    def declare_lag(self, key: int, max_lag: int) -> None:
+        self._lag_declared[int(key)] = int(max_lag)
+        self.upstream.declare_lag(int(key), int(max_lag))
+
+    def push_lag(self, key: int, worker: int, rnd: int,
+                 data: np.ndarray) -> None:
+        """Per-(key, round) local fold — several rounds coexist, that
+        is the lag bound. The host's round seal goes upstream as ONE
+        contribution from worker id ``host_id`` (staleness at host
+        granularity: a local straggler delays its host's seal, and the
+        REMOTE StaleStore's grace/late-fold machinery absorbs the
+        missing HOST, exactly-once, contribution gap counted in
+        hosts)."""
+        key, rnd = int(key), int(rnd)
+        st = self._state(key)
+        self.m_local_bytes.inc(int(data.nbytes))
+        with st.lock:
+            ent = self._lag_acc.get((key, rnd))
+            if ent is None:
+                ent = self._lag_acc[(key, rnd)] = [
+                    np.array(data, dtype=st.dtype, copy=True), 1]
+            else:
+                ent[0] += data.astype(st.dtype, copy=False)
+                ent[1] += 1
+            if ent[1] < self.num_workers:
+                return
+            self._lag_acc.pop((key, rnd))
+            host_sum = ent[0]
+        self.upstream.push_lag(key, self.host_id, rnd, host_sum)
+        self.m_remote_bytes.inc(int(host_sum.nbytes))
+        flight.record("hier_seal", round=rnd, nbytes=int(host_sum.nbytes),
+                      detail=f"lag fanin={self.num_workers} "
+                             f"host={self.host_id}")
+
+    def pull_lag(self, key: int, worker: int, rnd: int, out: np.ndarray,
+                 timeout_ms: int = 30000) -> int:
+        key, rnd = int(key), int(rnd)
+
+        def fetch():
+            buf = np.empty_like(out)
+            flags = self.upstream.pull_lag(key, self.host_id, rnd, buf,
+                                           timeout_ms=int(timeout_ms)
+                                           or 30000)
+            return int(flags), buf
+
+        flags, data = self._staged_fetch((key, rnd, "lag"), fetch,
+                                         timeout_ms)
+        np.copyto(out, data)
+        return int(flags)
+
+    # ------------------------------------------------------------ misc
+
+    def close(self) -> None:
+        try:
+            self.upstream.close()
+        except Exception:
+            pass

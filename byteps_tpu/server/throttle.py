@@ -210,6 +210,43 @@ class ThrottledSocket:
             # the frame
             nic.book_tx(len(part))
 
+    def sendmsg(self, buffers, *rest) -> int:
+        """Vectored send, metered. Without this override ``__getattr__``
+        would hand the transport the RAW socket's ``sendmsg`` and every
+        vectored byte would bypass the Nic — unthrottled AND uncounted,
+        silently blinding the scaling-curve byte model. One sendmsg call
+        is ONE frame (one latency charge); like ``sendall`` it returns
+        only once everything is written, so the caller's partial-send
+        resume loop never re-enters (which would recharge the frame)."""
+        views = [memoryview(b) for b in buffers]
+        n = sum(len(v) for v in views)
+        nic = self._nic
+        nic.frame_latency()
+        if n <= nic.SMALL_FRAME or nic.tx.try_consume(n):
+            sent = self._sock.sendmsg(views)
+            nic.book_tx(sent)
+            if sent < n:
+                # finish the short write's remainder without a second
+                # latency/bucket charge — still the same frame
+                skip = sent
+                for v in views:
+                    if skip >= len(v):
+                        skip -= len(v)
+                        continue
+                    part = v[skip:] if skip else v
+                    skip = 0
+                    self._sock.sendall(part)
+                    nic.book_tx(len(part))
+            return n
+        chunk = nic.chunk_size()
+        for v in views:
+            for off in range(0, len(v), chunk):
+                part = v[off:off + chunk]
+                nic.tx.consume(len(part))
+                self._sock.sendall(part)
+                nic.book_tx(len(part))
+        return n
+
     def recv(self, n: int, *flags):
         data = self._sock.recv(n, *flags)
         self._nic.on_recv(len(data))

@@ -117,13 +117,14 @@ class ChaosProxy:
 
 
 def test_exchange_survives_random_connection_kills(monkeypatch):
-    """80 rounds of a pipelined 2-worker exchange with live connections
-    being killed at random: every completed round's sum must be exact
-    (dedup = no double counts; per-key rounds = no stale pulls). Kill
-    cadence and channel count are sized so progress outruns the churn
-    even on a loaded single-core CI box — each cut restarts the
-    severed pull's server-side wait, so too-aggressive chaos degrades
-    into (bounded, detected) livelock rather than failure."""
+    """A pipelined 2-worker exchange (80-round blocks, extended until
+    the chaos lands ≥5 cuts) with live connections being killed at
+    random: every completed round's sum must be exact (dedup = no
+    double counts; per-key rounds = no stale pulls). Kill cadence and
+    channel count are sized so progress outruns the churn even on a
+    loaded single-core CI box — each cut restarts the severed pull's
+    server-side wait, so too-aggressive chaos degrades into (bounded,
+    detected) livelock rather than failure."""
     monkeypatch.delenv("BPS_ENABLE_SHM", raising=False)
     monkeypatch.setenv("BPS_PS_CONNS", "8")   # pulls must not be able to
     # monopolize every channel while pushes (which publish the rounds)
@@ -132,8 +133,24 @@ def test_exchange_survives_random_connection_kills(monkeypatch):
 
     be = PSServer(num_workers=2, engine_threads=2)
     srv = PSTransportServer(be, host="127.0.0.1", port=0)
-    proxy = ChaosProxy(srv.port, seed=7)
+    proxy = ChaosProxy(srv.port, kill_every=(0.08, 0.2), seed=7)
     errors = []
+
+    # The run must last long enough for the chaos to land its cuts, and
+    # wire speed varies across boxes (and gets faster PR over PR), so
+    # the workers extend the run in 80-round blocks until the kill
+    # floor is met. Both workers must agree on the stop round (every
+    # round is a 2-worker rendezvous), but proxy.kills is racy to read
+    # independently — the first worker to reach a block boundary
+    # freezes the decision for both.
+    decisions = {}
+    dlock = threading.Lock()
+
+    def stop_after(r):
+        with dlock:
+            if r not in decisions:
+                decisions[r] = proxy.kills >= 5 or r >= 800
+            return decisions[r]
 
     def worker(tag):
         try:
@@ -143,13 +160,17 @@ def test_exchange_survives_random_connection_kills(monkeypatch):
                                     pipeline_depth=4)
             tree = {"g": np.ones(6_000, np.float32),
                     "h": np.ones(500, np.float32)}
-            for r in range(1, 81):
+            r = 0
+            while True:
+                r += 1
                 scaled = {k: v * r for k, v in tree.items()}
                 out = ex.exchange(scaled, name="g")
                 for k in tree:
                     np.testing.assert_allclose(
                         out[k], 2.0 * r,
                         err_msg=f"{tag} round {r} key {k}")
+                if r % 80 == 0 and stop_after(r):
+                    break
             w.close()
         except Exception as e:          # noqa: BLE001 — surfaced below
             errors.append((tag, repr(e)))

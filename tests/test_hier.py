@@ -1,0 +1,650 @@
+"""Hierarchical intra-host aggregation + the vectored zero-copy wire
+path (ISSUE 17, byteps_tpu/server/hier.py + transport._send_frame).
+
+Four families:
+
+- parity: the two-tier plane (workers -> LocalAggBackend -> remote
+  shards) must be BITWISE identical to the flat plane at
+  local_size ∈ {1, 2, 4} — gradients drawn from dyadic rationals so
+  fp32 sums are exact under any association order;
+- wire: cross-host bytes drop by local_size (emulated-NIC byte
+  accounting at N=4, the tier-1 wire-bytes variant), and the vectored
+  send path performs ZERO payload copies (the copy-audit regression),
+  resumes partial writes, degrades without sendmsg, and stays metered
+  under ThrottledSocket;
+- topology: FleetManifest derivation (local_size=1 == flat, agg roles,
+  see-through BPS_NUM_WORKER), knob refusals, and the stale-shm sweep
+  (unit + supervisor restart with an injected SIGKILL);
+- pass-through: K-lag and fused (compressed) traffic fold locally and
+  cross hosts once, with the seal counters/flight events observable.
+
+docs/performance.md "Hierarchical aggregation" is the map.
+"""
+
+import os
+import signal
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.server import transport as T
+from byteps_tpu.server.engine import PSServer
+from byteps_tpu.server.hier import LocalAggBackend, hier_enabled
+from byteps_tpu.server.throttle import Nic, ThrottledSocket
+from byteps_tpu.server.transport import (PSTransportServer,
+                                         RemotePSBackend, _as_bytes,
+                                         _send_req)
+
+N_ELEMS = 1024
+NBYTES = N_ELEMS * 4
+
+
+def dyadic(w: int, r: int, n: int = N_ELEMS) -> np.ndarray:
+    """Per-(worker, round) gradients from the dyadic rationals k/1024:
+    sums of a few such values are EXACT in float32, so flat and
+    hierarchical association orders must agree to the byte."""
+    k = (np.arange(n, dtype=np.int64) * 37 + w * 1009 + r * 2003) % 1024
+    return ((k - 512) / 1024.0).astype(np.float32)
+
+
+def _plane(hosts: int, shards: int = 2):
+    """A remote PS plane gated at ``hosts`` contributions per round."""
+    servers = []
+    addrs = []
+    for _ in range(shards):
+        srv = PSServer(num_workers=hosts, engine_threads=2)
+        tsrv = PSTransportServer(srv, host="127.0.0.1", port=0)
+        servers.append((srv, tsrv))
+        addrs.append(f"127.0.0.1:{tsrv.port}")
+    return servers, addrs
+
+
+def _run_rounds(worker_bes, dp: int, rounds: int, keys=(0, 1)):
+    """Push dyadic grads from every worker, pull every sealed round;
+    returns {(worker, round, key): pulled array}."""
+    for be in worker_bes:
+        for k in keys:
+            be.init_key(k, NBYTES, "float32")
+    out = {}
+    for r in range(1, rounds + 1):
+        for w, be in enumerate(worker_bes):
+            for k in keys:
+                be.push(k, dyadic(w + 10 * k, r))
+        for w, be in enumerate(worker_bes):
+            for k in keys:
+                buf = np.empty(N_ELEMS, np.float32)
+                be.pull(k, buf, round=r, timeout_ms=30000)
+                out[(w, r, k)] = buf
+    return out
+
+
+# =====================================================================
+# Parity: two-tier vs flat, bitwise, local_size ∈ {1, 2, 4}
+# =====================================================================
+
+@pytest.mark.parametrize("local_size", [1, 2, 4])
+def test_hier_vs_flat_bitwise_parity(local_size):
+    """dp=4 split into dp/local_size hosts: every worker's pulled sum
+    must be byte-identical to the flat (direct, num_workers=4) plane.
+    local_size=1 is the degenerate pin — the tier with nothing to fold
+    must not perturb a single byte."""
+    dp, rounds = 4, 3
+    hosts = dp // local_size
+
+    # ---- flat reference
+    flat_srvs, flat_addrs = _plane(hosts=dp)
+    flat_bes = [RemotePSBackend(flat_addrs) for _ in range(dp)]
+    try:
+        flat = _run_rounds(flat_bes, dp, rounds)
+    finally:
+        for be in flat_bes:
+            be.close()
+        for srv, tsrv in flat_srvs:
+            tsrv.close()
+            srv.close()
+
+    # ---- hierarchical arm (local_size=1: workers dial shards direct,
+    # exactly what the manifest derives when the tier is auto-disabled)
+    if local_size == 1:
+        hier_srvs, hier_addrs = _plane(hosts=dp)
+        aggs, agg_tsrvs, up_bes = [], [], []
+        hier_bes = [RemotePSBackend(hier_addrs) for _ in range(dp)]
+    else:
+        hier_srvs, hier_addrs = _plane(hosts=hosts)
+        aggs, agg_tsrvs, hier_bes, up_bes = [], [], [], []
+        for h in range(hosts):
+            up = RemotePSBackend(hier_addrs)
+            up_bes.append(up)
+            agg = LocalAggBackend(up, local_size, host_id=h)
+            tsrv = PSTransportServer(agg, host="127.0.0.1", port=0)
+            aggs.append(agg)
+            agg_tsrvs.append(tsrv)
+        for w in range(dp):
+            addr = f"127.0.0.1:{agg_tsrvs[w // local_size].port}"
+            hier_bes.append(RemotePSBackend([addr]))
+    try:
+        hier = _run_rounds(hier_bes, dp, rounds)
+    finally:
+        for be in hier_bes:
+            be.close()
+        for tsrv in agg_tsrvs:
+            tsrv.close()
+        for agg in aggs:
+            agg.close()
+        for srv, tsrv in hier_srvs:
+            tsrv.close()
+            srv.close()
+
+    assert flat.keys() == hier.keys()
+    for k in flat:
+        assert flat[k].tobytes() == hier[k].tobytes(), (
+            f"hier local_size={local_size} diverges at (worker, round, "
+            f"key)={k}")
+
+
+def test_hier_wire_bytes_halved_n4():
+    """The tier-1 wire-bytes variant at N=4 (the scaling-curve rig's
+    N=8 sibling stays in the slow lane — see test_scaling_curve.py):
+    one remote shard behind an accounting Nic; the hierarchical plane
+    (4 workers over 2 aggs) must put ~half the flat plane's bytes on
+    the emulated cross-host wire, in BOTH directions."""
+    dp, local_size, rounds = 4, 2, 3
+    hosts = dp // local_size
+
+    def arm(hier: bool) -> int:
+        nic = Nic(rate=1e12)       # never paces; pure byte accounting
+        srv = PSServer(num_workers=hosts if hier else dp,
+                       engine_threads=2)
+        tsrv = PSTransportServer(srv, host="127.0.0.1", port=0, nic=nic)
+        addr = [f"127.0.0.1:{tsrv.port}"]
+        aggs, agg_tsrvs, ups = [], [], []
+        if hier:
+            bes = []
+            for h in range(hosts):
+                up = RemotePSBackend(addr)
+                ups.append(up)
+                agg = LocalAggBackend(up, local_size, host_id=h)
+                at = PSTransportServer(agg, host="127.0.0.1", port=0)
+                aggs.append(agg)
+                agg_tsrvs.append(at)
+            for w in range(dp):
+                bes.append(RemotePSBackend(
+                    [f"127.0.0.1:{agg_tsrvs[w // local_size].port}"]))
+        else:
+            bes = [RemotePSBackend(addr) for _ in range(dp)]
+        try:
+            _run_rounds(bes, dp, rounds, keys=(0,))
+        finally:
+            for be in bes:
+                be.close()
+            for at in agg_tsrvs:
+                at.close()
+            for agg in aggs:
+                agg.close()
+            tsrv.close()
+            srv.close()
+        return nic.rx_bytes + nic.tx_bytes
+
+    flat_bytes = arm(hier=False)
+    hier_bytes = arm(hier=True)
+    payload_floor = dp * rounds * NBYTES     # one direction, flat
+    assert flat_bytes > 2 * payload_floor * 0.9
+    ratio = hier_bytes / flat_bytes
+    assert ratio <= 0.55, (
+        f"hier cross-host bytes must be ≈ dense/local_size: "
+        f"{hier_bytes} vs flat {flat_bytes} ({ratio:.3f}x)")
+
+
+# =====================================================================
+# Vectored zero-copy send path
+# =====================================================================
+
+class _VecSock:
+    """sendmsg-capable test double: captures the EXACT buffer objects
+    handed to the kernel (copy-audit) and the reassembled stream."""
+
+    def __init__(self, max_per_call=None):
+        self.stream = bytearray()
+        self.calls = []            # list of list-of-memoryview
+        self.max_per_call = max_per_call
+
+    def sendmsg(self, buffers):
+        bufs = list(buffers)
+        self.calls.append(bufs)
+        n = sum(len(b) for b in bufs)
+        if self.max_per_call is not None:
+            n = min(n, self.max_per_call)
+        left = n
+        for b in bufs:
+            take = min(left, len(b))
+            self.stream += bytes(b[:take])
+            left -= take
+            if not left:
+                break
+        return n
+
+    def sendall(self, data):
+        self.stream += bytes(data)
+
+
+class _PlainSock:
+    """No sendmsg at all — the degraded sequential path."""
+
+    def __init__(self):
+        self.stream = bytearray()
+        self.sent = []             # the exact objects handed over
+
+    def sendall(self, data):
+        self.sent.append(data)
+        self.stream += bytes(data)
+
+
+def _frame_ref(op, key, rnd, nbytes, timeout, dtype, parts) -> bytes:
+    """The PRE-vectored wire image (hdr + joined payload): the format
+    pin — the zero-copy path must emit byte-identical frames."""
+    plen = sum(len(memoryview(p).cast("B")) for p in parts)
+    return T._HDR.pack(op, key, rnd, nbytes, timeout, plen,
+                       dtype.encode()[:8].ljust(8, b"\0")) \
+        + b"".join(bytes(memoryview(p).cast("B")) for p in parts)
+
+
+def test_vectored_send_zero_copy_audit():
+    """The copy-audit regression: the buffer sendmsg receives must BE
+    the caller's array memory — mutating the array after the call must
+    be visible through the captured view (a copy would freeze it)."""
+    arr = np.arange(N_ELEMS, dtype=np.float32)
+    sock = _VecSock()
+    _send_req(sock, T.OP_PUSH, 7, 9, arr.nbytes, 0, "float32",
+              _as_bytes(arr))
+    assert len(sock.calls) == 1
+    hdr_v, pay_v = sock.calls[0]
+    assert pay_v.obj is arr, "payload view does not alias the array"
+    assert bytes(pay_v) == arr.tobytes()
+    arr[0] = -1234.5
+    assert bytes(pay_v) == arr.tobytes(), (
+        "vectored send materialized a payload copy")
+    # and the wire image is byte-identical to the pre-vectored format
+    assert bytes(hdr_v) + arr.tobytes() == _frame_ref(
+        T.OP_PUSH, 7, 9, arr.nbytes, 0, "float32", [_as_bytes(arr)])
+
+
+def test_vectored_send_partial_write_resume():
+    """Short kernel writes resume from the first unsent byte — the
+    reassembled stream must equal the reference frame exactly, for a
+    multi-part scatter-gather payload including a raw float view."""
+    a = np.arange(33, dtype=np.float32)
+    parts = [b"\x01" * 13, _as_bytes(a), memoryview(b"tail-part")]
+    sock = _VecSock(max_per_call=7)
+    _send_req(sock, T.OP_PUSH_PART, 3, 1, 999, 250, "float32", parts)
+    assert bytes(sock.stream) == _frame_ref(
+        T.OP_PUSH_PART, 3, 1, 999, 250, "float32", parts)
+    assert len(sock.calls) > 1      # the resume loop actually resumed
+
+
+def test_vectored_send_multibyte_view_plen():
+    """A multi-byte-item buffer (float32 memoryview passed raw) must be
+    counted in BYTES: the header's plen and the stream agree."""
+    a = np.arange(17, dtype=np.float32)
+    sock = _VecSock()
+    _send_req(sock, T.OP_PUSH, 1, 1, a.nbytes, 0, "float32",
+              memoryview(a))
+    frame = bytes(sock.stream)
+    plen = T._HDR.unpack(frame[:T._HDR.size])[5]
+    assert plen == a.nbytes
+    assert frame[T._HDR.size:] == a.tobytes()
+
+
+def test_send_fallback_without_sendmsg():
+    """Sockets with no vectored primitive degrade to per-part sendall —
+    same bytes, and the payload part is handed through UNJOINED (the
+    single-part frame never pays a concatenation)."""
+    arr = np.arange(64, dtype=np.float32)
+    pay = _as_bytes(arr)
+    sock = _PlainSock()
+    _send_req(sock, T.OP_PULL, 2, 5, arr.nbytes, 100, "float32", pay)
+    assert bytes(sock.stream) == _frame_ref(
+        T.OP_PULL, 2, 5, arr.nbytes, 100, "float32", [pay])
+    assert len(sock.sent) == 2
+    assert sock.sent[1].obj is arr      # no join, no copy
+
+
+def test_throttled_socket_sendmsg_metered():
+    """ThrottledSocket must own sendmsg: vectored bytes are charged to
+    the Nic (pacing AND tx accounting) instead of slipping through
+    __getattr__ to the raw socket. Covers the fast path, a short
+    kernel write, and the chunk-paced slow path."""
+    assert "sendmsg" in ThrottledSocket.__dict__, (
+        "ThrottledSocket lost its sendmsg override — vectored sends "
+        "would silently bypass the emulated NIC")
+    payload = np.arange(8192, dtype=np.float32)
+
+    # fast path + short-write completion
+    raw = _VecSock(max_per_call=1000)
+    nic = Nic(rate=1e12)
+    ts = ThrottledSocket(raw, nic)
+    n = ts.sendmsg([memoryview(b"hdr!"), _as_bytes(payload)])
+    assert n == 4 + payload.nbytes
+    assert bytes(raw.stream) == b"hdr!" + payload.tobytes()
+    assert nic.tx_bytes == n
+
+    # slow (chunk-paced) path: burst smaller than the frame
+    raw2 = _VecSock()
+    nic2 = Nic(rate=4e6, burst=4096)
+    ts2 = ThrottledSocket(raw2, nic2)
+    n2 = ts2.sendmsg([memoryview(b"hdr!"), _as_bytes(payload)])
+    assert n2 == 4 + payload.nbytes
+    assert bytes(raw2.stream) == b"hdr!" + payload.tobytes()
+    assert nic2.tx_bytes == n2
+
+
+# =====================================================================
+# Topology: manifest derivation + knob
+# =====================================================================
+
+def test_hier_enabled_knob(monkeypatch):
+    monkeypatch.delenv("BPS_HIER_AGG", raising=False)
+    assert hier_enabled(1) is False          # auto
+    assert hier_enabled(2) is True
+    monkeypatch.setenv("BPS_HIER_AGG", "off")
+    assert hier_enabled(4) is False
+    monkeypatch.setenv("BPS_HIER_AGG", "on")
+    assert hier_enabled(2) is True
+    with pytest.raises(ValueError):
+        hier_enabled(1)                      # nothing to fold
+
+
+def test_manifest_local_size_one_is_flat(monkeypatch):
+    """local_size=1 must derive the SAME fleet the flat manifest does:
+    no agg roles, identical env contract (ports aside — they are
+    allocated fresh per build)."""
+    from byteps_tpu.launcher.fleet import FleetManifest
+    monkeypatch.delenv("BPS_HIER_AGG", raising=False)
+    flat = {s.name: s for s in FleetManifest(
+        stages=1, dp=4, shards=2, steps=2).build()}
+    ls1 = {s.name: s for s in FleetManifest(
+        stages=1, dp=4, shards=2, steps=2, local_size=1).build()}
+    assert sorted(flat) == sorted(ls1)
+    volatile = ("PORT", "ADDRS", "LOGDIR")
+    for name in flat:
+        assert flat[name].role == ls1[name].role
+        assert flat[name].argv[1:] == ls1[name].argv[1:]
+        fe = {k: v for k, v in flat[name].env.items()
+              if k.startswith("BPS_") and not any(t in k for t in volatile)}
+        le = {k: v for k, v in ls1[name].env.items()
+              if k.startswith("BPS_") and not any(t in k for t in volatile)}
+        assert fe == le, f"{name} env drifted under local_size=1"
+
+
+def test_manifest_hier_derivation(monkeypatch):
+    """dp=4 x local_size=2 x 2 shards: one agg per host, servers gated
+    at hosts (the see-through arrival accounting), each worker dialed
+    at ITS host's agg with a local rank."""
+    from byteps_tpu.launcher.fleet import FleetManifest
+    monkeypatch.delenv("BPS_HIER_AGG", raising=False)
+    man = FleetManifest(stages=1, dp=4, shards=2, steps=2, local_size=2)
+    by_name = {s.name: s for s in man.build()}
+    assert [n for n in sorted(by_name) if by_name[n].role == "agg"] \
+        == ["agg0", "agg1"]
+    assert len(man.agg_addrs) == 2
+    for i in range(2):
+        env = by_name[f"srv{i}"].env
+        assert env["BPS_NUM_WORKER"] == "2"      # hosts, not dp
+        agg_env = by_name[f"agg{i}"].env
+        assert agg_env["BPS_HIER_HOST_ID"] == str(i)
+        assert agg_env["BPS_LOCAL_SIZE"] == "2"
+        assert agg_env["BPS_HIER_UPSTREAM_ADDRS"] \
+            == ",".join(man.server_addrs)
+        assert man.agg_addrs[i].endswith(agg_env["BPS_SERVER_PORT"])
+    for r in range(4):
+        env = by_name[f"w-s0r{r}"].env
+        assert env["BPS_SERVER_ADDRS"] == man.agg_addrs[r // 2]
+        assert env["BPS_LOCAL_SIZE"] == "2"
+        assert env["BPS_LOCAL_RANK"] == str(r % 2)
+        assert env["BPS_NUM_WORKER"] == "4"      # dp is global truth
+
+
+def test_manifest_hier_refusals(monkeypatch):
+    from byteps_tpu.launcher.fleet import FleetManifest
+    monkeypatch.delenv("BPS_HIER_AGG", raising=False)
+    with pytest.raises(ValueError):
+        FleetManifest(stages=1, dp=3, shards=1, local_size=2).build()
+    # shards=0 with dp>1 auto-provisions one shard — a valid hier
+    # topology (the tier still shrinks that one cross-host link)
+    man0 = FleetManifest(stages=1, dp=4, shards=0, steps=2, local_size=2)
+    specs0 = man0.build()
+    assert len([s for s in specs0 if s.role == "server"]) == 1
+    assert len([s for s in specs0 if s.role == "agg"]) == 2
+    # BPS_HIER_AGG=off: topology declared but the tier disabled — flat
+    monkeypatch.setenv("BPS_HIER_AGG", "off")
+    man = FleetManifest(stages=1, dp=4, shards=2, steps=2, local_size=2)
+    specs = man.build()
+    assert not [s for s in specs if s.role == "agg"]
+    assert not man.agg_addrs
+    by_name = {s.name: s for s in specs}
+    assert by_name["srv0"].env["BPS_NUM_WORKER"] == "4"
+
+
+# =====================================================================
+# Stale-shm sweep
+# =====================================================================
+
+_SHM_CHILD = r"""
+import os, sys
+from byteps_tpu.server.transport import _PosixShm
+seg = _PosixShm(create=True, size=4096)
+print(seg.name, flush=True)
+if "--die" in sys.argv:
+    os.kill(os.getpid(), 9)
+else:
+    import time
+    time.sleep(60)
+"""
+
+
+def _shm_path(name: str) -> str:
+    return "/dev/shm/" + name.lstrip("/")
+
+
+def test_stale_shm_sweep_unlinks_dead_owner():
+    """A SIGKILLed owner strands its segment (the hazard documented at
+    transport._PosixShm); the sweep reclaims it once nobody maps it."""
+    from byteps_tpu.launcher.fleet import sweep_stale_shm
+    p = subprocess.Popen([sys.executable, "-c", _SHM_CHILD, "--die"],
+                         stdout=subprocess.PIPE, text=True,
+                         cwd=os.path.dirname(os.path.dirname(__file__)))
+    name = p.stdout.readline().strip()
+    p.wait(timeout=30)
+    assert name.startswith("/bps-shm-")
+    assert os.path.exists(_shm_path(name)), "child did not strand shm"
+    swept = sweep_stale_shm(grace_s=0.0)
+    assert name.lstrip("/") in [s.lstrip("/") for s in swept]
+    assert not os.path.exists(_shm_path(name))
+
+
+def test_stale_shm_sweep_spares_live_owner():
+    """A segment a LIVE process maps must survive the sweep — liveness
+    is read from /proc/*/maps, not from file age."""
+    from byteps_tpu.launcher.fleet import sweep_stale_shm
+    from byteps_tpu.server.transport import _PosixShm
+    seg = _PosixShm(create=True, size=4096)
+    try:
+        sweep_stale_shm(grace_s=0.0)
+        assert os.path.exists(_shm_path(seg.name)), (
+            "sweep unlinked a live process's segment")
+    finally:
+        seg.close()
+        seg.unlink()
+
+
+def test_supervisor_restart_sweeps_stranded_shm(tmp_path, monkeypatch):
+    """Injected SIGKILL: the supervisor's restart path must reclaim the
+    dead incarnation's segment BEFORE respawning, and emit the
+    shm_swept event postmortems read."""
+    from byteps_tpu.launcher.fleet import FleetSupervisor, ProcessSpec
+    monkeypatch.setenv("BPS_SHM_SWEEP_GRACE_S", "0")
+    name_file = tmp_path / "segname"
+    child = (
+        "import os, time\n"
+        "from byteps_tpu.server.transport import _PosixShm\n"
+        "seg = _PosixShm(create=True, size=4096)\n"
+        f"open({str(name_file)!r}, 'w').write(seg.name)\n"
+        "time.sleep(60)\n")
+    spec = ProcessSpec(name="shmrole", role="worker",
+                       argv=[sys.executable, "-c", child],
+                       env=dict(os.environ), restartable=True,
+                       expect_exit=False)
+    sup = FleetSupervisor([spec], logdir=str(tmp_path / "logs"),
+                          max_restarts=2, backoff_s=0.1)
+    sup.start()
+    try:
+        deadline = time.time() + 20
+        while not name_file.exists() and time.time() < deadline:
+            time.sleep(0.05)
+        first = name_file.read_text().strip()
+        assert first, "child never published its segment"
+        name_file.unlink()
+        sup.kill("shmrole")
+        while sup.restarts("shmrole") < 1 and time.time() < deadline:
+            sup.poll_once()
+            time.sleep(0.05)
+        assert sup.restarts("shmrole") >= 1
+        assert not os.path.exists(_shm_path(first)), (
+            "restart did not sweep the stranded segment")
+        assert any(e.get("event") == "shm_swept" for e in sup.events), (
+            f"no shm_swept event in {sup.events}")
+    finally:
+        sup.drain(timeout_s=10)
+    # drain SIGKILL-strands the replacement's segment too; the drain
+    # sweep must have reclaimed it
+    if name_file.exists():
+        second = name_file.read_text().strip()
+        assert not os.path.exists(_shm_path(second))
+
+
+# =====================================================================
+# Pass-through: K-lag, fused, observability
+# =====================================================================
+
+class _FakeUpstream:
+    """Records every upstream call; pull-side returns canned data."""
+
+    def __init__(self):
+        self.calls = []
+        self.pull_value = None
+        self.lag_flags = 0
+
+    def init_key(self, key, nbytes, dtype="float32", init=None,
+                 fused=False):
+        self.calls.append(("init", key, nbytes, dtype, fused))
+
+    def push(self, key, data):
+        self.calls.append(("push", key, np.array(data, copy=True)))
+
+    def pull(self, key, out, round=0, timeout_ms=None):
+        self.calls.append(("pull", key, round))
+        np.copyto(out, self.pull_value)
+
+    def round(self, key):
+        return 0
+
+    def declare_lag(self, key, max_lag):
+        self.calls.append(("declare_lag", key, max_lag))
+
+    def push_lag(self, key, worker, rnd, data):
+        self.calls.append(("push_lag", key, worker, rnd,
+                           np.array(data, copy=True)))
+
+    def pull_lag(self, key, worker, rnd, out, timeout_ms=None):
+        self.calls.append(("pull_lag", key, worker, rnd))
+        np.copyto(out, self.pull_value)
+        return self.lag_flags
+
+    def push_fused(self, key, payload):
+        self.calls.append(("push_fused", key, bytes(payload)))
+
+    def close(self):
+        pass
+
+
+def test_lag_passthrough_folds_to_host_granularity():
+    """K-lag traffic folds locally per (key, round) and crosses hosts
+    ONCE per host seal, spoken upstream as worker id host_id — the
+    remote StaleStore counts hosts, exactly as the flat plane counts
+    workers."""
+    up = _FakeUpstream()
+    agg = LocalAggBackend(up, 2, host_id=5)
+    agg.init_key(1, NBYTES, "float32")
+    agg.declare_lag(1, 4)
+    assert ("declare_lag", 1, 4) in up.calls
+    a, b = dyadic(0, 3), dyadic(1, 3)
+    agg.push_lag(1, 0, 3, a)
+    assert not [c for c in up.calls if c[0] == "push_lag"]
+    agg.push_lag(1, 1, 3, b)
+    sent = [c for c in up.calls if c[0] == "push_lag"]
+    assert len(sent) == 1
+    _, key, worker, rnd, data = sent[0]
+    assert (key, worker, rnd) == (1, 5, 3)
+    assert data.tobytes() == (a + b).tobytes()
+
+    # fan-out: two local pullers, ONE upstream fetch
+    up.pull_value = a + b
+    up.lag_flags = 2
+    outs = [np.empty(N_ELEMS, np.float32) for _ in range(2)]
+    flags = [agg.pull_lag(1, w, 3, outs[w]) for w in range(2)]
+    assert flags == [2, 2]
+    assert len([c for c in up.calls if c[0] == "pull_lag"]) == 1
+    for o in outs:
+        assert o.tobytes() == (a + b).tobytes()
+
+
+def test_fused_passthrough_merges_then_crosses_once(monkeypatch):
+    """Codec-homogeneous fused pushes merge decode-free in the host's
+    FusedSumStore and cross hosts as ONE re-encoded payload — the
+    lossless local_size reduction composing with the codec one."""
+    from byteps_tpu.compress import wire
+    monkeypatch.setenv("BPS_FUSED_HOMOG", "1")
+    up = _FakeUpstream()
+    agg = LocalAggBackend(up, 2, host_id=0)
+    agg.init_key(4, NBYTES, "float32", fused=True)
+    cid = wire.codec_id("none")
+    a, b = dyadic(0, 1), dyadic(1, 1)
+    agg.push_fused(4, wire.encode(cid, a))
+    assert not [c for c in up.calls if c[0] == "push_fused"]
+    agg.push_fused(4, wire.encode(cid, b))
+    sent = [c for c in up.calls if c[0] == "push_fused"]
+    assert len(sent) == 1
+    merged = wire.decode(sent[0][2], expect_elems=N_ELEMS)
+    assert merged.astype(np.float32).tobytes() == (a + b).tobytes()
+
+
+def test_seal_counters_and_keyless_flight_events():
+    """Every local seal is observable: ps/local_agg_bytes counts the
+    local hop, ps/remote_push_bytes what actually crossed, and the
+    hier_seal flight event is KEY-LESS so any key's postmortem sees
+    the tier's timing."""
+    from byteps_tpu.obs.flight import get_recorder
+    from byteps_tpu.obs.metrics import get_registry
+    rec = get_recorder()
+    rec.configure(enabled=True)
+    rec.clear()
+    reg = get_registry()
+    local0 = reg.counter("ps/local_agg_bytes").value
+    remote0 = reg.counter("ps/remote_push_bytes").value
+    up = _FakeUpstream()
+    agg = LocalAggBackend(up, 2, host_id=0)
+    agg.init_key(9, NBYTES, "float32")
+    agg.push(9, dyadic(0, 1))
+    agg.push(9, dyadic(1, 1))
+    assert reg.counter("ps/local_agg_bytes").value - local0 == 2 * NBYTES
+    assert reg.counter("ps/remote_push_bytes").value - remote0 == NBYTES
+    seals = [e for e in rec.events() if e["kind"] == "hier_seal"]
+    assert len(seals) == 1
+    assert "key" not in seals[0], "seal events must be key-less"
+    # key-less events pass ANY key filter — the postmortem contract
+    assert [e for e in rec.events(keys=[123456])
+            if e["kind"] == "hier_seal"]
