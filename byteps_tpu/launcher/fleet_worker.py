@@ -5,7 +5,7 @@ contract (docs/launcher.md has the table); everything here is DERIVED
 from that env — no argv, no shared state, exactly what a k8s pod or
 SSH-launched rank would see.
 
-Two modes (``BPS_FLEET_MODE``):
+Three modes (``BPS_FLEET_MODE``):
 
   - ``train`` (default): the pipeline stage worker. Builds the shared
     mlp program deterministically from ``BPS_FLEET_SEED``, partitions
@@ -27,6 +27,20 @@ Two modes (``BPS_FLEET_MODE``):
     (tests/_elastic_ps_worker.py's contract, now supervisor-driven).
     Prints per-round ``FLEET_STEP`` walls — the kill test's stall
     accounting reads them.
+  - ``embed``: the ISSUE-18 feature-store loop — a DLRM-style worker
+    driving the sharded embedding store (server/embed.py) with a
+    Zipfian request trace: per step, sparse-pull the batch's rows
+    (hot-row cache on unless ``BPS_EMBED_CACHE_ROWS=0``), push
+    deterministic dyadic per-(worker, step, row) deltas, tick the
+    cache round. ``BPS_EMBED_DENSE=1`` turns the PULL side into a
+    full-table dense fetch (the bench's wire-bytes control arm; pushes
+    stay trace-based so both arms converge to the same table).
+    ``BPS_EMBED_VERIFY=1`` makes worker 0 re-derive the expected final
+    table analytically (dyadic deltas make fp32 sums exact, so the
+    comparison is BITWISE) and poll with a no-cache client until the
+    server matches — the bench's convergence-parity column. Prints
+    per-step ``FLEET_STEP`` walls/fetch times and one ``FLEET_RESULT``
+    with hit/miss counters, fetch p50/p99, and the parity verdict.
 """
 
 from __future__ import annotations
@@ -153,6 +167,156 @@ def _run_rounds() -> int:
          "digests": digests}),
         flush=True)
     return 0
+
+
+def embed_trace(seed: int, wid: int, step: int, batch: int, rows: int,
+                zipf_a: float):
+    """The (worker, step) slice of the Zipfian request trace: ``batch``
+    row ids drawn Zipf(a) over [0, rows). Legacy ``RandomState`` keeps
+    the stream stable across numpy versions, and seeding per
+    (seed, wid, step) makes any slice recomputable in isolation — the
+    verify pass re-derives every worker's whole trace from scalars."""
+    import numpy as np
+    rng = np.random.RandomState(
+        (int(seed) * 1000003 + wid * 8191 + step) % (2 ** 32 - 1))
+    return ((rng.zipf(zipf_a, batch).astype(np.uint64) - np.uint64(1))
+            % np.uint64(rows))
+
+
+def embed_delta(seed: int, wid: int, step: int, rids, cols: int):
+    """Deterministic per-(worker, step, row) push deltas: dyadic
+    rationals from the store's own ``init_rows`` hash under a
+    (seed, wid, step)-mixed seed. Dyadic values keep every fp32 sum on
+    the path EXACT — client dedup fold, server row accumulation, and
+    the verify pass's count-weighted expectation all land on the same
+    bytes regardless of association order."""
+    from ..server.embed import init_rows
+    return init_rows(int(seed) * 1000003 + wid * 8191 + step, rids,
+                     cols)
+
+
+def _embed_verify(addrs, seed: int, dp: int, steps: int, rows: int,
+                  cols: int, batch: int, zipf_a: float,
+                  timeout_s: float = 60.0) -> bool:
+    """Worker 0's convergence-parity check: re-derive the expected
+    final table (init + every worker's trace-weighted deltas — all
+    dyadic, so the fp32 expectation is exact) and poll the plane with a
+    NO-CACHE client until the pulled bytes match bitwise. Polling,
+    because peers finish their last push asynchronously."""
+    import numpy as np
+
+    from ..server.embed import EmbedClient, init_rows
+
+    expect = init_rows(seed, np.arange(rows, dtype=np.uint64), cols)
+    for w in range(dp):
+        for s in range(1, steps + 1):
+            tids = embed_trace(seed, w, s, batch, rows, zipf_a)
+            uniq, counts = np.unique(tids, return_counts=True)
+            d = embed_delta(seed, w, s, uniq, cols)
+            expect[uniq.astype(np.int64)] += (
+                d * counts[:, None].astype(d.dtype))
+    ver = EmbedClient.connect(addrs, table_id=0, num_rows=rows,
+                              cols=cols, seed=seed, cache_rows=0)
+    all_ids = np.arange(rows, dtype=np.uint64)
+    deadline = time.time() + timeout_s
+    while True:
+        ok = bool(np.array_equal(ver.pull(all_ids), expect))
+        if ok or time.time() > deadline:
+            break
+        time.sleep(0.25)
+    ver.close()
+    return ok
+
+
+def _run_embed() -> int:
+    """Embedding feature-store mode: Zipfian sparse pull/push loop
+    against the row-sharded table on the plane (no jax import — pure
+    numpy over TCP, like rounds mode)."""
+    import numpy as np
+
+    from ..obs.metrics import get_registry
+    from ..server.embed import EmbedClient
+    from .fleet import wait_for_ports
+
+    dp = _env_int("BPS_NUM_WORKER", 1)
+    wid = _env_int("BPS_WORKER_ID", 0)
+    steps = _env_int("BPS_FLEET_STEPS", 8)
+    seed = _env_int("BPS_FLEET_SEED", 0)
+    rows = _env_int("BPS_EMBED_ROWS", 1 << 20)
+    cols = _env_int("BPS_EMBED_COLS", 32)
+    batch = _env_int("BPS_EMBED_BATCH", 256)
+    dense = _env_int("BPS_EMBED_DENSE", 0)
+    verify = _env_int("BPS_EMBED_VERIFY", 0)
+    # push accumulation (BPS_EMBED_PUSH_EVERY=R): fold R steps of
+    # deltas client-side and push once — the DLRM grad-accumulation
+    # idiom. Between flushes a worker's hot rows STAY cached (a push
+    # drops its rows from the cache — the hot-row staleness contract —
+    # so push-every-step traces re-fetch everything and the cache only
+    # saves validation bytes). Deltas are dyadic, so the folded sums
+    # are exact and the verify expectation is unchanged.
+    push_every = max(1, _env_int("BPS_EMBED_PUSH_EVERY", 1))
+    zipf_a = float(os.environ.get("BPS_EMBED_ZIPF_A", "1.1") or 1.1)
+    addrs = [a for a in os.environ.get("BPS_SERVER_ADDRS", "").split(",")
+             if a]
+    if not addrs:
+        print("FLEET_ERROR embed mode needs BPS_SERVER_ADDRS",
+              flush=True)
+        return 2
+    wait_for_ports(addrs, timeout_s=60.0)
+    cli = EmbedClient.connect(addrs, table_id=0, num_rows=rows,
+                              cols=cols, seed=seed)
+    dense_ids = (np.arange(rows, dtype=np.uint64) if dense else None)
+    fetch = []
+    acc_ids, acc_deltas = [], []
+    t_all = time.time()
+    for s in range(1, steps + 1):
+        t0 = time.time()
+        tids = embed_trace(seed, wid, s, batch, rows, zipf_a)
+        vals = cli.pull(dense_ids if dense else tids)
+        fetch.append(cli.last_fetch_s)
+        loss = float(np.mean(np.abs(vals)))
+        acc_ids.append(tids)
+        acc_deltas.append(embed_delta(seed, wid, s, tids, cols))
+        if s % push_every == 0 or s == steps:
+            cli.push(np.concatenate(acc_ids),
+                     np.concatenate(acc_deltas, axis=0))
+            acc_ids, acc_deltas = [], []
+        cli.tick()
+        print("FLEET_STEP " + json.dumps(
+            {"worker": wid, "step": s,
+             "wall_s": round(time.time() - t0, 4),
+             "fetch_s": round(fetch[-1], 4),
+             "loss": round(loss, 6)}), flush=True)
+    wall = time.time() - t_all
+    # snapshot counters BEFORE any verify traffic — the verify client
+    # shares this process's registry and would pollute the byte and
+    # hit-rate columns the bench reports
+    reg = get_registry()
+    hits = int(reg.counter("embed/cache_hits").value)
+    misses = int(reg.counter("embed/cache_misses").value)
+    fbytes = int(reg.counter("embed/row_fetch_bytes").value)
+    pushed = int(reg.counter("embed/rows_pushed").value)
+    parity = None
+    if verify and wid == 0:
+        parity = _embed_verify(addrs, seed, dp, steps, rows, cols,
+                               batch, zipf_a)
+    cli.close()
+    fs = sorted(fetch)
+
+    def q(p: float) -> float:
+        return fs[min(len(fs) - 1, int(p * len(fs)))]
+
+    print("FLEET_RESULT " + json.dumps(
+        {"mode": "embed", "worker": wid, "steps": steps, "rows": rows,
+         "cols": cols, "batch": batch, "dense": dense, "hits": hits,
+         "misses": misses,
+         "hit_rate": round(hits / max(1, hits + misses), 4),
+         "row_fetch_bytes": fbytes, "rows_pushed": pushed,
+         "fetch_p50_s": round(q(0.50), 5),
+         "fetch_p99_s": round(q(0.99), 5),
+         "lookups_per_s": round(batch * steps / wall, 1),
+         "wall_s": round(wall, 3), "parity": parity}), flush=True)
+    return 0 if parity in (None, True) else 3
 
 
 def _run_train() -> int:
@@ -326,6 +490,8 @@ def main() -> int:
     mode = os.environ.get("BPS_FLEET_MODE", "train").strip() or "train"
     if mode == "rounds":
         return _run_rounds()
+    if mode == "embed":
+        return _run_embed()
     if mode == "train":
         return _run_train()
     print(f"FLEET_ERROR unknown BPS_FLEET_MODE={mode!r}", flush=True)

@@ -112,6 +112,18 @@ LAG_COUNTERS: Tuple[str, ...] = (
     "lag/evicted_serves")
 LAG_GAUGES: Tuple[str, ...] = ("lag/max_streak",)
 
+# Sharded embedding store (server/embed.py, docs/embedding.md):
+# hit/miss split of the worker-side hot-row cache (hits = rows served
+# with ZERO row bytes on the wire — locally inside the K window or
+# version-validated "unchanged"), full-row fetch bytes, rows pushed
+# after the client-side dedup fold, and the live cache size —
+# pre-registered so the Prometheus export names the embedding plane's
+# families before the first table is declared.
+EMBED_COUNTERS: Tuple[str, ...] = (
+    "embed/cache_hits", "embed/cache_misses", "embed/row_fetch_bytes",
+    "embed/rows_pushed")
+EMBED_GAUGES: Tuple[str, ...] = ("embed/hot_set_size",)
+
 # ONE truthiness rule shared with Config (BPS_STATS must resolve
 # identically whether read here or through Config.stats_on)
 from ..common.config import _TRUE  # noqa: E402
@@ -334,6 +346,10 @@ class MetricsRegistry:
         for c in LAG_COUNTERS:
             self.counter(c)
         for g in LAG_GAUGES:
+            self.gauge(g)
+        for c in EMBED_COUNTERS:
+            self.counter(c)
+        for g in EMBED_GAUGES:
             self.gauge(g)
 
     def _get(self, name: str, cls, *args):

@@ -1,0 +1,527 @@
+"""Sharded embedding store: the PS plane as a feature store (ISSUE 18).
+
+DLRM-style workloads touch ~100 of 10⁷–10⁸ rows per request — the
+regime where a parameter server beats allreduce outright (PAPER.md:
+servers sum, workers own the optimizer; arXiv 2103.00543's sparse-
+regime analysis). The existing rowsparse path (server/rowsparse.py)
+still DENSIFIES server-side, so a 10⁷-row table is infeasible there.
+This module keeps the table sparse end to end:
+
+  - **row-sharded tables**: a table lives in key-space above the
+    bit-41/42 param/state tags (``EMBED_KEY_BASE = 1 << 43``); its
+    ROWS are hash-placed across plane shards by ``row_shard`` (a pure
+    fmix64 of the row id — every worker derives the identical
+    placement with no coordination), and a batch's rows travel as ONE
+    vectored request per shard (ids in the payload, not per-row wire
+    keys).
+  - **lazy materialization**: the server allocates a row on first
+    touch, initialized by ``init_rows`` — a counter-based dyadic hash
+    shared by server and workers, so a 10⁷-row declaration costs
+    nothing and any party can reproduce a never-touched row's value
+    exactly.
+  - **worker-side hot-row cache** with round-versioned invalidation:
+    the server bumps a per-row version on every applied push batch
+    (StaleStore's per-key rounds, generalized to row granularity); a
+    pull carries the cached versions and the server answers
+    "unchanged" (one flag byte) or the full row. Per-row staleness
+    rides the ``BPS_MAX_LAG`` contract: a COLD row may be served
+    locally for up to K rounds without wire contact; a HOT row (one
+    this worker pushed to) is invalidated immediately and never served
+    stale. K defaults to 1 — validate every round, which makes the
+    cache bitwise-transparent (tests/test_embed.py).
+  - **dedup'd rowsparse push**: duplicate row hits in a batch fold
+    client-side (``np.add.at`` over the unique ids) before the wire;
+    the server applies the sparse sums row-wise — no densify at any
+    layer.
+
+Wire formats (transport ops OP_EMBED_INIT/PULL/PUSH, all u64 ids
+little-endian via numpy, lengths framed by the transport header):
+
+  INIT  payload = JSON table meta {table, rows, cols, dtype, seed,
+        shard, shards}; idempotent first-wins, conflicting re-declare
+        refused loudly.
+  PULL  payload  = n:u32 | ids:u64[n] | cached_versions:u64[n]
+        response = flags:u8[n] | versions:u64[n] | rows (full row for
+        each flag==1, request order). flag==0 means the cached version
+        is current — no row bytes cross the wire.
+  PUSH  payload = n:u32 | ids:u64[n] | deltas:dtype[n·cols]; server
+        folds any remaining duplicates and applies row += delta with a
+        version bump per touched row; rides the push-dedup token so a
+        reconnect retry applies exactly once.
+
+The hierarchical tier (server/hier.py) is NOT a valid front for these
+ops: an aggregator's local fold has no row store, and silently passing
+through would split a table's rows across the agg's own upstream
+sharding. ``PSTransportServer.embed_store`` refuses loudly instead —
+point ``EmbedClient`` at the plane shards directly (docs/embedding.md
+has the failure matrix).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# key-space room above every existing tag: bit 40 = activation
+# channels, bit 41 = param-class keys, bit 42 = state/handoff keys,
+# bits 48+ = striping sub-keys. Embedding tables take bit 43; the low
+# 16 bits carry the table id (matching the decl<<16 convention).
+EMBED_KEY_BASE = 1 << 43
+
+_M64 = np.uint64(0xFFFFFFFFFFFFFFFF)
+_FMIX_C1 = np.uint64(0xFF51AFD7ED558CCD)
+_FMIX_C2 = np.uint64(0xC4CEB9FE1A85EC53)
+_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+
+
+def table_key(table_id: int) -> int:
+    """The wire key for table ``table_id`` — one key per table (rows
+    are addressed in the payload, not the key space)."""
+    if not 0 <= int(table_id) < (1 << 16):
+        raise ValueError(f"table id {table_id} outside [0, 65536)")
+    return EMBED_KEY_BASE | (int(table_id) << 16)
+
+
+def _fmix64(x: np.ndarray) -> np.ndarray:
+    """MurmurHash3's 64-bit finalizer, vectorized — a full-avalanche
+    integer hash, so consecutive row ids land on uncorrelated shards."""
+    x = x.astype(np.uint64, copy=True)
+    x ^= x >> np.uint64(33)
+    x *= _FMIX_C1
+    x ^= x >> np.uint64(33)
+    x *= _FMIX_C2
+    x ^= x >> np.uint64(33)
+    return x
+
+
+def row_shard(ids, num_shards: int) -> np.ndarray:
+    """Deterministic row → shard placement: a PURE function of
+    (row id, shard count), so every worker (and the bench's control
+    arithmetic) derives the identical placement with no coordination —
+    the determinism tests pin golden values against drift."""
+    ids = np.asarray(ids, dtype=np.uint64).reshape(-1)
+    return (_fmix64(ids) % np.uint64(num_shards)).astype(np.int64)
+
+
+def init_rows(seed: int, ids, cols: int, dtype: str = "float32"
+              ) -> np.ndarray:
+    """Deterministic per-row initial values, counter-based (no RNG
+    state): value[i, j] is a dyadic rational k/1024 · 2⁻³ derived from
+    fmix64(seed, row, col). Server-side lazy materialization and any
+    client-side control arithmetic reproduce a never-touched row
+    byte-identically — and dyadic values keep fp32 sums EXACT, the
+    property every bitwise-parity assertion in this plane rides on."""
+    ids = np.asarray(ids, dtype=np.uint64).reshape(-1, 1)
+    col = np.arange(int(cols), dtype=np.uint64).reshape(1, -1)
+    # seed folded via Python ints (numpy SCALAR uint64 overflow warns;
+    # array overflow wraps silently, which the hash relies on)
+    seed_term = np.uint64((int(seed) * 0xC4CEB9FE1A85EC53)
+                          & 0xFFFFFFFFFFFFFFFF)
+    h = _fmix64(ids * _GOLDEN + col + seed_term)
+    k = (h % np.uint64(1024)).astype(np.int64)
+    return (((k - 512) / 1024.0) / 8.0).astype(np.dtype(dtype))
+
+
+# ------------------------------------------------------------- server
+
+
+class _Table:
+    """One shard's slice of a table: rows materialize on first touch,
+    each carrying a version bumped per applied push batch (the per-row
+    generalization of StaleStore's per-key rounds)."""
+
+    __slots__ = ("meta", "num_rows", "cols", "dtype", "seed", "row_nbytes",
+                 "rows", "vers", "lock")
+
+    def __init__(self, meta: dict) -> None:
+        self.meta = dict(meta)
+        self.num_rows = int(meta["rows"])
+        self.cols = int(meta["cols"])
+        self.dtype = np.dtype(str(meta.get("dtype", "float32")))
+        self.seed = int(meta.get("seed", 0))
+        self.row_nbytes = self.cols * self.dtype.itemsize
+        if self.num_rows <= 0 or self.cols <= 0:
+            raise ValueError(f"bad table shape {self.num_rows}x{self.cols}")
+        self.rows: Dict[int, np.ndarray] = {}
+        self.vers: Dict[int, int] = {}
+        self.lock = threading.Lock()
+
+    def _row(self, rid: int) -> np.ndarray:
+        r = self.rows.get(rid)
+        if r is None:
+            r = init_rows(self.seed, [rid], self.cols,
+                          str(self.dtype)).reshape(-1)
+            self.rows[rid] = r
+            self.vers[rid] = 1   # versions start at 1: a client's
+            #                      "not cached" sentinel is 0
+        return r
+
+    def materialize(self, ids) -> None:
+        """Batch-materialize every missing row in ``ids`` with ONE
+        ``init_rows`` call (caller holds ``lock``). Per-row lazy init
+        was the cold-pull bottleneck: ~2000 tiny vectorized-hash calls
+        cost ~25× one 2000-row call. Values are identical by
+        construction (the hash is per-(row, col), not per-batch), so
+        this is pure mechanics. Rows are stored as VIEWS into the batch
+        block — safe because ``apply`` rebinds rows, never writes in
+        place."""
+        missing = [int(r) for r in ids if int(r) not in self.rows]
+        if not missing:
+            return
+        vals = init_rows(self.seed, missing, self.cols, str(self.dtype))
+        for j, rid in enumerate(missing):
+            self.rows[rid] = vals[j]
+            self.vers[rid] = 1
+
+
+class EmbedRowStore:
+    """Server-side sharded row store (transport-owned, like the act and
+    param mailboxes — every deployment's server role speaks it, raw
+    PSServer engines included)."""
+
+    def __init__(self) -> None:
+        self._tables: Dict[int, _Table] = {}
+        self._lock = threading.Lock()
+
+    def init_table(self, key: int, meta: dict) -> None:
+        """Idempotent first-wins declaration; a conflicting re-declare
+        (different shape/dtype/seed) is a mis-built worker and refused
+        loudly rather than silently serving rows at wrong offsets."""
+        fresh = _Table(meta)
+        with self._lock:
+            cur = self._tables.get(key)
+            if cur is None:
+                self._tables[key] = fresh
+                return
+            for f in ("rows", "cols", "dtype", "seed"):
+                a, b = cur.meta.get(f), fresh.meta.get(f)
+                if str(a) != str(b):
+                    raise ValueError(
+                        f"embed table {key:#x}: conflicting re-declare "
+                        f"({f}: {a} != {b}) — workers disagree on the "
+                        f"table")
+
+    def table(self, key: int) -> _Table:
+        t = self._tables.get(key)
+        if t is None:
+            raise KeyError(f"embed table {key:#x} not declared "
+                           f"(OP_EMBED_INIT first)")
+        return t
+
+    def pull(self, key: int, payload) -> Tuple[bytes, bytes, bytes]:
+        """Conditional sparse pull. Parses ``n | ids | cached_vers``;
+        returns (flags u8[n], versions u64[n], row bytes for the
+        flagged ids, request order). Rows are copied into ONE
+        contiguous buffer under the table lock — a concurrent push
+        mutates rows in place, and a torn row on the wire would be a
+        silent corruption; the flags/vers/rowbuf triple then rides one
+        vectored sendmsg with no further join."""
+        t = self.table(key)
+        (n,) = struct.unpack_from("<I", payload, 0)
+        ids = np.frombuffer(payload, np.uint64, count=n, offset=4)
+        vers = np.frombuffer(payload, np.uint64, count=n, offset=4 + 8 * n)
+        if np.any(ids >= np.uint64(t.num_rows)):
+            raise ValueError(f"row id out of range [0, {t.num_rows})")
+        flags = np.zeros(n, np.uint8)
+        out_vers = np.zeros(n, np.uint64)
+        chunks: List[np.ndarray] = []
+        with t.lock:
+            t.materialize(ids)
+            for i in range(n):
+                rid = int(ids[i])
+                row = t.rows[rid]
+                v = t.vers[rid]
+                out_vers[i] = v
+                if v != int(vers[i]):
+                    flags[i] = 1
+                    chunks.append(row)
+            rowbuf = (np.concatenate(chunks).tobytes() if chunks
+                      else b"")
+        return flags.tobytes(), out_vers.tobytes(), rowbuf
+
+    def apply(self, key: int, payload) -> int:
+        """Row-wise sparse apply: ``row += delta`` with a version bump
+        per touched row — NO dense expansion at any size. Clients fold
+        duplicates before the wire; any that remain (a raw client) fold
+        here first so each row's version moves once per push batch.
+        Returns the number of rows touched."""
+        t = self.table(key)
+        (n,) = struct.unpack_from("<I", payload, 0)
+        ids = np.frombuffer(payload, np.uint64, count=n, offset=4)
+        deltas = np.frombuffer(payload, t.dtype, offset=4 + 8 * n)
+        if n == 0:
+            return 0
+        if deltas.size != n * t.cols:
+            raise ValueError(f"delta payload {deltas.size} != "
+                             f"{n}x{t.cols} rows")
+        if np.any(ids >= np.uint64(t.num_rows)):
+            raise ValueError(f"row id out of range [0, {t.num_rows})")
+        deltas = deltas.reshape(n, t.cols)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        if uniq.size != n:
+            folded = np.zeros((uniq.size, t.cols), t.dtype)
+            np.add.at(folded, inv, deltas)
+        else:
+            folded = deltas
+        with t.lock:
+            t.materialize(uniq)
+            for i in range(uniq.size):
+                rid = int(uniq[i])
+                t.rows[rid] = t.rows[rid] + folded[i]
+                t.vers[rid] += 1
+        return int(uniq.size)
+
+
+# ------------------------------------------------------------- client
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name, "")
+    return int(v) if v else default
+
+
+class EmbedClient:
+    """Worker-side sharded table client: sparse row pull with a
+    hot-row cache, dedup'd rowsparse push, one vectored request per
+    shard.
+
+    ``handles`` are per-shard transport clients (single-address
+    ``RemotePSBackend``s — the plane-backend idiom), indexed by the
+    SAME shard order on every worker; ``row_shard`` routes rows.
+
+    Cache protocol (docs/embedding.md): an entry is (row, version,
+    validated_round). A row is served purely locally while
+    ``round - validated_round < K`` (K = ``BPS_EMBED_MAX_LAG``,
+    defaulting to ``BPS_MAX_LAG``, defaulting to 1); past that window
+    it is re-validated CONDITIONALLY — the cached version rides the
+    pull and the server sends one flag byte instead of the row when
+    nothing changed. A push from THIS worker invalidates its rows
+    immediately (the hot-row half of the staleness contract). At K=1
+    the cache is bitwise-transparent: every served value is validated
+    against the server's current version each round."""
+
+    def __init__(self, handles: Sequence, table_id: int, num_rows: int,
+                 cols: int, dtype: str = "float32", seed: int = 0,
+                 cache_rows: Optional[int] = None,
+                 max_lag: Optional[int] = None,
+                 timeout_ms: int = 30000) -> None:
+        if not handles:
+            raise ValueError("EmbedClient needs at least one shard handle")
+        self._handles = list(handles)
+        self._owned: List = []
+        self.key = table_key(table_id)
+        self.num_rows = int(num_rows)
+        self.cols = int(cols)
+        self.dtype = np.dtype(dtype)
+        self.seed = int(seed)
+        self.row_nbytes = self.cols * self.dtype.itemsize
+        self._timeout_ms = int(timeout_ms)
+        self.cache_rows = (_env_int("BPS_EMBED_CACHE_ROWS", 65536)
+                           if cache_rows is None else int(cache_rows))
+        self.max_lag = (max(1, _env_int("BPS_EMBED_MAX_LAG",
+                                        _env_int("BPS_MAX_LAG", 1)))
+                        if max_lag is None else max(1, int(max_lag)))
+        # row_id -> [row array, server version, validated_round]; LRU
+        # by OrderedDict recency
+        self._cache: "OrderedDict[int, list]" = OrderedDict()
+        self._round = 1
+        self._pool = None
+        self._pool_lock = threading.Lock()
+        self.last_fetch_s = 0.0   # wire time of the latest pull's
+        #                           fan-out — the p99 row-fetch column
+        from ..obs.metrics import get_registry
+        reg = get_registry()
+        self._m_hits = reg.counter("embed/cache_hits")
+        self._m_miss = reg.counter("embed/cache_misses")
+        self._m_fetch_bytes = reg.counter("embed/row_fetch_bytes")
+        self._m_rows_pushed = reg.counter("embed/rows_pushed")
+        self._m_hot = reg.gauge("embed/hot_set_size")
+        meta = {"table": int(table_id), "rows": self.num_rows,
+                "cols": self.cols, "dtype": str(self.dtype),
+                "seed": self.seed, "shards": len(self._handles)}
+        for s, h in enumerate(self._handles):
+            h.embed_init(self.key, dict(meta, shard=s))
+
+    @classmethod
+    def connect(cls, addrs: Sequence[str], table_id: int, num_rows: int,
+                cols: int, **kw) -> "EmbedClient":
+        """Dial one single-address transport client per shard (owned —
+        closed by ``close``) and declare the table on each."""
+        from .transport import RemotePSBackend
+        handles = [RemotePSBackend([a]) for a in addrs]
+        cli = cls(handles, table_id, num_rows, cols, **kw)
+        cli._owned = handles
+        return cli
+
+    # ------------------------------------------------------------ pull
+
+    def tick(self) -> None:
+        """Advance the client's round — one call per training step; the
+        denominator of every staleness-window decision."""
+        self._round += 1
+
+    def _fanout(self, fn, items):
+        """Run ``fn`` over per-shard work items, in parallel when more
+        than one shard has work (one small pool, shards-wide)."""
+        if len(items) == 1:
+            return [fn(items[0])]
+        if self._pool is None:
+            with self._pool_lock:
+                if self._pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
+                    self._pool = ThreadPoolExecutor(
+                        max_workers=len(self._handles),
+                        thread_name_prefix="bps-embed")
+        return list(self._pool.map(fn, items))
+
+    def pull(self, ids) -> np.ndarray:
+        """Fetch the current rows for ``ids`` (duplicates allowed —
+        resolved through one lookup per unique row). Only rows outside
+        the local staleness window touch the wire, one vectored request
+        per shard; of those, only rows whose version MOVED transfer
+        bytes."""
+        import time as _time
+        ids = np.asarray(ids, dtype=np.uint64).reshape(-1)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        out = np.empty((uniq.size, self.cols), self.dtype)
+        need: List[int] = []       # positions in uniq that go to the wire
+        hits = 0
+        for i in range(uniq.size):
+            rid = int(uniq[i])
+            ent = self._cache.get(rid)
+            if ent is not None and self._round - ent[2] < self.max_lag:
+                out[i] = ent[0]          # cold row inside the K window:
+                self._cache.move_to_end(rid)   # no wire contact at all
+                hits += 1
+            else:
+                need.append(i)
+        fetched_bytes = 0
+        t0 = _time.time()
+        if need:
+            shards = row_shard(uniq[need], len(self._handles))
+            work = []
+            for s in range(len(self._handles)):
+                pos = [need[j] for j in range(len(need)) if shards[j] == s]
+                if pos:
+                    work.append((s, pos))
+
+            def one(item):
+                s, pos = item
+                rids = uniq[pos]
+                vers = np.array(
+                    [self._cache[int(r)][1] if int(r) in self._cache
+                     else 0 for r in rids], np.uint64)
+                payload = (struct.pack("<I", len(pos)) + rids.tobytes()
+                           + vers.tobytes())
+                return pos, self._handles[s].embed_pull(
+                    self.key, payload, timeout_ms=self._timeout_ms)
+
+            for pos, resp in self._fanout(one, work):
+                n = len(pos)
+                flags = np.frombuffer(resp, np.uint8, count=n)
+                vers = np.frombuffer(resp, np.uint64, count=n, offset=n)
+                rows = np.frombuffer(resp, self.dtype, offset=n + 8 * n)
+                rows = rows.reshape(-1, self.cols).copy()
+                fetched_bytes += rows.nbytes
+                # cache entries hold VIEWS into the one block copy
+                # above — a per-row np copy on this path measurably
+                # rivals the wire time at DLRM batch sizes
+                r = 0
+                for j in range(n):
+                    i = pos[j]
+                    rid = int(uniq[i])
+                    if flags[j]:
+                        row = rows[r]
+                        out[i] = row
+                        r += 1
+                        self._m_miss.inc()
+                    else:
+                        # version unchanged: the cached bytes are
+                        # current — a validated hit, zero row bytes
+                        row = self._cache[rid][0]
+                        out[i] = row
+                        self._m_hits.inc()
+                    self._cache_put(rid, row, int(vers[j]))
+        self.last_fetch_s = _time.time() - t0
+        if hits:
+            self._m_hits.inc(hits)
+        if fetched_bytes:
+            self._m_fetch_bytes.inc(fetched_bytes)
+        self._m_hot.set(len(self._cache))
+        return out[inv].reshape(ids.size, self.cols)
+
+    def _cache_put(self, rid: int, row: np.ndarray, version: int) -> None:
+        """``row`` must be client-owned (a fetched-block view or an
+        already-cached array) — never a view into a caller's buffer."""
+        if self.cache_rows <= 0:
+            return
+        self._cache[rid] = [row, version, self._round]
+        self._cache.move_to_end(rid)
+        evicted = 0
+        while len(self._cache) > self.cache_rows:
+            self._cache.popitem(last=False)
+            evicted += 1
+        if evicted:
+            from ..obs import flight
+            flight.record("row_evict", nbytes=evicted * self.row_nbytes,
+                          round=self._round, detail=f"rows={evicted}")
+
+    # ------------------------------------------------------------ push
+
+    def push(self, ids, deltas) -> None:
+        """Dedup'd rowsparse gradient push: duplicate row hits fold
+        client-side (scatter-add over the unique ids) BEFORE the wire,
+        then one vectored request per shard. Pushed rows are dropped
+        from the cache — this worker's next pull of a row it just
+        updated must see the merged value (the hot-row half of the
+        staleness contract)."""
+        ids = np.asarray(ids, dtype=np.uint64).reshape(-1)
+        deltas = np.ascontiguousarray(deltas, dtype=self.dtype)
+        if deltas.ndim != 2 or deltas.shape != (ids.size, self.cols):
+            raise ValueError(f"deltas must be [{ids.size}, {self.cols}]; "
+                             f"got {deltas.shape}")
+        uniq, inv = np.unique(ids, return_inverse=True)
+        if uniq.size != ids.size:
+            folded = np.zeros((uniq.size, self.cols), self.dtype)
+            np.add.at(folded, inv, deltas)
+        else:
+            folded = deltas
+        shards = row_shard(uniq, len(self._handles))
+        work = []
+        for s in range(len(self._handles)):
+            mask = shards == s
+            if np.any(mask):
+                work.append((s, uniq[mask],
+                             np.ascontiguousarray(folded[mask])))
+
+        def one(item):
+            s, rids, rows = item
+            payload = (struct.pack("<I", rids.size) + rids.tobytes()
+                       + rows.tobytes())
+            self._handles[s].embed_push(self.key, payload)
+
+        self._fanout(one, work)
+        self._m_rows_pushed.inc(int(uniq.size))
+        inval = 0
+        for rid in uniq:
+            if self._cache.pop(int(rid), None) is not None:
+                inval += 1
+        if inval:
+            from ..obs import flight
+            flight.record("cache_inval", round=self._round,
+                          detail=f"rows={inval}")
+            self._m_hot.set(len(self._cache))
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+        for h in self._owned:
+            h.close()
+        self._owned = []

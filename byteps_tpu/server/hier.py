@@ -94,7 +94,18 @@ class LocalAggBackend:
     Satisfies the full backend surface ``PSTransportServer`` consumes —
     dense (push/pull/round), fused (push_fused/pull_fused), and K-lag
     (declare_lag/push_lag/pull_lag) — so the front transport needs no
-    hierarchical special-casing at all."""
+    hierarchical special-casing at all.
+
+    The ONE surface it refuses is the sharded embedding store
+    (OP_EMBED_*): rowsparse pushes compose — the transport expands
+    them to dense and this backend folds the dense sum like any other
+    (tests/test_hier.py pins the parity) — but embed tables must NOT
+    ride the agg: there is no row store here, and passing through
+    would re-shard one table's rows across the agg's upstream plane.
+    ``is_local_agg`` lets the transport's ``embed_store`` refuse
+    loudly at first use (docs/embedding.md failure matrix)."""
+
+    is_local_agg = True
 
     def __init__(self, upstream, local_size: int, host_id: int = 0) -> None:
         self.upstream = upstream

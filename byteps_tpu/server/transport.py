@@ -182,6 +182,19 @@ OP_TRACE = 25
 # (48 bits of round, 16 of worker). The pull response prefixes one
 # verdict byte (admission.LAG_* flags) to the dense payload.
 OP_LAG_DECL, OP_PUSH_LAG, OP_PULL_LAG = 26, 27, 28
+# Sharded embedding store (server/embed.py, docs/embedding.md): rows
+# of a table hash-placed across shards, addressed by id in the PAYLOAD
+# (one key per table — bit 43 of the key space), pulled conditionally
+# against cached per-row versions and pushed as dedup'd row-sparse
+# sums. Transport-owned like the act/param mailboxes so raw-PSServer
+# fleet server roles speak it; REFUSED on a hierarchical-agg front
+# (embed_store below — an aggregator has no row store to serve from).
+#   OP_EMBED_INIT: payload = JSON table meta; idempotent first-wins.
+#   OP_EMBED_PULL: payload = n:u32|ids:u64[n]|cached_vers:u64[n];
+#     response = flags:u8[n]|vers:u64[n]|full rows for flag==1 only.
+#   OP_EMBED_PUSH: payload = n:u32|ids:u64[n]|deltas:dtype[n·cols];
+#     ``rnd`` = push dedup token — a reconnect retry applies once.
+OP_EMBED_INIT, OP_EMBED_PULL, OP_EMBED_PUSH = 29, 30, 31
 _PART = struct.Struct("!IIHHQ")  # offset, part_len, part_idx, nparts, nonce
 _LAG_ROUND_MASK = (1 << 48) - 1
 ST_OK, ST_ERR, ST_TIMEOUT, ST_GONE = 0, 1, 2, 3
@@ -393,7 +406,10 @@ def _send_frame(sock, hdr, parts) -> None:
     for p in parts:
         bufs.append(_byteview(p))
     while bufs:
-        n = sendmsg(bufs)
+        # cap the iovec count: sendmsg raises EMSGSIZE past IOV_MAX
+        # (1024 on Linux) and a large row-gather can exceed it; the
+        # resume loop below already handles the unsent tail
+        n = sendmsg(bufs[:1024])
         while bufs and n >= len(bufs[0]):
             n -= len(bufs[0])
             bufs.pop(0)
@@ -436,7 +452,11 @@ _REUSE_SAFE_OPS = frozenset(
                      # the dense view) before the handler returns
      OP_ACT_PUSH,    # ActStore.put copies via bytes() synchronously
      OP_PARAM_PUT,   # ParamStore.put copies via bytes() synchronously
-     OP_PUSH_LAG})   # StaleStore.push folds (+=) before returning
+     OP_PUSH_LAG,    # StaleStore.push folds (+=) before returning
+     OP_EMBED_PUSH,  # EmbedRowStore.apply folds row-wise (new arrays)
+                     # before returning
+     OP_EMBED_PULL})  # ids/vers views are consumed inside .pull()
+#                       (the row buffer is a fresh concatenation)
 
 
 def _recv_req(sock: socket.socket, rholder: Optional[list] = None):
@@ -581,6 +601,10 @@ class PSTransportServer:
         self._acts_lock = threading.Lock()
         # param mailbox (sharded weight update, OP_PARAM_*) — lazy too
         self._params = None
+        # sharded embedding row store (server/embed.py, OP_EMBED_*) —
+        # lazy; deployments without tables never allocate it
+        self._embed = None
+        self._embed_lock = threading.Lock()
         self._shm = _ShmCache()
         # fused-pull caching lives behind self._fb (the backend's own
         # FusedPullCache, or FusedFront's, or the homog store's merged
@@ -948,6 +972,29 @@ class PSTransportServer:
                 body = _json.dumps(self.trace_payload()).encode()
                 conn.sendall(_RSP.pack(ST_OK, len(body)))
                 conn.sendall(body)
+            elif op == OP_EMBED_INIT:
+                import json as _json
+                self.embed_store().init_table(
+                    key, _json.loads(bytes(payload or b"{}")))
+                conn.sendall(_RSP.pack(ST_OK, 0))
+            elif op == OP_EMBED_PULL:
+                flags, vers, rowbuf = self.embed_store().pull(key,
+                                                              payload)
+                # vectored: status + flags + versions + the row gather
+                # in ONE sendmsg — the zero-copy path the sparse pull
+                # rides (rows are copied once under the table lock,
+                # never joined again)
+                _send_frame(conn,
+                            _RSP.pack(ST_OK, len(flags) + len(vers)
+                                      + len(rowbuf)),
+                            [flags, vers, rowbuf])
+            elif op == OP_EMBED_PUSH:
+                pay = payload   # consumed synchronously by apply()
+                plen_e = len(pay)
+                self._note_push(self._apply_push_once(
+                    key, rnd, lambda: self.embed_store().apply(key, pay)),
+                    key, rnd, plen_e)
+                conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_LAG_DECL:
                 self._lag_declare(key, int(rnd))
                 conn.sendall(_RSP.pack(ST_OK, 0))
@@ -1103,6 +1150,29 @@ class PSTransportServer:
                             if hasattr(self.backend, "queue_depth")
                             else None),
             start_ts=self._t0_wall)
+
+    def embed_store(self):
+        """This server's sharded embedding row store (OP_EMBED_*,
+        server/embed.py) — lazy like the act/param mailboxes. REFUSED
+        on a hierarchical-aggregation front (server/hier.py): an
+        aggregator's local fold has no row store, and silently passing
+        embed ops through would split one table's rows across the
+        agg's own upstream sharding — serving rows from the WRONG
+        shard's lazy-init values. Point EmbedClient at the plane
+        shards directly (docs/embedding.md failure matrix)."""
+        if self._embed is None:
+            with self._embed_lock:
+                if self._embed is None:
+                    if getattr(self.backend, "is_local_agg", False):
+                        raise RuntimeError(
+                            "embed tables cannot ride a hierarchical "
+                            "aggregator front (BPS_HIER_AGG): the agg "
+                            "tier folds dense gradients and has no row "
+                            "store — connect EmbedClient to the plane "
+                            "shards (BPS_SERVER_ADDRS), not the agg")
+                    from .embed import EmbedRowStore
+                    self._embed = EmbedRowStore()
+        return self._embed
 
     def param_store(self):
         """This server's param mailbox (sharded weight update,
@@ -1452,6 +1522,12 @@ class RemotePSBackend:
         # — without the re-declaration its first post-reconnect push
         # would be rejected and the worker's lag budget silently lost
         self._lag_decls: List[Dict[int, int]] = [dict() for _ in addrs]
+        # embed-table declaration replay log (OP_EMBED_INIT is
+        # idempotent first-wins, so replaying into a restarted server
+        # re-declares the table; its ROWS come from lazy re-init +
+        # whatever pushes land after — the same async-recovery
+        # semantics as the dense store without a snapshot)
+        self._embed_inits: List[Dict[int, bytes]] = [dict() for _ in addrs]
         # DEDICATED telemetry channel per shard (OP_STATS, obs/fleet):
         # scrapes must not draw from the data-plane pools — when every
         # pooled channel is parked on a round-blocked pull (the wedged
@@ -1576,6 +1652,10 @@ class RemotePSBackend:
         for k, lag in self._lag_decls[i].items():
             self._roundtrip(ch.sock, OP_LAG_DECL, k, int(lag), 0, 0,
                             "uint8", None)
+        # replay embed-table declarations (idempotent first-wins)
+        for k, body in self._embed_inits[i].items():
+            self._roundtrip(ch.sock, OP_EMBED_INIT, k, 0, 0, 0,
+                            "uint8", memoryview(body))
 
     def _send_init(self, sock, key, nbytes, dtype, init, compression,
                    fused=False):
@@ -1661,7 +1741,7 @@ class RemotePSBackend:
     # NIC outside the credit and nothing could overtake it
     _SCHED_GRAD_OPS = frozenset({OP_PUSH, OP_PUSH_C, OP_PUSH_RS,
                                  OP_PUSH_PART, OP_PUSH_F, OP_REPL_PUT,
-                                 OP_PUSH_LAG})
+                                 OP_PUSH_LAG, OP_EMBED_PUSH})
 
     def _rpc(self, op: int, key: int, rnd: int, nbytes: int,
              timeout_ms: int, dtype: str, payload: Optional[memoryview],
@@ -2342,6 +2422,38 @@ class RemotePSBackend:
             dtype = str(np.asarray(rows).dtype)
         self._rpc(OP_PUSH_RS, key, self._push_token(key), dense_nbytes, 0,
                   dtype, memoryview(pack_rows(idx, rows)))
+
+    # Sharded-embedding client (server/embed.py EmbedClient rides
+    # these; docs/embedding.md): one key per TABLE, rows addressed by
+    # id inside the payload — EmbedClient wraps single-address
+    # backends per shard (the plane-backend idiom), so these ops
+    # always target this client's one server.
+
+    def embed_init(self, key: int, meta: dict) -> None:
+        """Declare a table (idempotent first-wins server-side;
+        conflicting shape/dtype/seed refused loudly). Recorded for
+        replay so a restarted server relearns the declaration."""
+        import json as _json
+        body = _json.dumps(meta).encode()
+        self._rpc(OP_EMBED_INIT, key, 0, 0, 0, "uint8",
+                  memoryview(body))
+        self._embed_inits[self._shard(key)][key] = body
+
+    def embed_pull(self, key: int, payload,
+                   timeout_ms: int = 30000) -> bytes:
+        """Conditional sparse row pull: ship ids + cached versions,
+        receive flags + versions + only the rows whose version moved.
+        Never round-blocked — embedding rows live under the async
+        weight-delta contract, not the sync round gate."""
+        return self._rpc(OP_EMBED_PULL, key, 0, 0, timeout_ms,
+                         "uint8", memoryview(payload))
+
+    def embed_push(self, key: int, payload) -> None:
+        """Row-sparse delta push (ids + folded rows); dedup-tokenized
+        like any push so a reconnect retry applies exactly once, and
+        CLASS_GRAD in the wire scheduler like any gradient burst."""
+        self._rpc(OP_EMBED_PUSH, key, self._push_token(key), 0, 0,
+                  "uint8", memoryview(payload))
 
     def pull_bytes(self, key: int, round: int = 0,
                    timeout_ms: int = 30000) -> bytes:

@@ -1,0 +1,404 @@
+"""Sharded embedding store (ISSUE 18, byteps_tpu/server/embed.py).
+
+Four families:
+
+- determinism: row → shard placement and lazy row init are PURE
+  functions (golden values pinned against drift — every worker derives
+  the identical placement/values with no coordination, the property
+  the whole plane rides on);
+- wire: sparse pull returns the table's true rows across shards,
+  versions validate ("unchanged" moves one flag byte, not the row),
+  dedup'd push folds duplicates client-side AND server-side, the push
+  dedup token makes a retried push apply once;
+- cache: K=1 is bitwise-transparent (cache-on vs cache-off clients
+  agree to the byte through concurrent foreign pushes), the staleness
+  matrix holds (cold row served locally inside the K window, hot row —
+  one this worker pushed — never served stale), LRU eviction and
+  invalidation emit key-less flight events;
+- contracts: a table re-declared with a different shape is refused, an
+  EmbedClient pointed at a hierarchical aggregator front is refused
+  LOUDLY at init (the agg folds dense gradients and has no row store),
+  and rowsparse_push COMPOSES with the agg tier (tests/test_hier.py
+  pins the bitwise half of that contract).
+
+docs/embedding.md is the map.
+"""
+
+import numpy as np
+import pytest
+
+from byteps_tpu.obs import flight
+from byteps_tpu.obs.metrics import get_registry
+from byteps_tpu.server.embed import (EMBED_KEY_BASE, EmbedClient,
+                                     EmbedRowStore, init_rows, row_shard,
+                                     table_key)
+from byteps_tpu.server.engine import PSServer
+from byteps_tpu.server.hier import LocalAggBackend
+from byteps_tpu.server.transport import PSTransportServer, RemotePSBackend
+
+ROWS, COLS = 256, 8
+
+
+@pytest.fixture()
+def plane():
+    """Two real transport shards + teardown (embed ops are transport-
+    owned, so the raw engine backend is all a server role needs)."""
+    servers, addrs = [], []
+    for _ in range(2):
+        srv = PSServer(num_workers=1, engine_threads=1)
+        tsrv = PSTransportServer(srv, host="127.0.0.1", port=0)
+        servers.append((srv, tsrv))
+        addrs.append(f"127.0.0.1:{tsrv.port}")
+    yield servers, addrs
+    for srv, tsrv in servers:
+        tsrv.close()
+        srv.close()
+
+
+def _client(addrs, **kw):
+    kw.setdefault("table_id", 0)
+    kw.setdefault("num_rows", ROWS)
+    kw.setdefault("cols", COLS)
+    kw.setdefault("seed", 7)
+    return EmbedClient.connect(addrs, **kw)
+
+
+def _counters():
+    reg = get_registry()
+    return {c: reg.counter(f"embed/{c}").value
+            for c in ("cache_hits", "cache_misses", "row_fetch_bytes",
+                      "rows_pushed")}
+
+
+def _delta(after, before):
+    return {k: after[k] - before[k] for k in after}
+
+
+# =====================================================================
+# Determinism: placement + init are pure functions, pinned
+# =====================================================================
+
+def test_row_shard_golden():
+    """Golden placement values: any drift in the fmix64 constants or
+    the mod would silently re-home every deployed table's rows."""
+    ids = [0, 1, 2, 3, 1000, 12345, 10 ** 7 - 1, 2 ** 31, 2 ** 40 + 7]
+    assert row_shard(ids, 2).tolist() == [0, 0, 1, 0, 1, 1, 1, 0, 0]
+    assert row_shard(ids, 4).tolist() == [0, 0, 3, 2, 1, 1, 1, 2, 2]
+    assert table_key(3) == 0x80000030000
+    assert table_key(0) == EMBED_KEY_BASE
+
+
+def test_row_shard_deterministic_and_balanced():
+    """Same ids → same placement on every call (what "across workers"
+    means in-process: the function is stateless), and fmix64 avalanche
+    spreads sequential ids near-uniformly."""
+    ids = np.arange(100000, dtype=np.uint64)
+    a, b = row_shard(ids, 4), row_shard(ids, 4)
+    assert np.array_equal(a, b)
+    counts = np.bincount(a, minlength=4)
+    assert counts.min() > 0.9 * ids.size / 4, counts
+
+
+def test_init_rows_deterministic_dyadic():
+    v1 = init_rows(7, [0, 12345], 4)
+    v2 = init_rows(7, [0, 12345], 4)
+    assert v1.tobytes() == v2.tobytes()
+    # pinned golden: server-side lazy materialization and client-side
+    # control arithmetic must reproduce a never-touched row exactly
+    assert v1[0].tolist() == [0.05810546875, 0.0194091796875,
+                              0.03271484375, 0.0283203125]
+    assert init_rows(8, [0, 12345], 4).tobytes() != v1.tobytes()
+    # dyadic (multiples of 1/8192, |v| ≤ 1/16): fp32 sums stay exact
+    assert np.all(v1 * 8192 == np.round(v1 * 8192))
+    assert np.all(np.abs(v1) <= 1 / 16)
+
+
+# =====================================================================
+# Wire: sparse pull / dedup'd push across real shards
+# =====================================================================
+
+def test_sparse_pull_returns_init_rows(plane):
+    _, addrs = plane
+    cli = _client(addrs)
+    try:
+        ids = np.array([3, 9, 200, 9, 3], np.uint64)
+        got = cli.pull(ids)
+        want = init_rows(7, ids, COLS)
+        assert got.tobytes() == want.tobytes()
+    finally:
+        cli.close()
+
+
+def test_rows_live_only_on_their_shard(plane):
+    """Placement is real, not cosmetic: after touching rows through
+    the client, each shard's store holds exactly its row_shard slice."""
+    servers, addrs = plane
+    cli = _client(addrs)
+    try:
+        ids = np.arange(64, dtype=np.uint64)
+        cli.pull(ids)
+        sh = row_shard(ids, 2)
+        for s, (srv, tsrv) in enumerate(servers):
+            held = set(tsrv.embed_store().table(cli.key).rows)
+            assert held == set(int(i) for i in ids[sh == s])
+    finally:
+        cli.close()
+
+
+def test_push_dedup_folds_and_versions_move(plane):
+    """Duplicate row hits fold BEFORE the wire (rows_pushed counts
+    unique rows) and the server applies the exact dyadic sum with one
+    version bump per row per push batch."""
+    servers, addrs = plane
+    cli = _client(addrs, cache_rows=0)
+    try:
+        ids = np.array([5, 5, 7, 5], np.uint64)
+        d = np.full((4, COLS), 1 / 64, np.float32)
+        before = _counters()
+        cli.push(ids, d)
+        dc = _delta(_counters(), before)
+        assert dc["rows_pushed"] == 2      # {5, 7}, not 4
+        got = cli.pull(np.array([5, 7], np.uint64))
+        want = init_rows(7, [5, 7], COLS)
+        want[0] += 3 / 64                  # three dups folded into row 5
+        want[1] += 1 / 64
+        assert got.tobytes() == want.tobytes()
+        srv5 = servers[int(row_shard([5], 2)[0])][1]
+        t = srv5.embed_store().table(cli.key)
+        assert t.vers[5] == 2              # materialize=1, one push batch
+    finally:
+        cli.close()
+
+
+def test_push_retry_applies_once(plane):
+    """The push dedup token: replaying the SAME wire payload (same
+    token, the reconnect-retry shape) must not double-apply."""
+    import struct as _struct
+
+    servers, addrs = plane
+    cli = _client(addrs, cache_rows=0)
+    try:
+        rid = np.array([5], np.uint64)
+        shard = int(row_shard(rid, 2)[0])
+        payload = (_struct.pack("<I", 1) + rid.tobytes()
+                   + np.full(COLS, 1 / 64, np.float32).tobytes())
+        h = cli._handles[shard]
+        tok = h._push_token(cli.key)
+        h._rpc(31, cli.key, tok, 0, 0, "uint8", memoryview(payload))
+        h._rpc(31, cli.key, tok, 0, 0, "uint8", memoryview(payload))
+        got = cli.pull(rid)
+        want = init_rows(7, rid, COLS)[0] + 1 / 64
+        assert got[0].tobytes() == want.tobytes()
+    finally:
+        cli.close()
+
+
+def test_conflicting_redeclare_refused(plane):
+    _, addrs = plane
+    cli = _client(addrs)
+    try:
+        with pytest.raises(RuntimeError, match="conflicting re-declare"):
+            _client(addrs, cols=COLS * 2)
+    finally:
+        cli.close()
+
+
+def test_redeclare_same_meta_idempotent(plane):
+    """Every worker declares on connect; N identical declarations must
+    be a no-op (first-wins)."""
+    _, addrs = plane
+    a = _client(addrs)
+    b = _client(addrs)
+    try:
+        assert a.pull([0]).tobytes() == b.pull([0]).tobytes()
+    finally:
+        a.close()
+        b.close()
+
+
+# =====================================================================
+# Cache: transparency at K=1, the staleness matrix, eviction events
+# =====================================================================
+
+def test_cache_vs_nocache_bitwise_parity(plane):
+    """THE control-table parity pin: a cached client (K=1) and an
+    uncached client observe byte-identical rows every round, through
+    concurrent foreign pushes — at K=1 every cached entry is validated
+    against the server's per-row version before it is served."""
+    _, addrs = plane
+    cached = _client(addrs, max_lag=1)
+    plain = _client(addrs, cache_rows=0)
+    writer = _client(addrs, cache_rows=0)
+    rng = np.random.RandomState(0)
+    try:
+        for step in range(1, 6):
+            ids = (rng.zipf(1.2, 32).astype(np.uint64) - 1) % ROWS
+            a = cached.pull(ids)
+            b = plain.pull(ids)
+            assert a.tobytes() == b.tobytes(), f"diverged at step {step}"
+            wid = np.unique(ids)[:8]
+            writer.push(wid, init_rows(step, wid, COLS))
+            cached.tick()
+            plain.tick()
+            # re-pull AFTER the foreign push: the cached client must
+            # see the moved versions, not its stale bytes
+            a = cached.pull(ids)
+            b = plain.pull(ids)
+            assert a.tobytes() == b.tobytes(), f"stale at step {step}"
+    finally:
+        cached.close()
+        plain.close()
+        writer.close()
+
+
+def test_validated_unchanged_moves_no_row_bytes(plane):
+    """The conditional-pull half of the cache: when nothing moved, the
+    re-validation costs flag+version bytes, ZERO row bytes (counted as
+    a hit, not a miss)."""
+    _, addrs = plane
+    cli = _client(addrs, max_lag=1)
+    try:
+        ids = np.arange(16, dtype=np.uint64)
+        cli.pull(ids)
+        cli.tick()
+        before = _counters()
+        cli.pull(ids)
+        dc = _delta(_counters(), before)
+        assert dc["row_fetch_bytes"] == 0
+        assert dc["cache_misses"] == 0
+        assert dc["cache_hits"] == 16
+    finally:
+        cli.close()
+
+
+def test_cold_row_served_inside_k_window_no_wire(plane):
+    """Cold-row staleness: under K=2 a cached row is served with NO
+    wire contact for one extra round (hits move, fetch bytes do not),
+    then re-validated when the window closes."""
+    _, addrs = plane
+    cli = _client(addrs, max_lag=2)
+    foreign = _client(addrs, cache_rows=0)
+    try:
+        ids = np.array([11], np.uint64)
+        v0 = cli.pull(ids).copy()
+        foreign.push(ids, np.full((1, COLS), 1 / 32, np.float32))
+        cli.tick()
+        before = _counters()
+        v1 = cli.pull(ids)        # round 2: inside the window — the
+        dc = _delta(_counters(), before)   # (allowed) stale local serve
+        assert dc["row_fetch_bytes"] == 0 and dc["cache_hits"] == 1
+        assert v1.tobytes() == v0.tobytes()
+        cli.tick()
+        v2 = cli.pull(ids)        # round 3: window closed → re-validate
+        assert v2.tobytes() == (v0 + 1 / 32).astype(np.float32).tobytes()
+    finally:
+        cli.close()
+        foreign.close()
+
+
+def test_hot_row_never_served_stale(plane):
+    """Hot-row staleness: a row THIS worker pushed is dropped from the
+    cache immediately — the next pull fetches the merged value even
+    deep inside a K=4 window."""
+    _, addrs = plane
+    cli = _client(addrs, max_lag=4)
+    foreign = _client(addrs, cache_rows=0)
+    try:
+        ids = np.array([13], np.uint64)
+        v0 = cli.pull(ids).copy()
+        foreign.push(ids, np.full((1, COLS), 1 / 32, np.float32))
+        cli.push(ids, np.full((1, COLS), 1 / 64, np.float32))
+        v1 = cli.pull(ids)        # same round — no tick needed
+        want = (v0 + 1 / 32 + 1 / 64).astype(np.float32)
+        assert v1.tobytes() == want.tobytes()
+    finally:
+        cli.close()
+        foreign.close()
+
+
+def test_lru_eviction_and_flight_events(plane):
+    """A 4-row cache under an 8-row trace must evict LRU-first, and
+    eviction/invalidation emit KEY-LESS flight events (they pass every
+    postmortem key filter)."""
+    _, addrs = plane
+    cli = _client(addrs, cache_rows=4)
+    rec = flight.get_recorder()
+    rec.clear()
+    try:
+        cli.pull(np.arange(8, dtype=np.uint64))
+        assert len(cli._cache) == 4
+        cli.push(np.array([6], np.uint64),
+                 np.zeros((1, COLS), np.float32))
+        evs = rec.events(keys=[999999])   # arbitrary filter: key-less
+        kinds = [e["kind"] for e in evs]  # events must pass it
+        assert "row_evict" in kinds and "cache_inval" in kinds
+        for e in evs:
+            if e["kind"] in ("row_evict", "cache_inval"):
+                assert "key" not in e
+    finally:
+        cli.close()
+
+
+def test_hot_set_size_gauge(plane):
+    _, addrs = plane
+    cli = _client(addrs)
+    try:
+        cli.pull(np.arange(10, dtype=np.uint64))
+        assert get_registry().gauge("embed/hot_set_size").value == 10
+    finally:
+        cli.close()
+
+
+# =====================================================================
+# Contracts: hier front refuses embed (rowsparse composes — the other
+# half is pinned in tests/test_hier.py)
+# =====================================================================
+
+def test_embed_on_agg_front_refused_loudly():
+    """An EmbedClient pointed at a LocalAggBackend transport front must
+    be refused AT INIT (the declaration is the first op): the agg tier
+    folds dense gradients, has no row store, and silently passing
+    through would re-shard the table's rows across the agg's own
+    upstream placement."""
+    srv = PSServer(num_workers=2, engine_threads=1)
+    tsrv = PSTransportServer(srv, host="127.0.0.1", port=0)
+    up = RemotePSBackend([f"127.0.0.1:{tsrv.port}"])
+    agg = LocalAggBackend(up, 2, host_id=0)
+    atsrv = PSTransportServer(agg, host="127.0.0.1", port=0)
+    try:
+        with pytest.raises(RuntimeError,
+                           match="hierarchical aggregator"):
+            _client([f"127.0.0.1:{atsrv.port}"])
+    finally:
+        atsrv.close()
+        agg.close()
+        tsrv.close()
+        srv.close()
+
+
+def test_trace_and_delta_helpers_deterministic():
+    """The fleet embed mode's trace/delta helpers are recomputable from
+    scalars — what lets worker 0's verify pass re-derive every peer's
+    whole push history analytically (bench.py ps_embed)."""
+    from byteps_tpu.launcher.fleet_worker import embed_delta, embed_trace
+
+    t1 = embed_trace(3, 1, 5, 64, ROWS, 1.1)
+    t2 = embed_trace(3, 1, 5, 64, ROWS, 1.1)
+    assert np.array_equal(t1, t2)
+    assert t1.dtype == np.uint64 and np.all(t1 < ROWS)
+    assert not np.array_equal(t1, embed_trace(3, 0, 5, 64, ROWS, 1.1))
+    d1 = embed_delta(3, 1, 5, t1[:4], COLS)
+    assert d1.tobytes() == embed_delta(3, 1, 5, t1[:4], COLS).tobytes()
+    assert np.all(d1 * 8192 == np.round(d1 * 8192))
+
+
+def test_store_rejects_out_of_range_rows():
+    store = EmbedRowStore()
+    key = table_key(0)
+    store.init_table(key, {"table": 0, "rows": 4, "cols": 2,
+                           "dtype": "float32", "seed": 0})
+    import struct as _struct
+    bad = (_struct.pack("<I", 1) + np.array([9], np.uint64).tobytes()
+           + np.zeros(1, np.uint64).tobytes())
+    with pytest.raises(ValueError, match="out of range"):
+        store.pull(key, bad)

@@ -612,6 +612,14 @@ def test_prometheus_text_golden():
     reg.counter("lag/stale_serves").inc(4)
     reg.counter("lag/barrier_falls").inc(1)
     reg.gauge("lag/max_streak").set(1)
+    # sharded-embedding families (docs/embedding.md): the cache
+    # hit/miss split, fetched row bytes, dedup'd rows pushed, live
+    # cache size
+    reg.counter("embed/cache_hits").inc(90)
+    reg.counter("embed/cache_misses").inc(10)
+    reg.counter("embed/row_fetch_bytes").inc(1280)
+    reg.counter("embed/rows_pushed").inc(10)
+    reg.gauge("embed/hot_set_size").set(64)
     golden = "\n".join([
         '# TYPE bps_crit_absorbed_frac gauge',
         'bps_crit_absorbed_frac 0.11',
@@ -619,6 +627,16 @@ def test_prometheus_text_golden():
         'bps_crit_absorbed_s 0.8',
         '# TYPE bps_crit_wire_frac gauge',
         'bps_crit_wire_frac 0.62',
+        '# TYPE bps_embed_cache_hits_total counter',
+        'bps_embed_cache_hits_total 90',
+        '# TYPE bps_embed_cache_misses_total counter',
+        'bps_embed_cache_misses_total 10',
+        '# TYPE bps_embed_hot_set_size gauge',
+        'bps_embed_hot_set_size 64',
+        '# TYPE bps_embed_row_fetch_bytes_total counter',
+        'bps_embed_row_fetch_bytes_total 1280',
+        '# TYPE bps_embed_rows_pushed_total counter',
+        'bps_embed_rows_pushed_total 10',
         '# TYPE bps_fleet_clock_offset_s gauge',
         'bps_fleet_clock_offset_s{shard="s0"} 0.003',
         '# TYPE bps_fleet_server_engine_queue_depth gauge',

@@ -648,3 +648,78 @@ def test_seal_counters_and_keyless_flight_events():
     # key-less events pass ANY key filter — the postmortem contract
     assert [e for e in rec.events(keys=[123456])
             if e["kind"] == "hier_seal"]
+
+
+def test_rowsparse_push_composes_with_agg_tier():
+    """ISSUE-18 contract pin, compose half: a rowsparse key routed
+    through the LocalAggBackend front WORKS — the agg's transport
+    expands the sparse push to dense (rowsparse_push against the agg
+    backend), the host fold sums it like any dense grad, and every
+    pulled table is bitwise-identical to the flat plane (dyadic rows:
+    fp32 sums exact under any association order). The refuse half —
+    EMBED tables, which stay sparse server-side and have no dense
+    expansion to ride — is pinned in tests/test_embed.py."""
+    dp, local_size, rounds = 2, 2, 2
+    hosts = dp // local_size
+    num_rows, cols = 64, 16
+    dense_nbytes = num_rows * cols * 4
+
+    def sparse_grad(w: int, r: int):
+        # duplicate index 5: scatter-add must fold it, identically on
+        # the flat server and through the agg's expansion
+        idx = np.array([1, 5, 5, 40 + w], np.int32)
+        rows = np.stack([dyadic(w + 3 * j, r, n=cols) for j in range(4)])
+        return idx, rows.astype(np.float32)
+
+    def run(hier: bool):
+        aggs, agg_tsrvs, ups = [], [], []
+        if hier:
+            srvs, addrs = _plane(hosts=hosts, shards=1)
+            for h in range(hosts):
+                up = RemotePSBackend(addrs)
+                ups.append(up)
+                agg = LocalAggBackend(up, local_size, host_id=h)
+                at = PSTransportServer(agg, host="127.0.0.1", port=0)
+                aggs.append(agg)
+                agg_tsrvs.append(at)
+            bes = [RemotePSBackend(
+                [f"127.0.0.1:{agg_tsrvs[w // local_size].port}"])
+                for w in range(dp)]
+        else:
+            srvs, addrs = _plane(hosts=dp, shards=1)
+            bes = [RemotePSBackend(addrs) for _ in range(dp)]
+        out = {}
+        try:
+            for be in bes:
+                be.init_key(0, dense_nbytes, "float32")
+            for r in range(1, rounds + 1):
+                for w, be in enumerate(bes):
+                    idx, rows = sparse_grad(w, r)
+                    be.push_rowsparse(0, idx, rows, dense_nbytes)
+                for w, be in enumerate(bes):
+                    buf = np.empty(num_rows * cols, np.float32)
+                    be.pull(0, buf, round=r, timeout_ms=30000)
+                    out[(w, r)] = buf
+        finally:
+            for be in bes:
+                be.close()
+            for at in agg_tsrvs:
+                at.close()
+            for agg in aggs:
+                agg.close()
+            for srv, tsrv in srvs:
+                tsrv.close()
+                srv.close()
+        return out
+
+    flat, hier = run(False), run(True)
+    assert flat.keys() == hier.keys()
+    for k in flat:
+        assert flat[k].tobytes() == hier[k].tobytes(), (
+            f"rowsparse-through-agg diverges at (worker, round)={k}")
+    # the expansion really summed: round-1 row 5 = 2·dup + other dups
+    want = np.zeros((num_rows, cols), np.float32)
+    for w in range(dp):
+        idx, rows = sparse_grad(w, 1)
+        np.add.at(want, idx, rows)
+    assert flat[(0, 1)].tobytes() == want.reshape(-1).tobytes()

@@ -20,6 +20,8 @@ before the first backend client exists.
 import os
 import sys
 
+import pytest
+
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -49,6 +51,9 @@ def test_two_process_training_localhost():
         return
 
 
+@pytest.mark.slow  # ~68 s of interpreter spawns — the single largest
+# tier-1 wall item against the 870 s verify budget (the PR-16 trim
+# precedent); the 2-process rendezvous path stays tier-1 above
 def test_multiprocess_weak_scaling_2_and_4_procs():
     """Drive the emulated-cluster weak-scaling harness with REAL 2- and
     4-process runs over a (dcn) mesh: both must rendezvous, train, and
