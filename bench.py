@@ -1377,15 +1377,20 @@ def fleet_obs_breakdown(rounds: int = 40, iters: int = 30, warm: int = 5,
     compute-bound arm's shape) with BPS_STATS=1 + flight recorder +
     the causal span ring + a scraper (which now ALSO scrapes the span
     ring + clock samples over the trace surface each pass — ISSUE 14's
-    tracing rides the same A/B) versus BPS_STATS=0 and everything off.
-    Interleaved pairs, POOLED per-step medians (the ps_cross noise
-    methodology), ASSERTED within 2%."""
+    tracing rides the same A/B — AND persists each pass into the
+    on-disk tsdb ring while the BPS_AUTOTUNE=observe detector bank
+    runs over it, ISSUE 19's history + watchtower) versus BPS_STATS=0
+    and everything off. Interleaved pairs, POOLED per-step medians
+    (the ps_cross noise methodology), ASSERTED within 2%."""
     import statistics as _st
+    import tempfile as _tf
 
     import jax.numpy as jnp
 
     from byteps_tpu.obs import flight
     from byteps_tpu.obs import metrics as obs_metrics
+    from byteps_tpu.obs import tsdb as obs_tsdb
+    from byteps_tpu.obs import watchtower as obs_watchtower
     from byteps_tpu.obs.fleet import FleetScraper
     from byteps_tpu.server.engine import HostPSBackend, PSServer
     from byteps_tpu.server.ps_mode import PSGradientExchange
@@ -1422,13 +1427,21 @@ def fleet_obs_breakdown(rounds: int = 40, iters: int = 30, warm: int = 5,
 
     # ---- (2) observability-overhead A/B (compute-bound)
     saved = {k: os.environ.get(k)
-             for k in ("BPS_STATS", "BPS_FLIGHT_RECORDER")}
+             for k in ("BPS_STATS", "BPS_FLIGHT_RECORDER",
+                       "BPS_AUTOTUNE", "BPS_TSDB_DIR")}
+    tsdb_dir = _tf.mkdtemp(prefix="bps-obs-ab-tsdb-")
 
     def run_arm(obs_on: bool, n: int):
         os.environ["BPS_STATS"] = "1" if obs_on else "0"
         os.environ["BPS_FLIGHT_RECORDER"] = "1" if obs_on else "0"
+        # the full ISSUE-19 stack rides the obs arm: every scrape pass
+        # also appends to the on-disk ring and runs the detector bank
+        os.environ["BPS_AUTOTUNE"] = "observe" if obs_on else "off"
+        os.environ["BPS_TSDB_DIR"] = tsdb_dir if obs_on else "off"
         obs_metrics.configure()
         flight.configure()
+        obs_watchtower.configure()
+        obs_tsdb.reset_process_sink()
         abe = HostPSBackend(num_servers=1, num_workers=1,
                             engine_threads=2)
         aex = PSGradientExchange(abe, partition_bytes=1 << 20,
@@ -1482,11 +1495,15 @@ def fleet_obs_breakdown(rounds: int = 40, iters: int = 30, warm: int = 5,
         out["obs_step_ms"] = round(obs_ms, 3)
         out["off_step_ms"] = round(off_ms, 3)
         out["obs_overhead"] = round(overhead, 4)
-        # the acceptance bound: stats + scrape-on within 2% of
-        # BPS_STATS=0 on the compute-bound arm
+        out["tsdb_records"] = len(obs_tsdb.read_dir(tsdb_dir))
+        # the acceptance bound: stats + scrape + tsdb + watchtower
+        # within 2% of BPS_STATS=0 on the compute-bound arm
         assert overhead <= 1.02, (
             f"observability overhead {overhead:.4f}x exceeds the 2% "
             f"bound (obs {obs_ms:.3f}ms vs off {off_ms:.3f}ms)")
+        assert out["tsdb_records"] > 0, (
+            "the obs arm's scrape passes persisted nothing to "
+            f"{tsdb_dir} — the tsdb sink never ran")
     finally:
         for k, v in saved.items():
             if v is None:
@@ -1495,6 +1512,8 @@ def fleet_obs_breakdown(rounds: int = 40, iters: int = 30, warm: int = 5,
                 os.environ[k] = v
         obs_metrics.configure()
         flight.configure()
+        obs_watchtower.configure()
+        obs_tsdb.reset_process_sink()
     return out
 
 
@@ -2129,6 +2148,260 @@ def ps_lag_breakdown(steps: int = 40, skip: int = 6,
     }
 
 
+def ps_watch_breakdown(steps: int = 120, quiet_steps: int = 40,
+                       base_ms: float = 20.0, nbytes: int = 1 << 18,
+                       scrape_sec: float = 0.25, extra_ms: float = 150.0,
+                       nic_rate: float = 16e6) -> dict:
+    """THE HEADLINE RIG (ISSUE 19): the watchtower's three-act incident
+    choreography on REAL OS processes — a dp=2 rounds-mode fleet with
+    one NIC-throttled PS shard (launcher/fleet.py), the supervisor's
+    scraper running the detector bank in THIS process under
+    BPS_AUTOTUNE=observe (the children stay detector-free: the fleet
+    view is scraped, not self-reported).
+
+      act 1 (wire):      the throttled shard makes the fleet
+                         wire-bound; the regime ESTABLISHES as ``wire``
+                         silently — zero incidents.
+      act 2 (straggler): mid-run, worker w-s0r1 is handed +``extra_ms``
+                         per round via BPS_FLEET_PACE_FILE (the spawn
+                         env is frozen; the pace file is the only
+                         mid-run fault injector). Exactly two incidents
+                         must open, in order: a ``change_point`` on the
+                         span-derived merge wait (verdict straggler,
+                         blamed = that worker's push id) and a
+                         ``regime_flip`` wire -> straggler.
+      act 3 (dead):      after the workers drain, the shard is
+                         SIGKILLed; the scraper's up=0 gauge must
+                         confirm into a ``shard_dead`` incident
+                         (verdict dead, blamed shard, remedy RESHAPE).
+
+    Asserted: exactly those three incidents in that order, each within
+    3 detector windows of its fault; every remedy is logged with
+    ``acted: false`` (observe mode never actuates); ``/incidents.json``
+    serves the same records and ``/healthz`` answers 503; the on-disk
+    tsdb ring the scrape loop persisted replays OFFLINE to the same
+    shard_dead verdict; and a quiet control arm (same fleet, no
+    throttle, no pace file, no kill) opens ZERO incidents."""
+    import tempfile as _tf
+    import urllib.error
+    import urllib.request
+
+    from byteps_tpu.launcher.fleet import FleetManifest, FleetSupervisor
+    from byteps_tpu.obs import fleet as obs_fleet
+    from byteps_tpu.obs import metrics as obs_metrics
+    from byteps_tpu.obs import spans as obs_spans
+    from byteps_tpu.obs import tsdb as obs_tsdb
+    from byteps_tpu.obs import watchtower as wt
+    from byteps_tpu.obs.export import MetricsHTTPServer
+
+    saved = {k: os.environ.get(k)
+             for k in ("BPS_STATS", "BPS_AUTOTUNE", "BPS_TSDB_DIR")}
+
+    def fresh_obs(tsdb_dir: str) -> None:
+        # arm the bench process's detector bank from a clean slate:
+        # fresh registry, fresh engine, fresh span store, fresh sink
+        os.environ["BPS_STATS"] = "1"
+        os.environ["BPS_AUTOTUNE"] = "observe"
+        os.environ["BPS_TSDB_DIR"] = tsdb_dir
+        obs_metrics.configure()
+        wt.configure()
+        obs_tsdb.reset_process_sink()
+        obs_spans.reset()
+
+    def manifest(n_steps: int, faulted: bool,
+                 pace_path: str) -> FleetManifest:
+        role_env = {}
+        if faulted:
+            role_env = {
+                "srv0": {"BPS_NIC_RATE": str(int(nic_rate))},
+                "w-s0r1": {"BPS_FLEET_PACE_FILE": pace_path}}
+        return FleetManifest(
+            stages=1, dp=2, shards=1, steps=n_steps,
+            extra_env={
+                "BPS_FLEET_MODE": "rounds",
+                "BPS_FLEET_NBYTES": str(nbytes),
+                "BPS_FLEET_STEP_SLEEP": str(base_ms / 1e3),
+                "BPS_MAX_LAG": "1",
+                # children stay pure: detection happens HERE, over the
+                # scraped fleet view, never in the training processes
+                "BPS_AUTOTUNE": "off",
+                "BPS_TSDB_DIR": "off"},
+            role_env=role_env)
+
+    out: dict = {"shape": {
+        "steps": steps, "quiet_steps": quiet_steps, "base_ms": base_ms,
+        "nbytes": nbytes, "scrape_sec": scrape_sec,
+        "extra_ms": extra_ms, "nic_rate": nic_rate}}
+    try:
+        # ---- control arm: healthy fleet, detectors armed -> silence
+        fresh_obs("off")
+        man = manifest(quiet_steps, faulted=False, pace_path="")
+        sup = FleetSupervisor(man.build(), max_restarts=0,
+                              scrape_addrs=man.server_addrs,
+                              scrape_sec=scrape_sec)
+        watch = sup._scraper.watch
+        assert watch is not None, "observe mode did not arm the scraper"
+        try:
+            sup.start()
+            ok = sup.wait(timeout_s=600)
+            assert ok, (f"quiet arm failed: {sup.status()} "
+                        f"(logs: {sup.logdir})")
+        finally:
+            sup.drain()
+        quiet_incs = wt.get_engine().incidents()
+        assert not quiet_incs, (
+            "the quiet control arm must open ZERO incidents, got:\n"
+            + wt.format_timeline(quiet_incs))
+        out["quiet"] = {"incidents": 0, "ticks": watch.ticks}
+
+        # ---- faulted arm: wire -> straggler -> dead
+        tsdb_dir = _tf.mkdtemp(prefix="bps-ps-watch-tsdb-")
+        pace_path = os.path.join(
+            _tf.mkdtemp(prefix="bps-ps-watch-pace-"), "extra_ms")
+        fresh_obs(tsdb_dir)
+        man = manifest(steps, faulted=True, pace_path=pace_path)
+        sup = FleetSupervisor(man.build(), max_restarts=0,
+                              scrape_addrs=man.server_addrs,
+                              scrape_sec=scrape_sec)
+        watch = sup._scraper.watch
+        assert watch is not None
+        engine = wt.get_engine()
+        obs_fleet.set_current(sup._scraper)
+        http = MetricsHTTPServer(port=0, host="127.0.0.1").start()
+        # "within 3 detector windows" — the acceptance latency bound
+        window_s = 3 * watch.params["window"] * scrape_sec
+        try:
+            sup.start()
+            # act 1: wire regime must establish (silently) and the
+            # merge-wait detector must finish arming before the fault
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                det = watch._detectors.get("spans/merge_wait_ms")
+                if (watch.flip.current == "wire" and det is not None
+                        and len(det._hist) >= det.min_samples):
+                    break
+                time.sleep(0.1)
+            assert watch.flip.current == "wire", (
+                f"wire regime never established (regime="
+                f"{watch.flip.current}, ticks={watch.ticks}, "
+                f"logs: {sup.logdir})")
+            assert not engine.incidents(), (
+                "the wire-bound baseline must be incident-free:\n"
+                + wt.format_timeline(engine.incidents()))
+            # act 2: mid-run straggler injection via the pace file
+            t_inject = time.time()
+            tmp = pace_path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(extra_ms))
+            os.replace(tmp, pace_path)
+            while time.time() < t_inject + window_s:
+                if {"change_point", "regime_flip"} <= {
+                        i["kind"] for i in engine.incidents()}:
+                    break
+                time.sleep(0.1)
+            # act 3: drain the workers, then kill the shard
+            ok = sup.wait(timeout_s=600)
+            assert ok, (f"faulted arm failed: {sup.status()} "
+                        f"(logs: {sup.logdir})")
+            t_kill = time.time()
+            sup.kill("srv0")
+            while time.time() < t_kill + window_s:
+                if any(i["kind"] == "shard_dead"
+                       for i in engine.incidents()):
+                    break
+                time.sleep(0.1)
+            time.sleep(4 * scrape_sec)   # let the stale verdict land
+            incidents = engine.incidents()
+            base = f"http://127.0.0.1:{http.port}"
+            with urllib.request.urlopen(base + "/incidents.json",
+                                        timeout=5) as r:
+                served = json.loads(r.read().decode())
+            try:
+                with urllib.request.urlopen(base + "/healthz",
+                                            timeout=5) as r:
+                    hz_code, hz = r.status, json.loads(r.read().decode())
+            except urllib.error.HTTPError as e:
+                hz_code, hz = e.code, json.loads(e.read().decode())
+            push_id = None
+            for line in sup.output_lines("w-s0r1", "FLEET_RESULT "):
+                push_id = json.loads(
+                    line[len("FLEET_RESULT "):]).get("push_id")
+            incident_events = sum(1 for e in sup.events
+                                  if e["event"] == "incident")
+        finally:
+            obs_fleet.set_current(None)
+            http.stop()
+            sup.drain()
+
+        # ---- the acceptance: exactly three incidents, in order
+        timeline = wt.format_timeline(incidents)
+        kinds = [i["kind"] for i in incidents]
+        assert kinds == ["change_point", "regime_flip", "shard_dead"], (
+            f"expected the three choreographed incidents in order, "
+            f"got:\n{timeline}")
+        cp, flip, dead = incidents
+        assert cp["signal"] == "spans/merge_wait_ms" \
+            and cp["verdict"] == "straggler", cp
+        assert push_id is not None \
+            and cp["blamed"] == {"worker": push_id}, (
+            f"straggler blame {cp['blamed']} != injected worker's "
+            f"push id {push_id}")
+        assert flip["evidence"].get("from") == "wire" \
+            and flip["evidence"].get("to") == "straggler", \
+            flip["evidence"]
+        assert dead["verdict"] == "dead" \
+            and dead["blamed"] == {"shard": "s0"}, dead
+        for inc in incidents:
+            rem = inc.get("remedy") or {}
+            assert rem.get("knob") and rem.get("acted") is False, (
+                f"incident #{inc['id']} must log an intended remedy "
+                f"and never act on it: {rem}")
+        assert dead["remedy"]["knob"] == "fleet.RESHAPE"
+        lat = {"change_point": round(cp["opened_t"] - t_inject, 3),
+               "shard_dead": round(dead["opened_t"] - t_kill, 3)}
+        assert lat["change_point"] <= window_s \
+            and lat["shard_dead"] <= window_s, (lat, window_s)
+        # the serving surfaces agree with the engine
+        assert served["schema"] == "byteps_tpu.Incidents/v1" \
+            and len(served["incidents"]) == 3, served
+        assert hz_code == 503 \
+            and hz["status"] in ("degraded", "stale"), (hz_code, hz)
+        assert incident_events == 3, (
+            f"supervisor event log saw {incident_events} incidents")
+        # the persisted ring replays offline to the same dead verdict
+        recs = obs_tsdb.read_dir(tsdb_dir)
+        offline = wt.replay(recs)
+        assert any(i["kind"] == "shard_dead" and i["verdict"] == "dead"
+                   for i in offline), (
+            f"offline replay of {len(recs)} records missed the dead "
+            f"shard:\n{wt.format_timeline(offline)}")
+        out.update({
+            "incidents": [
+                {"id": i["id"], "kind": i["kind"], "signal": i["signal"],
+                 "verdict": i["verdict"], "blamed": i["blamed"],
+                 "remedy": (i.get("remedy") or {}).get("knob"),
+                 "open": i["closed_t"] is None} for i in incidents],
+            "latency_s": lat,
+            "window_s": round(window_s, 1),
+            "blamed_push_id": push_id,
+            "healthz": dict(hz, http_code=hz_code),
+            "offline_replay": {"records": len(recs),
+                               "incidents": len(offline)},
+            "timeline": timeline,
+        })
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        obs_metrics.configure()
+        wt.configure()
+        obs_tsdb.reset_process_sink()
+        obs_spans.reset()
+    return out
+
+
 def ps_hier_breakdown(steps: int = 24, skip: int = 4,
                       nbytes: int = 1 << 21,
                       rate: float = 40e6) -> dict:
@@ -2391,6 +2664,7 @@ _BREAKDOWNS = {
     "ps_elastic": ps_elastic_breakdown,
     "fleet": fleet_breakdown,
     "ps_lag": ps_lag_breakdown,
+    "ps_watch": ps_watch_breakdown,
     "ps_hier": ps_hier_breakdown,
     "ps_embed": ps_embed_breakdown,
 }

@@ -80,6 +80,12 @@ class GlobalState:
         obs_metrics.configure(config.stats_on)
         from ..obs import flight as obs_flight
         obs_flight.configure()       # re-read BPS_FLIGHT_RECORDER* too
+        # watchtower (obs/watchtower.py): re-resolve BPS_AUTOTUNE +
+        # BPS_WATCH_* for this init and drop the previous run's
+        # incidents — the detector thresholds must reflect THIS init's
+        # env, exactly like the metrics master switch above
+        from ..obs import watchtower as obs_watchtower
+        obs_watchtower.configure()
         # two-class wire send scheduler (server/sched.py): resolve the
         # byte credit for THIS init, before any backend is constructed,
         # so every transport client sees the same gate

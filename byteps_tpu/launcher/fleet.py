@@ -517,6 +517,23 @@ class FleetSupervisor:
                 list(scrape_addrs), lazy_dial=True)
             self._scraper = FleetScraper(self._scrape_backend,
                                          interval_sec=scrape_sec)
+        # watchtower incidents surface in the supervisor's event log:
+        # the scraper's detector bank (BPS_AUTOTUNE=observe) runs in
+        # THIS process, so a confirmed regime shift / dead shard lands
+        # next to the spawn/died/restart transitions it explains
+        self._incident_cb = None
+        if self._scraper is not None and self._scraper.watch is not None:
+            from ..obs import watchtower as _watchtower
+
+            def _on_incident(inc: dict) -> None:
+                self._event(
+                    "watchtower", "incident", id=inc.get("id"),
+                    incident_kind=inc.get("kind"),
+                    signal=inc.get("signal"),
+                    verdict=inc.get("verdict"), blamed=inc.get("blamed"))
+
+            self._incident_cb = _on_incident
+            _watchtower.get_engine().add_callback(_on_incident)
 
     # ------------------------------------------------------------ events
 
@@ -684,6 +701,10 @@ class FleetSupervisor:
                 m.state = "draining"
                 self._terminate(m, kill_after=timeout_s)
                 self._event(m.spec.name, "drained", rc=m.rc)
+        if self._incident_cb is not None:
+            from ..obs import watchtower as _watchtower
+            _watchtower.get_engine().remove_callback(self._incident_cb)
+            self._incident_cb = None
         if self._scraper is not None:
             self._scraper.stop()
             self._scraper = None

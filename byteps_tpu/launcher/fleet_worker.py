@@ -90,6 +90,21 @@ def _run_rounds() -> int:
     # to make that worker the straggler.
     pace = (float(os.environ.get("BPS_FLEET_STEP_SLEEP", "0") or 0)
             + float(os.environ.get("BPS_FLEET_SEG_MS", "0") or 0) / 1e3)
+    # mid-run pacing injection (BPS_FLEET_PACE_FILE): the spawn env is
+    # frozen at manifest build, so a bench that wants to turn a healthy
+    # worker into a straggler MID-RUN (the ps_watch regime-flip rig)
+    # names a file here; each round adds the extra milliseconds it
+    # currently holds (missing/empty/garbled file = 0 — the quiet arm)
+    pace_file = os.environ.get("BPS_FLEET_PACE_FILE", "").strip() or None
+
+    def extra_pace() -> float:
+        if pace_file is None:
+            return 0.0
+        try:
+            with open(pace_file) as f:
+                return max(0.0, float(f.read().strip() or 0)) / 1e3
+        except (OSError, ValueError):
+            return 0.0
     # grad_mode="dyadic": per-(worker, round, element) gradients drawn
     # from the dyadic rationals k/1024, k ∈ [-512, 512) — sums of ≤ dp
     # such values are EXACT in float32, so any association order (flat
@@ -110,8 +125,9 @@ def _run_rounds() -> int:
     digests = []
     while True:
         t0 = time.time()
-        if pace:
-            time.sleep(pace)
+        p = pace + extra_pace()
+        if p:
+            time.sleep(p)
         if grad_mode == "dyadic":
             tree = {"g": dyadic(wid, done + 1)}
         out = ex.exchange(tree, name="g")
@@ -156,12 +172,17 @@ def _run_rounds() -> int:
              "incarnation": incarnation, "digest": digest}), flush=True)
         if done >= steps:
             break
+    # the backend's push-dedup incarnation id is what server span
+    # records carry as the per-arrival worker id — print it so a
+    # driver can map a watchtower incident's blamed id to this role
+    push_id = int(getattr(be, "incarnation", 0))
     be.close()
     from ..obs.metrics import get_registry
     reg = get_registry()
     print("FLEET_RESULT " + json.dumps(
         {"mode": "rounds", "worker": wid, "steps": done,
          "incarnation": incarnation, "resumed_at": resumed_at,
+         "push_id": push_id,
          "push_bytes": int(reg.counter("ps/push_bytes").value),
          "pull_bytes": int(reg.counter("ps/pull_bytes").value),
          "digests": digests}),

@@ -35,7 +35,19 @@ This package is the always-on counterpart:
     what HAPPENED, not just what is stuck.
   - ``export``: Prometheus-text + JSON exporters — the
     ``python -m byteps_tpu.obs.export`` CLI (OP_STATS scrape or local
-    registry) and the ``BPS_METRICS_PORT`` HTTP endpoint.
+    registry) and the ``BPS_METRICS_PORT`` HTTP endpoint (plus
+    ``/healthz`` and ``/incidents.json``).
+  - ``tsdb``: the bounded on-disk time-series ring (``BPS_TSDB_DIR``):
+    every scrape tick's fleet/crit/histogram view persisted as
+    fixed-width mmap-readable records, so postmortems and detectors
+    see history, not the last scrape.
+  - ``watchtower``: online regime detection over that stream (robust
+    z-score change-points, critpath-verdict flips with hysteresis,
+    shard liveness) feeding a structured incident engine — window,
+    blamed signal/worker/shard, critpath verdict, attached flight
+    postmortem, intended-but-never-acted remedy (``BPS_AUTOTUNE=
+    observe``); replayable offline via
+    ``python -m byteps_tpu.obs.watchtower <tsdb_dir>``.
 """
 
 from __future__ import annotations
@@ -49,3 +61,6 @@ from .fleet import FleetScraper                                   # noqa: F401
 from .flight import FlightRecorder, get_recorder                  # noqa: F401
 from .spans import ClockEstimator, ServerSpanRing                 # noqa: F401
 from .critpath import attribute as critpath_attribute             # noqa: F401
+from .tsdb import TsdbSink, TsdbWriter                            # noqa: F401
+from .watchtower import (IncidentEngine, Watchtower,              # noqa: F401
+                         get_engine)

@@ -52,6 +52,8 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 SCHEMA = "byteps_tpu.CritPath/v1"
@@ -378,8 +380,13 @@ def step_attribution(events: List[dict], step: Optional[int],
     return res
 
 
+_last_attr_lock = threading.Lock()
+_last_attr: Optional[Tuple[float, dict]] = None
+
+
 def publish(res: Optional[dict], registry=None) -> None:
     """Land one step's attribution in the registry as ``crit/*``."""
+    global _last_attr
     if not res:
         return
     from .metrics import CRIT_CATEGORIES, get_registry
@@ -392,6 +399,18 @@ def publish(res: Optional[dict], registry=None) -> None:
         reg.gauge(f"crit/{c}_frac").set(
             round(s / total, 4) if total else 0.0)
     reg.counter("crit/steps").inc()
+    # stash the full result for the watchtower: the gauges above carry
+    # only the fractions, but an incident wants the straggler's worker
+    # id and the dominant verdict exactly as attributed
+    with _last_attr_lock:
+        _last_attr = (time.time(), res)
+
+
+def last_attribution() -> Optional[Tuple[float, dict]]:
+    """(wall time, result) of the newest ``publish`` in this process —
+    the watchtower's blame source; None before any attributed step."""
+    with _last_attr_lock:
+        return _last_attr
 
 
 # ---------------------------------------------------------------- CLI

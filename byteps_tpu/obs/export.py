@@ -140,6 +140,36 @@ def flight_json() -> Dict:
             "events": rec.events()}
 
 
+def incidents_json() -> Dict:
+    """The incident engine's full record — the ``/incidents.json``
+    endpoint body (``byteps_tpu.Incidents/v1``)."""
+    from . import watchtower as _watchtower
+    return _watchtower.get_engine().to_json()
+
+
+def healthz_json() -> Tuple[Dict, bool]:
+    """One folded health verdict (the k8s-probe shape): ``stale`` when
+    any shard's telemetry is too old to trust, else ``degraded`` when
+    a shard is down or an incident is open, else ``ok``. Returns
+    (body, healthy) — the endpoint maps healthy to 200 vs 503."""
+    from . import fleet as _fleet
+    from . import watchtower as _watchtower
+    sc = _fleet.current()
+    shards = sc.view() if sc is not None else {}
+    down = sorted(l for l, s in shards.items() if not s.get("up"))
+    stale = sorted(l for l, s in shards.items() if s.get("stale"))
+    open_n = len(_watchtower.get_engine().open_incidents())
+    if stale:
+        status = "stale"
+    elif down or open_n:
+        status = "degraded"
+    else:
+        status = "ok"
+    return ({"schema": "byteps_tpu.Healthz/v1", "status": status,
+             "shards": len(shards), "down": down, "stale": stale,
+             "open_incidents": open_n}, status == "ok")
+
+
 # ------------------------------------------------------ remote scrape
 
 def scrape_addr(addr: str, timeout_s: float = 5.0) -> Dict:
@@ -177,6 +207,7 @@ class MetricsHTTPServer:
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):               # noqa: N802 — http.server API
+                code = 200
                 if self.path.startswith("/metrics.json"):
                     body = json.dumps(registry_json(reg)).encode()
                     ctype = "application/json"
@@ -194,13 +225,26 @@ class MetricsHTTPServer:
                     # no debugger attached (obs/flight.py)
                     body = json.dumps(flight_json()).encode()
                     ctype = "application/json"
+                elif self.path.startswith("/incidents.json"):
+                    # the watchtower's structured incident log — the
+                    # postmortem artifact an operator (or the ps_watch
+                    # bench) pulls with curl (obs/watchtower.py)
+                    body = json.dumps(incidents_json()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/healthz"):
+                    # one folded verdict, k8s-probe-shaped: 200 only
+                    # when every shard is fresh+up and nothing is open
+                    hz, healthy = healthz_json()
+                    body = json.dumps(hz).encode()
+                    ctype = "application/json"
+                    code = 200 if healthy else 503
                 elif self.path.startswith("/metrics"):
                     body = prometheus_text(reg).encode()
                     ctype = "text/plain; version=0.0.4"
                 else:
                     self.send_error(404)
                     return
-                self.send_response(200)
+                self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

@@ -13,8 +13,11 @@ This module closes the gap:
     on a cadence (``BPS_FLEET_SCRAPE_SEC``) and folds every shard's
     snapshot into one role/shard-labeled view: each remote scalar
     metric lands in the LOCAL registry as ``fleet/<shard>/<metric>``
-    (histograms as ``…/p95_ms`` + ``…/count``), so the whole fleet is
-    queryable through the one registry surface that already exists.
+    (histograms as ``…/p50_ms`` + ``…/p95_ms`` + ``…/p99_ms`` +
+    ``…/count`` — the watchtower detectors steer on tails), so the
+    whole fleet is queryable through the one registry surface that
+    already exists. A ``fleet/<shard>/scrape_dur_s`` gauge makes the
+    scrape pass's own cost visible.
   - per-shard **scrape-age** gauges (``fleet/<shard>/scrape_age_s``)
     make staleness first-class: a shard that stops answering reads as
     STALE within one cadence — never as healthy-with-old-numbers. A
@@ -174,6 +177,20 @@ class FleetScraper:
         self.clock = ClockEstimator()
         self._trace_ok = hasattr(backend, "trace")
         self._trace_warned = False
+        # telemetry history + watchtower (obs/tsdb.py, obs/watchtower.py):
+        # every scrape pass persists the folded registry view into the
+        # process's on-disk ring (BPS_TSDB_DIR, default on) and — under
+        # BPS_AUTOTUNE=observe — runs the detector bank over it. Both
+        # are enrichments: they ride the scrape cadence, never raise
+        # into it, and stay fully off when stats are off.
+        from . import metrics as _metrics_mod
+        from . import tsdb as _tsdb
+        from . import watchtower as _watchtower
+        self._metrics_mod = _metrics_mod
+        self.tsdb = (_tsdb.process_sink()
+                     if _metrics_mod.metrics_enabled() else None)
+        self.watch = _watchtower.maybe_watchtower()
+        self._watch_warned = False
 
     # ---------------------------------------------------------- scraping
 
@@ -184,6 +201,7 @@ class FleetScraper:
         per-shard failures into ``{"error": …}`` entries, and anything
         that still escapes is caught here — the scrape thread is a
         control loop, one bad pass must not kill it."""
+        t_pass = time.monotonic()
         try:
             payloads = self.backend.stats(timeout_ms=self.timeout_ms)
         except TypeError:
@@ -210,7 +228,31 @@ class FleetScraper:
         if self._trace_ok:
             self._scrape_trace()
         self._act_on_staleness(views, now)
+        dur = round(time.monotonic() - t_pass, 6)
+        for sv in views:
+            self.reg.gauge(f"fleet/{sv.label}/scrape_dur_s").set(dur)
+        self._history_and_watch()
         return self.view()
+
+    def _history_and_watch(self) -> None:
+        """The scrape tick's enrichment tail: persist the folded view
+        into the on-disk ring, then run the watchtower detectors over
+        it. Both guarded — history and detection must never take the
+        scrape loop down with them."""
+        if self.tsdb is not None and self._metrics_mod.metrics_enabled():
+            try:
+                self.tsdb.sample(self.reg.snapshot(), time.time())
+            except Exception:   # noqa: BLE001 — see docstring
+                pass
+        if self.watch is not None:
+            try:
+                self.watch.observe_scrape(self)
+            except Exception as e:   # noqa: BLE001 — see docstring
+                if not self._watch_warned:
+                    self._watch_warned = True
+                    self._log.warning(
+                        "watchtower tick failed: %s (retrying each "
+                        "cadence)", e)
 
     def _scrape_trace(self) -> None:
         """One causal-trace pass: per-shard span ring + clock sample.
@@ -332,8 +374,15 @@ class FleetScraper:
             if isinstance(v, dict):          # histogram summary
                 if v.get("count") or name in sv.published:
                     sv.published.add(name)
+                    # p50+p99 alongside p95: the watchtower's shift
+                    # detectors need both the body and the tail (.get
+                    # defaults keep older two-field payloads scrapable)
+                    self.reg.gauge(f"{pre}/{name}/p50_ms").set(
+                        float(v.get("p50_ms", 0.0)))
                     self.reg.gauge(f"{pre}/{name}/p95_ms").set(
                         float(v.get("p95_ms", 0.0)))
+                    self.reg.gauge(f"{pre}/{name}/p99_ms").set(
+                        float(v.get("p99_ms", 0.0)))
                     self.reg.gauge(f"{pre}/{name}/count").set(
                         float(v.get("count", 0)))
             elif isinstance(v, (int, float)):

@@ -124,6 +124,14 @@ EMBED_COUNTERS: Tuple[str, ...] = (
     "embed/rows_pushed")
 EMBED_GAUGES: Tuple[str, ...] = ("embed/hot_set_size",)
 
+# Fleet watchtower (byteps_tpu.obs.watchtower): detector ticks, opened
+# incidents (regime flips split out), and the currently-open count —
+# pre-registered so the Prometheus export names the watchtower's
+# families before the first detection (all-zero on a quiet run).
+WATCH_COUNTERS: Tuple[str, ...] = (
+    "watch/ticks", "watch/incidents", "watch/regime_flips")
+WATCH_GAUGES: Tuple[str, ...] = ("watch/open_incidents",)
+
 # ONE truthiness rule shared with Config (BPS_STATS must resolve
 # identically whether read here or through Config.stats_on)
 from ..common.config import _TRUE  # noqa: E402
@@ -350,6 +358,10 @@ class MetricsRegistry:
         for c in EMBED_COUNTERS:
             self.counter(c)
         for g in EMBED_GAUGES:
+            self.gauge(g)
+        for c in WATCH_COUNTERS:
+            self.counter(c)
+        for g in WATCH_GAUGES:
             self.gauge(g)
 
     def _get(self, name: str, cls, *args):

@@ -319,6 +319,7 @@ class StepStatsEmitter:
         msg = (f"slow step {st.step}: wall {st.wall_s * 1e3:.1f}ms > "
                f"{self._slow_factor:g}x rolling median "
                f"{med * 1e3:.1f}ms (BPS_SLOW_STEP_FACTOR)")
+        keep = None
         if st.crit is not None:
             keep = {k: st.crit.get(k)
                     for k in ("window_s", "categories", "fracs",
@@ -332,6 +333,22 @@ class StepStatsEmitter:
         pm = flight.get_recorder().format_postmortem(last=60)
         if pm:
             msg += "\n" + pm
+        # the capture is a structured incident (obs/watchtower.py):
+        # one record with the critpath block + flight postmortem
+        # attached, queryable via /incidents.json — the engine is
+        # passive and always available, so this does not depend on
+        # BPS_AUTOTUNE; the rate limit and default-off gate above are
+        # unchanged. The human-readable WARNING stays on THIS logger.
+        inc = None
+        try:
+            from . import watchtower as _watchtower
+            inc = _watchtower.slow_step_incident(
+                msg, wall_ms=st.wall_s * 1e3, median_ms=med * 1e3,
+                factor=self._slow_factor, crit=keep)
+        except Exception:   # noqa: BLE001 — capture must still log
+            pass
+        if inc is not None:
+            msg = f"incident #{inc['id']}: {msg}"
         self._log.warning("%s", msg)
 
     def flush(self) -> None:

@@ -1,0 +1,306 @@
+"""Bounded on-disk time-series ring: the telemetry plane's history.
+
+Every signal the observability plane produces so far is an
+*instantaneous* read — the registry holds the last value, the scraper
+holds the last scrape — so nothing downstream can answer "what did
+this gauge look like two minutes ago, before the step time doubled?".
+This module persists the scraped view as a bounded append-only ring of
+fixed-width records, one file per process under ``BPS_TSDB_DIR``:
+
+  - **Fixed-width records** (64 bytes: f64 wall-clock seconds, a
+    48-byte NUL-padded metric name, f64 value) so the file is
+    mmap-readable with zero parsing state — any record boundary is
+    computable from the header alone, which is what lets the
+    ``python -m byteps_tpu.obs.watchtower <dir>`` CLI replay a run's
+    detectors from the ring with the producing process long gone.
+  - **Ring semantics**: the header carries a monotonic ``written``
+    count; record ``i`` lives at slot ``i % capacity``, so the file
+    never exceeds ``BPS_TSDB_SIZE`` bytes (default 8 MiB ≈ 131k
+    samples) and old history is overwritten oldest-first. The header's
+    count is committed only AFTER a batch's records are on disk, so a
+    crash mid-batch loses at most that batch, never corrupts the ring.
+  - **One file per process** (``bps-<pid>.tsdb``): writers never
+    contend; a postmortem reads the whole directory and merges by
+    timestamp. The process-wide writer is a lazy singleton shared by
+    every scraper in the process (a supervisor and an in-process rig
+    must not interleave two writers into one pid's file).
+
+What gets persisted (``TsdbSink.sample``, driven by ``FleetScraper``
+at its cadence — default ON whenever stats are on): every
+``fleet/<shard>/*`` scalar gauge, every ``crit/*_frac`` blame
+fraction, and every histogram's p50/p95/p99 + count. That is exactly
+the stream the ``obs/watchtower.py`` detectors consume — scalars for
+level shifts, tails for skew, counts for rates, blame fractions for
+regime flips.
+
+``BPS_TSDB_DIR`` defaults to ``<tmpdir>/bps-tsdb-<uid>``; set it to
+``off``/``0``/``none`` to disable persistence entirely. Writes are
+best-effort: an unwritable directory disables the sink with one
+warning, it never raises into the scrape loop.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+import tempfile
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..common.logging import get_logger
+
+MAGIC = b"BPSTSDB1"
+VERSION = 1
+# header: magic(8) version(u32) rec_size(u32) capacity(u64) written(u64)
+_HDR = struct.Struct("<8sIIQQ")
+HEADER_SIZE = _HDR.size            # 32
+_REC = struct.Struct("<d48sd")     # t, name (NUL-padded), value
+RECORD_SIZE = _REC.size            # 64
+NAME_BYTES = 48
+
+DEFAULT_SIZE = 8 << 20
+_OFF = {"", "0", "off", "none", "false", "no"}
+
+
+def env_dir() -> Optional[str]:
+    """Resolve ``BPS_TSDB_DIR``: unset → the per-uid tmp default,
+    ``off``-ish → None (persistence disabled), anything else → itself."""
+    raw = os.environ.get("BPS_TSDB_DIR")
+    if raw is None:
+        try:
+            uid = os.getuid()
+        except AttributeError:          # non-posix
+            uid = 0
+        return os.path.join(tempfile.gettempdir(), f"bps-tsdb-{uid}")
+    if raw.strip().lower() in _OFF:
+        return None
+    return raw
+
+
+def env_size() -> int:
+    try:
+        return max(RECORD_SIZE + HEADER_SIZE,
+                   int(os.environ.get("BPS_TSDB_SIZE", "") or DEFAULT_SIZE))
+    except ValueError:
+        return DEFAULT_SIZE
+
+
+class TsdbWriter:
+    """Append-only fixed-width ring writer over one file.
+
+    ``append``/``append_many`` stage records into the slot region;
+    ``commit`` (called automatically at the end of ``append_many``)
+    publishes them by rewriting the header's ``written`` count — the
+    reader-visible commit point."""
+
+    def __init__(self, path: str, size_bytes: Optional[int] = None) -> None:
+        self.path = path
+        size = env_size() if size_bytes is None else int(size_bytes)
+        self.capacity = max(1, (size - HEADER_SIZE) // RECORD_SIZE)
+        self._lock = threading.Lock()
+        exists = os.path.exists(path) and os.path.getsize(path) >= HEADER_SIZE
+        self._f = open(path, "r+b" if exists else "w+b")
+        if exists:
+            hdr = self._f.read(HEADER_SIZE)
+            try:
+                magic, ver, rec, cap, written = _HDR.unpack(hdr)
+            except struct.error:
+                magic = b""
+            if magic == MAGIC and rec == RECORD_SIZE:
+                self.capacity = int(cap)   # file's geometry wins
+                self.written = int(written)
+            else:                          # foreign/corrupt: start over
+                self.written = 0
+                self._write_header()
+        else:
+            self.written = 0
+            self._write_header()
+
+    def _write_header(self) -> None:
+        self._f.seek(0)
+        self._f.write(_HDR.pack(MAGIC, VERSION, RECORD_SIZE,
+                                self.capacity, self.written))
+
+    def append(self, t: float, name: str, value: float) -> None:
+        with self._lock:
+            self._append_one(t, name, value)
+            self._write_header()
+            self._f.flush()
+
+    def _append_one(self, t: float, name: str, value: float) -> None:
+        nb = name.encode("utf-8", "replace")[:NAME_BYTES]
+        slot = self.written % self.capacity
+        self._f.seek(HEADER_SIZE + slot * RECORD_SIZE)
+        self._f.write(_REC.pack(float(t), nb, float(value)))
+        self.written += 1
+
+    def append_many(self, t: float,
+                    samples: Iterable[Tuple[str, float]]) -> int:
+        """One batch (one scrape tick): stage every record, then commit
+        the header once — the crash-consistency unit."""
+        n = 0
+        with self._lock:
+            for name, value in samples:
+                self._append_one(t, name, value)
+                n += 1
+            if n:
+                self._write_header()
+                self._f.flush()
+        return n
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._write_header()
+                self._f.close()
+            except (OSError, ValueError):
+                pass
+
+
+def read_records(path: str) -> List[Tuple[float, str, float]]:
+    """Decode one ring file, oldest record first (mmap, read-only).
+    Tolerant by design: a foreign or torn file yields ``[]`` — the
+    postmortem CLI must render whatever survives, not raise."""
+    try:
+        with open(path, "rb") as f:
+            try:
+                mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+            except ValueError:          # empty file
+                return []
+            with mm:
+                if len(mm) < HEADER_SIZE:
+                    return []
+                magic, _ver, rec, cap, written = _HDR.unpack(
+                    mm[:HEADER_SIZE])
+                if magic != MAGIC or rec != RECORD_SIZE or cap < 1:
+                    return []
+                # records actually on disk AND committed
+                avail = (len(mm) - HEADER_SIZE) // RECORD_SIZE
+                n = min(int(written), int(cap), avail)
+                start = int(written) % int(cap) if written > cap else 0
+                out: List[Tuple[float, str, float]] = []
+                for i in range(n):
+                    slot = (start + i) % int(cap)
+                    off = HEADER_SIZE + slot * RECORD_SIZE
+                    t, nb, v = _REC.unpack(mm[off:off + RECORD_SIZE])
+                    out.append((t, nb.rstrip(b"\x00").decode(
+                        "utf-8", "replace"), v))
+                return out
+    except OSError:
+        return []
+
+
+def read_dir(path: str) -> List[Tuple[float, str, float]]:
+    """Every record in every ``*.tsdb`` ring under ``path``, merged in
+    timestamp order — the multi-process postmortem view."""
+    out: List[Tuple[float, str, float]] = []
+    try:
+        names = sorted(os.listdir(path))
+    except OSError:
+        return out
+    for n in names:
+        if n.endswith(".tsdb"):
+            out.extend(read_records(os.path.join(path, n)))
+    out.sort(key=lambda r: r[0])
+    return out
+
+
+def series(records: Iterable[Tuple[float, str, float]]
+           ) -> Dict[str, List[Tuple[float, float]]]:
+    """Fold flat records into {name: [(t, value), …]} (input order)."""
+    out: Dict[str, List[Tuple[float, float]]] = {}
+    for t, name, v in records:
+        out.setdefault(name, []).append((t, v))
+    return out
+
+
+class TsdbSink:
+    """The persistence policy over a writer: which registry entries
+    become history. Never raises — a failed write disables the sink
+    with one warning (history is an enrichment, the scrape loop is a
+    control loop)."""
+
+    def __init__(self, writer: TsdbWriter) -> None:
+        self.writer = writer
+        self._dead = False
+        self._log = get_logger()
+
+    @staticmethod
+    def _select(snapshot: dict) -> Iterable[Tuple[str, float]]:
+        for name, v in snapshot.items():
+            if isinstance(v, dict):             # histogram summary
+                if not v.get("count"):
+                    continue
+                yield f"{name}/p50_ms", float(v.get("p50_ms", 0.0))
+                yield f"{name}/p95_ms", float(v.get("p95_ms", 0.0))
+                yield f"{name}/p99_ms", float(v.get("p99_ms", 0.0))
+                yield f"{name}/count", float(v.get("count", 0))
+            elif isinstance(v, (int, float)):
+                # zeros are persisted on purpose: fleet/<s>/up == 0 IS
+                # the dead-shard signal the offline replay detects
+                if name.startswith("fleet/") or (
+                        name.startswith("crit/")
+                        and name.endswith("_frac")):
+                    yield name, float(v)
+
+    def sample(self, snapshot: dict, t: float) -> int:
+        """Persist one scrape tick's selection; returns records written."""
+        if self._dead:
+            return 0
+        try:
+            return self.writer.append_many(t, self._select(snapshot))
+        except (OSError, ValueError) as e:
+            self._dead = True
+            self._log.warning(
+                "tsdb: write to %s failed (%s) — history disabled for "
+                "this process", self.writer.path, e)
+            return 0
+
+
+# ------------------------------------------------ process-wide singleton
+
+_proc_lock = threading.Lock()
+_proc_sink: Optional[TsdbSink] = None
+_proc_key: Optional[Tuple[str, int]] = None
+
+
+def process_sink() -> Optional[TsdbSink]:
+    """The process's shared sink (None when ``BPS_TSDB_DIR`` disables
+    persistence or the directory is unwritable). Shared on purpose:
+    two scrapers in one process must not interleave two writers into
+    the same ``bps-<pid>.tsdb`` ring. Re-resolves the env when it
+    changes (bench arms flip the knobs between rigs)."""
+    global _proc_sink, _proc_key
+    d = env_dir()
+    if d is None:
+        return None
+    key = (d, env_size())
+    with _proc_lock:
+        if _proc_sink is not None and _proc_key == key:
+            return _proc_sink
+        if _proc_sink is not None:
+            _proc_sink.writer.close()
+            _proc_sink = None
+        try:
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(d, f"bps-{os.getpid()}.tsdb")
+            _proc_sink = TsdbSink(TsdbWriter(path, size_bytes=key[1]))
+            _proc_key = key
+        except OSError as e:
+            get_logger().warning(
+                "tsdb: cannot open ring under %s (%s) — history "
+                "disabled", d, e)
+            _proc_sink = None
+            _proc_key = key
+        return _proc_sink
+
+
+def reset_process_sink() -> None:
+    """Drop the singleton (tests/bench arms re-resolve on next use)."""
+    global _proc_sink, _proc_key
+    with _proc_lock:
+        if _proc_sink is not None:
+            _proc_sink.writer.close()
+        _proc_sink = None
+        _proc_key = None

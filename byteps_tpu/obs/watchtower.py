@@ -1,0 +1,962 @@
+"""Fleet watchtower: online regime detection + the structured incident
+engine (the SENSING half of ROADMAP item 2's self-driving loop).
+
+The plane now measures *why* a step is slow (``crit/*`` blame
+fractions, scraped ``fleet/<shard>/*`` gauges, server spans) and —
+with ``obs/tsdb.py`` — remembers it. This module closes the loop's
+front half: it watches those streams online, decides "the regime
+changed at t=X and here is the evidence", and emits a structured
+**Incident** record. It never actuates anything: under
+``BPS_AUTOTUNE=observe`` every incident carries the *intended remedy*
+from ROADMAP item 2's knob table (codec ceiling / rebalance / credit
+shares / K-lag / reshape), logged verbatim for the future autotuner to
+consume, with ``acted: false`` — the kill-switch contract the roadmap
+specifies, proven out here before any knob is ever turned.
+
+Detectors (all online, O(window) memory, run at the FleetScraper
+cadence via ``Watchtower.observe_scrape``):
+
+  - **Robust z-score change-point** (``ChangePointDetector``) on step
+    time, per-shard engine queue depth, wire byte rate, embed cache
+    hit rate, and span-derived merge wait: baseline = rolling
+    median ± MAD (EWMA-free of outlier pollution), a detection needs
+    ``BPS_WATCH_CONFIRM`` *consecutive* breaches of
+    ``max(z·σ, min_delta)``, and recovery needs the same count of calm
+    samples below HALF that threshold — two-sided hysteresis, so a
+    borderline oscillating signal can neither open nor flap an
+    incident. The baseline FREEZES while a detection is active: a
+    permanent regime shift stays one incident, it is never absorbed
+    into "normal".
+  - **Dominant-category flip** (``FlipDetector``): the critpath
+    verdict (fresh ``crit/*_frac`` gauges when a trainer publishes
+    them, else a span/NIC-derived classification on the scraped fleet
+    view) must name the SAME new category ``BPS_WATCH_CONFIRM`` ticks
+    in a row to flip the regime; the first established regime is
+    silent, every later flip opens an incident. Wire vs straggler is
+    disambiguated by *blame concentration*: a shared-pipe bottleneck
+    serializes arrivals, so the last-arrival worker alternates and its
+    merge wait just re-measures transfer time — diffuse blame (top
+    worker under ``BPS_WATCH_BLAME_CONC`` of the weighted tally) hands
+    the merge wait to the wire score; a true straggler concentrates
+    the tally on one worker and keeps it.
+  - **Shard liveness**: ``fleet/<shard>/up``/``stale`` held down for
+    ``BPS_WATCH_CONFIRM`` ticks opens a ``shard_dead`` incident
+    (verdict ``dead``, remedy = fleet RESHAPE); recovery closes it.
+    Boot-graced: a shard that was never scraped up is still dialing,
+    not dead — "dead" strictly means "was up, went down".
+
+A confirmed detection opens an Incident: window, blamed signal,
+critpath verdict, implicated worker/shard (the merge-wait-weighted
+last-arrival worker of the span window for straggler verdicts), the
+attached
+flight-recorder postmortem, and the intended remedy. Incidents are
+emitted as ``watch/*`` gauges + counters, key-less flight events, the
+``/incidents.json`` endpoint on ``BPS_METRICS_PORT``, supervisor
+``events`` (launcher/fleet.py), and the offline timeline CLI::
+
+    python -m byteps_tpu.obs.watchtower <tsdb_dir>
+
+which replays the detectors over the on-disk ring alone — no live
+process required. The engine itself is always available (the PR-14
+slow-step auto-capture routes through it regardless of mode); the
+*detectors* only run under ``BPS_AUTOTUNE=observe``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..common.config import _TRUE  # noqa: F401  (env idiom parity)
+from ..common.logging import get_logger
+from . import flight as _flight
+from . import metrics as _metrics
+from . import tsdb as _tsdb
+from .metrics import get_registry
+
+INCIDENT_SCHEMA = "byteps_tpu.Incident/v1"
+INCIDENTS_SCHEMA = "byteps_tpu.Incidents/v1"
+
+# ROADMAP item 2's knob table: verdict category -> the remedy the
+# future autotuner (PR 20) would actuate. In observe mode these are
+# LOGGED VERBATIM on every incident and never executed — the whole
+# point of the kill-switch mode is that PR 20 only has to trust
+# verdicts this PR proves correct, not invent them.
+REMEDIES: Dict[str, Dict[str, Optional[str]]] = {
+    "wire": {"knob": "BPS_COMPRESS_MAX",
+             "action": "raise codec ladder ceiling / shrink "
+                       "BPS_PS_PARTITION_BYTES"},
+    "server_queue": {"knob": "BPS_PLANE_REBALANCE_SEC",
+                     "action": "rebalance key placement off the hot "
+                               "shard"},
+    "credit": {"knob": "BPS_SCHEDULING_CREDIT",
+               "action": "adjust per-class credit shares"},
+    "straggler": {"knob": "BPS_MAX_LAG",
+                  "action": "raise bounded-staleness K-lag"},
+    "dead": {"knob": "fleet.RESHAPE",
+             "action": "respawn/replace the shard via the supervisor"},
+    "cache": {"knob": "BPS_EMBED_CACHE_ROWS",
+              "action": "grow the hot-row cache / lower push "
+                        "frequency"},
+}
+
+
+# ------------------------------------------------------------ env knobs
+
+def autotune_mode() -> str:
+    """``BPS_AUTOTUNE``: ``off`` (default) or ``observe`` — anything
+    else reads as ``off`` (fail safe: an unknown mode must not start
+    detectors someone meant to configure differently)."""
+    v = os.environ.get("BPS_AUTOTUNE", "off").strip().lower() or "off"
+    return v if v in ("off", "observe") else "off"
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_i(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+# How many per-record NIC stall-times of merge wait pipe serialization
+# is allowed to explain before merge wait reads as a straggler rather
+# than the wire: with W contributors interleaving behind one bucket the
+# first→last arrival gap runs ~2× the per-record stall (measured in
+# bench.ps_watch_breakdown's wire-bound phase); a real straggler's wait
+# is an order of magnitude beyond it.
+_WIRE_EXCESS = 2.5
+
+
+def watch_params() -> dict:
+    """The ``BPS_WATCH_*`` threshold knobs (docs/env.md), re-read per
+    construction so bench arms can flip them between rigs."""
+    return {
+        "z": _env_f("BPS_WATCH_Z", 4.0),
+        "confirm": max(1, _env_i("BPS_WATCH_CONFIRM", 3)),
+        "window": max(8, _env_i("BPS_WATCH_WINDOW", 64)),
+        "min_samples": max(3, _env_i("BPS_WATCH_MIN_SAMPLES", 8)),
+        "regime_floor_ms": _env_f("BPS_WATCH_REGIME_FLOOR_MS", 5.0),
+        "blame_conc": _env_f("BPS_WATCH_BLAME_CONC", 0.8),
+        "max_incidents": max(16, _env_i("BPS_WATCH_MAX_INCIDENTS", 256)),
+    }
+
+
+def _category_for(signal: str) -> Optional[str]:
+    """Default verdict category for a shifted stream (used when no
+    fresh critpath attribution names one)."""
+    if "merge_wait" in signal:
+        return "straggler"
+    if "queue_depth" in signal:
+        return "server_queue"
+    if "nic/" in signal or signal.startswith("wire/"):
+        return "wire"
+    if "hit_rate" in signal:
+        return "cache"
+    return None
+
+
+# ------------------------------------------------------------ detectors
+
+class ChangePointDetector:
+    """Robust z-score change-point with two-sided hysteresis.
+
+    Baseline = median ± MAD over a rolling window of CALM samples
+    (breaching samples never join the baseline; the baseline freezes
+    entirely while a detection is active, so a permanent shift stays
+    detected instead of becoming the new normal). Opens after
+    ``confirm`` consecutive samples beyond ``max(z·σ, min_delta)`` in
+    the armed ``direction``; closes after ``confirm`` consecutive
+    samples back inside HALF that threshold."""
+
+    def __init__(self, signal: str, z: float = 4.0, confirm: int = 3,
+                 window: int = 64, min_samples: int = 8,
+                 min_delta: float = 0.0, direction: int = 1) -> None:
+        self.signal = signal
+        self.z = float(z)
+        self.confirm = max(1, int(confirm))
+        self.min_samples = max(3, int(min_samples))
+        self.min_delta = float(min_delta)
+        self.direction = int(direction)
+        self._hist: deque = deque(maxlen=max(window, min_samples))
+        self.active = False
+        self._baseline: Optional[Tuple[float, float]] = None
+        self._breach = 0
+        self._calm = 0
+        self._opened_t: Optional[float] = None
+
+    def _stats(self) -> Tuple[float, float]:
+        med = statistics.median(self._hist)
+        mad = statistics.median(abs(x - med) for x in self._hist)
+        # σ floor: a perfectly quiet baseline (MAD 0) must not turn
+        # femto-jitter into a confirmed shift — min_delta is the real
+        # guard, the relative floor just keeps z finite
+        sigma = max(1.4826 * mad, 0.05 * abs(med), 1e-9)
+        return med, sigma
+
+    def _breaching(self, x: float, med: float, sigma: float) -> bool:
+        dev = x - med
+        if self.direction > 0 and dev <= 0:
+            return False
+        if self.direction < 0 and dev >= 0:
+            return False
+        return abs(dev) > max(self.z * sigma, self.min_delta)
+
+    def update(self, t: float, x: float) -> Optional[dict]:
+        """Fold one sample; returns an ``{"event": "open"|"close"}``
+        record at the confirmed transition, else None."""
+        if not self.active:
+            if len(self._hist) >= self.min_samples:
+                med, sigma = self._stats()
+                if self._breaching(x, med, sigma):
+                    self._breach += 1
+                    if self._breach >= self.confirm:
+                        self.active = True
+                        self._baseline = (med, sigma)
+                        self._breach = 0
+                        self._calm = 0
+                        self._opened_t = t
+                        return {"event": "open", "signal": self.signal,
+                                "baseline": round(med, 6),
+                                "sigma": round(sigma, 6),
+                                "observed": round(x, 6),
+                                "z": round((x - med) / sigma, 3),
+                                "samples": len(self._hist)}
+                    return None
+                self._breach = 0
+            self._hist.append(x)
+            return None
+        med, sigma = self._baseline
+        if abs(x - med) <= max(self.z * sigma, self.min_delta) / 2.0:
+            self._calm += 1
+            if self._calm >= self.confirm:
+                self.active = False
+                self._calm = 0
+                self._hist.clear()
+                self._hist.append(x)
+                dur = t - self._opened_t if self._opened_t else 0.0
+                self._opened_t = None
+                return {"event": "close", "signal": self.signal,
+                        "duration_s": round(max(0.0, dur), 3)}
+        else:
+            self._calm = 0
+        return None
+
+
+class FlipDetector:
+    """Dominant-category flip with hysteresis: a NEW category must win
+    ``confirm`` consecutive ticks to become the regime. The first
+    established regime returns no flip (there is nothing to flip
+    from); an oscillating verdict never confirms."""
+
+    def __init__(self, confirm: int = 3) -> None:
+        self.confirm = max(1, int(confirm))
+        self.current: Optional[str] = None
+        self._cand: Optional[str] = None
+        self._n = 0
+
+    def update(self, category: Optional[str]) -> Optional[Tuple[str, str]]:
+        """Returns ``(old, new)`` on a confirmed FLIP (old is a real
+        category — the silent first establishment returns None)."""
+        if category is None or category == self.current:
+            self._cand, self._n = None, 0
+            return None
+        if category == self._cand:
+            self._n += 1
+        else:
+            self._cand, self._n = category, 1
+        if self._n >= self.confirm:
+            old, self.current = self.current, category
+            self._cand, self._n = None, 0
+            return (old, category) if old is not None else None
+        return None
+
+
+# -------------------------------------------------------- incident engine
+
+class IncidentEngine:
+    """Process-wide structured incident log (bounded).
+
+    Always available — the slow-step capture records through it with
+    the detectors off — and strictly passive: it logs, counts, and
+    remembers; the ``remedy`` block on every record is an intention,
+    never an action (``acted`` stays false until a PR-20 actuator
+    exists and is explicitly enabled)."""
+
+    def __init__(self, max_incidents: Optional[int] = None) -> None:
+        cap = (watch_params()["max_incidents"]
+               if max_incidents is None else int(max_incidents))
+        self._incidents: deque = deque(maxlen=cap)
+        self._next_id = 1
+        self._lock = threading.Lock()
+        self._callbacks: List[Callable[[dict], None]] = []
+        self._log = get_logger()
+
+    # ------------------------------------------------------- lifecycle
+
+    def open_incident(self, kind: str, signal: str,
+                      verdict: Optional[str] = None,
+                      blamed: Optional[dict] = None,
+                      evidence: Optional[dict] = None,
+                      window: Optional[dict] = None,
+                      detail: Optional[str] = None,
+                      crit: Optional[dict] = None,
+                      resolve: bool = False,
+                      attach_flight: bool = True,
+                      quiet: bool = False,
+                      at: Optional[float] = None) -> Optional[dict]:
+        """Open (and for point events immediately resolve) one
+        incident. Returns the record, or None when an incident of the
+        same (kind, signal) is already open — one cause, one record.
+        ``at`` stamps the record (offline replay passes the RECORDED
+        frame time so the timeline reads in ring time, not now)."""
+        now = time.time() if at is None else float(at)
+        with self._lock:
+            for inc in self._incidents:
+                if (inc["kind"] == kind and inc["signal"] == signal
+                        and inc["closed_t"] is None):
+                    return None
+            remedy = None
+            if verdict in REMEDIES:
+                remedy = dict(REMEDIES[verdict], acted=False)
+            inc = {
+                "schema": INCIDENT_SCHEMA,
+                "id": self._next_id,
+                "opened_t": round(now, 3),
+                "closed_t": round(now, 3) if resolve else None,
+                "kind": kind,
+                "signal": signal,
+                "verdict": verdict,
+                "blamed": blamed or None,
+                "evidence": evidence or {},
+                "window": window or {},
+                "remedy": remedy,
+                "detail": detail,
+            }
+            if crit:
+                inc["crit"] = crit
+            self._next_id += 1
+            self._incidents.append(inc)
+        if attach_flight:
+            try:
+                inc["flight"] = _flight.get_recorder().postmortem(last=40)
+            except Exception:   # noqa: BLE001 — enrichment only
+                pass
+        self._emit(inc, quiet=quiet)
+        return inc
+
+    def close_incident(self, kind: str, signal: str,
+                       evidence: Optional[dict] = None,
+                       at: Optional[float] = None) -> Optional[dict]:
+        """Close the open incident for (kind, signal), if any."""
+        now = time.time() if at is None else float(at)
+        with self._lock:
+            for inc in reversed(self._incidents):
+                if (inc["kind"] == kind and inc["signal"] == signal
+                        and inc["closed_t"] is None):
+                    inc["closed_t"] = round(now, 3)
+                    if evidence:
+                        inc["evidence"].update(evidence)
+                    break
+            else:
+                return None
+        self._publish_gauges()
+        self._log.info("watchtower: incident #%d (%s %s) closed",
+                       inc["id"], kind, signal)
+        return inc
+
+    # -------------------------------------------------------- emission
+
+    def _emit(self, inc: dict, quiet: bool = False) -> None:
+        reg = get_registry()
+        reg.counter("watch/incidents").inc()
+        if inc["kind"] == "regime_flip":
+            reg.counter("watch/regime_flips").inc()
+        self._publish_gauges()
+        rem = inc.get("remedy") or {}
+        _flight.record(
+            "incident", outcome="open",
+            detail=f"#{inc['id']} {inc['kind']} {inc['signal']} "
+                   f"verdict={inc['verdict']}")
+        if quiet:
+            # the caller owns the human-readable WARNING (the slow-step
+            # path logs on the emitter's logger to keep its contract)
+            for cb in list(self._callbacks):
+                try:
+                    cb(inc)
+                except Exception:   # noqa: BLE001
+                    pass
+            return
+        self._log.warning(
+            "watchtower: INCIDENT #%d %s signal=%s verdict=%s blamed=%s "
+            "intended_remedy=%s (mode=%s, NOT acted on)%s",
+            inc["id"], inc["kind"], inc["signal"], inc["verdict"],
+            inc["blamed"], rem.get("knob"), autotune_mode(),
+            "\n" + inc["detail"] if inc.get("detail") else "")
+        for cb in list(self._callbacks):
+            try:
+                cb(inc)
+            except Exception:   # noqa: BLE001 — observer must not kill us
+                pass
+
+    def _publish_gauges(self) -> None:
+        get_registry().gauge("watch/open_incidents").set(
+            float(len(self.open_incidents())))
+
+    # --------------------------------------------------------- queries
+
+    def incidents(self) -> List[dict]:
+        with self._lock:
+            return [dict(i) for i in self._incidents]
+
+    def open_incidents(self) -> List[dict]:
+        with self._lock:
+            return [dict(i) for i in self._incidents
+                    if i["closed_t"] is None]
+
+    def add_callback(self, cb: Callable[[dict], None]) -> None:
+        self._callbacks.append(cb)
+
+    def remove_callback(self, cb: Callable[[dict], None]) -> None:
+        try:
+            self._callbacks.remove(cb)
+        except ValueError:
+            pass
+
+    def clear(self) -> None:
+        with self._lock:
+            self._incidents.clear()
+            self._next_id = 1
+            self._callbacks = []
+
+    def to_json(self) -> dict:
+        incs = self.incidents()
+        return {"schema": INCIDENTS_SCHEMA, "mode": autotune_mode(),
+                "open": sum(1 for i in incs if i["closed_t"] is None),
+                "incidents": incs}
+
+
+_engine_lock = threading.Lock()
+_engine: Optional[IncidentEngine] = None
+
+
+def get_engine() -> IncidentEngine:
+    """The process's incident engine (lazy singleton — always exists;
+    the slow-step path records through it even with detectors off)."""
+    global _engine
+    with _engine_lock:
+        if _engine is None:
+            _engine = IncidentEngine()
+        return _engine
+
+
+def reset_engine() -> None:
+    """Drop every recorded incident + callback (tests/bench arms)."""
+    global _engine
+    with _engine_lock:
+        if _engine is not None:
+            _engine.clear()
+        _engine = None
+
+
+def configure() -> None:
+    """Re-resolve the env (mode + thresholds) — ``bps.init()`` calls
+    this so a process that flipped ``BPS_AUTOTUNE`` between inits gets
+    fresh detector parameters on its next scraper."""
+    reset_engine()
+
+
+def slow_step_incident(msg: str, wall_ms: float, median_ms: float,
+                       factor: float,
+                       crit: Optional[dict] = None) -> Optional[dict]:
+    """The PR-14 slow-step auto-capture, as a structured incident: one
+    record per capture (kind ``slow_step``), the critpath block
+    attached, resolved immediately (a point event, not a held-open
+    regime). The caller owns the ≥60 s rate limit and the
+    ``BPS_SLOW_STEP_FACTOR`` default-off gate — both unchanged."""
+    verdict = (crit or {}).get("dominant")
+    blamed = None
+    strag = (crit or {}).get("straggler") or {}
+    if strag.get("worker") is not None:
+        blamed = {"worker": strag["worker"]}
+    return get_engine().open_incident(
+        kind="slow_step", signal="step/wall_s", verdict=verdict,
+        blamed=blamed,
+        evidence={"wall_ms": round(wall_ms, 3),
+                  "median_ms": round(median_ms, 3),
+                  "factor": round(factor, 3)},
+        detail=msg, crit=crit, resolve=True, quiet=True)
+
+
+# ------------------------------------------------------------ watchtower
+
+# per-stream detector shape: (substring, direction, min_delta)
+_STREAM_RULES: Tuple[Tuple[str, int, float], ...] = (
+    ("spans/merge_wait_ms", 1, 10.0),
+    ("server/engine_queue_depth", 1, 4.0),
+    ("step/wall_ms", 1, 1.0),
+    ("wire/mbps", 0, 1.0),
+    ("embed/hit_rate", -1, 0.1),
+    ("merge_wait_s/p99_ms", 1, 10.0),   # offline (recorded percentiles)
+    ("wall_s/p99_ms", 1, 1.0),          # offline
+)
+
+
+class Watchtower:
+    """The detector bank over one telemetry stream (live scraper or
+    recorded ring). ``tick(t, frame)`` is the whole surface — the live
+    adapter (``observe_scrape``) and the offline replay both reduce to
+    frames::
+
+        {"streams": {name: sample},            # one value per tick max
+         "shards":  {label: {"up": 0/1, "stale": 0/1}},
+         "regime":  "wire" | None,             # pre-hysteresis category
+         "blame_worker": wid | None}           # straggler candidate
+    """
+
+    def __init__(self, engine: Optional[IncidentEngine] = None,
+                 params: Optional[dict] = None) -> None:
+        self.engine = engine if engine is not None else get_engine()
+        self.params = dict(watch_params(), **(params or {}))
+        self._detectors: Dict[str, ChangePointDetector] = {}
+        self.flip = FlipDetector(confirm=self.params["confirm"])
+        self._down: Dict[str, int] = {}     # shard -> consecutive down
+        self._up: Dict[str, int] = {}       # shard -> consecutive up
+        self._was_up: Dict[str, bool] = {}  # boot grace (see tick)
+        self.ticks = 0
+        # live-adapter deltas
+        self._prev: Optional[dict] = None
+        self._prev_t: Optional[float] = None
+        self._span_mark: Dict[int, int] = {}    # key -> round watermark
+        self._last_wids: deque = deque(maxlen=64)
+        self._crit_steps = 0.0
+
+    # ----------------------------------------------------------- core
+
+    def _detector(self, signal: str) -> ChangePointDetector:
+        det = self._detectors.get(signal)
+        if det is None:
+            direction, min_delta = 1, 0.0
+            for sub, d, md in _STREAM_RULES:
+                if sub in signal:
+                    direction, min_delta = d, md
+                    break
+            det = self._detectors[signal] = ChangePointDetector(
+                signal, z=self.params["z"],
+                confirm=self.params["confirm"],
+                window=self.params["window"],
+                min_samples=self.params["min_samples"],
+                min_delta=min_delta, direction=direction)
+        return det
+
+    def tick(self, t: float, frame: dict) -> List[dict]:
+        """Fold one telemetry tick; returns incidents opened by it."""
+        self.ticks += 1
+        get_registry().counter("watch/ticks").inc()
+        opened: List[dict] = []
+        blame_worker = frame.get("blame_worker")
+        # 1) change-point detectors over every sampled stream
+        for signal, x in sorted((frame.get("streams") or {}).items()):
+            if x is None:
+                continue
+            ev = self._detector(signal).update(t, float(x))
+            if not ev:
+                continue
+            if ev["event"] == "close":
+                self.engine.close_incident(
+                    "change_point", signal,
+                    evidence={"recovered": True,
+                              "duration_s": ev["duration_s"]}, at=t)
+                continue
+            verdict = (frame.get("crit_dominant")
+                       or _category_for(signal)
+                       or frame.get("regime"))
+            blamed = self._blame(signal, verdict, frame, blame_worker)
+            inc = self.engine.open_incident(
+                kind="change_point", signal=signal, verdict=verdict,
+                blamed=blamed,
+                evidence={k: ev[k] for k in
+                          ("baseline", "sigma", "observed", "z")},
+                window={"t1": round(t, 3),
+                        "samples": ev["samples"],
+                        "confirm": self.params["confirm"]}, at=t)
+            if inc:
+                opened.append(inc)
+        # 2) shard liveness
+        for label, st in sorted((frame.get("shards") or {}).items()):
+            down = (not st.get("up", 1)) or bool(st.get("stale", 0))
+            sig = f"fleet/{label}/up"
+            if down:
+                # boot grace: a shard that was NEVER up is still
+                # dialing (the scraper lazy-dials while the server
+                # boots) — "dead" means "was up, went down"
+                if not self._was_up.get(label):
+                    continue
+                self._up[label] = 0
+                self._down[label] = self._down.get(label, 0) + 1
+                if self._down[label] == self.params["confirm"]:
+                    inc = self.engine.open_incident(
+                        kind="shard_dead", signal=sig, verdict="dead",
+                        blamed={"shard": label},
+                        evidence={"up": int(bool(st.get("up", 0))),
+                                  "stale": int(bool(st.get("stale", 0)))},
+                        window={"t1": round(t, 3),
+                                "confirm": self.params["confirm"]},
+                        at=t)
+                    if inc:
+                        opened.append(inc)
+            else:
+                self._was_up[label] = True
+                self._down[label] = 0
+                self._up[label] = self._up.get(label, 0) + 1
+                if self._up[label] == self.params["confirm"]:
+                    self.engine.close_incident(
+                        "shard_dead", sig, evidence={"recovered": True},
+                        at=t)
+        # 3) dominant-category flip
+        flip = self.flip.update(frame.get("regime"))
+        if flip is not None:
+            old, new = flip
+            inc = self.engine.open_incident(
+                kind="regime_flip", signal="crit/dominant", verdict=new,
+                blamed=self._blame("regime", new, frame, blame_worker),
+                evidence={"from": old, "to": new},
+                window={"t1": round(t, 3),
+                        "confirm": self.params["confirm"]},
+                resolve=True, at=t)
+            if inc:
+                opened.append(inc)
+        return opened
+
+    @staticmethod
+    def _blame(signal: str, verdict: Optional[str], frame: dict,
+               blame_worker) -> Optional[dict]:
+        if verdict == "straggler" and blame_worker is not None:
+            return {"worker": blame_worker}
+        # per-shard streams blame their shard: fleet/<label>/…
+        if signal.startswith("fleet/"):
+            label = signal.split("/", 2)[1]
+            return {"shard": label}
+        return None
+
+    # ------------------------------------------------- live adaptation
+
+    def observe_scrape(self, scraper) -> List[dict]:
+        """One live tick driven by a ``FleetScraper`` pass: derive the
+        frame from the registry snapshot (deltas vs the previous tick)
+        + the collected server spans, then ``tick``. Guarded by the
+        caller — this is an enrichment on the scrape loop."""
+        now = time.time()
+        snap = scraper.reg.snapshot()
+        frame = self._frame_from_live(snap, now)
+        out = self.tick(now, frame)
+        self._prev, self._prev_t = snap, now
+        return out
+
+    def _frame_from_live(self, snap: dict, now: float) -> dict:
+        prev = self._prev or {}
+        dt = max(1e-6, now - self._prev_t) if self._prev_t else None
+        streams: Dict[str, Optional[float]] = {}
+
+        def _num(d: dict, name: str, f: str = "") -> float:
+            v = d.get(name)
+            if isinstance(v, dict):
+                return float(v.get(f, 0.0) or 0.0)
+            return float(v or 0.0)
+
+        # step time: per-tick mean wall from the local histogram deltas
+        dc = _num(snap, "step/wall_s", "count") - _num(
+            prev, "step/wall_s", "count")
+        if dt and dc > 0:
+            ds = _num(snap, "step/wall_s", "sum_ms") - _num(
+                prev, "step/wall_s", "sum_ms")
+            streams["step/wall_ms"] = ds / dc
+        # wire byte rate (this process's PS traffic)
+        if dt:
+            db = ((_num(snap, "ps/push_bytes")
+                   + _num(snap, "ps/pull_bytes"))
+                  - (_num(prev, "ps/push_bytes")
+                     + _num(prev, "ps/pull_bytes")))
+            if db > 0 or "wire/mbps" in self._detectors:
+                streams["wire/mbps"] = db / dt / 1e6
+        # embed cache hit rate over the tick's lookups
+        dh = _num(snap, "embed/cache_hits") - _num(prev,
+                                                   "embed/cache_hits")
+        dm = _num(snap, "embed/cache_misses") - _num(
+            prev, "embed/cache_misses")
+        if dh + dm >= 16:
+            streams["embed/hit_rate"] = dh / (dh + dm)
+        # per-shard scraped gauges + liveness
+        shards: Dict[str, dict] = {}
+        for name, v in snap.items():
+            if not name.startswith("fleet/") or isinstance(v, dict):
+                continue
+            parts = name.split("/")
+            if len(parts) >= 3 and parts[2] in ("up", "stale"):
+                shards.setdefault(parts[1], {})[parts[2]] = v
+            elif name.endswith("/server/engine_queue_depth"):
+                streams[name] = float(v)
+        # span-derived merge wait + blame + regime scores
+        strag_ms, queue_ms, new_recs = self._fold_spans()
+        if new_recs:
+            streams["spans/merge_wait_ms"] = strag_ms
+        wire_ms = self._wire_ms(snap, prev, new_recs)
+        # merge-wait-weighted last-arrival tally over the recent
+        # per-round window: blame candidate AND the wire-vs-straggler
+        # discriminator below
+        wid_scores: Dict = {}
+        for w, ms in self._last_wids:
+            # the 1e-3 floor keeps a zero-wait window decidable
+            # (degenerates to modal last-arrival)
+            wid_scores[w] = wid_scores.get(w, 0.0) + ms + 1e-3
+        wid_total = sum(wid_scores.values())
+        conc = (max(wid_scores.values()) / wid_total
+                if wid_total > 0 else 1.0)
+        conc_n = len(self._last_wids)
+        # dominant category: a fresh critpath attribution wins; else
+        # classify the scraped fleet view by dominant seconds-per-round
+        crit_dominant = self._crit_dominant(snap)
+        regime = crit_dominant
+        if regime is None and new_recs:
+            floor = self.params["regime_floor_ms"]
+            strag_score, wire_score = strag_ms, wire_ms
+            # A shared-pipe bottleneck serializes arrivals: the
+            # last-arrival worker ALTERNATES and its merge wait tracks
+            # transfer time — merge wait a straggler score would
+            # double-count. Claiming "straggler" over live wire
+            # telemetry therefore needs BOTH (a) the weighted blame
+            # tally concentrated on one worker (boot skew alone gives
+            # this, so (a) is not sufficient) and (b) merge wait in
+            # EXCESS of what pipe serialization explains (a few
+            # transfer times); otherwise the merge wait is handed to
+            # the wire score. Without wire telemetry it stays put.
+            focused = (conc_n >= 8
+                       and conc >= self.params["blame_conc"])
+            excess = strag_ms >= _WIRE_EXCESS * wire_ms
+            if wire_ms > 0.0 and not (focused and excess):
+                strag_score, wire_score = 0.0, wire_ms + strag_ms
+            scores = {"straggler": strag_score,
+                      "server_queue": queue_ms, "wire": wire_score}
+            cat = max(scores, key=scores.get)
+            rest = sorted(scores.values())[-2]
+            if scores[cat] >= max(floor, 1.5 * rest):
+                regime = cat
+        # blame candidate: a fresh critpath attribution's straggler
+        # worker wins (it is per-step exact); else the last-arrival
+        # worker carrying the most merge-wait over the recent span
+        # window — WEIGHTED by each record's wait, not modal, so one
+        # tick of real straggling outvotes a window of jitter-ordered
+        # calm records (pre-fault arrival order is a coin flip)
+        blame = None
+        if crit_dominant is not None:
+            try:
+                from . import critpath as _critpath
+                la = _critpath.last_attribution()
+                strag = (la[1].get("straggler") or {}) if la else {}
+                if strag.get("worker") is not None:
+                    blame = strag["worker"]
+            except Exception:   # noqa: BLE001 — enrichment only
+                pass
+        if blame is None and wid_scores:
+            blame = max(wid_scores, key=wid_scores.get)
+        return {"streams": streams, "shards": shards, "regime": regime,
+                "crit_dominant": crit_dominant, "blame_worker": blame}
+
+    def _fold_spans(self) -> Tuple[float, float, int]:
+        """Mean merge-wait / queue time (ms) over span records NEWLY
+        completed since the previous tick (per-key round watermarks),
+        feeding the straggler stream + last-arrival blame window."""
+        from . import spans as _spans
+        waits: List[float] = []
+        queues: List[float] = []
+        n = 0
+        try:
+            recs = _spans.collected()
+        except Exception:   # noqa: BLE001 — enrichment only
+            return 0.0, 0.0, 0
+        # one last-arrival sample per ROUND, not per key-record: all
+        # keys of a round share the same last worker, so per-record
+        # samples are correlated and the blame-concentration statistic
+        # oscillates on what is effectively a handful of coin flips
+        round_last: Dict = {}
+        for r in recs:
+            key, rnd = r.get("key"), r.get("round")
+            if key is None or rnd is None or r.get("complete_t") is None:
+                continue
+            if rnd <= self._span_mark.get(key, 0):
+                continue
+            self._span_mark[key] = rnd
+            n += 1
+            if r.get("merge_wait_s") is not None:
+                waits.append(float(r["merge_wait_s"]) * 1e3)
+            if r.get("queue_s") is not None:
+                queues.append(float(r["queue_s"]) * 1e3)
+            arrivals = r.get("arrivals") or []
+            if len(arrivals) >= 2 and not r.get("sealed"):
+                last = max(arrivals, key=lambda a: a.get("t", 0.0))
+                if last.get("w") is not None:
+                    gap_ms = (last.get("t", 0.0) - min(
+                        a.get("t", 0.0) for a in arrivals)) * 1e3
+                    if r.get("merge_wait_s") is not None:
+                        gap_ms = float(r["merge_wait_s"]) * 1e3
+                    prev = round_last.get(rnd)
+                    if prev is None or gap_ms > prev[1]:
+                        round_last[rnd] = (last["w"], max(0.0, gap_ms))
+        for rnd in sorted(round_last):
+            self._last_wids.append(round_last[rnd])
+        mean = lambda xs: sum(xs) / len(xs) if xs else 0.0  # noqa: E731
+        return mean(waits), mean(queues), n
+
+    def _wire_ms(self, snap: dict, prev: dict, new_recs: int) -> float:
+        """NIC pacing stall time per newly completed span record, from
+        the scraped (or local) ``nic/stall_s`` histograms — the
+        wire-bound score of the regime classifier."""
+        total = 0.0
+        for name, v in snap.items():
+            if isinstance(v, dict) and name.endswith("nic/stall_s"):
+                pv = prev.get(name)
+                total += (float(v.get("sum_ms", 0.0))
+                          - float((pv or {}).get("sum_ms", 0.0)))
+            elif name.endswith("nic/stall_s/count") and not \
+                    isinstance(v, dict):
+                # scraped shard histograms arrive as flattened gauges:
+                # per-stall p50 × new stalls approximates stall seconds
+                pv = float(prev.get(name) or 0.0)
+                p50 = float(snap.get(
+                    name[:-len("count")] + "p50_ms") or 0.0)
+                total += max(0.0, float(v) - pv) * p50
+        return total / max(1, new_recs)
+
+    def _crit_dominant(self, snap: dict) -> Optional[str]:
+        """The critpath verdict, only when a NEW attribution landed
+        since the last tick (stale gauges must not outvote the live
+        fleet classifier)."""
+        steps = float(snap.get("crit/steps") or 0.0)
+        if steps <= self._crit_steps:
+            return None
+        self._crit_steps = steps
+        best, best_v = None, 0.0
+        for name, v in snap.items():
+            if (name.startswith("crit/") and name.endswith("_frac")
+                    and not isinstance(v, dict) and float(v) > best_v):
+                best, best_v = name[len("crit/"):-len("_frac")], float(v)
+        return best if best_v > 0.25 else None
+
+
+def maybe_watchtower(params: Optional[dict] = None
+                     ) -> Optional[Watchtower]:
+    """A ``Watchtower`` bound to the process engine when
+    ``BPS_AUTOTUNE=observe`` and stats are on; else None. The
+    FleetScraper's constructor hook — detectors ride the scrape
+    cadence, so observe mode without a scraper runs nothing."""
+    if autotune_mode() != "observe" or not _metrics.metrics_enabled():
+        return None
+    return Watchtower(params=params)
+
+
+# ------------------------------------------------------- offline replay
+
+def replay(records: List[Tuple[float, str, float]],
+           params: Optional[dict] = None) -> List[dict]:
+    """Re-run the detector bank over a recorded ring: group records
+    into per-timestamp frames (a ``TsdbSink`` batch shares one stamp),
+    map the recorded series onto detector streams, and tick a fresh
+    ``Watchtower`` through them. Liveness, queue depth, recorded-tail
+    shifts and ``crit/*_frac`` flips replay faithfully; span blame and
+    wire-rate deltas need the live process and are absent offline."""
+    engine = IncidentEngine()
+    wt = Watchtower(engine=engine, params=params)
+    frames: Dict[float, Dict[str, float]] = {}
+    for t, name, v in records:
+        frames.setdefault(round(t, 3), {})[name] = v
+    for t in sorted(frames):
+        batch = frames[t]
+        streams: Dict[str, float] = {}
+        shards: Dict[str, dict] = {}
+        fracs: Dict[str, float] = {}
+        for name, v in batch.items():
+            parts = name.split("/")
+            if name.startswith("fleet/") and len(parts) >= 3 \
+                    and parts[2] in ("up", "stale"):
+                shards.setdefault(parts[1], {})[parts[2]] = v
+            elif name.endswith("/server/engine_queue_depth"):
+                streams[name] = v
+            elif name.endswith("merge_wait_s/p99_ms") \
+                    or name == "step/wall_s/p99_ms":
+                streams[name] = v
+            elif name.startswith("crit/") and name.endswith("_frac"):
+                fracs[name[len("crit/"):-len("_frac")]] = v
+        regime = None
+        if fracs:
+            cat = max(fracs, key=fracs.get)
+            if fracs[cat] > 0.25:
+                regime = cat
+        wt.tick(t, {"streams": streams, "shards": shards,
+                    "regime": regime, "crit_dominant": regime})
+    return engine.incidents()
+
+
+def format_timeline(incidents: List[dict]) -> str:
+    if not incidents:
+        return "no incidents"
+    t0 = incidents[0]["opened_t"]
+    lines = [f"incident timeline ({len(incidents)} incidents, "
+             f"t0={t0:.3f}):"]
+    for inc in incidents:
+        rem = inc.get("remedy") or {}
+        state = ("resolved" if inc["closed_t"] is not None else "OPEN")
+        lines.append(
+            f"  +{inc['opened_t'] - t0:8.1f}s #{inc['id']:<3d} "
+            f"{inc['kind']:<12s} {state:<8s} signal={inc['signal']} "
+            f"verdict={inc['verdict']} blamed={inc['blamed']} "
+            f"remedy={rem.get('knob')}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="byteps_tpu.obs.watchtower",
+        description="replay the watchtower detectors over an on-disk "
+                    "telemetry ring (BPS_TSDB_DIR) and render the "
+                    "incident timeline")
+    ap.add_argument("tsdb_dir", help="directory of bps-<pid>.tsdb rings")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the Incidents/v1 JSON instead of text")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.tsdb_dir):
+        print(f"error: {args.tsdb_dir} is not a directory",
+              file=sys.stderr)
+        return 2
+    records = _tsdb.read_dir(args.tsdb_dir)
+    if not records:
+        print(f"error: no tsdb records under {args.tsdb_dir}",
+              file=sys.stderr)
+        return 1
+    incidents = replay(records)
+    if args.json:
+        print(json.dumps({"schema": INCIDENTS_SCHEMA,
+                          "records": len(records),
+                          "incidents": incidents}, default=str))
+    else:
+        span = records[-1][0] - records[0][0]
+        print(f"{len(records)} records over {span:.1f}s from "
+              f"{args.tsdb_dir}")
+        print(format_timeline(incidents))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

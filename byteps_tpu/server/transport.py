@@ -1913,6 +1913,14 @@ class RemotePSBackend:
         for _, _, skey in self._stripe_plans.get(key, ()):
             self._send_prio[skey] = int(prio)
 
+    @property
+    def incarnation(self) -> int:
+        """This client's push-dedup incarnation id — the worker id the
+        server's span ring records per arrival, and therefore the id a
+        watchtower incident blames. Surfaced so a driver (the ps_watch
+        bench) can map a blamed id back to a fleet role."""
+        return self._wid
+
     def _push_token(self, key: int) -> int:
         with self._push_seq_lock:
             seq = self._push_seq.get(key, 0) + 1

@@ -620,6 +620,12 @@ def test_prometheus_text_golden():
     reg.counter("embed/row_fetch_bytes").inc(1280)
     reg.counter("embed/rows_pushed").inc(10)
     reg.gauge("embed/hot_set_size").set(64)
+    # watchtower families (docs/observability.md): detector tick +
+    # incident counters, flip counter, live open-incident gauge
+    reg.counter("watch/ticks").inc(12)
+    reg.counter("watch/incidents").inc(2)
+    reg.counter("watch/regime_flips").inc(1)
+    reg.gauge("watch/open_incidents").set(1)
     golden = "\n".join([
         '# TYPE bps_crit_absorbed_frac gauge',
         'bps_crit_absorbed_frac 0.11',
@@ -658,6 +664,14 @@ def test_prometheus_text_golden():
         'bps_stage_PS_PUSH{quantile="0.5"} 0.005',
         'bps_stage_PS_PUSH{quantile="0.95"} 0.005',
         'bps_stage_PS_PUSH{quantile="0.99"} 0.005',
+        '# TYPE bps_watch_incidents_total counter',
+        'bps_watch_incidents_total 2',
+        '# TYPE bps_watch_open_incidents gauge',
+        'bps_watch_open_incidents 1',
+        '# TYPE bps_watch_regime_flips_total counter',
+        'bps_watch_regime_flips_total 1',
+        '# TYPE bps_watch_ticks_total counter',
+        'bps_watch_ticks_total 12',
     ]) + "\n"
     assert prometheus_text(reg) == golden
 
