@@ -2504,6 +2504,10 @@ def ps_embed_breakdown(steps: int = 12, skip: int = 2,
                        ctrl_rows: int = 4096, ctrl_cols: int = 16,
                        ctrl_batch: int = 512,
                        ctrl_steps: int = 10) -> dict:
+    if "--kill-shard" in sys.argv[1:]:
+        # the ISSUE-20 durability choreography replaces the scaling
+        # arms: `bench.py ps_embed --kill-shard` (the CI smoke leg)
+        return ps_embed_kill_breakdown()
     """THE HEADLINE RIG (ISSUE 18): the sharded embedding store on REAL
     OS processes — embed-mode fleets (dp=2) driving a Zipfian trace
     against a 2²⁴-row table (server/embed.py: rows materialize lazily,
@@ -2646,6 +2650,188 @@ def ps_embed_breakdown(steps: int = 12, skip: int = 2,
     }
 
 
+def ps_embed_kill_breakdown(steps: int = 24, rows: int = 4096,
+                            cols: int = 16, batch: int = 512,
+                            step_sleep: float = 0.08,
+                            scrape_sec: float = 0.25,
+                            kill_after_steps: int = 4) -> dict:
+    """DURABILITY CHOREOGRAPHY (ISSUE 20, `bench.py ps_embed
+    --kill-shard`): an embed-mode fleet (dp=2 over THREE shards,
+    BPS_EMBED_REPLICAS=1 — every applied push is chain-forwarded to its
+    slice successor before the ack) has one shard SIGKILLed mid-run.
+
+    The workers' own fleet scrapers (fleet_worker: FleetScraper with
+    failover_backend=EmbedClient) plus their first connection error
+    fail the dead shard over to its chain successors; pushes in flight
+    retry under the same dedup token against the promoted primary
+    (exactly-once); and the bench-process watchtower — scraping the
+    same shard telemetry — must open a ``shard_dead`` incident naming
+    the killed shard with the failover remedy.
+
+    Asserted:
+      - the fleet FINISHES (both workers exit 0 with one shard gone),
+      - BPS_EMBED_VERIFY passes BITWISE on the degraded plane (worker 0
+        re-derives the final table analytically — dyadic deltas, exact
+        fp32 sums — and the promoted replicas must serve exactly it),
+      - every worker failed over (FLEET_RESULT failovers >= 1),
+      - the stall is bounded: per worker, at most 2 steps slower than
+        5x the median + 50 ms (the ps_elastic membership-event bound),
+      - the ``shard_dead`` incident opens within 3 detector windows of
+        the kill, blames the killed shard, and carries the embed
+        failover remedy (acted: false — observe mode never actuates).
+    """
+    import statistics
+    import tempfile as _tf
+
+    from byteps_tpu.launcher.fleet import FleetManifest, FleetSupervisor
+    from byteps_tpu.obs import metrics as obs_metrics
+    from byteps_tpu.obs import spans as obs_spans
+    from byteps_tpu.obs import tsdb as obs_tsdb
+    from byteps_tpu.obs import watchtower as wt
+
+    saved = {k: os.environ.get(k)
+             for k in ("BPS_STATS", "BPS_AUTOTUNE", "BPS_TSDB_DIR")}
+    try:
+        # arm the bench process's detector bank (the ps_watch idiom)
+        os.environ["BPS_STATS"] = "1"
+        os.environ["BPS_AUTOTUNE"] = "observe"
+        os.environ["BPS_TSDB_DIR"] = "off"
+        obs_metrics.configure()
+        wt.configure()
+        obs_tsdb.reset_process_sink()
+        obs_spans.reset()
+
+        man = FleetManifest(
+            stages=1, dp=2, shards=3, steps=steps,
+            extra_env={
+                "BPS_FLEET_MODE": "embed",
+                "BPS_EMBED_ROWS": str(rows),
+                "BPS_EMBED_COLS": str(cols),
+                "BPS_EMBED_BATCH": str(batch),
+                "BPS_EMBED_ZIPF_A": "1.1",
+                "BPS_EMBED_VERIFY": "1",
+                "BPS_FLEET_STEPS": str(steps),
+                "BPS_FLEET_STEP_SLEEP": str(step_sleep),
+                # the durability knobs under test
+                "BPS_EMBED_REPLICAS": "1",
+                "BPS_EMBED_SCRAPE_SEC": str(scrape_sec),
+                "BPS_EMBED_RECONNECT_SECS": "0.5",
+                # children stay pure: detection happens HERE
+                "BPS_AUTOTUNE": "off",
+                "BPS_TSDB_DIR": "off"})
+        sup = FleetSupervisor(man.build(), max_restarts=0,
+                              scrape_addrs=man.server_addrs,
+                              scrape_sec=scrape_sec)
+        watch = sup._scraper.watch
+        assert watch is not None, "observe mode did not arm the scraper"
+        engine = wt.get_engine()
+        window_s = 3 * watch.params["window"] * scrape_sec
+        victim = 1
+        out: dict = {"shape": {
+            "dp": 2, "shards": 3, "replicas": 1, "rows": rows,
+            "cols": cols, "batch": batch, "steps": steps,
+            "step_sleep": step_sleep, "scrape_sec": scrape_sec,
+            "victim": f"srv{victim}"}}
+        try:
+            sup.start()
+            # let the fleet make real progress, then murder the shard
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                sup.poll_once()
+                if len(sup.output_lines("w-s0r0", "FLEET_STEP ")) \
+                        >= kill_after_steps:
+                    break
+                time.sleep(0.05)
+            t_kill = time.time()
+            sup.kill(f"srv{victim}")
+            # the workers must DRAIN CLEAN on the degraded plane — the
+            # killed server legitimately sits at "failed" (restart
+            # budget 0), so wait on the worker roles, not the fleet
+            deadline = time.time() + 600
+            while time.time() < deadline:
+                sup.poll_once()
+                wstates = [m.state for m in sup._managed.values()
+                           if m.spec.role == "worker"]
+                if all(s == "done" for s in wstates):
+                    break
+                assert "failed" not in wstates, (
+                    f"worker died after the shard kill: {sup.status()} "
+                    f"(logs: {sup.logdir})")
+                time.sleep(0.1)
+            else:
+                raise AssertionError(
+                    f"fleet did not drain: {sup.status()} "
+                    f"(logs: {sup.logdir})")
+            # the watchtower verdict: dead shard, failover remedy
+            while time.time() < t_kill + window_s:
+                if any(i["kind"] == "shard_dead"
+                       for i in engine.incidents()):
+                    break
+                time.sleep(0.1)
+            time.sleep(4 * scrape_sec)   # let the stale verdict land
+            incidents = engine.incidents()
+
+            results, stalls = {}, {}
+            for w in ("w-s0r0", "w-s0r1"):
+                line = sup.output_lines(w, "FLEET_RESULT ")[-1]
+                results[w] = json.loads(line[len("FLEET_RESULT "):])
+                walls = [json.loads(l[len("FLEET_STEP "):])["wall_s"]
+                         for l in sup.output_lines(w, "FLEET_STEP ")]
+                med = statistics.median(walls)
+                stalls[w] = [round(x, 3) for x in walls
+                             if x > 5 * med + 0.05]
+        finally:
+            sup.drain()
+
+        # ---- acceptance
+        assert results["w-s0r0"]["parity"] is True, (
+            "BITWISE verify failed on the degraded plane: "
+            f"{results['w-s0r0']} (logs: {sup.logdir})")
+        for w, r in results.items():
+            assert r["failovers"] >= 1, (
+                f"{w} never failed over the killed shard: {r}")
+            assert len(stalls[w]) <= 2, (
+                f"{w} stalled {len(stalls[w])} steps (> 2) across ONE "
+                f"membership event: {stalls[w]}")
+        dead = [i for i in incidents if i["kind"] == "shard_dead"]
+        assert dead, (
+            "watchtower never opened shard_dead for the killed embed "
+            f"shard:\n{wt.format_timeline(incidents)}")
+        assert dead[0]["blamed"] == {"shard": f"s{victim}"}, dead[0]
+        rem = dead[0].get("remedy") or {}
+        assert rem.get("knob") == "fleet.RESHAPE" \
+            and "BPS_EMBED_REPLICAS" in (rem.get("action") or "") \
+            and rem.get("acted") is False, (
+            f"shard_dead must carry the (unacted) embed failover "
+            f"remedy: {rem}")
+        lat = round(dead[0]["opened_t"] - t_kill, 3)
+        assert lat <= window_s, (
+            f"shard_dead took {lat}s > {window_s:.1f}s "
+            f"(3 detector windows)")
+        out.update({
+            "finished_degraded": True,
+            "bitwise_parity": True,
+            "failovers": {w: r["failovers"]
+                          for w, r in results.items()},
+            "stall_steps": {w: len(s) for w, s in stalls.items()},
+            "shard_dead": {"blamed": dead[0]["blamed"],
+                           "latency_s": lat,
+                           "window_s": round(window_s, 1),
+                           "remedy": rem.get("knob")},
+        })
+        return out
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        obs_metrics.configure()
+        wt.configure()
+        obs_tsdb.reset_process_sink()
+        obs_spans.reset()
+
+
 # dispatch table: name -> the breakdown callable, DIRECT references
 # (partial for pinned args) — `--help` renders each entry's docstring
 # first line, so a bench that lands here is documented by construction
@@ -2691,6 +2877,9 @@ def _usage() -> str:
         "",
         "--stats        attach the obs metrics-registry summary",
         "--fleet-stats  attach per-shard fleet telemetry columns",
+        "--kill-shard   (ps_embed only) run the durability",
+        "               choreography: SIGKILL one replicated embed",
+        "               shard mid-run, assert failover + bitwise parity",
     ]
     return "\n".join(lines)
 

@@ -82,8 +82,8 @@ def save_checkpoint(path: str, params: Any, opt_state: Any = None,
     _save_state(path, state)
 
 
-def save_sharded_checkpoint(path: str, trainer, step: Optional[int] = None
-                            ) -> None:
+def save_sharded_checkpoint(path: str, trainer, step: Optional[int] = None,
+                            embed=None) -> None:
     """Durable SHARDED state (``BPS_SHARDED_UPDATE=1``,
     docs/elasticity.md): full params (replicated — every rank holds
     them) plus THIS replica's owned 1/dp optimizer-state slices, one
@@ -102,7 +102,15 @@ def save_sharded_checkpoint(path: str, trainer, step: Optional[int] = None
     place LAST — the meta is the checkpoint's commit marker, and it
     names the slice directory it pairs with, so an interrupted re-save
     to the same path can never mix one save's slices with another's
-    params or meta."""
+    params or meta.
+
+    ``embed`` (optional, an ``EmbedClient``): the feature-store tables
+    ride the same checkpoint — rank 0 fans a per-shard row snapshot
+    into ``embed/s<step>/`` (``EmbedClient.save_checkpoint``, its own
+    meta-last marker inside) BEFORE the top-level meta rename, and the
+    meta records the embed step it pairs with. Never-written rows are
+    not dumped and lazy-materialize identically after restore
+    (docs/embedding.md)."""
     st = getattr(trainer, "_sharded", None)
     chunked = getattr(trainer, "_chunked", None)
     if st is None or chunked is None or not chunked.decomposable:
@@ -125,8 +133,13 @@ def save_sharded_checkpoint(path: str, trainer, step: Optional[int] = None
         os.replace(tmp, os.path.join(shard_dir, f"g{gi}.bin"))
     if plan.rank != 0:
         return
-    # params next, the meta rename LAST (commit marker — see docstring)
+    # params next, then embed (its own committed sub-marker), the
+    # top-level meta rename LAST (commit marker — see docstring)
     _save_state(path, {"params": params})
+    embed_meta = None
+    if embed is not None:
+        embed_meta = embed.save_checkpoint(
+            os.path.join(path, "embed"), step_val)
     meta = {
         "step": step_val,
         "sharded": {
@@ -138,13 +151,17 @@ def save_sharded_checkpoint(path: str, trainer, step: Optional[int] = None
             "groups": [list(g) for g in plan.groups],
         },
     }
+    if embed_meta is not None:
+        meta["embed"] = {"dir": "embed", "step": step_val,
+                         "table": embed_meta.get("table"),
+                         "shards": embed_meta.get("shards")}
     tmp = os.path.join(path, f".bps_meta.json.tmp.{os.getpid()}")
     with open(tmp, "w") as f:
         json.dump(meta, f)
     os.replace(tmp, os.path.join(path, "bps_meta.json"))
 
 
-def restore_sharded_checkpoint(path: str, params_like: Any):
+def restore_sharded_checkpoint(path: str, params_like: Any, embed=None):
     """Read a sharded checkpoint: (params, {group: opt-state blob},
     step, meta). Blobs are raw ``pack_opt_state`` bytes — the caller
     (``DistributedTrainer.restore_sharded``) unpacks each against a
@@ -154,10 +171,20 @@ def restore_sharded_checkpoint(path: str, params_like: Any):
     are returned regardless of the saved owner map — any rank can
     adopt any group (the kill-and-replace path). Stale slices from an
     interrupted or superseded save live in other per-step directories
-    and are never read."""
+    and are never read.
+
+    ``embed`` (optional, an ``EmbedClient`` dialed at the restored
+    plane): when the meta carries an embed marker, the feature-store
+    rows are fanned back to their shards (``restore_checkpoint`` on the
+    client — epoch-bumped server-side so stale worker caches drop)."""
     path = os.path.abspath(path)
     with open(os.path.join(path, "bps_meta.json")) as f:
         meta = json.load(f)
+    if embed is not None and "embed" in meta:
+        em = meta["embed"]
+        embed.restore_checkpoint(
+            os.path.join(path, em.get("dir", "embed")),
+            step=em.get("step"))
     if "sharded" not in meta:
         raise ValueError(
             f"{path} is not a sharded checkpoint (no membership meta) "

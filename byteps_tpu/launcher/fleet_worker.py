@@ -277,6 +277,9 @@ def _run_embed() -> int:
     # are exact and the verify expectation is unchanged.
     push_every = max(1, _env_int("BPS_EMBED_PUSH_EVERY", 1))
     zipf_a = float(os.environ.get("BPS_EMBED_ZIPF_A", "1.1") or 1.1)
+    # same knob rounds mode honors: the kill-shard bench stretches the
+    # run so the mid-run fault lands between steps, not after drain
+    sleep_s = float(os.environ.get("BPS_FLEET_STEP_SLEEP", "0") or 0)
     addrs = [a for a in os.environ.get("BPS_SERVER_ADDRS", "").split(",")
              if a]
     if not addrs:
@@ -284,8 +287,21 @@ def _run_embed() -> int:
               flush=True)
         return 2
     wait_for_ports(addrs, timeout_s=60.0)
+    # replication rides env (BPS_EMBED_REPLICAS, defaulting to
+    # BPS_PLANE_REPLICAS) straight into the client ctor
     cli = EmbedClient.connect(addrs, table_id=0, num_rows=rows,
                               cols=cols, seed=seed)
+    scraper = None
+    if cli.replicas > 0:
+        # acted-on liveness: a black-holed shard (not just a refused
+        # dial) is declared dead by the scrape cadence and failed over
+        # through cli.note_stale — the same scraper/failover_backend
+        # wiring the dense plane uses (docs/elasticity.md)
+        from ..obs.fleet import FleetScraper
+        interval = float(os.environ.get("BPS_EMBED_SCRAPE_SEC", "0.5")
+                         or 0.5)
+        scraper = FleetScraper(cli, interval_sec=interval,
+                               failover_backend=cli).start()
     dense_ids = (np.arange(rows, dtype=np.uint64) if dense else None)
     fetch = []
     acc_ids, acc_deltas = [], []
@@ -303,6 +319,8 @@ def _run_embed() -> int:
                      np.concatenate(acc_deltas, axis=0))
             acc_ids, acc_deltas = [], []
         cli.tick()
+        if sleep_s:
+            time.sleep(sleep_s)
         print("FLEET_STEP " + json.dumps(
             {"worker": wid, "step": s,
              "wall_s": round(time.time() - t0, 4),
@@ -321,6 +339,9 @@ def _run_embed() -> int:
     if verify and wid == 0:
         parity = _embed_verify(addrs, seed, dp, steps, rows, cols,
                                batch, zipf_a)
+    if scraper is not None:
+        scraper.stop()
+    failovers = cli.failovers
     cli.close()
     fs = sorted(fetch)
 
@@ -336,7 +357,8 @@ def _run_embed() -> int:
          "fetch_p50_s": round(q(0.50), 5),
          "fetch_p99_s": round(q(0.99), 5),
          "lookups_per_s": round(batch * steps / wall, 1),
-         "wall_s": round(wall, 3), "parity": parity}), flush=True)
+         "wall_s": round(wall, 3), "parity": parity,
+         "failovers": failovers}), flush=True)
     return 0 if parity in (None, True) else 3
 
 

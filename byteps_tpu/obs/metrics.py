@@ -118,10 +118,14 @@ LAG_GAUGES: Tuple[str, ...] = ("lag/max_streak",)
 # version-validated "unchanged"), full-row fetch bytes, rows pushed
 # after the client-side dedup fold, and the live cache size —
 # pre-registered so the Prometheus export names the embedding plane's
-# families before the first table is declared.
+# families before the first table is declared. The durability trio
+# (ISSUE 20): rows forward-logged to chain successors, failover
+# promotions replayed from the replica log, and table-epoch bumps
+# (server promotions/restores + client cache invalidations).
 EMBED_COUNTERS: Tuple[str, ...] = (
-    "embed/cache_hits", "embed/cache_misses", "embed/row_fetch_bytes",
-    "embed/rows_pushed")
+    "embed/cache_hits", "embed/cache_misses", "embed/epoch_bumps",
+    "embed/failover_replays", "embed/replicated_rows",
+    "embed/row_fetch_bytes", "embed/rows_pushed")
 EMBED_GAUGES: Tuple[str, ...] = ("embed/hot_set_size",)
 
 # Fleet watchtower (byteps_tpu.obs.watchtower): detector ticks, opened

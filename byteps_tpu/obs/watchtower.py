@@ -101,7 +101,9 @@ REMEDIES: Dict[str, Dict[str, Optional[str]]] = {
     "straggler": {"knob": "BPS_MAX_LAG",
                   "action": "raise bounded-staleness K-lag"},
     "dead": {"knob": "fleet.RESHAPE",
-             "action": "respawn/replace the shard via the supervisor"},
+             "action": "respawn/replace the shard via the supervisor "
+                       "(replicated embed slices fail over to their "
+                       "chain successor meanwhile — BPS_EMBED_REPLICAS)"},
     "cache": {"knob": "BPS_EMBED_CACHE_ROWS",
               "action": "grow the hot-row cache / lower push "
                         "frequency"},

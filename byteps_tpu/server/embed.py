@@ -38,16 +38,43 @@ Wire formats (transport ops OP_EMBED_INIT/PULL/PUSH, all u64 ids
 little-endian via numpy, lengths framed by the transport header):
 
   INIT  payload = JSON table meta {table, rows, cols, dtype, seed,
-        shard, shards}; idempotent first-wins, conflicting re-declare
-        refused loudly.
+        shard, shards[, replicas, addrs]}; idempotent first-wins,
+        conflicting re-declare refused loudly. ``replicas``/``addrs``
+        (present when replication is on) teach each server its slice's
+        chain successors and how to dial them.
   PULL  payload  = n:u32 | ids:u64[n] | cached_versions:u64[n]
-        response = flags:u8[n] | versions:u64[n] | rows (full row for
-        each flag==1, request order). flag==0 means the cached version
-        is current — no row bytes cross the wire.
+                   [| table_epoch:u64]
+        response = table_epoch:u64 | flags:u8[n] | versions:u64[n] |
+        rows (full row for each flag==1, request order). flag==0 means
+        the cached version is current — no row bytes cross the wire. A
+        request epoch BEHIND the table's (a failover promoted this
+        server, or a snapshot restore re-seeded it, since the client
+        last looked) forces every row full — cached versions from the
+        pre-epoch server must never validate as "unchanged"
+        (docs/embedding.md failure matrix).
   PUSH  payload = n:u32 | ids:u64[n] | deltas:dtype[n·cols]; server
         folds any remaining duplicates and applies row += delta with a
         version bump per touched row; rides the push-dedup token so a
-        reconnect retry applies exactly once.
+        reconnect retry applies exactly once. With replication on, the
+        applied rows' ABSOLUTE post-apply state (+ versions + the dedup
+        token) is forward-logged to the slice's chain successors BEFORE
+        the ack (OP_EMBED_REPL) — an acked push is never lost, and a
+        retry across a failover is deduped by the token the log carried
+        (exactly-once, tests/test_embed.py).
+
+Durability (ISSUE 20): rows are replicated per SLICE — the (table,
+origin shard) unit ``row_shard`` carves — along the same consistent-
+hash successor walk the dense plane's ``backups_of`` rides (PR 13).
+``slice_chain``/``slice_primary`` are pure functions of (key, shard
+count, dead set), so every worker and every server derive identical
+chains and failover routing with no coordination. A promoted successor
+installs the logged absolute rows + versions (``failover``), seeds the
+push-dedup tokens the log carried, and bumps the table's EPOCH so
+worker hot-row caches drop versions the promoted replica never issued.
+Snapshots (``snapshot_state``/``save_shard``) dump live rows +
+versions + metas per shard with the checkpoint module's atomic
+tmp+rename discipline; restore bumps the epoch past the saved one and
+leaves never-written rows to lazy init.
 
 The hierarchical tier (server/hier.py) is NOT a valid front for these
 ops: an aggregator's local fold has no row store, and silently passing
@@ -128,6 +155,68 @@ def init_rows(seed: int, ids, cols: int, dtype: str = "float32"
     return (((k - 512) / 1024.0) / 8.0).astype(np.dtype(dtype))
 
 
+# ------------------------------------------------- replication chain
+#
+# The replication/failover unit is the SLICE: the set of a table's rows
+# that ``row_shard`` places on one origin shard. Its wire key packs the
+# origin into the table key's free low 16 bits (table_key uses
+# ``tid << 16``), and its chain is the consistent-hash successor walk
+# of that key — the same HashRing the dense plane's ``backups_of``
+# rides, so placement and replication speak one geometry. All three
+# functions are PURE in (key, num_shards, dead set): every worker and
+# every server derive the identical chain with no coordination.
+
+_RINGS: Dict[int, object] = {}
+_RINGS_LOCK = threading.Lock()
+
+
+def _ring(num_shards: int):
+    r = _RINGS.get(num_shards)
+    if r is None:
+        with _RINGS_LOCK:
+            r = _RINGS.get(num_shards)
+            if r is None:
+                from .plane.placement import HashRing
+                r = _RINGS[num_shards] = HashRing(int(num_shards))
+    return r
+
+
+def slice_key(key: int, shard: int) -> int:
+    """Wire key of the (table, origin shard) slice — the low 16 bits of
+    a table key are free (``table_key`` packs the id at bit 16)."""
+    if not 0 <= int(shard) < (1 << 16):
+        raise ValueError(f"shard {shard} outside [0, 65536)")
+    return int(key) | int(shard)
+
+
+def slice_chain(key: int, shard: int, num_shards: int, replicas: int,
+                dead=()) -> List[int]:
+    """The slice's replication chain: its first ``replicas`` LIVE ring
+    successors (origin excluded). ``BPS_EMBED_REPLICAS=R`` forward-logs
+    every applied row here, so R successive shard deaths leave at least
+    one chain member holding the slice's absolute row state."""
+    skip = {int(d) for d in dead}
+    skip.add(int(shard))
+    return _ring(num_shards).successors(slice_key(key, shard),
+                                        int(replicas), skip=skip)
+
+
+def slice_primary(key: int, shard: int, num_shards: int, dead=()) -> int:
+    """The shard SERVING the slice: the origin while it lives, else the
+    first live ring successor — exactly where the forward log went, so
+    promotion lands on the replica that already holds the rows."""
+    dead = {int(d) for d in dead}
+    if int(shard) not in dead:
+        return int(shard)
+    order = _ring(num_shards).successors(slice_key(key, shard),
+                                         int(num_shards), skip=dead)
+    if not order:
+        raise RuntimeError(
+            f"embed slice {slice_key(key, shard):#x}: no live shards "
+            f"left to serve it")
+    return order[0]
+
+
 # ------------------------------------------------------------- server
 
 
@@ -137,7 +226,7 @@ class _Table:
     generalization of StaleStore's per-key rounds)."""
 
     __slots__ = ("meta", "num_rows", "cols", "dtype", "seed", "row_nbytes",
-                 "rows", "vers", "lock")
+                 "rows", "vers", "epoch", "lock")
 
     def __init__(self, meta: dict) -> None:
         self.meta = dict(meta)
@@ -150,6 +239,12 @@ class _Table:
             raise ValueError(f"bad table shape {self.num_rows}x{self.cols}")
         self.rows: Dict[int, np.ndarray] = {}
         self.vers: Dict[int, int] = {}
+        # per-table epoch, carried in every pull response: bumped when a
+        # failover promotes this server for one of the table's slices or
+        # a snapshot restore re-seeds the store — a client seeing a new
+        # epoch drops its cached row versions for the table instead of
+        # validating them against versions this server never issued
+        self.epoch = 0
         self.lock = threading.Lock()
 
     def _row(self, rid: int) -> np.ndarray:
@@ -180,14 +275,52 @@ class _Table:
             self.vers[rid] = 1
 
 
+# recent dedup tokens retained per replica slice: far beyond any retry
+# window (the transport's exact-membership window is 256 seqs per
+# incarnation), bounded so a long-lived chain member cannot grow without
+# limit
+_SLICE_TOKENS = 4096
+
+
 class EmbedRowStore:
     """Server-side sharded row store (transport-owned, like the act and
     param mailboxes — every deployment's server role speaks it, raw
-    PSServer engines included)."""
+    PSServer engines included).
 
-    def __init__(self) -> None:
+    ``dedup_seed(table_key, token)`` — when given (the transport passes
+    its push-dedup adopter) — lets a failover promotion seed the tokens
+    its replica log carried, so a worker retrying a push across the
+    failover is acknowledged without re-applying (exactly-once)."""
+
+    def __init__(self, dedup_seed=None) -> None:
         self._tables: Dict[int, _Table] = {}
         self._lock = threading.Lock()
+        self._dedup_seed = dedup_seed
+        # replication config, learned from the first INIT meta carrying
+        # it (the client sends replicas+addrs when replication is on;
+        # with replicas == 0 none of the state below is ever touched —
+        # the serve path stays byte-for-byte the PR-18 one)
+        self.shard = 0
+        self.num_shards = 1
+        self.replicas = 0
+        self.addrs: List[str] = []
+        self._dead: set = set()
+        # slices hosted FOR other shards: slice key -> {"rows":
+        # {rid: (bytes, version)}, "tokens": OrderedDict (recency)}
+        self._replica: Dict[int, dict] = {}
+        # slices this server was promoted for (idempotent failover) and,
+        # per slice this server forwards, the chain members known to
+        # hold every record so far (a member joining after a chain death
+        # gets one full-slice sync before deltas resume)
+        self._promoted: set = set()
+        self._chain_ok: Dict[int, set] = {}
+        self._peers: Dict[int, object] = {}
+        self._peer_lock = threading.Lock()
+        from ..obs.metrics import get_registry
+        reg = get_registry()
+        self._m_repl_rows = reg.counter("embed/replicated_rows")
+        self._m_replays = reg.counter("embed/failover_replays")
+        self._m_epochs = reg.counter("embed/epoch_bumps")
 
     def init_table(self, key: int, meta: dict) -> None:
         """Idempotent first-wins declaration; a conflicting re-declare
@@ -195,6 +328,16 @@ class EmbedRowStore:
         loudly rather than silently serving rows at wrong offsets."""
         fresh = _Table(meta)
         with self._lock:
+            # replication config rides the INIT meta (first-wins, like
+            # the table declaration itself); shard/shards describe THIS
+            # server's place in the plane, so a later table re-declares
+            # the same values
+            if int(meta.get("replicas", 0) or 0) > 0 and not self.addrs:
+                self.shard = int(meta.get("shard", 0))
+                self.num_shards = int(meta.get("shards", 1))
+                self.replicas = max(0, min(int(meta["replicas"]),
+                                           self.num_shards - 1))
+                self.addrs = list(meta.get("addrs") or [])
             cur = self._tables.get(key)
             if cur is None:
                 self._tables[key] = fresh
@@ -214,43 +357,63 @@ class EmbedRowStore:
                            f"(OP_EMBED_INIT first)")
         return t
 
-    def pull(self, key: int, payload) -> Tuple[bytes, bytes, bytes]:
-        """Conditional sparse pull. Parses ``n | ids | cached_vers``;
-        returns (flags u8[n], versions u64[n], row bytes for the
-        flagged ids, request order). Rows are copied into ONE
-        contiguous buffer under the table lock — a concurrent push
-        mutates rows in place, and a torn row on the wire would be a
-        silent corruption; the flags/vers/rowbuf triple then rides one
-        vectored sendmsg with no further join."""
+    def pull(self, key: int, payload) -> Tuple[bytes, bytes, bytes, bytes]:
+        """Conditional sparse pull. Parses ``n | ids | cached_vers
+        [| epoch]``; returns (epoch u64, flags u8[n], versions u64[n],
+        row bytes for the flagged ids, request order). A client epoch
+        BEHIND the table's means the cached versions were issued by a
+        server this one replaced (failover) or a pre-restore
+        incarnation — every row is served FULL rather than trusting a
+        version match that means nothing across the epoch. Rows are
+        copied into ONE contiguous buffer under the table lock — a
+        concurrent push mutates rows in place, and a torn row on the
+        wire would be a silent corruption; the epoch/flags/vers/rowbuf
+        quad then rides one vectored sendmsg with no further join."""
         t = self.table(key)
         (n,) = struct.unpack_from("<I", payload, 0)
         ids = np.frombuffer(payload, np.uint64, count=n, offset=4)
         vers = np.frombuffer(payload, np.uint64, count=n, offset=4 + 8 * n)
+        cep = 0
+        if len(payload) >= 4 + 16 * n + 8:
+            (cep,) = struct.unpack_from("<Q", payload, 4 + 16 * n)
         if np.any(ids >= np.uint64(t.num_rows)):
             raise ValueError(f"row id out of range [0, {t.num_rows})")
         flags = np.zeros(n, np.uint8)
         out_vers = np.zeros(n, np.uint64)
         chunks: List[np.ndarray] = []
         with t.lock:
+            ep = t.epoch
+            stale_epoch = cep < ep   # pre-epoch cache (or a legacy
+            #                          epochless request): versions do
+            #                          not validate — full rows
             t.materialize(ids)
             for i in range(n):
                 rid = int(ids[i])
                 row = t.rows[rid]
                 v = t.vers[rid]
                 out_vers[i] = v
-                if v != int(vers[i]):
+                if stale_epoch or v != int(vers[i]):
                     flags[i] = 1
                     chunks.append(row)
             rowbuf = (np.concatenate(chunks).tobytes() if chunks
                       else b"")
-        return flags.tobytes(), out_vers.tobytes(), rowbuf
+        return (struct.pack("<Q", ep), flags.tobytes(),
+                out_vers.tobytes(), rowbuf)
 
-    def apply(self, key: int, payload) -> int:
+    def apply(self, key: int, payload, token: int = 0) -> int:
         """Row-wise sparse apply: ``row += delta`` with a version bump
         per touched row — NO dense expansion at any size. Clients fold
         duplicates before the wire; any that remain (a raw client) fold
         here first so each row's version moves once per push batch.
-        Returns the number of rows touched."""
+        Returns the number of rows touched.
+
+        With replication on, the touched rows' ABSOLUTE post-apply
+        state + versions are forward-logged to the slice's chain
+        successors before this returns (and therefore before the
+        transport acks) — chain-replication's invariant that an acked
+        mutation survives the primary. ``token`` is the push-dedup
+        token; it rides the log so a promoted replica can refuse a
+        worker's cross-failover retry of an already-replicated push."""
         t = self.table(key)
         (n,) = struct.unpack_from("<I", payload, 0)
         ids = np.frombuffer(payload, np.uint64, count=n, offset=4)
@@ -269,13 +432,297 @@ class EmbedRowStore:
             np.add.at(folded, inv, deltas)
         else:
             folded = deltas
+        fwd = None
         with t.lock:
             t.materialize(uniq)
             for i in range(uniq.size):
                 rid = int(uniq[i])
                 t.rows[rid] = t.rows[rid] + folded[i]
                 t.vers[rid] += 1
+            if self.replicas > 0 and self.num_shards > 1 and self.addrs:
+                # snapshot the post-apply state INSIDE the lock — the
+                # forwarded record must be the exact bytes this apply
+                # produced, not whatever a racing push left behind
+                fwd = (np.stack([t.rows[int(r)] for r in uniq]),
+                       np.array([t.vers[int(r)] for r in uniq],
+                                np.uint64))
+        if fwd is not None:
+            self._forward(key, uniq, fwd[1], fwd[0], token)
         return int(uniq.size)
+
+    # ------------------------------------------------ replication chain
+
+    def _peer(self, b: int):
+        """Lazily-dialed transport client for peer shard ``b`` —
+        single-address, like the plane's shard clients."""
+        p = self._peers.get(b)
+        if p is None:
+            with self._peer_lock:
+                p = self._peers.get(b)
+                if p is None:
+                    from .transport import RemotePSBackend
+                    p = self._peers[b] = RemotePSBackend(
+                        [self.addrs[b]], lazy_dial=True,
+                        conns_per_shard=1,
+                        reconnect_secs=_embed_reconnect_secs())
+        return p
+
+    def _forward(self, key: int, uniq: np.ndarray, vers: np.ndarray,
+                 rows: np.ndarray, token: int) -> None:
+        """Forward one apply's absolute row state to the chain of every
+        origin slice it touched (one slice on the healthy path — the
+        client groups pushes per origin; several only after failovers
+        landed foreign slices here). A chain member dying mid-forward
+        is a shard death like any other: mark it dead, recompute the
+        chain, full-sync any member that joined it, keep forwarding —
+        the apply that produced this record was healthy and must not
+        error. TimeoutError stays an application answer and surfaces."""
+        origins = row_shard(uniq, self.num_shards)
+        for o in np.unique(origins):
+            o = int(o)
+            mask = origins == o
+            rec = (struct.pack("<I", int(mask.sum()))
+                   + uniq[mask].tobytes() + vers[mask].tobytes()
+                   + np.ascontiguousarray(rows[mask]).tobytes())
+            skey = slice_key(key, o)
+            chain = [b for b in slice_chain(key, o, self.num_shards,
+                                            self.replicas, self._dead)
+                     if b != self.shard]
+            known = self._chain_ok.setdefault(skey, set(chain))
+            fails = 0
+            while chain:
+                b = chain[0]
+                try:
+                    if b not in known:
+                        self._sync_slice(key, o, b)
+                        known.add(b)
+                    self._peer(b).embed_repl(skey, token, rec)
+                    self._m_repl_rows.inc(int(mask.sum()))
+                    chain = chain[1:]
+                except TimeoutError:
+                    raise
+                except (ConnectionError, OSError) as e:
+                    fails += 1
+                    if fails > self.num_shards:
+                        raise
+                    self._dead.add(b)
+                    from ..common.logging import get_logger
+                    get_logger().warning(
+                        "embed: chain member s%d unreachable (%s) — "
+                        "recomputing slice %#x's chain", b, e, skey)
+                    chain = [c for c in slice_chain(
+                        key, o, self.num_shards, self.replicas,
+                        self._dead) if c != self.shard]
+
+    def _sync_slice(self, key: int, origin: int, peer: int) -> None:
+        """Full-slice catch-up for a chain member that joined after the
+        slice's birth (a prior member died): every live row of the
+        origin's slice, absolute, token-less. Rare (membership events
+        only) — never on the per-push path."""
+        t = self.table(key)
+        with t.lock:
+            live = np.array(sorted(t.rows), np.uint64)
+            if not live.size:
+                return
+            arr = live[row_shard(live, self.num_shards) == origin]
+            if not arr.size:
+                return
+            rids = [int(r) for r in arr]
+            rec = (struct.pack("<I", len(rids)) + arr.tobytes()
+                   + np.array([t.vers[r] for r in rids],
+                              np.uint64).tobytes()
+                   + np.stack([t.rows[r] for r in rids]).tobytes())
+        self._peer(peer).embed_repl(slice_key(key, origin), 0, rec)
+
+    def repl_apply(self, skey: int, token: int, payload) -> int:
+        """Install a forwarded record into the slice's replica log:
+        absolute rows + versions, last-wins per row by version (frames
+        from one primary are ordered per connection; a full-sync frame
+        racing a delta must not roll a row back). The dedup token is
+        retained (bounded recency window) so a failover promotion can
+        seed the transport's push dedup with every replicated push."""
+        tkey = int(skey) & ~0xFFFF
+        t = self.table(tkey)   # declared on every shard by the client
+        (n,) = struct.unpack_from("<I", payload, 0)
+        ids = np.frombuffer(payload, np.uint64, count=n, offset=4)
+        vers = np.frombuffer(payload, np.uint64, count=n, offset=4 + 8 * n)
+        rows = np.frombuffer(payload, t.dtype, offset=4 + 16 * n)
+        if rows.size != n * t.cols:
+            raise ValueError(f"replica payload {rows.size} != "
+                             f"{n}x{t.cols} rows")
+        rows = rows.reshape(n, t.cols)
+        with self._lock:
+            sl = self._replica.get(int(skey))
+            if sl is None:
+                sl = self._replica[int(skey)] = {
+                    "rows": {}, "tokens": OrderedDict()}
+            for i in range(n):
+                rid = int(ids[i])
+                old = sl["rows"].get(rid)
+                if old is None or int(vers[i]) >= old[1]:
+                    sl["rows"][rid] = (rows[i].tobytes(), int(vers[i]))
+            if token:
+                sl["tokens"][int(token)] = None
+                sl["tokens"].move_to_end(int(token))
+                while len(sl["tokens"]) > _SLICE_TOKENS:
+                    sl["tokens"].popitem(last=False)
+        return int(n)
+
+    def failover(self, skey: int, dead, observe: bool = False) -> dict:
+        """Promote this server for a slice whose primary died: install
+        the replica log's absolute rows + versions into the serving
+        table, seed the replicated dedup tokens, bump the table epoch.
+        Idempotent per slice (a second client racing the first gets the
+        same answer without a second epoch bump). Per-row install
+        errors are COLLECTED — every remaining row is still installed
+        and the epoch still bumps — and the first is re-raised after
+        the loop (the PR-13 ``fail_shard`` hardening: a double death
+        mid-replay must never leave the slice half-promoted forever).
+
+        ``observe=True`` adopts the dead set WITHOUT promoting — the
+        client broadcasts it to the healthy shards so their forward
+        chains skip the corpse immediately instead of each paying one
+        dial window discovering it on their next push."""
+        skey = int(skey)
+        tkey = skey & ~0xFFFF
+        src = skey & 0xFFFF
+        t = self.table(tkey)
+        if observe:
+            with self._lock:
+                self._dead.update(int(d) for d in (dead or ()))
+                self._dead.discard(self.shard)
+            with t.lock:
+                return {"observed": True, "epoch": t.epoch}
+        with self._lock:
+            self._dead.update(int(d) for d in (dead or ()))
+            self._dead.discard(self.shard)
+            already = skey in self._promoted
+            self._promoted.add(skey)
+            sl = self._replica.get(skey)
+            tokens = list(sl["tokens"]) if sl is not None else []
+        installed = 0
+        errors = 0
+        first_err: Optional[BaseException] = None
+        if not already:
+            with t.lock:
+                if sl is not None:
+                    for rid, (buf, ver) in list(sl["rows"].items()):
+                        try:
+                            arr = np.frombuffer(buf, t.dtype)
+                            if arr.size != t.cols:
+                                raise ValueError(
+                                    f"row {rid}: {arr.size} elems != "
+                                    f"{t.cols} cols")
+                            t.rows[rid] = arr.copy()
+                            t.vers[rid] = int(ver)
+                            installed += 1
+                        except Exception as e:   # noqa: BLE001 — collected
+                            errors += 1
+                            if first_err is None:
+                                first_err = e
+                t.epoch += 1
+                epoch = t.epoch
+            self._m_replays.inc()
+            self._m_epochs.inc()
+            if self._dedup_seed is not None:
+                for tok in tokens:
+                    self._dedup_seed(tkey, tok)
+            from ..common.logging import get_logger
+            get_logger().warning(
+                "embed: promoted for slice %#x (origin shard s%d): "
+                "%d row(s) installed, %d error(s), table epoch -> %d",
+                skey, src, installed, errors, epoch)
+        else:
+            with t.lock:
+                epoch = t.epoch
+        if first_err is not None:
+            raise first_err
+        return {"table": (tkey >> 16) & 0xFFFF, "slice": src,
+                "rows": installed, "errors": errors, "epoch": epoch,
+                "already": bool(already)}
+
+    # ---------------------------------------------------- durable state
+
+    def snapshot_state(self) -> Dict[str, np.ndarray]:
+        """This shard's live embed state as npz-ready arrays — one
+        ``e<key>|{meta,ids,vers,rows}`` quad per table. Only
+        MATERIALIZED rows are dumped (never-written rows lazy-init
+        identically after restore); the replica log is NOT dumped (the
+        primary's own snapshot is the durable copy of its slice)."""
+        out: Dict[str, np.ndarray] = {}
+        with self._lock:
+            tables = list(self._tables.items())
+        for key, t in tables:
+            with t.lock:
+                rids = sorted(t.rows)
+                meta = dict(t.meta)
+                meta["epoch"] = t.epoch
+                out[f"e{key}|meta"] = np.frombuffer(
+                    json.dumps(meta).encode(), np.uint8)
+                out[f"e{key}|ids"] = np.array(rids, np.uint64)
+                out[f"e{key}|vers"] = np.array(
+                    [t.vers[r] for r in rids], np.uint64)
+                rows = (np.stack([t.rows[r] for r in rids])
+                        if rids else np.zeros((0, t.cols), t.dtype))
+                out[f"e{key}|rows"] = rows.reshape(-1).view(np.uint8)
+        return out
+
+    def restore_state(self, entries: Dict[str, np.ndarray]) -> int:
+        """Re-seed tables from ``snapshot_state`` arrays. The restored
+        epoch is the saved one PLUS ONE: any client still holding row
+        versions from the pre-restart server must drop them (pushes
+        applied after the snapshot are gone — serving "unchanged"
+        against their versions would resurrect lost writes silently).
+        Never-written rows stay absent and lazy-materialize exactly as
+        before. Returns the number of rows restored."""
+        keys = sorted({int(name[1:].split("|", 1)[0])
+                       for name in entries if name.startswith("e")})
+        total = 0
+        for key in keys:
+            meta = json.loads(bytes(entries[f"e{key}|meta"].tobytes()
+                                    ).decode())
+            saved_epoch = int(meta.pop("epoch", 0))
+            self.init_table(key, meta)
+            t = self.table(key)
+            ids = entries[f"e{key}|ids"].astype(np.uint64)
+            vers = entries[f"e{key}|vers"].astype(np.uint64)
+            rows = np.frombuffer(entries[f"e{key}|rows"].tobytes(),
+                                 t.dtype).reshape(ids.size, t.cols)
+            with t.lock:
+                for i in range(ids.size):
+                    rid = int(ids[i])
+                    t.rows[rid] = rows[i].copy()
+                    t.vers[rid] = int(vers[i])
+                t.epoch = max(t.epoch, saved_epoch + 1)
+            total += int(ids.size)
+            self._m_epochs.inc()
+        return total
+
+    def save_shard(self, path: str) -> dict:
+        """Atomic npz dump of ``snapshot_state`` (tmp + os.replace, the
+        checkpoint module's discipline) — the OP_EMBED_SNAP handler."""
+        state = self.snapshot_state()
+        tmp = f"{path}.tmp.{os.getpid()}.npz"
+        np.savez(tmp, **state)
+        os.replace(tmp, path)
+        rows = sum(int(v.size) for k, v in state.items()
+                   if k.endswith("|ids"))
+        return {"tables": sum(1 for k in state if k.endswith("|meta")),
+                "rows": rows, "path": path}
+
+    def restore_shard(self, path: str) -> dict:
+        data = np.load(path)
+        rows = self.restore_state({n: data[n] for n in data.files})
+        return {"rows": rows, "path": path}
+
+    def close(self) -> None:
+        with self._peer_lock:
+            peers, self._peers = list(self._peers.values()), {}
+        for p in peers:
+            try:
+                p.close()
+            except Exception:   # noqa: BLE001 — best-effort teardown
+                pass
 
 
 # ------------------------------------------------------------- client
@@ -284,6 +731,21 @@ class EmbedRowStore:
 def _env_int(name: str, default: int) -> int:
     v = os.environ.get(name, "")
     return int(v) if v else default
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name, "")
+    return float(v) if v else default
+
+
+def _embed_reconnect_secs() -> float:
+    """Dial-retry window for replicated embed connections (client→
+    shard and server→successor). The plane's 30s BPS_RECONNECT_SECS
+    default assumes reconnect IS the recovery story; with a replica
+    chain it inverts — a dead peer should surface fast so the ring
+    reroutes, bounding the stall a death injects to ~one dial window
+    (BPS_EMBED_RECONNECT_SECS, default 2s)."""
+    return _env_float("BPS_EMBED_RECONNECT_SECS", 2.0)
 
 
 class EmbedClient:
@@ -310,12 +772,44 @@ class EmbedClient:
                  cols: int, dtype: str = "float32", seed: int = 0,
                  cache_rows: Optional[int] = None,
                  max_lag: Optional[int] = None,
-                 timeout_ms: int = 30000) -> None:
+                 timeout_ms: int = 30000,
+                 replicas: Optional[int] = None,
+                 addrs: Optional[Sequence[str]] = None) -> None:
         if not handles:
             raise ValueError("EmbedClient needs at least one shard handle")
         self._handles = list(handles)
         self._owned: List = []
         self.key = table_key(table_id)
+        self.table_id = int(table_id)
+        # replication: BPS_EMBED_REPLICAS defaults to the dense plane's
+        # BPS_PLANE_REPLICAS (one survivability story per deployment),
+        # clamped to the shard count like the plane does. addrs teach
+        # the SERVERS how to dial their chain successors — replication
+        # needs them (EmbedClient.connect supplies its dial list).
+        if replicas is None:
+            replicas = _env_int("BPS_EMBED_REPLICAS",
+                                _env_int("BPS_PLANE_REPLICAS", 0))
+        self.replicas = max(0, min(int(replicas), len(self._handles) - 1))
+        self._addrs = list(addrs or [])
+        if self.replicas > 0 and not self._addrs:
+            raise ValueError(
+                "embed replication needs the shard address list (use "
+                "EmbedClient.connect, or pass addrs=) — servers "
+                "forward-log row state to their chain successors and "
+                "must be able to dial them")
+        self._dead: set = set()
+        self._fail_lock = threading.Lock()
+        self._epoch = 0
+        self.failovers = 0
+        self._liveness_warned: set = set()
+        # cross-failover push-dedup tokens: ONE generator for the whole
+        # client (not per shard handle) — a retried push must land on
+        # the promoted replica with the token the dead primary's chain
+        # already logged, and tokens from different origin slices must
+        # never collide once a failover merges them onto one server
+        self._inc = int.from_bytes(os.urandom(4), "big") or 1
+        self._seq = 0
+        self._seq_lock = threading.Lock()
         self.num_rows = int(num_rows)
         self.cols = int(cols)
         self.dtype = np.dtype(dtype)
@@ -341,23 +835,216 @@ class EmbedClient:
         self._m_miss = reg.counter("embed/cache_misses")
         self._m_fetch_bytes = reg.counter("embed/row_fetch_bytes")
         self._m_rows_pushed = reg.counter("embed/rows_pushed")
+        self._m_epoch_bumps = reg.counter("embed/epoch_bumps")
         self._m_hot = reg.gauge("embed/hot_set_size")
         meta = {"table": int(table_id), "rows": self.num_rows,
                 "cols": self.cols, "dtype": str(self.dtype),
                 "seed": self.seed, "shards": len(self._handles)}
+        if self.replicas > 0:
+            meta["replicas"] = self.replicas
+            meta["addrs"] = self._addrs
+        failed: List[Tuple[int, BaseException]] = []
         for s, h in enumerate(self._handles):
-            h.embed_init(self.key, dict(meta, shard=s))
+            try:
+                h.embed_init(self.key, dict(meta, shard=s))
+            except TimeoutError:
+                raise
+            except (ConnectionError, OSError) as e:
+                # a client joining a plane that ALREADY lost a shard
+                # (the verify client after a kill, an elastic
+                # replacement worker): construction must survive and
+                # promote, not crash — replicas=0 keeps the old loud
+                # failure via fail_shard below
+                failed.append((s, e))
+        for s, e in failed:
+            self.fail_shard(s, cause=e)
 
     @classmethod
     def connect(cls, addrs: Sequence[str], table_id: int, num_rows: int,
                 cols: int, **kw) -> "EmbedClient":
         """Dial one single-address transport client per shard (owned —
-        closed by ``close``) and declare the table on each."""
+        closed by ``close``) and declare the table on each. Lazy dial:
+        a dead shard surfaces on its INIT rpc (handled by the ctor's
+        failover path when replication is on), never as a constructor
+        crash before the live shards were even declared."""
         from .transport import RemotePSBackend
-        handles = [RemotePSBackend([a]) for a in addrs]
-        cli = cls(handles, table_id, num_rows, cols, **kw)
+        reps = kw.get("replicas")
+        if reps is None:
+            reps = _env_int("BPS_EMBED_REPLICAS",
+                            _env_int("BPS_PLANE_REPLICAS", 0))
+        # replication inverts the reconnect story (see
+        # _embed_reconnect_secs); without it, keep the plane default
+        rc = ({"reconnect_secs": _embed_reconnect_secs()}
+              if int(reps) > 0 else {})
+        handles = [RemotePSBackend([a], lazy_dial=True, **rc)
+                   for a in addrs]
+        cli = cls(handles, table_id, num_rows, cols,
+                  addrs=list(addrs), **kw)
         cli._owned = handles
         return cli
+
+    # ------------------------------------------------------- liveness
+
+    def _token(self) -> int:
+        """Next push-dedup token (incarnation<<32 | seq) — allocated
+        once per shard batch and REUSED verbatim by the cross-failover
+        retry, so the promoted replica's seeded dedup recognizes it."""
+        with self._seq_lock:
+            self._seq += 1
+            if self._seq > 0xFFFFFFFF:
+                self._inc = int.from_bytes(os.urandom(4), "big") or 1
+                self._seq = 1
+            return (self._inc << 32) | self._seq
+
+    def _primary(self, shard: int) -> int:
+        """The shard SERVING origin ``shard``'s slice under the current
+        dead set — the pure ring walk every party shares."""
+        if shard not in self._dead:
+            return shard
+        return slice_primary(self.key, shard, len(self._handles),
+                             self._dead)
+
+    def fail_shard(self, shard: int,
+                   cause: Optional[BaseException] = None) -> None:
+        """Reroute + promote: mark the shard dead and ask the acting
+        primary of every dead origin's slice to install its replica log
+        (OP_EMBED_FAILOVER — idempotent server-side, so racing workers
+        and repeated deaths converge). Without replication there is
+        nothing to promote — the original error propagates loudly, the
+        plane's contract. Per-slice promotion errors are collected and
+        the first re-raised AFTER every slice was attempted (double
+        death must not strand later slices unpromoted forever)."""
+        shard = int(shard)
+        with self._fail_lock:
+            if shard in self._dead or not 0 <= shard < len(self._handles):
+                return
+            if self.replicas <= 0:
+                if cause is not None:
+                    raise cause
+                raise RuntimeError(
+                    f"embed shard {shard} unreachable and replication "
+                    f"is off (BPS_EMBED_REPLICAS=0) — no replica log "
+                    f"to fail over onto")
+            self._dead.add(shard)
+            dead = set(self._dead)
+        self.failovers += 1
+        if len(dead) > len(self._handles) - 1:
+            raise RuntimeError("embed plane: no live shards left")
+        from ..common.logging import get_logger
+        from ..obs import flight
+        get_logger().warning(
+            "embed: shard %d unreachable (%s) — failing table %d over "
+            "(dead=%s)", shard, cause, self.table_id, sorted(dead))
+        first_err: Optional[BaseException] = None
+        for o in sorted(dead):
+            p = self._primary(o)
+            body = json.dumps({"dead": sorted(dead)}).encode()
+            try:
+                resp = self._handles[p].embed_failover(
+                    slice_key(self.key, o), body,
+                    timeout_ms=self._timeout_ms)
+                st = json.loads(bytes(resp).decode())
+            except TimeoutError:
+                raise
+            except (ConnectionError, OSError) as e:
+                if first_err is None:
+                    first_err = e
+                continue
+            # membership events are FIRST-CLASS flight events, recorded
+            # key-less like the dense plane's (a postmortem under any
+            # key filter sees the epoch transition)
+            flight.record(
+                "embed_failover", outcome="failover",
+                detail=f"table {st.get('table', self.table_id)} slice "
+                       f"s{o} -> s{p}; rows={st.get('rows', 0)} "
+                       f"epoch={st.get('epoch', 0)}")
+            self._adopt_epoch(int(st.get("epoch", 0)))
+        # broadcast the dead set to the OTHER live shards (observe-only
+        # — no promotion) so their forward chains skip the corpse now
+        # instead of each paying one dial window on its next push.
+        # Best-effort: a shard that misses it discovers on its own.
+        primaries = {self._primary(o) for o in dead}
+        obs = json.dumps({"dead": sorted(dead),
+                          "observe": True}).encode()
+        for s in range(len(self._handles)):
+            if s in dead or s in primaries:
+                continue
+            try:
+                self._handles[s].embed_failover(
+                    self.key, obs, timeout_ms=self._timeout_ms)
+            except (TimeoutError, ConnectionError, OSError):
+                pass
+        if first_err is not None:
+            raise first_err
+
+    def note_stale(self, shard: int, age_s: Optional[float] = None,
+                   source: str = "fleet") -> bool:
+        """Scraper-observed liveness, ACTED ON (the plane backend's
+        contract, mirrored): a black-holed shard — answering no scrape
+        for 3 cadences, not just refusing connections — is declared
+        dead and failed over. replicas=0 keeps the verdict
+        observed-only with one warning per shard."""
+        if not 0 <= int(shard) < len(self._handles):
+            return False
+        shard = int(shard)
+        if shard in self._dead:
+            return False
+        if self.replicas <= 0:
+            if shard not in self._liveness_warned:
+                self._liveness_warned.add(shard)
+                from ..common.logging import get_logger
+                get_logger().warning(
+                    "embed: shard %d stale per %s (scrape age %.1fs) "
+                    "but replication is off — liveness verdict stays "
+                    "observed-only (no replica log to fail over onto)",
+                    shard, source,
+                    age_s if age_s is not None else -1.0)
+            return False
+        from ..obs import flight
+        flight.record(
+            "member_leave",
+            detail=f"embed shard {shard} declared dead by {source} "
+                   f"(scrape age {age_s if age_s is not None else '?'}s)")
+        self.fail_shard(shard, cause=TimeoutError(
+            f"{source}: scrape age "
+            f"{age_s if age_s is not None else '?'}s past the "
+            f"staleness line — black-holed embed shard declared dead"))
+        return True
+
+    def stats(self, timeout_ms: int = 5000) -> Dict[str, dict]:
+        """Fleet stats surface over the shard handles (the plane
+        backend's shape) so a ``FleetScraper`` can watch embed shards
+        and drive ``note_stale`` — per-shard failures become error
+        entries, never exceptions on the scrape thread."""
+        out: Dict[str, dict] = {}
+        for i, h in enumerate(self._handles):
+            label = f"s{i}"
+            if i in self._dead:
+                out[label] = {"error": "failed over (shard marked dead)"}
+                continue
+            try:
+                out[label] = h.stats_shard(0, timeout_ms)
+            except Exception as e:   # noqa: BLE001 — per-shard isolation
+                out[label] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
+    def _adopt_epoch(self, epoch: int) -> None:
+        """A pull response (or failover answer) carried a table epoch
+        ahead of ours: the rows we cached were versioned by a server
+        that no longer serves them — drop the WHOLE table cache rather
+        than ever validating a stale version as \"unchanged\"
+        (satellite fix, docs/embedding.md failure matrix)."""
+        if epoch <= self._epoch:
+            return
+        dropped = len(self._cache)
+        self._cache.clear()
+        self._epoch = int(epoch)
+        self._m_epoch_bumps.inc()
+        self._m_hot.set(0)
+        if dropped:
+            from ..obs import flight
+            flight.record("cache_inval", round=self._round,
+                          detail=f"epoch {epoch}: rows={dropped}")
 
     # ------------------------------------------------------------ pull
 
@@ -409,24 +1096,70 @@ class EmbedClient:
             for s in range(len(self._handles)):
                 pos = [need[j] for j in range(len(need)) if shards[j] == s]
                 if pos:
+                    # grouped per ORIGIN shard, ROUTED to its acting
+                    # primary — one unit per slice, so the failover
+                    # retry below re-resolves routing per item
                     work.append((s, pos))
 
             def one(item):
                 s, pos = item
                 rids = uniq[pos]
+                # cached rows captured WITH the versions we send: a
+                # flag==0 answer references these, and they must
+                # survive an epoch bump from ANOTHER shard's response
+                # clearing the cache while this one is decoded
+                kept = {int(r): self._cache[int(r)]
+                        for r in rids if int(r) in self._cache}
                 vers = np.array(
-                    [self._cache[int(r)][1] if int(r) in self._cache
-                     else 0 for r in rids], np.uint64)
+                    [kept[int(r)][1] if int(r) in kept else 0
+                     for r in rids], np.uint64)
                 payload = (struct.pack("<I", len(pos)) + rids.tobytes()
-                           + vers.tobytes())
-                return pos, self._handles[s].embed_pull(
-                    self.key, payload, timeout_ms=self._timeout_ms)
+                           + vers.tobytes()
+                           + struct.pack("<Q", self._epoch))
+                # the acting primary is captured WITH the attempt — the
+                # failover below must blame the shard the op actually
+                # ran on, not whatever routing resolves to after a
+                # concurrent failure already moved it
+                p = self._primary(s)
+                try:
+                    return pos, kept, self._handles[p].embed_pull(
+                        self.key, payload,
+                        timeout_ms=self._timeout_ms), None
+                except TimeoutError:
+                    raise
+                except (ConnectionError, OSError) as e:
+                    return item, p, None, e
 
-            for pos, resp in self._fanout(one, work):
+            results = self._fanout(one, work)
+            retries = [(item, p, err) for item, p, _r, err in results
+                       if err is not None]
+            if retries:
+                # one failover + one retry against the new routing —
+                # the plane backend's shape. fail_shard is idempotent;
+                # replicas=0 re-raises the cause there (loud).
+                for _item, p, err in retries:
+                    self.fail_shard(p, cause=err)
+                for item, _p, _err in retries:
+                    pos, kept, resp, err = one(item)
+                    if err is not None:
+                        raise err
+                    results.append((pos, kept, resp, None))
+            for pos, kept, resp, err in results:
+                if err is not None:
+                    continue                 # retried above
                 n = len(pos)
-                flags = np.frombuffer(resp, np.uint8, count=n)
-                vers = np.frombuffer(resp, np.uint64, count=n, offset=n)
-                rows = np.frombuffer(resp, self.dtype, offset=n + 8 * n)
+                (rep,) = struct.unpack_from("<Q", resp, 0)
+                if rep > self._epoch:
+                    # a failover/restore bumped the table since we last
+                    # looked: every cached version is void. The server
+                    # already force-sent full rows for THIS response
+                    # (our request epoch was behind) — drop the rest.
+                    self._adopt_epoch(rep)
+                flags = np.frombuffer(resp, np.uint8, count=n, offset=8)
+                vers = np.frombuffer(resp, np.uint64, count=n,
+                                     offset=8 + n)
+                rows = np.frombuffer(resp, self.dtype,
+                                     offset=8 + n + 8 * n)
                 rows = rows.reshape(-1, self.cols).copy()
                 fetched_bytes += rows.nbytes
                 # cache entries hold VIEWS into the one block copy
@@ -444,7 +1177,7 @@ class EmbedClient:
                     else:
                         # version unchanged: the cached bytes are
                         # current — a validated hit, zero row bytes
-                        row = self._cache[rid][0]
+                        row = kept[rid][0]
                         out[i] = row
                         self._m_hits.inc()
                     self._cache_put(rid, row, int(vers[j]))
@@ -497,16 +1230,39 @@ class EmbedClient:
         for s in range(len(self._handles)):
             mask = shards == s
             if np.any(mask):
-                work.append((s, uniq[mask],
-                             np.ascontiguousarray(folded[mask])))
+                # one request per ORIGIN slice (not per acting primary):
+                # the dedup token then maps to exactly one slice chain,
+                # so a cross-failover retry of this request is either
+                # fully replicated (deduped) or fully unseen (applied
+                # fresh) — never half of each
+                payload = (struct.pack("<I", int(mask.sum()))
+                           + uniq[mask].tobytes()
+                           + np.ascontiguousarray(folded[mask]).tobytes())
+                work.append((s, payload, self._token()))
 
         def one(item):
-            s, rids, rows = item
-            payload = (struct.pack("<I", rids.size) + rids.tobytes()
-                       + rows.tobytes())
-            self._handles[s].embed_push(self.key, payload)
+            s, payload, tok = item
+            p = self._primary(s)   # blamed on failure — see pull()
+            try:
+                # the token is allocated once per slice batch and rides
+                # the retry VERBATIM: the promoted replica seeded it
+                # from the replicated log iff the dead primary finished
+                # forwarding, which is exactly the applied-or-not line
+                self._handles[p].embed_push(self.key, payload, token=tok)
+                return None
+            except TimeoutError:
+                raise
+            except (ConnectionError, OSError) as e:
+                return item, p, e
 
-        self._fanout(one, work)
+        fails = [f for f in self._fanout(one, work) if f is not None]
+        if fails:
+            for _item, p, err in fails:
+                self.fail_shard(p, cause=err)
+            for item, _p, err in fails:
+                res = one(item)
+                if res is not None:
+                    raise res[2]
         self._m_rows_pushed.inc(int(uniq.size))
         inval = 0
         for rid in uniq:
@@ -517,6 +1273,89 @@ class EmbedClient:
             flight.record("cache_inval", round=self._round,
                           detail=f"rows={inval}")
             self._m_hot.set(len(self._cache))
+
+    # ----------------------------------------------------- checkpoints
+
+    def save_checkpoint(self, path: str, step: int) -> dict:
+        """Durable sharded embed snapshot: every live acting shard dumps
+        its row store (OP_EMBED_SNAP → atomic tmp+rename server-side)
+        into the per-step directory ``path/s<step>/``, then the client
+        commits by writing ``bps_embed_meta.json`` LAST (same meta-last
+        marker discipline as ``save_sharded_checkpoint``). A directory
+        without the meta file is an aborted save — restore ignores it."""
+        d = os.path.join(path, f"s{int(step)}")
+        os.makedirs(d, exist_ok=True)
+        with self._fail_lock:
+            dead = sorted(self._dead)
+        live = [s for s in range(len(self._handles)) if s not in set(dead)]
+
+        def one(s):
+            body = json.dumps(
+                {"path": os.path.join(d, f"shard{s}.npz")}).encode()
+            return s, json.loads(bytes(self._handles[s].embed_snap(
+                self.key, body, timeout_ms=self._timeout_ms)))
+
+        shards = {s: st for s, st in self._fanout(one, live)}
+        meta = {"step": int(step), "table": self.table_id,
+                "shards": len(self._handles), "live": live, "dead": dead,
+                "rows": sum(int(st.get("rows", 0))
+                            for st in shards.values())}
+        tmp = os.path.join(d, f".bps_embed_meta.{os.getpid()}.tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, os.path.join(d, "bps_embed_meta.json"))
+        from ..obs import flight
+        flight.record("embed_snap", round=self._round,
+                      detail=f"step {int(step)}: shards={len(live)} "
+                             f"rows={meta['rows']}")
+        return meta
+
+    def restore_checkpoint(self, path: str,
+                           step: Optional[int] = None) -> dict:
+        """Restore from the newest COMMITTED per-step directory (or an
+        explicit ``step``): adopts the saved dead-set so routing matches
+        the topology the files were cut against, then fans each shard
+        file back to the server it came from (OP_EMBED_RESTORE).
+        Server-side ``restore_state`` bumps the table epoch past the
+        saved one, so every client's next pull drops its cache; rows
+        never written before the save stay lazily materialized."""
+        if step is None:
+            steps = sorted(
+                int(n[1:]) for n in os.listdir(path)
+                if n.startswith("s") and n[1:].isdigit()
+                and os.path.exists(os.path.join(path, n,
+                                                "bps_embed_meta.json")))
+            if not steps:
+                raise FileNotFoundError(
+                    f"no committed embed checkpoint under {path}")
+            step = steps[-1]
+        d = os.path.join(path, f"s{int(step)}")
+        with open(os.path.join(d, "bps_embed_meta.json")) as f:
+            meta = json.load(f)
+        if int(meta.get("shards", 0)) != len(self._handles):
+            raise ValueError(
+                f"embed checkpoint cut at {meta.get('shards')} shards; "
+                f"this client has {len(self._handles)} — resharding a "
+                f"row-hashed table needs a rebalance pass, not a restore")
+        live = [int(s) for s in meta.get("live", [])]
+        with self._fail_lock:
+            self._dead = {int(s) for s in meta.get("dead", [])}
+
+        def one(s):
+            body = json.dumps(
+                {"path": os.path.join(d, f"shard{s}.npz")}).encode()
+            return s, json.loads(bytes(self._handles[s].embed_restore(
+                self.key, body, timeout_ms=self._timeout_ms)))
+
+        shards = {s: st for s, st in self._fanout(one, live)}
+        # the restored servers re-issued their epochs; drop everything
+        # local rather than waiting for the next pull to notice
+        self._adopt_epoch(self._epoch + 1)
+        from ..obs import flight
+        flight.record("embed_restore", round=self._round,
+                      detail=f"step {int(step)}: shards={len(live)} "
+                             f"rows={sum(int(st.get('rows', 0)) for st in shards.values())}")
+        return meta
 
     def close(self) -> None:
         if self._pool is not None:

@@ -190,11 +190,26 @@ OP_LAG_DECL, OP_PUSH_LAG, OP_PULL_LAG = 26, 27, 28
 # fleet server roles speak it; REFUSED on a hierarchical-agg front
 # (embed_store below — an aggregator has no row store to serve from).
 #   OP_EMBED_INIT: payload = JSON table meta; idempotent first-wins.
-#   OP_EMBED_PULL: payload = n:u32|ids:u64[n]|cached_vers:u64[n];
-#     response = flags:u8[n]|vers:u64[n]|full rows for flag==1 only.
+#   OP_EMBED_PULL: payload = n:u32|ids:u64[n]|cached_vers:u64[n]
+#     [|table_epoch:u64]; response = table_epoch:u64|flags:u8[n]|
+#     vers:u64[n]|full rows for flag==1 only. A request epoch behind
+#     the table's forces every row full (failover/restore coherence).
 #   OP_EMBED_PUSH: payload = n:u32|ids:u64[n]|deltas:dtype[n·cols];
 #     ``rnd`` = push dedup token — a reconnect retry applies once.
 OP_EMBED_INIT, OP_EMBED_PULL, OP_EMBED_PUSH = 29, 30, 31
+# Embed durability (ISSUE 20, server↔server + admin ops):
+#   OP_EMBED_REPL: chain forward of applied rows — key = slice key
+#     (table | origin shard), ``rnd`` = the originating push's dedup
+#     token, payload = n:u32|ids:u64[n]|vers:u64[n]|rows (ABSOLUTE
+#     post-apply state; last-wins by version on the replica).
+#   OP_EMBED_FAILOVER: promote this server for a dead slice — key =
+#     slice key, payload = JSON {"dead": [shards]}; response = JSON
+#     stats {table, slice, rows, errors, epoch, already}. Idempotent.
+#   OP_EMBED_SNAP / OP_EMBED_RESTORE: payload = JSON {"path"}; the
+#     server dumps/loads its whole row store as one npz (atomic
+#     tmp+rename on SNAP); response = JSON stats.
+OP_EMBED_REPL, OP_EMBED_FAILOVER = 32, 33
+OP_EMBED_SNAP, OP_EMBED_RESTORE = 34, 35
 _PART = struct.Struct("!IIHHQ")  # offset, part_len, part_idx, nparts, nonce
 _LAG_ROUND_MASK = (1 << 48) - 1
 ST_OK, ST_ERR, ST_TIMEOUT, ST_GONE = 0, 1, 2, 3
@@ -455,8 +470,10 @@ _REUSE_SAFE_OPS = frozenset(
      OP_PUSH_LAG,    # StaleStore.push folds (+=) before returning
      OP_EMBED_PUSH,  # EmbedRowStore.apply folds row-wise (new arrays)
                      # before returning
-     OP_EMBED_PULL})  # ids/vers views are consumed inside .pull()
-#                       (the row buffer is a fresh concatenation)
+     OP_EMBED_PULL,  # ids/vers views are consumed inside .pull()
+                     # (the row buffer is a fresh concatenation)
+     OP_EMBED_REPL})  # handler materializes via bytes() before
+#                       repl_apply stores per-row copies
 
 
 def _recv_req(sock: socket.socket, rholder: Optional[list] = None):
@@ -978,23 +995,53 @@ class PSTransportServer:
                     key, _json.loads(bytes(payload or b"{}")))
                 conn.sendall(_RSP.pack(ST_OK, 0))
             elif op == OP_EMBED_PULL:
-                flags, vers, rowbuf = self.embed_store().pull(key,
-                                                              payload)
-                # vectored: status + flags + versions + the row gather
-                # in ONE sendmsg — the zero-copy path the sparse pull
-                # rides (rows are copied once under the table lock,
-                # never joined again)
+                ep, flags, vers, rowbuf = self.embed_store().pull(
+                    key, payload)
+                # vectored: status + epoch + flags + versions + the row
+                # gather in ONE sendmsg — the zero-copy path the sparse
+                # pull rides (rows are copied once under the table
+                # lock, never joined again)
                 _send_frame(conn,
-                            _RSP.pack(ST_OK, len(flags) + len(vers)
-                                      + len(rowbuf)),
-                            [flags, vers, rowbuf])
+                            _RSP.pack(ST_OK, len(ep) + len(flags)
+                                      + len(vers) + len(rowbuf)),
+                            [ep, flags, vers, rowbuf])
             elif op == OP_EMBED_PUSH:
                 pay = payload   # consumed synchronously by apply()
                 plen_e = len(pay)
+                tok = int(rnd)
                 self._note_push(self._apply_push_once(
-                    key, rnd, lambda: self.embed_store().apply(key, pay)),
+                    key, rnd,
+                    lambda: self.embed_store().apply(key, pay,
+                                                     token=tok)),
                     key, rnd, plen_e)
                 conn.sendall(_RSP.pack(ST_OK, 0))
+            elif op == OP_EMBED_REPL:
+                self.embed_store().repl_apply(key, int(rnd),
+                                              bytes(payload or b""))
+                conn.sendall(_RSP.pack(ST_OK, 0))
+            elif op == OP_EMBED_FAILOVER:
+                import json as _json
+                req = _json.loads(bytes(payload or b"{}"))
+                st = self.embed_store().failover(
+                    key, req.get("dead") or (),
+                    observe=bool(req.get("observe")))
+                body = _json.dumps(st).encode()
+                conn.sendall(_RSP.pack(ST_OK, len(body)))
+                conn.sendall(body)
+            elif op == OP_EMBED_SNAP:
+                import json as _json
+                req = _json.loads(bytes(payload or b"{}"))
+                st = self.embed_store().save_shard(str(req["path"]))
+                body = _json.dumps(st).encode()
+                conn.sendall(_RSP.pack(ST_OK, len(body)))
+                conn.sendall(body)
+            elif op == OP_EMBED_RESTORE:
+                import json as _json
+                req = _json.loads(bytes(payload or b"{}"))
+                st = self.embed_store().restore_shard(str(req["path"]))
+                body = _json.dumps(st).encode()
+                conn.sendall(_RSP.pack(ST_OK, len(body)))
+                conn.sendall(body)
             elif op == OP_LAG_DECL:
                 self._lag_declare(key, int(rnd))
                 conn.sendall(_RSP.pack(ST_OK, 0))
@@ -1171,8 +1218,32 @@ class PSTransportServer:
                             "store — connect EmbedClient to the plane "
                             "shards (BPS_SERVER_ADDRS), not the agg")
                     from .embed import EmbedRowStore
-                    self._embed = EmbedRowStore()
+                    # the dedup-seed hook lets a failover promotion
+                    # install the replicated log's push tokens into
+                    # THIS server's dedup table — a worker retrying an
+                    # acked-at-the-dead-primary push lands here and is
+                    # acknowledged without re-applying (exactly-once
+                    # across failover, ISSUE 20)
+                    self._embed = EmbedRowStore(
+                        dedup_seed=self._seed_push_token)
         return self._embed
+
+    def _seed_push_token(self, key: int, token: int) -> None:
+        """Mark a push-dedup token as already applied for ``key`` —
+        the failover-replay half of ``_apply_push_once``'s contract
+        (tokens arrive via the replicated embed log, not the wire)."""
+        tok = int(token)
+        if not tok:
+            return
+        ident = (int(key), tok >> 32)
+        seq = tok & 0xFFFFFFFF
+        with self._push_lock:
+            st = self._push_seen.get(ident)
+            if st is None:
+                st = self._push_seen[ident] = _DedupState()
+            if not st.is_applied(seq):
+                st.record(seq)
+            st.ts = time.time()
 
     def param_store(self):
         """This server's param mailbox (sharded weight update,
@@ -1304,9 +1375,16 @@ class PSTransportServer:
         number of keys saved. Keys whose pull fails or times out (e.g. a
         sync-mode key with no completed round yet — async pulls return
         immediately) are skipped with a warning; the short per-key
-        timeout bounds the stall a sync-mode snapshot can cause."""
+        timeout bounds the stall a sync-mode snapshot can cause.
+
+        Embed tables ride the same file: live rows + versions + metas
+        as ``e<key>|…`` entries next to the dense ``k<key>|<dtype>``
+        ones (only when the embed store was ever touched — plain
+        deployments pay nothing)."""
+        embed = (self._embed.snapshot_state()
+                 if self._embed is not None else None)
         return snapshot_store(self.backend, list(self._key_meta.items()),
-                              path, timeout_ms)
+                              path, timeout_ms, embed=embed)
 
     def restore(self, path: str) -> int:
         """Re-seed the store from a snapshot. NOTE: this server accepts
@@ -1314,13 +1392,24 @@ class PSTransportServer:
         worker's INIT can't land first and pin its own values, restore
         the BACKEND before constructing the transport
         (``restore_snapshot`` + the ``key_meta`` ctor arg, as
-        bpslaunch-tpu --server does)."""
+        bpslaunch-tpu --server does). Embed ``e<key>|…`` entries (if
+        present) repopulate the row store and bump each table's epoch
+        past the saved one."""
         meta = restore_snapshot(self.backend, path)
         self._key_meta.update(meta)
+        data = np.load(path)
+        embed = {n: data[n] for n in data.files if n.startswith("e")}
+        if embed:
+            self.embed_store().restore_state(embed)
         return len(meta)
 
     def close(self) -> None:
         self._stop.set()
+        if self._embed is not None:
+            try:
+                self._embed.close()
+            except Exception:
+                pass
         self._shm.close()
         try:
             self._sock.close()
@@ -1341,11 +1430,13 @@ class PSTransportServer:
 # ------------------------------------------------------- state snapshots
 
 def snapshot_store(backend, key_meta, path: str,
-                   timeout_ms: int = 250) -> int:
+                   timeout_ms: int = 250, embed=None) -> int:
     """Dump ``key_meta`` (iterable of (key, (nbytes, dtype))) from
     ``backend`` to ``path`` atomically. Entries are named
     ``k<key>|<dtype>`` with raw-byte payloads, so dtypes numpy can't
-    round-trip through npz (bfloat16) survive."""
+    round-trip through npz (bfloat16) survive. ``embed`` (optional) is
+    an already-rendered ``EmbedRowStore.snapshot_state()`` dict whose
+    ``e<key>|…`` entries ride the same npz."""
     import os as _os
 
     from ..common.logging import get_logger
@@ -1359,6 +1450,8 @@ def snapshot_store(backend, key_meta, path: str,
             get_logger().warning("snapshot: skipping key %d: %s", key, e)
             continue
         arrays[f"k{key}|{dtype}"] = buf.view(np.uint8)
+    if embed:
+        arrays.update(embed)
     tmp = f"{path}.tmp.npz"
     np.savez(tmp, **arrays)
     _os.replace(tmp, path)         # atomic: readers never see a torn file
@@ -1371,11 +1464,14 @@ def restore_snapshot(backend, path: str):
     dtype) meta restored. Run this BEFORE the transport server starts
     accepting, or a fast-reconnecting worker's INIT can allocate the key
     first and the restored value is silently dropped (server-side init
-    is first-wins)."""
+    is first-wins). Non-dense entries (embed ``e<key>|…``) are left to
+    ``PSTransportServer.restore``."""
     from ..common.logging import get_logger
     data = np.load(path)
     meta = {}
     for name in data.files:
+        if not name.startswith("k"):
+            continue               # embed entries, handled by the caller
         keypart, dtype = name[1:].split("|", 1)
         key = int(keypart)
         arr = np.frombuffer(data[name].tobytes(), np.dtype(dtype))
@@ -1741,7 +1837,8 @@ class RemotePSBackend:
     # NIC outside the credit and nothing could overtake it
     _SCHED_GRAD_OPS = frozenset({OP_PUSH, OP_PUSH_C, OP_PUSH_RS,
                                  OP_PUSH_PART, OP_PUSH_F, OP_REPL_PUT,
-                                 OP_PUSH_LAG, OP_EMBED_PUSH})
+                                 OP_PUSH_LAG, OP_EMBED_PUSH,
+                                 OP_EMBED_REPL})
 
     def _rpc(self, op: int, key: int, rnd: int, nbytes: int,
              timeout_ms: int, dtype: str, payload: Optional[memoryview],
@@ -2456,12 +2553,47 @@ class RemotePSBackend:
         return self._rpc(OP_EMBED_PULL, key, 0, 0, timeout_ms,
                          "uint8", memoryview(payload))
 
-    def embed_push(self, key: int, payload) -> None:
+    def embed_push(self, key: int, payload,
+                   token: Optional[int] = None) -> None:
         """Row-sparse delta push (ids + folded rows); dedup-tokenized
         like any push so a reconnect retry applies exactly once, and
-        CLASS_GRAD in the wire scheduler like any gradient burst."""
-        self._rpc(OP_EMBED_PUSH, key, self._push_token(key), 0, 0,
+        CLASS_GRAD in the wire scheduler like any gradient burst.
+        ``token`` lets the caller pin the dedup token across a
+        FAILOVER retry (EmbedClient allocates one per slice batch and
+        resends it verbatim to the promoted replica — the replicated
+        log already carries it iff the dead primary applied)."""
+        self._rpc(OP_EMBED_PUSH, key,
+                  self._push_token(key) if token is None else int(token),
+                  0, 0, "uint8", memoryview(payload))
+
+    def embed_repl(self, key: int, token: int, payload,
+                   timeout_ms: int = 30000) -> None:
+        """Chain forward of applied rows to a slice successor (server→
+        server): absolute post-apply state + versions, dedup token in
+        ``rnd`` so the replica can seed exactly-once across failover."""
+        self._rpc(OP_EMBED_REPL, key, int(token), 0, timeout_ms,
                   "uint8", memoryview(payload))
+
+    def embed_failover(self, key: int, payload,
+                       timeout_ms: int = 30000) -> bytes:
+        """Promote this client's server for a dead slice (``key`` = the
+        slice key); returns the server's JSON stats body."""
+        return self._rpc(OP_EMBED_FAILOVER, key, 0, 0, timeout_ms,
+                         "uint8", memoryview(payload))
+
+    def embed_snap(self, key: int, payload,
+                   timeout_ms: int = 60000) -> bytes:
+        """Ask this client's server to dump its embed row store to the
+        JSON-named path (atomic tmp+rename); returns JSON stats."""
+        return self._rpc(OP_EMBED_SNAP, key, 0, 0, timeout_ms,
+                         "uint8", memoryview(payload))
+
+    def embed_restore(self, key: int, payload,
+                      timeout_ms: int = 60000) -> bytes:
+        """Ask this client's server to load its embed row store from
+        the JSON-named path; returns JSON stats."""
+        return self._rpc(OP_EMBED_RESTORE, key, 0, 0, timeout_ms,
+                         "uint8", memoryview(payload))
 
     def pull_bytes(self, key: int, round: int = 0,
                    timeout_ms: int = 30000) -> bytes:

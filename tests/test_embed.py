@@ -402,3 +402,492 @@ def test_store_rejects_out_of_range_rows():
            + np.zeros(1, np.uint64).tobytes())
     with pytest.raises(ValueError, match="out of range"):
         store.pull(key, bad)
+
+
+# =====================================================================
+# Durability (ISSUE 20): chain replication, failover replay, epochs,
+# exactly-once across failover, sharded snapshots
+# =====================================================================
+
+import socket as _socket
+import struct as _struct
+import threading as _threading
+
+from byteps_tpu.server.embed import slice_chain, slice_key, slice_primary
+
+
+class _Proxy:
+    """Killable TCP pass-through: lets a test sever a LIVE shard's
+    connections (a transport ``close()`` only stops the listener — the
+    accepted sockets keep serving, which is not what SIGKILL does)."""
+
+    def __init__(self, upstream_port: int) -> None:
+        self._up = upstream_port
+        self._sock = _socket.socket()
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._pairs = []
+        self._lock = _threading.Lock()
+        self.dead = False
+        _threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self) -> None:
+        while True:
+            try:
+                c, _ = self._sock.accept()
+            except OSError:
+                return
+            if self.dead:
+                c.close()
+                continue
+            u = _socket.create_connection(("127.0.0.1", self._up))
+            with self._lock:
+                self._pairs.append((c, u))
+            for a, b in ((c, u), (u, c)):
+                _threading.Thread(target=self._pump, args=(a, b),
+                                  daemon=True).start()
+
+    @staticmethod
+    def _pump(a, b) -> None:
+        try:
+            while True:
+                d = a.recv(65536)
+                if not d:
+                    break
+                b.sendall(d)
+        except OSError:
+            pass
+        for s in (a, b):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def kill(self) -> None:
+        self.dead = True
+        with self._lock:
+            for pair in self._pairs:
+                for s in pair:
+                    try:
+                        s.shutdown(_socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+@pytest.fixture()
+def rplane(monkeypatch):
+    """Three shards, each behind a killable proxy, with a FAST dial
+    window so a death surfaces in ~0.2s instead of the 2s default."""
+    monkeypatch.setenv("BPS_EMBED_RECONNECT_SECS", "0.2")
+    servers, proxies, addrs = [], [], []
+    for _ in range(3):
+        srv = PSServer(num_workers=1, engine_threads=1)
+        tsrv = PSTransportServer(srv, host="127.0.0.1", port=0)
+        px = _Proxy(tsrv.port)
+        servers.append((srv, tsrv))
+        proxies.append(px)
+        addrs.append(f"127.0.0.1:{px.port}")
+    yield servers, proxies, addrs
+    for px in proxies:
+        px.kill()
+    for srv, tsrv in servers:
+        tsrv.close()
+        srv.close()
+
+
+def test_chain_helpers_pure_and_consistent():
+    """slice_chain/slice_primary are pure functions of (key, shards,
+    dead): every worker and server derive the identical chain with no
+    coordination — the property failover routing rides."""
+    key = table_key(2)
+    for o in range(4):
+        c1 = slice_chain(key, o, 4, 2)
+        assert c1 == slice_chain(key, o, 4, 2)
+        assert o not in c1 and len(c1) == 2
+        assert len(set(c1)) == len(c1)
+        # primary of a live origin is the origin itself; once dead, the
+        # first live chain member — and a chain computed UNDER that
+        # death starts at the promoted shard
+        assert slice_primary(key, o, 4) == o
+        p = slice_primary(key, o, 4, dead={o})
+        assert p == c1[0]
+        assert p not in slice_chain(key, o, 4, 2, dead={o, p}) or True
+    with pytest.raises(RuntimeError, match="no live shard"):
+        slice_primary(key, 0, 2, dead={0, 1})
+
+
+def test_replicas_off_no_forward_state(plane):
+    """BPS_PLANE_REPLICAS=0 (the default plane fixture): pushes must
+    leave ZERO replication state anywhere — no replica slices, no
+    chain bookkeeping, no replicated-row counts (the PR-18 serve path,
+    byte for byte)."""
+    servers, addrs = plane
+    reg = get_registry()
+    before = reg.counter("embed/replicated_rows").value
+    cli = _client(addrs, cache_rows=0)
+    try:
+        ids = np.arange(32, dtype=np.uint64)
+        cli.pull(ids)
+        cli.push(ids, np.full((32, COLS), 1 / 64, np.float32))
+        for srv, tsrv in servers:
+            st = tsrv.embed_store()
+            assert st.replicas == 0 and not st._replica
+            assert not st._chain_ok and not st._peers
+        assert reg.counter("embed/replicated_rows").value == before
+    finally:
+        cli.close()
+
+
+def test_replicas_off_fail_shard_is_loud(plane):
+    _, addrs = plane
+    cli = _client(addrs)
+    try:
+        boom = ConnectionError("sliced cable")
+        with pytest.raises(ConnectionError, match="sliced cable"):
+            cli.fail_shard(0, cause=boom)
+        assert cli._dead == set()
+    finally:
+        cli.close()
+
+
+def test_note_stale_replicas_off_observed_only_one_warning(plane):
+    """The plane's note_stale contract, mirrored: without a replica log
+    the scraper's verdict stays observed-only — refused with ONE
+    warning per shard, never an exception on the scrape thread."""
+    _, addrs = plane
+    cli = _client(addrs)
+    try:
+        assert cli.note_stale(1, age_s=9.9, source="test") is False
+        assert cli.note_stale(1, age_s=12.3, source="test") is False
+        assert cli._liveness_warned == {1}
+        assert cli._dead == set()
+        assert cli.note_stale(99) is False      # out of range: ignored
+    finally:
+        cli.close()
+
+
+def test_push_forward_logs_to_chain_successors(rplane):
+    """With replicas=1 every applied push lands on the origin's chain
+    successor BEFORE the ack: the replica slice holds the absolute
+    post-apply bytes + versions, and embed/replicated_rows counts
+    them."""
+    servers, _, addrs = rplane
+    reg = get_registry()
+    before = reg.counter("embed/replicated_rows").value
+    cli = _client(addrs, replicas=1, cache_rows=0)
+    try:
+        ids = np.arange(24, dtype=np.uint64)
+        cli.push(ids, np.full((24, COLS), 1 / 64, np.float32))
+        assert reg.counter("embed/replicated_rows").value - before == 24
+        sh = row_shard(ids, 3)
+        for o in range(3):
+            mine = ids[sh == o]
+            if not mine.size:
+                continue
+            b = slice_chain(cli.key, o, 3, 1)[0]
+            sl = servers[b][1].embed_store()._replica[
+                slice_key(cli.key, o)]
+            t = servers[o][1].embed_store().table(cli.key)
+            for rid in mine:
+                rid = int(rid)
+                buf, ver = sl["rows"][rid]
+                assert buf == t.rows[rid].tobytes()   # absolute, bitwise
+                assert ver == int(t.vers[rid])
+            assert len(sl["tokens"]) >= 1             # dedup token rode
+    finally:
+        cli.close()
+
+
+def test_kill_shard_failover_bitwise_and_late_joiner(rplane):
+    """THE headline: sever one shard mid-run — the next pull fails the
+    shard over to its chain successor, replays the replica log, and
+    serves BITWISE-identical rows; pushes keep applying; a client
+    joining the degraded plane converges to the same bytes; the
+    failover is a key-less flight event naming table, rows and epoch."""
+    _, proxies, addrs = rplane
+    reg = get_registry()
+    replays0 = reg.counter("embed/failover_replays").value
+    rec = flight.get_recorder()
+    rec.clear()
+    cli = _client(addrs, replicas=1, cache_rows=0)
+    try:
+        ids = np.arange(60, dtype=np.uint64)
+        base = cli.pull(ids).copy()
+        cli.push(ids, np.ones((60, COLS), np.float32))
+        cli.tick()
+        v1 = cli.pull(ids).copy()
+        assert np.array_equal(v1, base + 1)
+
+        victim = 1
+        proxies[victim].kill()
+        cli.tick()
+        v2 = cli.pull(ids)
+        assert cli.failovers == 1 and cli._dead == {victim}
+        assert np.array_equal(v2, v1), "rows diverged across failover"
+        assert (reg.counter("embed/failover_replays").value
+                - replays0) >= 1
+
+        # pushes keep applying, routed to the promoted primary
+        cli.push(ids, np.full((60, COLS), 0.5, np.float32))
+        cli.tick()
+        v3 = cli.pull(ids)
+        assert np.array_equal(v3, v1 + 0.5)
+
+        # a late joiner (ctor INIT hits the corpse) self-heals and
+        # converges bitwise
+        late = _client(addrs, replicas=1, cache_rows=0)
+        try:
+            assert np.array_equal(late.pull(ids), v3)
+        finally:
+            late.close()
+
+        evs = rec.events(keys=[424242])    # key-less: passes any filter
+        fo = [e for e in evs if e["kind"] == "embed_failover"]
+        assert fo, "failover must be a first-class flight event"
+        assert f"s{victim}" in fo[0]["detail"]
+        assert "epoch=" in fo[0]["detail"]
+    finally:
+        cli.close()
+
+
+def test_post_failover_pull_never_validates_stale_versions(rplane):
+    """Satellite fix pin: a client whose hot-row cache was versioned by
+    the DEAD shard must not have those versions validate as
+    \"unchanged\" against the promoted replica. The failover bumps the
+    table epoch; the first post-failover pull transfers EVERY row full
+    (row bytes move despite bitwise-matching versions) and the client
+    adopts the epoch, dropping the cache."""
+    _, proxies, addrs = rplane
+    reg = get_registry()
+    cli = _client(addrs, replicas=1, max_lag=1)
+    writer = _client(addrs, replicas=1, cache_rows=0)
+    try:
+        sh = row_shard(np.arange(ROWS, dtype=np.uint64), 3)
+        victim = 1
+        ids = np.arange(ROWS, dtype=np.uint64)[sh == victim][:12]
+        writer.push(ids, np.full((12, COLS), 1 / 32, np.float32))
+        cli.pull(ids)                      # cache rows @ victim versions
+        assert cli._epoch == 0
+
+        proxies[victim].kill()
+        writer.tick()
+        writer.pull(ids)                   # writer trips the failover
+        assert writer.failovers == 1
+
+        cli.tick()
+        bumps0 = reg.counter("embed/epoch_bumps").value
+        before = _counters()
+        got = cli.pull(ids)                # cli discovers via its own
+        dc = _delta(_counters(), before)   # conn error OR the epoch
+        assert cli._epoch >= 1, "client must adopt the bumped epoch"
+        assert reg.counter("embed/epoch_bumps").value > bumps0
+        # every row came over FULL — none validated "unchanged" against
+        # a version the promoted replica never issued
+        assert dc["row_fetch_bytes"] >= 12 * cli.row_nbytes
+        want = init_rows(7, ids, COLS) + np.float32(1 / 32)
+        assert got.tobytes() == want.astype(np.float32).tobytes()
+    finally:
+        cli.close()
+        writer.close()
+
+
+def test_exactly_once_across_failover(rplane):
+    """Satellite: worker pushes, the shard dies BEFORE the worker sees
+    the ack, the worker retries the same token against the promoted
+    replica. Applied-at-the-primary half: the token rode the replicated
+    log, the retry is deduped — the row moves ONCE. Never-applied half:
+    a fresh token the chain never saw applies normally."""
+    _, proxies, addrs = rplane
+    cli = _client(addrs, replicas=1, cache_rows=0)
+    try:
+        sh = row_shard(np.arange(ROWS, dtype=np.uint64), 3)
+        victim = 0
+        rid = np.arange(ROWS, dtype=np.uint64)[sh == victim][:1]
+        payload = (_struct.pack("<I", 1) + rid.tobytes()
+                   + np.full(COLS, 1 / 64, np.float32).tobytes())
+        tok = cli._token()
+        # the push is APPLIED (and chain-forwarded) but the "worker"
+        # never sees the ack
+        cli._handles[victim].embed_push(cli.key, payload, token=tok)
+
+        proxies[victim].kill()
+        cli.fail_shard(victim, cause=ConnectionError("killed"))
+        promoted = cli._primary(victim)
+        assert promoted != victim
+
+        # retry VERBATIM against the promoted replica: deduped
+        cli._handles[promoted].embed_push(cli.key, payload, token=tok)
+        got = cli.pull(rid)
+        want = init_rows(7, rid, COLS)[0] + np.float32(1 / 64)
+        assert got[0].tobytes() == want.astype(np.float32).tobytes(), \
+            "retried push double-applied across failover"
+
+        # the never-applied half: a fresh token applies exactly once
+        tok2 = cli._token()
+        cli._handles[promoted].embed_push(cli.key, payload, token=tok2)
+        cli._handles[promoted].embed_push(cli.key, payload, token=tok2)
+        cli.tick()
+        got2 = cli.pull(rid)
+        want2 = want + np.float32(1 / 64)
+        assert got2[0].tobytes() == want2.astype(np.float32).tobytes()
+    finally:
+        cli.close()
+
+
+def test_double_death_failover_collects_row_errors():
+    """Satellite fix pin: a corrupt replica record must not strand the
+    REST of the slice unreplayed — per-row errors are collected, every
+    remaining row still installs, the epoch still bumps, and the first
+    error re-raises after the loop (the fail_shard hardening)."""
+    seeded = []
+    store = EmbedRowStore(dedup_seed=lambda k, t: seeded.append((k, t)))
+    key = table_key(0)
+    store.init_table(key, {"table": 0, "rows": 64, "cols": 2,
+                           "dtype": "float32", "seed": 3,
+                           "shard": 1, "shards": 2, "replicas": 1,
+                           "addrs": ["x:1", "x:2"]})
+    good = np.arange(4, dtype=np.uint64)
+    rec = (_struct.pack("<I", 4) + good.tobytes()
+           + np.full(4, 7, np.uint64).tobytes()
+           + np.full((4, 2), 0.25, np.float32).tobytes())
+    skey = slice_key(key, 0)
+    store.repl_apply(skey, token=(9 << 32) | 1, payload=rec)
+    # corrupt ONE logged row (wrong byte length)
+    store._replica[skey]["rows"][2] = (b"\x00" * 3, 7)
+    with pytest.raises(ValueError):
+        store.failover(skey, dead=[0])
+    t = store.table(key)
+    for rid in (0, 1, 3):
+        assert t.rows[int(rid)].tolist() == [0.25, 0.25]
+        assert int(t.vers[int(rid)]) == 7
+    assert 2 not in t.rows                 # the corrupt row, skipped
+    assert t.epoch == 1                    # epoch bumped regardless
+    assert seeded == [(key, (9 << 32) | 1)]   # dedup token seeded
+    # idempotent: a second (racing) failover neither re-raises nor
+    # bumps the epoch again
+    st = store.failover(skey, dead=[0])
+    assert st["already"] is True and st["epoch"] == 1
+
+
+def test_store_snapshot_restore_bitwise_and_lazy():
+    """Sharded snapshot round-trip at the store level: materialized
+    rows + versions restore bitwise, the epoch lands PAST the saved one
+    (clients drop pre-restore caches), and never-written rows still
+    lazy-materialize from init_rows."""
+    import os
+    import tempfile
+    store = EmbedRowStore()
+    key = table_key(5)
+    meta = {"table": 5, "rows": 128, "cols": 4, "dtype": "float32",
+            "seed": 11}
+    store.init_table(key, meta)
+    ids = np.array([3, 7, 60], np.uint64)
+    push = (_struct.pack("<I", 3) + ids.tobytes()
+            + np.full((3, 4), 1 / 8, np.float32).tobytes())
+    store.apply(key, push)
+    t = store.table(key)
+    want = {int(r): t.rows[int(r)].tobytes() for r in ids}
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "shard0.npz")
+        st = store.save_shard(p)
+        assert st["rows"] == 3 and os.path.exists(p)
+        assert not [f for f in os.listdir(d) if ".tmp." in f]
+        fresh = EmbedRowStore()
+        rs = fresh.restore_shard(p)
+        assert rs["rows"] == 3
+    ft = fresh.table(key)
+    for rid in ids:
+        rid = int(rid)
+        assert ft.rows[rid].tobytes() == want[rid]
+        assert ft.vers[rid] == t.vers[rid]
+    assert ft.epoch == t.epoch + 1         # strictly past the saved one
+    # never-written rows stayed ABSENT and lazy-init identically
+    assert 50 not in ft.rows
+    pull = (_struct.pack("<I", 1) + np.array([50], np.uint64).tobytes()
+            + np.zeros(1, np.uint64).tobytes())
+    _, flags, _, rowbuf = fresh.pull(key, pull)
+    assert np.frombuffer(rowbuf, np.float32).tobytes() == \
+        init_rows(11, [50], 4).tobytes()
+
+
+def test_client_checkpoint_restore_across_fresh_plane(rplane, tmp_path):
+    """Durable embed checkpoint end to end: save on one plane, restore
+    onto a FRESH plane (new servers, empty stores) — pulled rows are
+    bitwise-identical, the restore bumps epochs so the restoring
+    client's cache drops, and never-written rows still lazy-init."""
+    _, _, addrs = rplane
+    cli = _client(addrs, replicas=1, cache_rows=0)
+    ids = np.arange(40, dtype=np.uint64)
+    try:
+        cli.push(ids, np.full((40, COLS), 1 / 16, np.float32))
+        want = cli.pull(ids).copy()
+        meta = cli.save_checkpoint(str(tmp_path), step=7)
+        assert meta["step"] == 7 and meta["rows"] >= 40
+        assert (tmp_path / "s7" / "bps_embed_meta.json").exists()
+    finally:
+        cli.close()
+
+    # a fresh plane: nothing but the checkpoint files survives
+    servers2, addrs2 = [], []
+    for _ in range(3):
+        srv = PSServer(num_workers=1, engine_threads=1)
+        tsrv = PSTransportServer(srv, host="127.0.0.1", port=0)
+        servers2.append((srv, tsrv))
+        addrs2.append(f"127.0.0.1:{tsrv.port}")
+    cli2 = _client(addrs2, replicas=1, cache_rows=0)
+    try:
+        cli2.restore_checkpoint(str(tmp_path))   # newest committed step
+        got = cli2.pull(ids)
+        assert got.tobytes() == want.tobytes()
+        # never-written rows lazy-init identically on the new plane
+        fresh_ids = np.array([200, 250], np.uint64)
+        assert cli2.pull(fresh_ids).tobytes() == \
+            init_rows(7, fresh_ids, COLS).tobytes()
+    finally:
+        cli2.close()
+        for srv, tsrv in servers2:
+            tsrv.close()
+            srv.close()
+
+
+def test_transport_snapshot_carries_embed_tables(tmp_path):
+    """The PR-13 server snapshot grows embed coverage: ``e<key>|…``
+    entries ride the same npz as the dense ``k<key>|`` ones, and
+    ``restore`` repopulates the row store (epoch-bumped) without
+    touching the dense path."""
+    srv = PSServer(num_workers=1, engine_threads=1)
+    tsrv = PSTransportServer(srv, host="127.0.0.1", port=0)
+    cli = _client([f"127.0.0.1:{tsrv.port}"], cache_rows=0)
+    p = str(tmp_path / "snap.npz")
+    try:
+        ids = np.array([1, 2, 9], np.uint64)
+        cli.push(ids, np.full((3, COLS), 1 / 4, np.float32))
+        want = cli.pull(ids).copy()
+        ep0 = tsrv.embed_store().table(cli.key).epoch
+        tsrv.snapshot(p)
+    finally:
+        cli.close()
+        tsrv.close()
+        srv.close()
+
+    srv2 = PSServer(num_workers=1, engine_threads=1)
+    tsrv2 = PSTransportServer(srv2, host="127.0.0.1", port=0)
+    try:
+        tsrv2.restore(p)
+        store = tsrv2.embed_store()
+        t = store.table(table_key(0))
+        assert t.epoch == ep0 + 1
+        cli2 = _client([f"127.0.0.1:{tsrv2.port}"], cache_rows=0)
+        try:
+            got = cli2.pull(np.array([1, 2, 9], np.uint64))
+            assert got.tobytes() == want.tobytes()
+        finally:
+            cli2.close()
+    finally:
+        tsrv2.close()
+        srv2.close()

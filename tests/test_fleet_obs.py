@@ -614,12 +614,16 @@ def test_prometheus_text_golden():
     reg.gauge("lag/max_streak").set(1)
     # sharded-embedding families (docs/embedding.md): the cache
     # hit/miss split, fetched row bytes, dedup'd rows pushed, live
-    # cache size
+    # cache size, and the durability trio (replicated rows, failover
+    # replays, epoch bumps — ISSUE 20)
     reg.counter("embed/cache_hits").inc(90)
     reg.counter("embed/cache_misses").inc(10)
     reg.counter("embed/row_fetch_bytes").inc(1280)
     reg.counter("embed/rows_pushed").inc(10)
     reg.gauge("embed/hot_set_size").set(64)
+    reg.counter("embed/replicated_rows").inc(10)
+    reg.counter("embed/failover_replays").inc(1)
+    reg.counter("embed/epoch_bumps").inc(2)
     # watchtower families (docs/observability.md): detector tick +
     # incident counters, flip counter, live open-incident gauge
     reg.counter("watch/ticks").inc(12)
@@ -637,8 +641,14 @@ def test_prometheus_text_golden():
         'bps_embed_cache_hits_total 90',
         '# TYPE bps_embed_cache_misses_total counter',
         'bps_embed_cache_misses_total 10',
+        '# TYPE bps_embed_epoch_bumps_total counter',
+        'bps_embed_epoch_bumps_total 2',
+        '# TYPE bps_embed_failover_replays_total counter',
+        'bps_embed_failover_replays_total 1',
         '# TYPE bps_embed_hot_set_size gauge',
         'bps_embed_hot_set_size 64',
+        '# TYPE bps_embed_replicated_rows_total counter',
+        'bps_embed_replicated_rows_total 10',
         '# TYPE bps_embed_row_fetch_bytes_total counter',
         'bps_embed_row_fetch_bytes_total 1280',
         '# TYPE bps_embed_rows_pushed_total counter',
